@@ -1,0 +1,32 @@
+"""Test harness bootstrap.
+
+All tests run on a virtual 8-device CPU mesh so multi-chip shardings are
+exercised without TPU hardware (the driver separately dry-runs the multichip
+path; the real chip only runs bench.py).
+
+Note: this container's sitecustomize registers an `axon` TPU plugin at
+interpreter boot and force-selects it via jax.config.update("jax_platforms",
+"axon,cpu") — setting the JAX_PLATFORMS env var here is too late.  We call
+config.update back to "cpu" before any backend is initialized, which pins the
+whole pytest process to the virtual CPU devices.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# NOTE: no persistent compilation cache here — this container's remote-compile
+# service produces AOT results for a different host CPU (feature-mismatch
+# SIGILL risk when reloaded).
+
+assert jax.devices()[0].platform == "cpu"

@@ -1,0 +1,259 @@
+"""Pure-kernel tests for the Paxos cell state machine.
+
+These mirror the invariants of the reference's paxos suite at the tensor
+level: agreement (ndecided cross-check, paxos/test_test.go:32-49), minority-
+partition safety (:72-78, 777-783), convergence under unreliable delivery,
+and Done/Min propagation — before any host API exists on top.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu6824.core.kernel import (
+    NO_VAL,
+    apply_starts,
+    init_state,
+    paxos_step,
+)
+
+Z = jnp.zeros
+F32 = jnp.float32
+
+
+def full_link(G, P):
+    return jnp.ones((G, P, P), bool)
+
+
+def mk_args(G, P, drop_req=0.0, drop_rep=0.0):
+    return dict(
+        link=full_link(G, P),
+        done=jnp.full((G, P), -1, jnp.int32),
+        drop_req=jnp.full((G, P, P), drop_req, F32),
+        drop_rep=jnp.full((G, P, P), drop_rep, F32),
+    )
+
+
+def start(state, g, i, p, vid, G=None, I=None, P=None):
+    G_, I_, P_ = state.np_.shape
+    sa = np.zeros((G_, I_, P_), bool)
+    sv = np.full((G_, I_, P_), NO_VAL, np.int32)
+    sa[g, i, p] = True
+    sv[g, i, p] = vid
+    return apply_starts(state, jnp.zeros((G_, I_), bool), jnp.asarray(sa), jnp.asarray(sv))
+
+
+def run_steps(state, n, key, **kw):
+    io = None
+    for k in jax.random.split(key, n):
+        state, io = paxos_step(state, key=k, **kw)
+    return state, io
+
+
+def ndecided(state, g, i):
+    """All peers that decided (g,i) decided the same value; return count.
+    Mirrors paxos/test_test.go:32-49."""
+    d = np.asarray(state.decided[g, i])
+    vals = d[d >= 0]
+    if len(vals):
+        assert (vals == vals[0]).all(), f"disagreement: {d}"
+    return int((d >= 0).sum())
+
+
+def test_single_proposer_one_step():
+    state = init_state(1, 4, 3)
+    state = start(state, 0, 0, 0, vid=7)
+    state, io = run_steps(state, 1, jax.random.key(0), **mk_args(1, 3))
+    d = np.asarray(state.decided[0, 0])
+    assert (d == 7).all()  # reliable net: full agreement in one step
+    assert ndecided(state, 0, 1) == 0  # untouched slot stays undecided
+    # proposer deactivated once decided
+    assert not bool(state.active[0, 0, 0])
+
+
+def test_dueling_proposers_agree():
+    state = init_state(1, 2, 5)
+    for p in range(5):
+        state = start(state, 0, 0, p, vid=100 + p)
+    state, _ = run_steps(state, 3, jax.random.key(1), **mk_args(1, 5))
+    assert ndecided(state, 0, 0) == 5
+    v = int(state.decided[0, 0, 0])
+    assert v in range(100, 105)
+
+
+def test_unique_proposal_numbers_mod_P():
+    state = init_state(1, 1, 3)
+    for p in range(3):
+        state = start(state, 0, 0, p, vid=p)
+    state, _ = run_steps(state, 2, jax.random.key(2), **mk_args(1, 3))
+    # n = k*P + p + 1  =>  (n - 1) % P == p for every promise recorded
+    na = np.asarray(state.na[0, 0])
+    assert ((na[na > 0] - 1) % 3 < 3).all()
+
+
+def test_minority_partition_blocks():
+    """Peers {0,1} | {2,3,4}: the 2-minority must not decide; the 3-majority
+    must.  Mirrors paxos/test_test.go TestPartition 'no decision if
+    partitioned' + 'decision in majority'."""
+    G, I, P = 1, 2, 5
+    link = np.zeros((G, P, P), bool)
+    for grp in ([0, 1], [2, 3, 4]):
+        for a in grp:
+            for b in grp:
+                link[0, a, b] = True
+    state = init_state(G, I, P)
+    state = start(state, 0, 0, 0, vid=10)  # proposer in minority
+    state = start(state, 0, 1, 2, vid=20)  # proposer in majority
+    args = mk_args(G, P)
+    args["link"] = jnp.asarray(link)
+    state, _ = run_steps(state, 10, jax.random.key(3), **args)
+    assert ndecided(state, 0, 0) == 0  # minority blocked
+    d1 = np.asarray(state.decided[0, 1])
+    assert (d1[2:] == 20).all()  # majority decided
+    assert (d1[:2] == NO_VAL).all()  # partitioned peers didn't learn
+
+    # Heal: gossip must spread both the decided value and let slot 0 finish.
+    args["link"] = full_link(G, P)
+    state, _ = run_steps(state, 10, jax.random.key(4), **args)
+    assert ndecided(state, 0, 1) == 5
+    assert ndecided(state, 0, 0) == 5
+    assert int(state.decided[0, 0, 0]) == 10
+
+
+def test_deaf_peer_catches_up():
+    """One peer unreachable (rx loss — socket removed, paxos/test_test.go:194)
+    still lets the other 4 decide; once links heal the deaf peer learns."""
+    G, I, P = 1, 1, 5
+    link = np.ones((G, P, P), bool)
+    link[0, :, 4] = False  # nobody can deliver TO peer 4
+    link[0, 4, 4] = True
+    state = init_state(G, I, P)
+    state = start(state, 0, 0, 0, vid=5)
+    args = mk_args(G, P)
+    args["link"] = jnp.asarray(link)
+    state, _ = run_steps(state, 5, jax.random.key(5), **args)
+    d = np.asarray(state.decided[0, 0])
+    assert (d[:4] == 5).all() and d[4] == NO_VAL
+    args["link"] = full_link(G, P)
+    state, _ = run_steps(state, 5, jax.random.key(6), **args)
+    assert ndecided(state, 0, 0) == 5
+
+
+def test_unreliable_converges():
+    state = init_state(1, 4, 3)
+    for i in range(4):
+        state = start(state, 0, i, i % 3, vid=50 + i)
+    args = mk_args(1, 3, drop_req=0.10, drop_rep=0.20)
+    state, _ = run_steps(state, 60, jax.random.key(7), **args)
+    for i in range(4):
+        assert ndecided(state, 0, i) == 3
+        assert int(state.decided[0, i, 0]) == 50 + i
+
+
+def test_safety_fuzz_random_masks():
+    """Random link masks re-drawn every few steps + heavy loss + all peers
+    proposing different values: every (g,i) that decides anywhere must agree
+    everywhere, across the whole run."""
+    G, I, P = 4, 4, 5
+    rng = np.random.default_rng(0)
+    state = init_state(G, I, P)
+    for g in range(G):
+        for i in range(I):
+            for p in range(P):
+                state = start(state, g, i, p, vid=1000 * g + 10 * i + p)
+    args = mk_args(G, P, drop_req=0.3, drop_rep=0.3)
+    key = jax.random.key(8)
+    for step in range(40):
+        if step % 5 == 0:
+            link = rng.random((G, P, P)) < 0.7
+            args["link"] = jnp.asarray(link)
+        key, k = jax.random.split(key)
+        state, _ = paxos_step(state, key=k, **args)
+        dec = np.asarray(state.decided)
+        for g in range(G):
+            for i in range(I):
+                vals = dec[g, i][dec[g, i] >= 0]
+                assert len(vals) == 0 or (vals == vals[0]).all()
+    # Heal everything: all must converge.
+    args["link"] = full_link(G, P)
+    args["drop_req"] = jnp.zeros((G, P, P), F32)
+    args["drop_rep"] = jnp.zeros((G, P, P), F32)
+    state, _ = run_steps(state, 15, jax.random.key(9), **args)
+    dec = np.asarray(state.decided)
+    assert (dec >= 0).all()
+
+
+def test_done_piggyback_and_partition():
+    G, P = 1, 3
+    state = init_state(G, 2, P)
+    args = mk_args(G, P)
+    done = np.full((G, P), -1, np.int32)
+    done[0, 0] = 9
+    done[0, 1] = 4
+    args["done"] = jnp.asarray(done)
+    state, _ = run_steps(state, 2, jax.random.key(10), **args)
+    dv = np.asarray(state.done_view[0])
+    assert dv[2, 0] == 9 and dv[2, 1] == 4  # learned via heartbeat
+    assert dv[0, 0] == 9  # self-knowledge
+    # Partitioned peer must NOT learn newer done values.
+    link = np.ones((G, P, P), bool)
+    link[0, :, 2] = False
+    link[0, 2, :] = False
+    link[0, 2, 2] = True
+    args["link"] = jnp.asarray(link)
+    done[0, 0] = 42
+    args["done"] = jnp.asarray(done)
+    state, _ = run_steps(state, 3, jax.random.key(11), **args)
+    dv = np.asarray(state.done_view[0])
+    assert dv[2, 0] == 9  # stale — no traffic reaches peer 2
+    assert dv[1, 0] == 42
+
+
+def test_slot_recycle_reset():
+    state = init_state(1, 2, 3)
+    state = start(state, 0, 0, 0, vid=3)
+    state, _ = run_steps(state, 1, jax.random.key(12), **mk_args(1, 3))
+    assert ndecided(state, 0, 0) == 3
+    reset = jnp.asarray(np.array([[True, False]]))
+    zb = jnp.zeros((1, 2, 3), bool)
+    zv = jnp.full((1, 2, 3), NO_VAL, jnp.int32)
+    state = apply_starts(state, reset, zb, zv)
+    assert ndecided(state, 0, 0) == 0
+    assert int(state.np_[0, 0, 0]) == 0
+    # Recycled slot is reusable for a fresh agreement.
+    state = start(state, 0, 0, 1, vid=77)
+    state, _ = run_steps(state, 2, jax.random.key(13), **mk_args(1, 3))
+    assert ndecided(state, 0, 0) == 3
+    assert int(state.decided[0, 0, 0]) == 77
+
+
+def test_message_budget_serial():
+    """Reliable net, single proposer, P=3: one agreement costs one step of
+    3 phases × 2 remote destinations = 6 remote messages + ≤1 step of decide
+    gossip — comfortably under the reference's 9-RPC bound per agreement
+    (paxos/test_test.go:535-543) once self-calls are excluded as the
+    reference does."""
+    state = init_state(1, 1, 3)
+    state = start(state, 0, 0, 0, vid=1)
+    args = mk_args(1, 3)
+    state, io = run_steps(state, 1, jax.random.key(14), **args)
+    assert int(io.msgs) <= 6
+    # After everyone decided, gossip stops: zero messages on later steps.
+    state, io = run_steps(state, 1, jax.random.key(15), **args)
+    assert int(io.msgs) == 0
+
+
+def test_batched_groups_independent():
+    """1024 groups advance in lockstep; each decides its own value — the
+    north-star batching dimension."""
+    G, I, P = 64, 2, 3
+    state = init_state(G, I, P)
+    sa = np.zeros((G, I, P), bool)
+    sv = np.full((G, I, P), NO_VAL, np.int32)
+    sa[:, 0, 0] = True
+    sv[:, 0, 0] = np.arange(G)
+    state = apply_starts(state, jnp.zeros((G, I), bool), jnp.asarray(sa), jnp.asarray(sv))
+    state, _ = run_steps(state, 1, jax.random.key(16), **mk_args(G, P))
+    dec = np.asarray(state.decided[:, 0, :])
+    assert (dec == np.arange(G)[:, None]).all()

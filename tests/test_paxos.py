@@ -1,0 +1,249 @@
+"""Host-API Paxos tests — ports of the reference paxos suite's invariants
+(`paxos/test_test.go`) onto the fabric/peer API.
+
+Each reference scenario keeps its name and its assertion; the mechanics
+(goroutine servers, socket surgery) become fabric network controls."""
+
+import numpy as np
+import pytest
+
+from tpu6824.core.fabric import PaxosFabric, WindowFullError
+from tpu6824.core.peer import Fate, make_group
+from tpu6824.utils.timing import wait_until
+
+
+@pytest.fixture
+def fab3():
+    f = PaxosFabric(ngroups=1, npeers=3, ninstances=16, auto_step=True)
+    yield f
+    f.stop_clock()
+
+
+@pytest.fixture
+def fab5():
+    f = PaxosFabric(ngroups=1, npeers=5, ninstances=16, auto_step=True)
+    yield f
+    f.stop_clock()
+
+
+def waitn(fab, g, seq, want, timeout=30.0):
+    """paxos/test_test.go:51-70 — wait for `want` peers decided, assert
+    agreement along the way."""
+    ok = wait_until(lambda: fab.ndecided(g, seq) >= want, timeout)
+    assert ok, f"too few decided on seq {seq}: {fab.ndecided(g, seq)} < {want}"
+
+
+def waitmajority(fab, g, seq):
+    waitn(fab, g, seq, fab.P // 2 + 1)
+
+
+def test_basic_single_proposer(fab3):
+    """TestBasic 'single proposer' (paxos/test_test.go:114-172)."""
+    pxa = make_group(fab3)
+    pxa[0].start(0, "hello")
+    waitn(fab3, 0, 0, 3)
+    fate, v = pxa[2].status(0)
+    assert fate == Fate.DECIDED and v == "hello"
+
+
+def test_basic_many_proposers_same_value(fab3):
+    pxa = make_group(fab3)
+    for px in pxa:
+        px.start(1, 77)
+    waitn(fab3, 0, 1, 3)
+
+
+def test_basic_many_proposers_different_values(fab3):
+    pxa = make_group(fab3)
+    pxa[0].start(2, 100)
+    pxa[1].start(2, 101)
+    pxa[2].start(2, 102)
+    waitn(fab3, 0, 2, 3)
+    _, v = pxa[0].status(2)
+    assert v in (100, 101, 102)
+
+
+def test_basic_out_of_order_instances(fab3):
+    pxa = make_group(fab3)
+    pxa[0].start(7, 700)
+    pxa[0].start(6, 600)
+    pxa[1].start(5, 500)
+    waitn(fab3, 0, 7, 3)
+    pxa[0].start(4, 400)
+    pxa[1].start(3, 300)
+    waitn(fab3, 0, 6, 3)
+    waitn(fab3, 0, 5, 3)
+    waitn(fab3, 0, 4, 3)
+    waitn(fab3, 0, 3, 3)
+    assert pxa[0].max() == 7
+
+
+def test_deaf(fab3):
+    """TestDeaf (paxos/test_test.go:174-221): a peer nobody can dial still
+    decides when *it* proposes (its own connections carry the replies)."""
+    pxa = make_group(fab3)
+    pxa[0].start(0, "hello")
+    waitn(fab3, 0, 0, 3)
+
+    fab3.deafen(0, 2)
+    pxa[0].start(1, "goodbye")
+    waitn(fab3, 0, 1, 2)
+    assert fab3.ndecided(0, 1) == 2  # deaf peer hasn't heard
+
+    pxa[2].start(1, "xxx")
+    waitn(fab3, 0, 1, 3)
+    _, v = pxa[2].status(1)
+    assert v == "goodbye"  # adopted the already-chosen value
+
+
+def test_forget(fab3):
+    """TestForget (paxos/test_test.go:~300): Min advances only after *all*
+    peers call Done and the word spreads."""
+    pxa = make_group(fab3)
+    for px in pxa:
+        assert px.min() == 0
+    pxa[0].start(0, "00")
+    pxa[1].start(1, "11")
+    waitn(fab3, 0, 0, 3)
+    waitn(fab3, 0, 1, 3)
+
+    pxa[0].done(0)
+    # One peer's Done must not advance anyone's Min.
+    fab3.wait_steps(3)
+    for px in pxa:
+        assert px.min() == 0
+
+    for px in pxa:
+        px.done(1)
+    ok = wait_until(lambda: all(px.min() == 2 for px in pxa), 10.0)
+    assert ok, [px.min() for px in pxa]
+    f, _ = pxa[0].status(0)
+    assert f == Fate.FORGOTTEN
+    f, _ = pxa[0].status(1)
+    assert f == Fate.FORGOTTEN
+
+
+def test_forget_memory_reclaimed(fab3):
+    """TestForgetMem analog (paxos/test_test.go:371-454): payload store
+    shrinks once instances are forgotten."""
+    pxa = make_group(fab3)
+    big = "x" * 100_000
+    for seq in range(6):
+        pxa[0].start(seq, big + str(seq))
+        waitn(fab3, 0, seq, 3)
+    peak = fab3.intern.approx_bytes()
+    assert peak > 500_000
+    for px in pxa:
+        px.done(5)
+    ok = wait_until(lambda: fab3.intern.approx_bytes() < peak / 2, 10.0)
+    assert ok, fab3.intern.approx_bytes()
+
+
+def test_window_recycling_many_instances(fab3):
+    """TestMany analog (paxos/test_test.go): more instances than slots, Done
+    as we go — the fixed window sustains an unbounded sequence."""
+    pxa = make_group(fab3)
+    nseq = 80  # 5x the 16-slot window
+    for seq in range(nseq):
+        pxa[seq % 3].start(seq, seq * 10)
+        waitn(fab3, 0, seq, 3)
+        for px in pxa:
+            px.done(seq)
+    assert pxa[0].max() >= nseq - 1
+
+
+def test_window_full_raises():
+    f = PaxosFabric(ngroups=1, npeers=3, ninstances=4, auto_step=False)
+    pxa = make_group(f)
+    for seq in range(4):
+        pxa[0].start(seq, seq)
+    with pytest.raises(WindowFullError):
+        pxa[0].start(4, 4)
+
+
+def test_partition_safety_and_heal(fab5):
+    """TestPartition core invariants (paxos/test_test.go:712-830): no
+    agreement in a minority; agreement in a majority; convergence on heal."""
+    pxa = make_group(fab5)
+    fab5.partition(0, [0, 2], [1, 3, 4])
+    pxa[1].start(0, "majority")
+    waitn(fab5, 0, 0, 3)
+    pxa[0].start(1, "minority")
+    fab5.wait_steps(10)
+    assert fab5.ndecided(0, 1) == 0
+
+    fab5.heal(0)
+    waitn(fab5, 0, 0, 5)
+    waitn(fab5, 0, 1, 5)
+    _, v = pxa[3].status(1)
+    assert v == "minority"
+
+
+def test_one_peer_switches_partitions(fab5):
+    """TestPartition 'one peer switches partitions' — decided value survives
+    arbitrary re-partitioning."""
+    pxa = make_group(fab5)
+    seq = 0
+    fab5.partition(0, [0, 1, 2], [3, 4])
+    pxa[0].start(seq, 'alpha')
+    waitn(fab5, 0, seq, 3)
+    fab5.partition(0, [0, 1], [2, 3, 4])
+    waitn(fab5, 0, seq, 5, timeout=30.0)
+    for p in range(5):
+        _, v = pxa[p].status(seq)
+        assert v == 'alpha'
+
+
+def test_unreliable_basic(fab3):
+    """TestBasic under the unreliable net (10% req / 20% reply drops)."""
+    fab3.set_unreliable(True)
+    pxa = make_group(fab3)
+    for seq in range(5):
+        pxa[seq % 3].start(seq, seq)
+    for seq in range(5):
+        waitn(fab3, 0, seq, 3, timeout=60.0)
+
+
+def test_rpc_budget_serial(fab3):
+    """TestRPCCount analog (paxos/test_test.go:503-573): bounded remote
+    messages per serial agreement.  Reference bound: ≤ 9 RPCs per agreement
+    for 3 peers; one kernel step costs ≤ 6 remote messages + one gossip round
+    ≤ 6 more."""
+    pxa = make_group(fab3)
+    base = fab3.msgs_total
+    ninst = 5
+    for seq in range(ninst):
+        pxa[0].start(seq, seq)
+        waitn(fab3, 0, seq, 3)
+    total = fab3.msgs_total - base
+    assert total <= ninst * 12, f"too chatty: {total} msgs for {ninst} agreements"
+
+
+def test_dead_peer_minority_blocks(fab5):
+    """Kill 3 of 5: no progress.  Kill only 2: progress."""
+    pxa = make_group(fab5)
+    fab5.kill(0, 3)
+    fab5.kill(0, 4)
+    pxa[0].start(0, "still-alive")
+    waitn(fab5, 0, 0, 3)
+    fab5.kill(0, 2)
+    pxa[0].start(1, "doomed")
+    fab5.wait_steps(10)
+    assert fab5.ndecided(0, 1) == 0
+
+
+def test_many_groups_lockstep():
+    """The batching axis: 8 groups × independent agreement, one clock."""
+    f = PaxosFabric(ngroups=8, npeers=3, ninstances=8, auto_step=True)
+    try:
+        for g in range(8):
+            f.start(g, 0, 0, f"g{g}")
+        ok = wait_until(
+            lambda: all(f.ndecided(g, 0) == 3 for g in range(8)), 30.0
+        )
+        assert ok
+        for g in range(8):
+            fate, v = f.status(g, 1, 0)
+            assert fate == Fate.DECIDED and v == f"g{g}"
+    finally:
+        f.stop_clock()

@@ -1,0 +1,29 @@
+"""tpu6824 — a TPU-native distributed-systems framework.
+
+A ground-up rebuild of the capabilities of the MIT 6.824 (Spring 2015) lab
+stack — multi-instance Paxos, replicated key/value stores, a sharding
+configuration service, a reconfiguring sharded KV store, primary/backup
+replication with a view service, MapReduce, and persistent sharded storage —
+re-architected for TPU hardware.
+
+Instead of goroutines exchanging RPCs over Unix sockets (reference:
+`paxos/rpc.go:24-42` and per-package `call()`), consensus state lives in dense
+`(ngroups, ninstances, npeers)` device arrays advanced by one deterministic,
+globally-stepped JAX kernel.  The asynchronous lossy network of the reference
+becomes per-step boolean delivery-mask tensors; majority quorums become integer
+reductions over the peer axis (a `psum` over ICI when the peer axis is sharded
+across a device mesh).
+
+Layout:
+  core/      — the Paxos cell state machine kernel + host fabric + peer API
+  services/  — kvpaxos, shardmaster, shardkv, viewservice, pbservice,
+               lockservice, mapreduce, diskv
+  parallel/  — mesh construction, sharding specs, shard_map'd kernel variants
+  ops/       — hashing (fnv32a/key2shard), rebalance kernel, pallas kernels
+  utils/     — config, errors, timing helpers
+"""
+
+__version__ = "0.1.0"
+
+from tpu6824.core.fabric import PaxosFabric  # noqa: E402,F401
+from tpu6824.core.peer import Fate, PaxosPeer, make_group  # noqa: E402,F401

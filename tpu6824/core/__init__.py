@@ -1,0 +1,1 @@
+from tpu6824.core.kernel import PaxosState, init_state, paxos_step, apply_starts  # noqa: F401

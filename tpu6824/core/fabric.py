@@ -1,0 +1,360 @@
+"""PaxosFabric — host runtime that owns the device state and the step clock.
+
+This replaces the reference's per-process runtime: socket listeners
+(`paxos/paxos.go:524-552`), the unreliable accept loop (`:528-544`), and the
+test harness's filesystem network surgery (`paxos/test_test.go:712-751`
+partitions, `:194-195` deafness) all become host-owned mask/probability arrays
+fed into the jitted `paxos_step` kernel.  One fabric hosts G independent Paxos
+groups × I instance slots × P peers and advances them all in lockstep.
+
+Host↔device contract (designed to avoid per-op round-trips — SURVEY §7 "Host↔
+device chatter"):
+  - API calls (`start/status/done/...`) only touch host mirrors and pending-op
+    queues under a lock; they never talk to the device.
+  - A single clock thread drains queues into `apply_starts`, runs
+    `paxos_step`, and refreshes the mirrors — one device round-trip per step
+    for the whole universe of cells, regardless of op rate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tpu6824.core.intern import Intern
+from tpu6824.core.kernel import NO_VAL, apply_starts, init_state, paxos_step
+
+# Reference unreliable-network rates: 10% of requests dropped before
+# processing, a further ~20% processed but the reply discarded
+# (paxos/paxos.go:528-544).
+UNRELIABLE_REQ_DROP = 0.10
+UNRELIABLE_REP_DROP = 0.20
+
+
+class WindowFullError(RuntimeError):
+    """No free instance slot: callers are outrunning Done()/Min() GC.
+
+    The reference has no such limit because it leaks memory instead
+    (`paxos/paxos.go` keeps every un-GC'd instance in a map); the fixed
+    window is what makes the device arrays bounded (SURVEY §5 long-context
+    note)."""
+
+
+class PaxosFabric:
+    def __init__(
+        self,
+        ngroups: int = 1,
+        npeers: int = 3,
+        ninstances: int = 64,
+        seed: int = 0,
+        auto_step: bool = False,
+        step_sleep: float = 0.0,
+    ):
+        self.G, self.I, self.P = ngroups, ninstances, npeers
+        G, I, P = self.G, self.I, self.P
+        self._state = init_state(G, I, P)
+        self._key = jax.random.key(seed)
+
+        # Host-owned network condition (device inputs):
+        self._link = np.ones((G, P, P), bool)
+        self._unreliable = np.zeros((G, P), bool)  # per receiving server
+        self._done = np.full((G, P), -1, np.int32)
+
+        # Host mirrors of device outputs:
+        self.m_decided = np.full((G, I, P), NO_VAL, np.int64)
+        self.m_done_view = np.full((G, P, P), -1, np.int64)
+        self._max_seq = np.full((G, P), -1, np.int64)  # Max() running high-water
+        self.msgs_total = 0
+        self.steps_total = 0
+
+        # Slot management (host only): which absolute seq lives in each slot.
+        self._slot_seq = np.full((G, I), -1, np.int64)
+        self._seq2slot: list[dict[int, int]] = [dict() for _ in range(G)]
+        self._slot_vids: list[list[list[int]]] = [
+            [[] for _ in range(I)] for _ in range(G)
+        ]  # interned ids referenced by each slot (for GC decref)
+
+        self.intern = Intern()
+
+        self._lock = threading.RLock()
+        self._pending_starts: list[tuple[int, int, int, int]] = []  # (g, slot, p, vid)
+        self._pending_resets: list[tuple[int, int]] = []  # (g, slot)
+        self._dead = np.zeros((G, P), bool)
+
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._step_sleep = step_sleep
+        self._stepped = threading.Condition(self._lock)
+        if auto_step:
+            self.start_clock()
+
+    # ------------------------------------------------------------------ clock
+
+    def start_clock(self):
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._clock_loop, daemon=True)
+        self._thread.start()
+
+    def stop_clock(self):
+        with self._lock:
+            self._running = False
+        if self._thread:
+            self._thread.join()
+            self._thread = None
+
+    def _clock_loop(self):
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+            self.step()
+            if self._step_sleep:
+                time.sleep(self._step_sleep)
+
+    def step(self, n: int = 1):
+        """Advance the whole fabric by n kernel steps (callable from the clock
+        thread or directly in deterministic tests)."""
+        for _ in range(n):
+            self._step_once()
+
+    def _step_once(self):
+        with self._lock:
+            starts = self._pending_starts
+            resets = self._pending_resets
+            self._pending_starts = []
+            self._pending_resets = []
+            link = jnp.asarray(self._link)
+            done = jnp.asarray(self._done)
+            # Per-edge drop probabilities from per-server unreliable flags:
+            # the *destination* server's accept loop does the dropping.
+            unrel = self._unreliable.astype(np.float32)  # (G, P)
+            drop_req = jnp.asarray(
+                np.broadcast_to(unrel[:, None, :], (self.G, self.P, self.P))
+                * UNRELIABLE_REQ_DROP
+            )
+            drop_rep = jnp.asarray(
+                np.broadcast_to(unrel[:, None, :], (self.G, self.P, self.P))
+                * UNRELIABLE_REP_DROP
+            )
+            self._key, sub = jax.random.split(self._key)
+
+        state = self._state
+        if starts or resets:
+            reset = np.zeros((self.G, self.I), bool)
+            sa = np.zeros((self.G, self.I, self.P), bool)
+            sv = np.full((self.G, self.I, self.P), NO_VAL, np.int32)
+            for g, slot in resets:
+                reset[g, slot] = True
+            for g, slot, p, vid in starts:
+                sa[g, slot, p] = True
+                sv[g, slot, p] = vid
+            state = apply_starts(
+                state, jnp.asarray(reset), jnp.asarray(sa), jnp.asarray(sv)
+            )
+
+        state, io = paxos_step(state, link, done, sub, drop_req, drop_rep)
+        self._state = state
+        decided, done_view, touched, msgs = jax.device_get(
+            (io.decided, io.done_view, io.touched, io.msgs)
+        )
+
+        with self._lock:
+            self.m_decided = decided.astype(np.int64)
+            self.m_done_view = done_view.astype(np.int64)
+            self.msgs_total += int(msgs)
+            self.steps_total += 1
+            # Max() bookkeeping: highest seq this peer has participated in.
+            seqs = np.where(touched, self._slot_seq[:, :, None], -1)  # (G,I,P)
+            self._max_seq = np.maximum(self._max_seq, seqs.max(axis=1))
+            self._gc_locked()
+            self._stepped.notify_all()
+
+    def wait_steps(self, n: int, timeout: float = 30.0):
+        """Block until the fabric has advanced n more steps."""
+        with self._lock:
+            target = self.steps_total + n
+            deadline = time.monotonic() + timeout
+            while self.steps_total < target:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._running:
+                    break
+                self._stepped.wait(remaining)
+
+    # ---------------------------------------------------------------- GC
+
+    def _global_min_locked(self, g: int) -> int:
+        # min over peers of Min_p, where Min_p = 1 + min_q done_view[p, q]
+        # (paxos/paxos.go:420-425).  Conservative: a slot may be recycled only
+        # once *every* peer has forgotten it.
+        return int(self.m_done_view[g].min(axis=1).min()) + 1
+
+    def _gc_locked(self):
+        for g in range(self.G):
+            gmin = self._global_min_locked(g)
+            stale = [s for s in self._seq2slot[g] if s < gmin]
+            for seq in stale:
+                slot = self._seq2slot[g].pop(seq)
+                self._slot_seq[g, slot] = -1
+                for vid in self._slot_vids[g][slot]:
+                    self.intern.decref(vid)
+                self._slot_vids[g][slot] = []
+                self._pending_resets.append((g, slot))
+                # Mirrors must stop reporting the old tenant immediately.
+                self.m_decided[g, slot, :] = NO_VAL
+
+    # ---------------------------------------------------------------- API
+
+    def _slot_for_locked(self, g: int, seq: int, create: bool) -> int | None:
+        slot = self._seq2slot[g].get(seq)
+        if slot is not None:
+            return slot
+        if not create:
+            return None
+        free = np.nonzero(self._slot_seq[g] == -1)[0]
+        pending_resets = {s for gg, s in self._pending_resets if gg == g}
+        for cand in free:
+            if int(cand) not in pending_resets:
+                slot = int(cand)
+                break
+        else:
+            if len(free):
+                slot = int(free[0])  # pending reset is applied before the start
+            else:
+                raise WindowFullError(
+                    f"group {g}: all {self.I} instance slots live; "
+                    f"call Done() to advance Min() (global_min={self._global_min_locked(g)})"
+                )
+        self._slot_seq[g, slot] = seq
+        self._seq2slot[g][seq] = slot
+        return slot
+
+    def start(self, g: int, p: int, seq: int, value) -> None:
+        """paxos.Start(seq, v) for peer p of group g (paxos/paxos.go:99-109):
+        asynchronous — agreement proceeds on subsequent clock steps."""
+        with self._lock:
+            if self._dead[g, p]:
+                return
+            if seq < self.peer_min(g, p):
+                return  # forgotten; reference ignores such Starts
+            slot = self._seq2slot[g].get(seq)
+            if slot is not None and self.m_decided[g, slot, p] >= 0:
+                return  # already decided locally; nothing to do
+            vid = self.intern.put(value)
+            slot = self._slot_for_locked(g, seq, create=True)
+            self._slot_vids[g][slot].append(vid)
+            self._pending_starts.append((g, slot, p, vid))
+            self._max_seq[g, p] = max(self._max_seq[g, p], seq)
+
+    def status(self, g: int, p: int, seq: int):
+        """paxos.Status (paxos/paxos.go:434-447) → (Fate, value)."""
+        from tpu6824.core.peer import Fate
+
+        with self._lock:
+            if seq < self.peer_min(g, p):
+                return Fate.FORGOTTEN, None
+            slot = self._seq2slot[g].get(seq)
+            if slot is None:
+                return Fate.PENDING, None
+            vid = int(self.m_decided[g, slot, p])
+            if vid < 0:
+                return Fate.PENDING, None
+            return Fate.DECIDED, self.intern.get(vid)
+
+    def done(self, g: int, p: int, seq: int) -> None:
+        """paxos.Done (paxos/paxos.go:352-359)."""
+        with self._lock:
+            self._done[g, p] = max(self._done[g, p], seq)
+            # Own view updates without needing a message to self.
+            self.m_done_view[g, p, p] = max(self.m_done_view[g, p, p], seq)
+
+    def peer_min(self, g: int, p: int) -> int:
+        """paxos.Min (paxos/paxos.go:420-425): 1 + min over peers of done as
+        known to p via piggybacked/heartbeat traffic."""
+        with self._lock:
+            return int(self.m_done_view[g, p].min()) + 1
+
+    def peer_max(self, g: int, p: int) -> int:
+        """paxos.Max (paxos/paxos.go:385-390)."""
+        with self._lock:
+            return int(self._max_seq[g, p])
+
+    # ------------------------------------------------------- network control
+
+    def set_unreliable(self, flag: bool, g: int | None = None, p: int | None = None):
+        """Per-receiving-server message loss (the accept-loop coin flips,
+        paxos/paxos.go:528-544)."""
+        with self._lock:
+            gs = slice(None) if g is None else g
+            ps = slice(None) if p is None else p
+            self._unreliable[gs, ps] = flag
+
+    def partition(self, g: int, *parts: list[int]):
+        """Split group g's peers into disjoint partitions; traffic flows only
+        within a partition (the socket hard-link farm,
+        paxos/test_test.go:712-751).  Peers not listed are fully isolated."""
+        with self._lock:
+            self._link[g] = False
+            for part in parts:
+                for a in part:
+                    for b in part:
+                        self._link[g, a, b] = True
+
+    def heal(self, g: int | None = None):
+        with self._lock:
+            if g is None:
+                self._link[:] = True
+            else:
+                self._link[g] = True
+            for gg in range(self.G) if g is None else [g]:
+                self._apply_dead_locked(gg)
+
+    def deafen(self, g: int, p: int):
+        """Nothing can be delivered TO peer p (socket file removed,
+        paxos/test_test.go:194-195); p can still send."""
+        with self._lock:
+            self._link[g, :, p] = False
+
+    def set_link(self, g: int, src: int, dst: int, up: bool):
+        with self._lock:
+            self._link[g, src, dst] = up
+
+    def _apply_dead_locked(self, g: int):
+        for p in range(self.P):
+            if self._dead[g, p]:
+                self._link[g, :, p] = False
+                self._link[g, p, :] = False
+
+    def kill(self, g: int, p: int):
+        """Crash peer p of group g (paxos.Kill, paxos/paxos.go:456-461): no
+        more sends or receives; its state is NOT recovered (the reference
+        Paxos has no persistence)."""
+        with self._lock:
+            self._dead[g, p] = True
+            self._apply_dead_locked(g)
+
+    def is_dead(self, g: int, p: int) -> bool:
+        with self._lock:
+            return bool(self._dead[g, p])
+
+    # ------------------------------------------------------------- stats
+
+    def ndecided(self, g: int, seq: int) -> int:
+        """Test helper mirroring paxos/test_test.go:32-49: asserts agreement
+        and returns how many peers have decided `seq`."""
+        with self._lock:
+            slot = self._seq2slot[g].get(seq)
+            if slot is None:
+                return 0
+            d = self.m_decided[g, slot]
+        vals = d[d >= 0]
+        if len(vals):
+            assert (vals == vals[0]).all(), f"seq {seq}: peers disagree: {d}"
+        return int((d >= 0).sum())

@@ -1,0 +1,70 @@
+"""Host-side value interning.
+
+The device agrees on int32 value *ids*; payloads (arbitrary picklable Python
+values — the reference gob-encodes interface{} values the same way,
+`paxos/rpc.go:44-84`) live in this refcounted host store.  When the Done/Min
+window GC recycles an instance slot, its payload references are dropped — the
+moral equivalent of `doMemShrink` freeing forgotten instances
+(`paxos/paxos.go:362-378`) and the property the reference's TestForgetMem
+asserts (`paxos/test_test.go:371-454`)."""
+
+from __future__ import annotations
+
+import pickle
+import threading
+
+
+class Intern:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_key: dict[bytes, int] = {}
+        self._vals: list = []
+        self._keys: list = []
+        self._refs: list[int] = []
+        self._free: list[int] = []
+
+    def put(self, value) -> int:
+        """Intern `value`, increment its refcount, return its id."""
+        key = pickle.dumps(value, protocol=4)
+        with self._lock:
+            vid = self._by_key.get(key)
+            if vid is None:
+                if self._free:
+                    vid = self._free.pop()
+                    self._vals[vid] = value
+                    self._keys[vid] = key
+                    self._refs[vid] = 0
+                else:
+                    vid = len(self._vals)
+                    self._vals.append(value)
+                    self._keys.append(key)
+                    self._refs.append(0)
+                self._by_key[key] = vid
+            self._refs[vid] += 1
+            return vid
+
+    def get(self, vid: int):
+        return self._vals[vid]
+
+    def incref(self, vid: int):
+        with self._lock:
+            self._refs[vid] += 1
+
+    def decref(self, vid: int):
+        with self._lock:
+            self._refs[vid] -= 1
+            if self._refs[vid] <= 0:
+                del self._by_key[self._keys[vid]]
+                self._vals[vid] = None
+                self._keys[vid] = None
+                self._free.append(vid)
+
+    @property
+    def nlive(self) -> int:
+        with self._lock:
+            return len(self._vals) - len(self._free)
+
+    def approx_bytes(self) -> int:
+        """Rough payload footprint — enough for memory-reclamation tests."""
+        with self._lock:
+            return sum(len(k) for k in self._keys if k is not None)

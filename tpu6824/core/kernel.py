@@ -1,0 +1,269 @@
+"""The Paxos cell state machine as a single jittable tensor kernel.
+
+Capability parity target: the multi-instance single-decree Paxos library of the
+reference (`paxos/paxos.go`) — `Start/Status/Done/Min/Max` semantics, majority
+quorums, safety under partitions and message loss, the Done/Min garbage
+collection protocol with done-value piggybacking (`paxos/rpc.go:74-80`,
+`paxos/paxos.go:328,339-341`).
+
+Architecture (deliberately NOT a translation).  The reference runs one
+goroutine per in-flight proposal doing three sequential RPC fan-outs
+(`paxos/paxos.go:122-152` propose; `:161-190` sendPrepareToAll; `:259-271`
+sendAcceptToAll; `:315-320` sendDecidedToAll).  Here the *entire* universe of
+consensus cells — `G` independent Paxos groups × `I` instance slots × `P`
+peers — advances in one globally-clocked `paxos_step`:
+
+  - every active proposer runs prepare, accept and decide *phases* within one
+    step, as masked exchanges over the peer axis;
+  - an acceptor processes all of a phase's incoming messages at once, with the
+    per-step serialization rule that makes the lockstep schedule equivalent to
+    a legal sequential interleaving (all prepares of the step ordered before
+    all accepts; at most one accept wins per acceptor per step);
+  - majority checks are integer sums over the peer axis (psum over ICI when P
+    is sharded across devices);
+  - the lossy/partitioned network of the reference's test harness
+    (`paxos/paxos.go:528-544` unreliable accept loop; socket-link partitions
+    `paxos/test_test.go:712-751`) becomes per-edge boolean delivery masks and
+    per-step Bernoulli drops from a counter PRNG — deterministic under seed.
+
+Proposal numbers are globally unique by construction: n = k·P + p + 1 for peer
+p, round k (fixes the reference defect where `chooseProposalNumber` =
+highest-seen+1 can collide across peers, `paxos/paxos.go:154-159`).
+
+Values never touch the device: the host interns payloads and the kernel agrees
+on int32 value *ids* (-1 = none).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+NO_VAL = -1  # value-id sentinel: no value
+
+
+class PaxosState(NamedTuple):
+    """Device-resident consensus state.
+
+    Shapes: G = groups, I = instance slots, P = peers.
+    """
+
+    # Acceptor state per cell (paxos/paxos.go:75-79 State{prepProposal,
+    # accpProposal, accpValue} — here n_promised / n_accepted / value id):
+    np_: jnp.ndarray      # (G, I, P) i32  highest proposal promised; 0 = none
+    na: jnp.ndarray       # (G, I, P) i32  highest proposal accepted; 0 = none
+    va: jnp.ndarray       # (G, I, P) i32  accepted value id; NO_VAL = none
+    # Learner state:
+    decided: jnp.ndarray  # (G, I, P) i32  decided value id per peer; NO_VAL = undecided
+    # Proposer state (the reference's free-running `propose` goroutine,
+    # paxos/paxos.go:122-152, flattened into per-cell registers):
+    active: jnp.ndarray   # (G, I, P) bool peer is proposing on this instance
+    propv: jnp.ndarray    # (G, I, P) i32  value id the proposer wants
+    maxseen: jnp.ndarray  # (G, I, P) i32  highest proposal number observed
+    # Done/Min GC protocol (paxos/paxos.go:352-425):
+    done_view: jnp.ndarray  # (G, P, P) i32 [g, p, q] = p's knowledge of q's done seq
+
+
+def init_state(G: int, I: int, P: int) -> PaxosState:
+    # NB: distinct buffers per field — paxos_step donates its input state, and
+    # aliased buffers would be donated twice.
+    return PaxosState(
+        np_=jnp.zeros((G, I, P), I32),
+        na=jnp.zeros((G, I, P), I32),
+        va=jnp.full((G, I, P), NO_VAL, I32),
+        decided=jnp.full((G, I, P), NO_VAL, I32),
+        active=jnp.zeros((G, I, P), bool),
+        propv=jnp.full((G, I, P), NO_VAL, I32),
+        maxseen=jnp.zeros((G, I, P), I32),
+        done_view=jnp.full((G, P, P), -1, I32),
+    )
+
+
+class StepIO(NamedTuple):
+    """Per-step observable outputs the host mirrors after each step."""
+
+    decided: jnp.ndarray    # (G, I, P) i32
+    done_view: jnp.ndarray  # (G, P, P) i32
+    touched: jnp.ndarray    # (G, I, P) bool — peer participated in the slot (for Max())
+    msgs: jnp.ndarray       # () i32 — remote messages sent this step (RPC-count analog)
+
+
+def _edge_masks(key, shape, link, drop, eye):
+    """One phase's delivery mask: static connectivity AND'd with a per-edge
+    Bernoulli keep.  `drop` is (G, P, P) f32 — per-edge drop probability,
+    derived host-side from per-server unreliable flags (the reference's
+    accept-loop coin flips, paxos/paxos.go:528-544, are per *receiving*
+    server).  Self edges always deliver (reference self-calls are plain
+    function calls, never RPCs: paxos/paxos.go:214-228)."""
+    if len(shape) == 4:
+        d = drop[:, None, :, :]
+    else:
+        d = drop
+    keep = jax.random.uniform(key, shape) >= d
+    return (keep | eye) & link
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def paxos_step(
+    state: PaxosState,
+    link: jnp.ndarray,       # (G, P, P) bool — [g, src, dst] connectivity (partitions/deafness)
+    done: jnp.ndarray,       # (G, P) i32 — host-owned per-peer Done() high-water marks
+    key: jnp.ndarray,        # PRNG key for this step
+    drop_req: jnp.ndarray,   # (G, P, P) f32 — request drop prob per edge (unreliable, ~0.10)
+    drop_rep: jnp.ndarray,   # (G, P, P) f32 — reply drop prob per edge (executed-but-unacked, ~0.20)
+) -> tuple[PaxosState, StepIO]:
+    """Advance every consensus cell by one prepare→accept→decide round."""
+    G, I, P = state.np_.shape
+    eye = jnp.eye(P, dtype=bool)
+    shape4 = (G, I, P, P)
+    k1, k2, k3, k1r, k2r, k3r, khb = jax.random.split(key, 7)
+
+    L = (link | eye)[:, None, :, :]  # (G, 1, P, P); self always connected
+    Mreq1 = _edge_masks(k1, shape4, L, drop_req, eye)
+    Mreq2 = _edge_masks(k2, shape4, L, drop_req, eye)
+    Mreq3 = _edge_masks(k3, shape4, L, drop_req, eye)
+    Mrep1 = _edge_masks(k1r, shape4, L, drop_rep, eye)
+    Mrep2 = _edge_masks(k2r, shape4, L, drop_rep, eye)
+
+    pid = jnp.arange(P, dtype=I32)
+    # Unique, ever-growing proposal number: smallest n ≡ p+1 (mod P) with
+    # n > maxseen.  maxseen always includes the proposer's own acceptor promise
+    # from its previous round (self reply is never dropped), so n strictly
+    # increases every step a proposer stays active — no self-livelock.
+    n_prop = (state.maxseen // P + 1) * P + pid + 1  # (G, I, P)
+
+    np_pre, na_pre, va_pre = state.np_, state.na, state.va
+
+    # ---- Phase 1: PREPARE (paxos/paxos.go:161-190 send; :244-257 handler) ----
+    send1 = state.active
+    D1 = Mreq1 & send1[..., :, None]  # [g,i,p(src),q(dst)] delivered
+    grant = D1 & (n_prop[..., :, None] > np_pre[..., None, :])
+    np_post1 = jnp.maximum(
+        np_pre, jnp.max(jnp.where(D1, n_prop[..., :, None], 0), axis=-2)
+    )
+    R1 = grant & Mrep1  # promise made it back to the proposer
+    cnt1 = R1.sum(-1).astype(I32)
+    maj1 = cnt1 * 2 > P
+    # Adopt the value of the highest accepted proposal among promisers
+    # (paxos/paxos.go:166-189): else keep our own propv.
+    na_rep = jnp.where(R1, na_pre[..., None, :], -1)  # (G,I,P,q)
+    best_q = jnp.argmax(na_rep, axis=-1)
+    best_na = jnp.take_along_axis(na_rep, best_q[..., None], axis=-1)[..., 0]
+    va_b = jnp.broadcast_to(va_pre[..., None, :], na_rep.shape)
+    va_best = jnp.take_along_axis(va_b, best_q[..., None], axis=-1)[..., 0]
+    v1 = jnp.where(best_na > 0, va_best, state.propv)
+    # Rejections teach the proposer higher numbers (the reference learns them
+    # through its own acceptor state; we return the acceptor's promise).
+    rep1 = jnp.where(D1 & Mrep1, np_post1[..., None, :], 0)
+    maxseen = jnp.maximum(state.maxseen, rep1.max(-1))
+
+    # ---- Phase 2: ACCEPT (paxos/paxos.go:259-271 send; :300-313 handler) ----
+    send2 = send1 & maj1
+    D2 = Mreq2 & send2[..., :, None]
+    ok2 = D2 & (n_prop[..., :, None] >= np_post1[..., None, :])
+    # Per-step serialization: an acceptor accepts at most ONE proposal per
+    # step — the highest eligible n (unique per proposer).  This makes the
+    # lockstep round equivalent to processing the step's prepares before its
+    # accepts in a sequential schedule, preserving Paxos safety.
+    win_n = jnp.max(jnp.where(ok2, n_prop[..., :, None], 0), axis=-2)  # (G,I,q)
+    win = ok2 & (n_prop[..., :, None] == win_n[..., None, :])
+    any_acc = win_n > 0
+    np_post2 = jnp.maximum(np_post1, win_n)
+    na_new = jnp.where(any_acc, win_n, na_pre)
+    va_win = jnp.sum(jnp.where(win, v1[..., :, None], 0), axis=-2)
+    va_new = jnp.where(any_acc, va_win, va_pre)
+    R2 = win & Mrep2
+    cnt2 = R2.sum(-1).astype(I32)
+    maj2 = cnt2 * 2 > P
+    rep2 = jnp.where(D2 & Mrep2, np_post2[..., None, :], 0)
+    maxseen = jnp.maximum(maxseen, rep2.max(-1))
+
+    # ---- Phase 3: DECIDE broadcast + learned-value gossip ----
+    # (paxos/paxos.go:315-332 sendDecidedToAll; gossip keeps re-broadcasting
+    # until every peer has learned, replacing the reference pattern where a
+    # missed Decided is repaired only by a later proposal.)
+    decider = send2 & maj2  # at most one per (g, i): accept winners are exclusive
+    dv = jnp.where(decider, v1, state.decided)
+    all_dec = (state.decided >= 0).all(-1)  # (G, I): stop gossip when everyone knows
+    send3 = decider | ((state.decided >= 0) & ~all_dec[..., None])
+    D3 = Mreq3 & send3[..., :, None]
+    dec_in = jnp.max(jnp.where(D3, dv[..., :, None], NO_VAL), axis=-2)
+    decided_new = jnp.where(state.decided >= 0, state.decided, dec_in)
+
+    # ---- Done piggyback + heartbeat (paxos/rpc.go:74-80) ----
+    # p learns q's done high-water mark whenever any message q→p lands this
+    # step; an additional once-per-step heartbeat over live links replaces the
+    # reference's piggyback-on-next-instance pattern.
+    anymsg = (D1 | D2 | D3).any(axis=1)  # (G, src, dst)
+    hb = _edge_masks(khb, (G, P, P), (link | eye), drop_req, eye)
+    gotmsg = jnp.swapaxes(anymsg | hb, -1, -2)  # [g, dst(p), src(q)]
+    done_view = jnp.maximum(state.done_view, jnp.where(gotmsg, done[:, None, :], -1))
+    # A peer always knows its own done value:
+    done_view = jnp.maximum(done_view, jnp.where(eye[None], done[:, None, :], -1))
+
+    # ---- Proposer bookkeeping ----
+    active_new = state.active & (decided_new < 0)
+
+    # Remote-message count (self edges excluded) — the RPC-budget analog of
+    # paxos/test_test.go:503-573.
+    offdiag = ~eye[None, None]
+    msgs = (
+        (D1 & offdiag).sum() + (D2 & offdiag).sum() + (D3 & offdiag).sum()
+    ).astype(I32)
+
+    new_state = PaxosState(
+        np_=np_post2,
+        na=na_new,
+        va=va_new,
+        decided=decided_new,
+        active=active_new,
+        propv=state.propv,
+        maxseen=maxseen,
+        done_view=done_view,
+    )
+    touched = (np_post2 > 0) | (na_new > 0) | (decided_new >= 0) | active_new
+    io = StepIO(decided=decided_new, done_view=done_view, touched=touched, msgs=msgs)
+    return new_state, io
+
+
+@jax.jit
+def apply_starts(
+    state: PaxosState,
+    reset: jnp.ndarray,         # (G, I) bool — recycle these slots (window GC)
+    start_active: jnp.ndarray,  # (G, I, P) bool — peer begins proposing
+    start_val: jnp.ndarray,     # (G, I, P) i32 — proposed value id
+) -> PaxosState:
+    """Host→device op injection: recycle forgotten slots, then arm proposers.
+
+    The reference's `Start(seq, v)` spawns a goroutine (`paxos/paxos.go:99-109`);
+    here it flips the cell's proposer registers.  Slot recycling implements the
+    memory reclamation `doMemShrink` performs once Min advances
+    (`paxos/paxos.go:362-378`).
+    """
+    r3 = reset[..., None]
+
+    def rz(a, v):
+        return jnp.where(r3, v, a)
+
+    np_ = rz(state.np_, 0)
+    na = rz(state.na, 0)
+    va = rz(state.va, NO_VAL)
+    decided = rz(state.decided, NO_VAL)
+    active = jnp.where(r3, False, state.active)
+    propv = rz(state.propv, NO_VAL)
+    maxseen = rz(state.maxseen, 0)
+
+    active = active | (start_active & (decided < 0))
+    # A re-Start on an instance this peer already has a value staged for keeps
+    # the original value (semantics only require *some* started value can win;
+    # first-set is deterministic).  Post-reset propv is NO_VAL, so recycled
+    # slots always take the new value.
+    propv = jnp.where(start_active & (propv < 0), start_val, propv)
+    return PaxosState(
+        np_=np_, na=na, va=va, decided=decided, active=active,
+        propv=propv, maxseen=maxseen, done_view=state.done_view,
+    )

@@ -1,0 +1,59 @@
+"""PaxosPeer — the per-peer view of a fabric group, with the reference's
+public Paxos contract: Make/Start/Status/Done/Min/Max
+(`paxos/paxos.go:13-21`)."""
+
+from __future__ import annotations
+
+import enum
+
+from tpu6824.core.fabric import PaxosFabric
+
+
+class Fate(enum.Enum):
+    # paxos/paxos.go Fate constants: Decided / Pending / Forgotten.
+    DECIDED = 1
+    PENDING = 2
+    FORGOTTEN = 3
+
+
+class PaxosPeer:
+    """Handle for peer `me` of group `g` on a shared fabric.
+
+    The reference's `Make(peers, me, rpcs)` (paxos/paxos.go:488-557) boots a
+    socket listener per peer; here all peers of all groups share one device
+    fabric, and a handle is just (group, index) coordinates into it."""
+
+    def __init__(self, fabric: PaxosFabric, g: int, me: int):
+        self.fabric = fabric
+        self.g = g
+        self.me = me
+
+    def start(self, seq: int, value) -> None:
+        """Async: begin agreement on instance seq (paxos/paxos.go:99-109)."""
+        self.fabric.start(self.g, self.me, seq, value)
+
+    def status(self, seq: int) -> tuple[Fate, object]:
+        """Local-only read (paxos/paxos.go:434-447)."""
+        return self.fabric.status(self.g, self.me, seq)
+
+    def done(self, seq: int) -> None:
+        self.fabric.done(self.g, self.me, seq)
+
+    def min(self) -> int:
+        return self.fabric.peer_min(self.g, self.me)
+
+    def max(self) -> int:
+        return self.fabric.peer_max(self.g, self.me)
+
+    def kill(self) -> None:
+        self.fabric.kill(self.g, self.me)
+
+    @property
+    def dead(self) -> bool:
+        return self.fabric.is_dead(self.g, self.me)
+
+
+def make_group(fabric: PaxosFabric, g: int = 0) -> list[PaxosPeer]:
+    """All P peer handles of group g — the analog of calling paxos.Make once
+    per server process."""
+    return [PaxosPeer(fabric, g, p) for p in range(fabric.P)]

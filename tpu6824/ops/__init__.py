@@ -1,0 +1,1 @@
+from tpu6824.ops.hashing import ihash, key2shard, ihash_batch, key2shard_batch  # noqa: F401

@@ -1,0 +1,68 @@
+"""Partitioning hash functions, bit-for-bit compatible with the reference.
+
+- `ihash` is FNV-1a 32-bit, used by MapReduce to route a key to a reduce
+  bucket (`mapreduce/mapreduce.go:185-189`, applied `:222`).
+- `key2shard` routes a key to one of NShards shards by its first byte
+  (`shardkv/client.go:75-82`).
+
+Both are provided as scalar host functions and as vectorized JAX ops so a
+batched mapper/partitioner can run the routing for an entire batch of keys on
+device in one shot.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+FNV_OFFSET32 = np.uint32(2166136261)
+FNV_PRIME32 = np.uint32(16777619)
+
+NSHARDS = 10  # shardmaster/common.go:35
+
+
+def ihash(key: str) -> int:
+    """FNV-1a 32-bit of the UTF-8 bytes of `key` (mapreduce/mapreduce.go:185-189)."""
+    h = FNV_OFFSET32
+    for b in key.encode("utf-8"):
+        h = np.uint32(h ^ np.uint32(b))
+        h = np.uint32(h * FNV_PRIME32)
+    return int(h)
+
+
+def key2shard(key: str, nshards: int = NSHARDS) -> int:
+    """First byte of key mod nshards (shardkv/client.go:75-82); empty key -> 0."""
+    if key:
+        return key.encode("utf-8")[0] % nshards
+    return 0
+
+
+def ihash_batch(keys_u8: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized FNV-1a over a padded byte matrix.
+
+    keys_u8: (B, L) uint8, zero-padded rows.
+    lengths: (B,) int32 actual byte lengths.
+    Returns (B,) uint32 hashes identical to `ihash` per row.
+
+    Implemented as a scan over the padded length so XLA compiles one fused
+    loop; masked positions leave the accumulator unchanged.
+    """
+    B, L = keys_u8.shape
+    pos = jnp.arange(L, dtype=jnp.int32)
+    mask = pos[None, :] < lengths[:, None]  # (B, L)
+
+    def body(h, i):
+        b = keys_u8[:, i].astype(jnp.uint32)
+        m = mask[:, i]
+        h2 = (h ^ b) * jnp.uint32(FNV_PRIME32)
+        return jnp.where(m, h2, h), None
+
+    h0 = jnp.full((B,), FNV_OFFSET32, dtype=jnp.uint32)
+    import jax
+
+    h, _ = jax.lax.scan(body, h0, jnp.arange(L, dtype=jnp.int32))
+    return h
+
+
+def key2shard_batch(first_bytes: jnp.ndarray, nshards: int = NSHARDS) -> jnp.ndarray:
+    """Vectorized key2shard: (B,) uint8 first bytes -> (B,) int32 shard ids."""
+    return (first_bytes.astype(jnp.int32)) % nshards

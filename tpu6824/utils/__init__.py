@@ -1,0 +1,11 @@
+from tpu6824.utils.errors import (  # noqa: F401
+    Err,
+    OK,
+    ErrNoKey,
+    ErrWrongGroup,
+    ErrWrongServer,
+    ErrNotReady,
+    ErrUninitServer,
+    RPCError,
+)
+from tpu6824.utils.timing import wait_until  # noqa: F401

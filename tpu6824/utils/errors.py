@@ -1,0 +1,22 @@
+"""Error vocabulary shared by all services.
+
+Mirrors the Err string constants scattered through the reference wire types
+(`pbservice/common.go:21-47`, `kvpaxos/common.go`, `shardmaster/common.go`,
+`shardkv/common.go`) — collected in one place instead of re-declared per
+package.
+"""
+
+OK = "OK"
+ErrNoKey = "ErrNoKey"
+ErrWrongServer = "ErrWrongServer"
+ErrWrongGroup = "ErrWrongGroup"
+ErrNotReady = "ErrNotReady"
+ErrUninitServer = "ErrUninitServer"
+
+Err = str
+
+
+class RPCError(Exception):
+    """A host-level 'call failed' — the moral equivalent of `call()` returning
+    false in the reference (`lockservice/client.go:26-40`): the caller must
+    assume the operation *may or may not* have executed."""

@@ -1,0 +1,32 @@
+"""Polling helpers for tests and service loops.
+
+The reference tests poll with exponential backoff and a hard cap
+(`paxos/test_test.go:51-70` waitn: 30 polls, 10ms doubling to 1s).  Service
+sync loops do the same (`kvpaxos/server.go:73-77,105-109`).  `wait_until`
+reproduces that rhythm for the host-side harness.
+"""
+
+import time
+
+
+def wait_until(pred, timeout=10.0, initial=0.001, cap=0.1):
+    """Poll `pred` with exponential backoff until it returns truthy or
+    `timeout` seconds elapse.  Returns the last value of pred()."""
+    deadline = time.monotonic() + timeout
+    sleep = initial
+    while True:
+        v = pred()
+        if v:
+            return v
+        if time.monotonic() >= deadline:
+            return v
+        time.sleep(sleep)
+        sleep = min(sleep * 2, cap)
+
+
+def backoff_sleeps(initial=0.001, cap=0.1):
+    """Generator of exponentially growing sleep intervals."""
+    sleep = initial
+    while True:
+        yield sleep
+        sleep = min(sleep * 2, cap)
