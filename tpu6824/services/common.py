@@ -1,0 +1,60 @@
+"""Shared service-layer plumbing.
+
+The reference's clerks talk to servers through `call()` — a dial-per-call RPC
+that can fail before OR after the server executed the op
+(`lockservice/client.go:26-40` spells out the contract).  Host services here
+are plain objects, so the lossy client↔server leg is reproduced explicitly:
+`flaky_call` drops a request before processing (op not executed) or drops the
+reply after processing (op executed, caller can't tell) with the reference
+accept-loop rates (`paxos/paxos.go:528-544`)."""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+
+from tpu6824.utils.errors import RPCError
+
+REQ_DROP = 0.10
+REP_DROP = 0.20
+
+_cid_counter = itertools.count(1)
+_cid_lock = threading.Lock()
+
+
+def fresh_cid() -> int:
+    """Unique client id (the reference uses nrand(), 62-bit random)."""
+    with _cid_lock:
+        return next(_cid_counter)
+
+
+class FlakyNet:
+    """Per-server unreliability switch for the clerk↔server leg."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._unreliable: set[object] = set()
+        self._lock = threading.Lock()
+
+    def set_unreliable(self, server_key, flag: bool):
+        with self._lock:
+            if flag:
+                self._unreliable.add(server_key)
+            else:
+                self._unreliable.discard(server_key)
+
+    def call(self, server_key, fn, *args, **kwargs):
+        """Invoke fn; under unreliability, maybe drop the request (RPCError
+        before execution) or the reply (fn runs, RPCError after) — the two
+        failure modes at-most-once machinery must survive."""
+        with self._lock:
+            unrel = server_key in self._unreliable
+            r1 = self._rng.random()
+            r2 = self._rng.random()
+        if unrel and r1 < REQ_DROP:
+            raise RPCError("request dropped")
+        out = fn(*args, **kwargs)
+        if unrel and r2 < REP_DROP:
+            raise RPCError("reply dropped")
+        return out

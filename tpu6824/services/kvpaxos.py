@@ -1,0 +1,212 @@
+"""kvpaxos — linearizable replicated KV store on the Paxos fabric.
+
+Capability parity with the reference's Lab 3B service (`kvpaxos/server.go`,
+`kvpaxos/client.go`): Get/Put/Append sequenced through the shared Paxos log;
+every replica applies the log in order; duplicate client requests are filtered
+so retries are at-most-once.
+
+Differences from the reference, by design:
+  - The reference's TTL-based OpID filter (`kvpaxos/server.go:49-62,187-198`)
+    is replaced by the per-client monotonic-sequence filter the reference
+    itself uses in shardkv (`shardkv/server.go:186-203`) — no timing races.
+  - The reference's sync loop holds the server mutex and polls Status with
+    10ms→1s backoff (`kvpaxos/server.go:69-113`); here the poll waits on the
+    fabric clock, and gives up after `op_timeout` so a minority-partitioned
+    server surfaces the same 'call failed' the reference's RPC timeout does.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import NamedTuple
+
+from tpu6824.core.fabric import PaxosFabric, WindowFullError
+from tpu6824.core.peer import Fate, PaxosPeer
+from tpu6824.services.common import FlakyNet, fresh_cid
+from tpu6824.utils.errors import OK, ErrNoKey, RPCError
+
+
+class Op(NamedTuple):
+    """One log entry (the gob-encoded Op of kvpaxos/server.go:25-33)."""
+
+    kind: str  # 'get' | 'put' | 'append'
+    key: str
+    value: str
+    cid: int
+    cseq: int
+
+
+class KVPaxosServer:
+    def __init__(self, fabric: PaxosFabric, g: int, me: int, op_timeout: float = 8.0):
+        self.px = PaxosPeer(fabric, g, me)
+        self.me = me
+        self.mu = threading.RLock()
+        self.kv: dict[str, str] = {}
+        self.applied = -1  # highest paxos seq applied to kv
+        self.dup: dict[int, tuple[int, object]] = {}  # cid -> (max cseq, reply)
+        self.op_timeout = op_timeout
+        self.dead = False
+        # Background catch-up: apply already-decided instances and advance
+        # Done() even when no client talks to this replica.  The reference
+        # only applies inside RPC handlers (kvpaxos/server.go:69-113), which
+        # lets passive replicas pin the log forever; shardkv's tick()/catchUp
+        # (shardkv/server.go:162-184,488-493) is the pattern generalized here.
+        # Without it the fixed instance window could never recycle.
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker.start()
+
+    def _tick_loop(self):
+        while not self.dead:
+            time.sleep(0.02)
+            with self.mu:
+                if self.dead:
+                    return
+                self._drain_decided()
+
+    def _drain_decided(self):
+        """Apply every already-decided instance in order; never proposes."""
+        while True:
+            fate, v = self.px.status(self.applied + 1)
+            if fate == Fate.DECIDED:
+                self._apply(v)
+                self.applied += 1
+                self.px.done(self.applied)
+            elif fate == Fate.FORGOTTEN:
+                self.applied += 1
+            else:
+                return
+
+    # ------------------------------------------------------------ RSM core
+
+    def _apply(self, op: Op):
+        """Apply one decided op (doGet/doPutAppend, kvpaxos/server.go:115-162)
+        with at-most-once duplicate suppression."""
+        seen, reply = self.dup.get(op.cid, (-1, None))
+        if op.cseq <= seen:
+            return reply
+        if op.kind == "get":
+            reply = (OK, self.kv[op.key]) if op.key in self.kv else (ErrNoKey, "")
+        elif op.kind == "put":
+            self.kv[op.key] = op.value
+            reply = (OK, "")
+        elif op.kind == "append":
+            self.kv[op.key] = self.kv.get(op.key, "") + op.value
+            reply = (OK, "")
+        else:
+            reply = (OK, "")
+        self.dup[op.cid] = (op.cseq, reply)
+        return reply
+
+    def _sync(self, want: Op):
+        """Drive `want` into the log and apply everything up to it
+        (kvpaxos/server.go:69-113).  Returns the op's reply, or raises
+        RPCError on timeout (the caller's RPC would have timed out)."""
+        deadline = time.monotonic() + self.op_timeout
+        seq = self.applied + 1
+        started_here = False
+        while True:
+            if self.dead:
+                raise RPCError("server killed")
+            fate, v = self.px.status(seq)
+            if fate == Fate.DECIDED:
+                reply = self._apply(v)
+                self.applied = seq
+                self.px.done(seq)
+                if isinstance(v, Op) and v.cid == want.cid and v.cseq == want.cseq:
+                    return reply
+                seq += 1
+                started_here = False
+                continue
+            if fate == Fate.FORGOTTEN:
+                # Another replica applied + GC'd past us; our dup filter will
+                # be refreshed by the ops we *can* still see.
+                seq += 1
+                continue
+            if not started_here:
+                try:
+                    self.px.start(seq, want)
+                    started_here = True
+                except WindowFullError:
+                    pass  # transient: wait for GC to recycle a slot
+            if time.monotonic() >= deadline:
+                raise RPCError("op timeout (no majority?)")
+            time.sleep(0.002)
+
+    # ------------------------------------------------------------ RPC surface
+
+    def get(self, key: str, cid: int, cseq: int):
+        with self.mu:
+            if self.dead:
+                raise RPCError("dead")
+            seen, reply = self.dup.get(cid, (-1, None))
+            if cseq <= seen:
+                return reply
+            return self._sync(Op("get", key, "", cid, cseq))
+
+    def put_append(self, kind: str, key: str, value: str, cid: int, cseq: int):
+        with self.mu:
+            if self.dead:
+                raise RPCError("dead")
+            seen, reply = self.dup.get(cid, (-1, None))
+            if cseq <= seen:
+                return reply
+            return self._sync(Op(kind, key, value, cid, cseq))
+
+    def kill(self):
+        with self.mu:
+            self.dead = True
+        self.px.kill()
+
+
+class Clerk:
+    """kvpaxos/client.go:69-104 — try every server forever, at-most-once via
+    (cid, cseq)."""
+
+    def __init__(self, servers: list[KVPaxosServer], net: FlakyNet | None = None):
+        self.servers = servers
+        self.net = net or FlakyNet()
+        self.cid = fresh_cid()
+        self.cseq = 0
+        self.mu = threading.Lock()
+
+    def _next(self) -> int:
+        with self.mu:
+            self.cseq += 1
+            return self.cseq
+
+    def _loop(self, fn_name, *args, timeout=None):
+        cseq = self._next()
+        deadline = time.monotonic() + timeout if timeout else None
+        i = 0
+        while True:
+            srv = self.servers[i % len(self.servers)]
+            i += 1
+            try:
+                fn = getattr(srv, fn_name)
+                err, val = self.net.call(srv, fn, *args, self.cid, cseq)
+                return err, val
+            except RPCError:
+                pass
+            if deadline and time.monotonic() >= deadline:
+                raise RPCError("clerk timeout")
+            time.sleep(0.01)
+
+    def get(self, key: str, timeout=None) -> str:
+        err, val = self._loop("get", key, timeout=timeout)
+        return val if err == OK else ""
+
+    def put(self, key: str, value: str, timeout=None):
+        self._loop("put_append", "put", key, value, timeout=timeout)
+
+    def append(self, key: str, value: str, timeout=None):
+        self._loop("put_append", "append", key, value, timeout=timeout)
+
+
+def make_cluster(nservers=3, ninstances=64, fabric=None, g=0, **kw):
+    """Boot a kvpaxos replica group on (a group of) a fabric."""
+    if fabric is None:
+        fabric = PaxosFabric(ngroups=1, npeers=nservers, ninstances=ninstances,
+                             auto_step=True)
+    servers = [KVPaxosServer(fabric, g, p, **kw) for p in range(nservers)]
+    return fabric, servers
