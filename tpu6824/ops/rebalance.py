@@ -1,0 +1,112 @@
+"""Shard rebalancing as a fixed-point argmax/argmin kernel.
+
+Capability parity: the shardmaster's rebalance step
+(`shardmaster/server.go:195-226`) — move shards from the most-loaded group to
+the least-loaded until the spread is ≤ 1, touching as few shards as possible.
+
+Two implementations with identical semantics:
+  - `rebalance_host`: the deterministic host algorithm the replicated state
+    machine applies (must be bit-identical across replicas, so all ties break
+    toward the lowest group id);
+  - `rebalance_jax`: the same fixed point as a `lax.while_loop` over the
+    shard→group assignment vector, jittable and vmappable over many
+    independent configurations at once (the batched-groups axis of the
+    north star).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tpu6824.ops.hashing import NSHARDS
+
+UNASSIGNED = 0  # gid 0 = invalid/unassigned (shardmaster/common.go Config zero value)
+
+
+def rebalance_host(shards: list[int], gids: list[int]) -> list[int]:
+    """Rebalance `shards` (shard index → gid) over active `gids`.
+
+    Rules, in order:
+      1. no active groups → all shards UNASSIGNED;
+      2. shards on dead/unknown groups (incl. UNASSIGNED) go to the currently
+         least-loaded group;
+      3. while spread > 1, move one shard from the most-loaded to the
+         least-loaded group.  Ties break to the lowest gid; within a group
+         the lowest-numbered shard moves first.  Deterministic, so every
+         replica computes the same config.
+    """
+    shards = list(shards)
+    if not gids:
+        return [UNASSIGNED] * len(shards)
+    order = sorted(gids)
+
+    def counts():
+        return {g: sum(1 for s in shards if s == g) for g in order}
+
+    def argmin_g():
+        c = counts()
+        return min(order, key=lambda g: (c[g], g))
+
+    def argmax_g():
+        c = counts()
+        return max(order, key=lambda g: (c[g], -g))
+
+    for i, g in enumerate(shards):
+        if g not in order:
+            shards[i] = argmin_g()
+
+    while True:
+        c = counts()
+        hi, lo = argmax_g(), argmin_g()
+        if c[hi] - c[lo] <= 1:
+            return shards
+        i = next(i for i, g in enumerate(shards) if g == hi)
+        shards[i] = lo
+
+
+def rebalance_jax(shards: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
+    """JAX twin of `rebalance_host`.
+
+    shards: (NSHARDS,) int32 of gids.
+    active: (K,) bool over a static gid universe [1..K] — active[g-1] says gid
+            g is a live group.
+    Returns (NSHARDS,) int32.  Jit/vmap-friendly: fixed trip bounds, no
+    data-dependent shapes.
+    """
+    K = active.shape[0]
+    gid_univ = jnp.arange(1, K + 1, dtype=jnp.int32)
+    BIG = jnp.int32(NSHARDS + 1)
+    any_active = active.any()
+
+    def counts(sh):
+        return (sh[None, :] == gid_univ[:, None]).sum(-1).astype(jnp.int32)
+
+    def argmin_gid(sh):
+        c = jnp.where(active, counts(sh), BIG)
+        return gid_univ[jnp.argmin(c)]  # ties → lowest gid (argmin first index)
+
+    def orphan_body(i, sh):
+        bad = ~(active & (gid_univ == sh[i])).any()
+        return sh.at[i].set(jnp.where(bad, argmin_gid(sh), sh[i]))
+
+    sh = jax.lax.fori_loop(0, NSHARDS, orphan_body, shards.astype(jnp.int32))
+
+    def cond(sh):
+        c = jnp.where(active, counts(sh), BIG)
+        cmax = jnp.where(active, counts(sh), -1).max()
+        return any_active & (cmax - c.min() > 1)
+
+    def body(sh):
+        c = counts(sh)
+        lo = gid_univ[jnp.argmin(jnp.where(active, c, BIG))]
+        # argmax with lowest-gid tie-break: take first index of max.
+        hi = gid_univ[jnp.argmax(jnp.where(active, c, -1))]
+        # lowest-numbered shard of hi moves:
+        idx = jnp.argmax(sh == hi)
+        return sh.at[idx].set(lo)
+
+    sh = jax.lax.while_loop(cond, body, sh)
+    return jnp.where(any_active, sh, jnp.full_like(sh, UNASSIGNED))

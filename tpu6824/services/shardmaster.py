@@ -1,0 +1,260 @@
+"""shardmaster — Paxos-replicated shard configuration service.
+
+Capability parity with the reference Lab 4A (`shardmaster/server.go`,
+`shardmaster/client.go`): Join/Leave/Move/Query produce a numbered sequence of
+`Config{num, shards[NSHARDS]→gid, groups{gid→servers}}`; rebalancing moves as
+few shards as possible and keeps the spread ≤ 1.
+
+Fixes a reference defect on purpose: the reference's `Move()` handler logs the
+op with type Leave (`shardmaster/server.go:82`), so replicas replaying the log
+apply a Leave instead of a Move.  Here Move is logged and applied as Move.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+from tpu6824.core.fabric import PaxosFabric, WindowFullError
+from tpu6824.core.peer import Fate, PaxosPeer
+from tpu6824.ops.hashing import NSHARDS
+from tpu6824.ops.rebalance import UNASSIGNED, rebalance_host
+from tpu6824.services.common import FlakyNet, fresh_cid
+from tpu6824.utils.errors import RPCError
+
+
+@dataclass(frozen=True)
+class Config:
+    """shardmaster/common.go:35-41 — one numbered configuration."""
+
+    num: int
+    shards: tuple  # len NSHARDS, shard index -> gid (UNASSIGNED if none)
+    groups: tuple  # sorted tuple of (gid, tuple(servers))
+
+    def groups_dict(self) -> dict[int, tuple]:
+        return dict(self.groups)
+
+    @staticmethod
+    def initial() -> "Config":
+        return Config(0, (UNASSIGNED,) * NSHARDS, ())
+
+
+class Op(NamedTuple):
+    kind: str  # 'join' | 'leave' | 'move' | 'query'
+    gid: int
+    servers: tuple
+    shard: int
+    cid: int
+    cseq: int
+
+
+class ShardMasterServer:
+    def __init__(self, fabric: PaxosFabric, g: int, me: int, op_timeout: float = 8.0):
+        self.px = PaxosPeer(fabric, g, me)
+        self.me = me
+        self.mu = threading.RLock()
+        self.configs: list[Config] = [Config.initial()]
+        self.applied = -1
+        self.dup: dict[int, tuple[int, object]] = {}
+        self.op_timeout = op_timeout
+        self.dead = False
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker.start()
+
+    # ----------------------------------------------------------- RSM apply
+
+    def _apply(self, op: Op):
+        seen, reply = self.dup.get(op.cid, (-1, None))
+        if op.cseq <= seen:
+            return reply
+        if op.kind == "join":
+            reply = self._do_join(op.gid, op.servers)
+        elif op.kind == "leave":
+            reply = self._do_leave(op.gid)
+        elif op.kind == "move":
+            reply = self._do_move(op.shard, op.gid)
+        elif op.kind == "query":
+            reply = None  # resolved read-side after apply
+        self.dup[op.cid] = (op.cseq, reply)
+        return reply
+
+    def _next_config(self) -> tuple[list, dict]:
+        """Copy-on-write of the latest config
+        (prepareNextConfig, shardmaster/server.go:185-193)."""
+        cur = self.configs[-1]
+        return list(cur.shards), dict(cur.groups)
+
+    def _push(self, shards: list, groups: dict):
+        self.configs.append(
+            Config(
+                num=len(self.configs),
+                shards=tuple(shards),
+                groups=tuple(sorted(groups.items())),
+            )
+        )
+
+    def _do_join(self, gid: int, servers: tuple):
+        shards, groups = self._next_config()
+        if gid in groups:
+            # Rejoin with new server list still makes a new config.
+            groups[gid] = tuple(servers)
+        else:
+            groups[gid] = tuple(servers)
+        shards = rebalance_host(shards, list(groups.keys()))
+        self._push(shards, groups)
+
+    def _do_leave(self, gid: int):
+        shards, groups = self._next_config()
+        groups.pop(gid, None)
+        shards = rebalance_host(shards, list(groups.keys()))
+        self._push(shards, groups)
+
+    def _do_move(self, shard: int, gid: int):
+        # Correct Move semantics (reference logs it as Leave — §2.4.4).
+        shards, groups = self._next_config()
+        shards[shard] = gid
+        self._push(shards, groups)
+
+    # ----------------------------------------------------------- log driver
+
+    def _tick_loop(self):
+        while not self.dead:
+            time.sleep(0.02)
+            with self.mu:
+                if self.dead:
+                    return
+                self._drain_decided()
+
+    def _drain_decided(self):
+        while True:
+            fate, v = self.px.status(self.applied + 1)
+            if fate == Fate.DECIDED:
+                self._apply(v)
+                self.applied += 1
+                self.px.done(self.applied)
+            elif fate == Fate.FORGOTTEN:
+                self.applied += 1
+            else:
+                return
+
+    def _sync(self, want: Op):
+        deadline = time.monotonic() + self.op_timeout
+        started = False
+        while True:
+            if self.dead:
+                raise RPCError("server killed")
+            seq = self.applied + 1
+            fate, v = self.px.status(seq)
+            if fate == Fate.DECIDED:
+                reply = self._apply(v)
+                self.applied = seq
+                self.px.done(seq)
+                if isinstance(v, Op) and v.cid == want.cid and v.cseq == want.cseq:
+                    return reply
+                started = False
+                continue
+            if not started:
+                try:
+                    self.px.start(seq, want)
+                    started = True
+                except WindowFullError:
+                    pass
+            if time.monotonic() >= deadline:
+                raise RPCError("op timeout (no majority?)")
+            time.sleep(0.002)
+
+    # ----------------------------------------------------------- RPC surface
+
+    def join(self, gid: int, servers, cid: int, cseq: int):
+        with self.mu:
+            self._check()
+            self._dedup_or_sync(Op("join", gid, tuple(servers), -1, cid, cseq))
+            return True
+
+    def leave(self, gid: int, cid: int, cseq: int):
+        with self.mu:
+            self._check()
+            self._dedup_or_sync(Op("leave", gid, (), -1, cid, cseq))
+            return True
+
+    def move(self, shard: int, gid: int, cid: int, cseq: int):
+        with self.mu:
+            self._check()
+            self._dedup_or_sync(Op("move", gid, (), shard, cid, cseq))
+            return True
+
+    def query(self, num: int, cid: int, cseq: int) -> Config:
+        with self.mu:
+            self._check()
+            self._dedup_or_sync(Op("query", -1, (), -1, cid, cseq))
+            if num == -1 or num >= len(self.configs):
+                return self.configs[-1]
+            return self.configs[num]
+
+    def _check(self):
+        if self.dead:
+            raise RPCError("dead")
+
+    def _dedup_or_sync(self, op: Op):
+        seen, _ = self.dup.get(op.cid, (-1, None))
+        if op.cseq <= seen:
+            return
+        self._sync(op)
+
+    def kill(self):
+        with self.mu:
+            self.dead = True
+        self.px.kill()
+
+
+class Clerk:
+    """shardmaster/client.go:56-120."""
+
+    def __init__(self, servers: list[ShardMasterServer], net: FlakyNet | None = None):
+        self.servers = servers
+        self.net = net or FlakyNet()
+        self.cid = fresh_cid()
+        self.cseq = 0
+        self.mu = threading.Lock()
+
+    def _next(self):
+        with self.mu:
+            self.cseq += 1
+            return self.cseq
+
+    def _loop(self, fn_name, *args, timeout=None):
+        cseq = self._next()
+        deadline = time.monotonic() + timeout if timeout else None
+        i = 0
+        while True:
+            srv = self.servers[i % len(self.servers)]
+            i += 1
+            try:
+                return self.net.call(srv, getattr(srv, fn_name), *args, self.cid, cseq)
+            except RPCError:
+                pass
+            if deadline and time.monotonic() >= deadline:
+                raise RPCError("clerk timeout")
+            time.sleep(0.01)
+
+    def join(self, gid: int, servers, timeout=None):
+        self._loop("join", gid, tuple(servers), timeout=timeout)
+
+    def leave(self, gid: int, timeout=None):
+        self._loop("leave", gid, timeout=timeout)
+
+    def move(self, shard: int, gid: int, timeout=None):
+        self._loop("move", shard, gid, timeout=timeout)
+
+    def query(self, num: int = -1, timeout=None) -> Config:
+        return self._loop("query", num, timeout=timeout)
+
+
+def make_cluster(nservers=3, ninstances=32, fabric=None, g=0, **kw):
+    if fabric is None:
+        fabric = PaxosFabric(ngroups=1, npeers=nservers, ninstances=ninstances,
+                             auto_step=True)
+    servers = [ShardMasterServer(fabric, g, p, **kw) for p in range(nservers)]
+    return fabric, servers
