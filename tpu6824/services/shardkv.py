@@ -1,0 +1,402 @@
+"""shardkv — sharded, reconfiguring, Paxos-replicated KV store (the capstone).
+
+Capability parity with the reference Lab 4B (`shardkv/server.go`,
+`shardkv/client.go`): many replica groups, each a Paxos RSM; the shardmaster
+assigns shards; groups reconfigure at config boundaries, transferring shard
+state while staying linearizable.
+
+Design points carried over from the reference (by behavior, not code):
+  - Reconfigurations walk configs strictly one at a time, in order
+    (`shardkv/server.go:377-392,488-493`).
+  - The receiving group's *proposing* replica pulls the shard snapshot once,
+    then ships it THROUGH the Paxos log inside the Reconf op, so every replica
+    of the group applies identical state (`shardkv/server.go:301-322` +
+    catchUp `:162-184`).
+  - Donors refuse `transfer_state` with ErrNotReady until they have reached
+    the config themselves (`shardkv/server.go:340-349`), giving a monotone
+    config lattice.
+  - Per-client duplicate filters travel WITH the shard data
+    (`XState{KVStore, MRRSMap, Replies}`, `shardkv/server.go:71-102`), so
+    at-most-once survives re-sharding.
+
+TPU-shaped difference: every replica group (and the shardmaster) lives on ONE
+shared PaxosFabric — each group is a lane of the batched (G, I, P) consensus
+kernel, so a 100-group deployment advances in the same lockstep kernel steps
+as a 1-group one.
+
+Deliberate in-process divergence: `transfer_state` acquires the donor's lock
+with a timeout (cross-group pulls in-process could otherwise deadlock where
+the reference's cross-process RPCs cannot).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import NamedTuple
+
+from tpu6824.core.fabric import PaxosFabric, WindowFullError
+from tpu6824.core.peer import Fate, PaxosPeer
+from tpu6824.ops.hashing import NSHARDS, key2shard
+from tpu6824.services import shardmaster
+from tpu6824.services.common import FlakyNet, fresh_cid
+from tpu6824.services.shardmaster import Config
+from tpu6824.utils.errors import (
+    OK,
+    ErrNoKey,
+    ErrNotReady,
+    ErrWrongGroup,
+    RPCError,
+)
+
+
+class Op(NamedTuple):
+    kind: str  # 'get' | 'put' | 'append' | 'reconf'
+    key: str
+    value: str
+    cid: int
+    cseq: int
+    extra: object  # reconf: (Config, xstate)
+
+
+class XState(NamedTuple):
+    """Transferable shard state (shardkv/server.go:71-102)."""
+
+    kv: tuple  # ((key, value), ...)
+    dup: tuple  # ((cid, (cseq, reply)), ...)
+
+
+class ShardKVServer:
+    def __init__(
+        self,
+        fabric: PaxosFabric,
+        fg: int,
+        gid: int,
+        me: int,
+        sm_clerk_servers,
+        directory: dict,
+        op_timeout: float = 8.0,
+    ):
+        self.px = PaxosPeer(fabric, fg, me)
+        self.gid = gid
+        self.me = me
+        self.name = f"g{gid}-{me}"
+        self.directory = directory
+        directory[self.name] = self
+        self.smck = shardmaster.Clerk(sm_clerk_servers)
+        self.mu = threading.RLock()
+        self.kv: dict[str, str] = {}
+        self.dup: dict[int, tuple[int, object]] = {}
+        self.config: Config = Config.initial()
+        self.applied = -1
+        self.op_timeout = op_timeout
+        self.dead = False
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker.start()
+
+    # ----------------------------------------------------------- RSM apply
+
+    def _owns(self, key: str) -> bool:
+        return self.config.shards[key2shard(key)] == self.gid
+
+    def _apply(self, op: Op):
+        if op.kind == "reconf":
+            cfg, xstate = op.extra
+            if cfg.num != self.config.num + 1:
+                return None  # stale/duplicate reconf entry
+            for k, v in xstate.kv:
+                self.kv[k] = v
+            for cid, (cseq, reply) in xstate.dup:
+                seen, _ = self.dup.get(cid, (-1, None))
+                if cseq > seen:
+                    self.dup[cid] = (cseq, reply)
+            self.config = cfg
+            return None
+
+        seen, reply = self.dup.get(op.cid, (-1, None))
+        if op.cseq <= seen:
+            return reply
+        if not self._owns(op.key):
+            # NOT recorded in the dup filter: the client will retry at the
+            # right group with the same cseq (shardkv/server.go:205-242).
+            return (ErrWrongGroup, "")
+        if op.kind == "get":
+            reply = (OK, self.kv[op.key]) if op.key in self.kv else (ErrNoKey, "")
+        elif op.kind == "put":
+            self.kv[op.key] = op.value
+            reply = (OK, "")
+        elif op.kind == "append":
+            self.kv[op.key] = self.kv.get(op.key, "") + op.value
+            reply = (OK, "")
+        self.dup[op.cid] = (op.cseq, reply)
+        return reply
+
+    def _drain_decided(self):
+        while True:
+            fate, v = self.px.status(self.applied + 1)
+            if fate == Fate.DECIDED:
+                self._apply(v)
+                self.applied += 1
+                self.px.done(self.applied)
+            elif fate == Fate.FORGOTTEN:
+                self.applied += 1
+            else:
+                return
+
+    def _sync(self, want: Op):
+        deadline = time.monotonic() + self.op_timeout
+        started = False
+        while True:
+            if self.dead:
+                raise RPCError("server killed")
+            seq = self.applied + 1
+            fate, v = self.px.status(seq)
+            if fate == Fate.DECIDED:
+                reply = self._apply(v)
+                self.applied = seq
+                self.px.done(seq)
+                if (
+                    isinstance(v, Op)
+                    and v.kind == want.kind
+                    and v.cid == want.cid
+                    and v.cseq == want.cseq
+                ):
+                    return reply
+                started = False
+                continue
+            if not started:
+                try:
+                    self.px.start(seq, want)
+                    started = True
+                except WindowFullError:
+                    pass
+            if time.monotonic() >= deadline:
+                raise RPCError("op timeout (no majority?)")
+            time.sleep(0.002)
+
+    # ----------------------------------------------------------- reconfig
+
+    def _tick_loop(self):
+        """shardkv/server.go:488-493: periodic catch-up + config walk."""
+        while not self.dead:
+            time.sleep(0.05)
+            try:
+                self.tick()
+            except RPCError:
+                continue  # shardmaster unreachable / donor not ready: retry
+
+    def tick(self):
+        with self.mu:
+            if self.dead:
+                return
+            self._drain_decided()
+            cur = self.config.num
+        try:
+            latest = self.smck.query(-1, timeout=2.0)
+        except RPCError:
+            return
+        for n in range(cur + 1, latest.num + 1):
+            with self.mu:
+                if self.dead:
+                    return
+                self._drain_decided()
+                if self.config.num >= n:
+                    continue
+                try:
+                    cfg = self.smck.query(n, timeout=2.0)
+                except RPCError:
+                    return
+                if not self._reconfigure(cfg):
+                    return  # donor not ready; retry next tick
+
+    def _reconfigure(self, cfg: Config) -> bool:
+        """Pull newly-owned shards from their previous owners, then log the
+        Reconf op carrying the merged snapshot (shardkv/server.go:301-322)."""
+        old = self.config
+        need: dict[int, list[int]] = {}  # old_gid -> [shard,...]
+        for s in range(NSHARDS):
+            if (
+                cfg.shards[s] == self.gid
+                and old.shards[s] != self.gid
+                and old.shards[s] != shardmaster.UNASSIGNED
+            ):
+                need.setdefault(old.shards[s], []).append(s)
+
+        kv_merge: dict[str, str] = {}
+        dup_merge: dict[int, tuple[int, object]] = {}
+        for old_gid, shards_list in need.items():
+            got = self._pull_shards(old, old_gid, cfg.num, shards_list)
+            if got is None:
+                return False
+            for k, v in got.kv:
+                kv_merge[k] = v
+            for cid, (cseq, reply) in got.dup:
+                seen, _ = dup_merge.get(cid, (-1, None))
+                if cseq > seen:
+                    dup_merge[cid] = (cseq, reply)
+
+        xstate = XState(
+            kv=tuple(sorted(kv_merge.items())),
+            dup=tuple(sorted(dup_merge.items())),
+        )
+        op = Op("reconf", "", "", -cfg.num, cfg.num, (cfg, xstate))
+        try:
+            self._sync(op)
+        except RPCError:
+            return False
+        return True
+
+    def _pull_shards(self, old_cfg: Config, old_gid: int, confign: int, shards_list):
+        """requestShard (shardkv/server.go:324-338): try every server of the
+        donor group until one hands over the state."""
+        names = old_cfg.groups_dict().get(old_gid, ())
+        for name in names:
+            srv = self.directory.get(name)
+            if srv is None:
+                continue
+            try:
+                return srv.transfer_state(confign, tuple(shards_list))
+            except RPCError:
+                continue
+        return None
+
+    def transfer_state(self, confign: int, shards_list: tuple) -> XState:
+        """Donor side (shardkv/server.go:340-367).  ErrNotReady until this
+        group has itself reached `confign` (so it no longer serves the
+        shards)."""
+        if self.dead:
+            raise RPCError("dead")
+        if not self.mu.acquire(timeout=1.0):
+            raise RPCError("donor busy")  # breaks in-process pull cycles
+        try:
+            if self.config.num < confign:
+                raise RPCError(ErrNotReady)
+            kv = tuple(
+                (k, v) for k, v in self.kv.items() if key2shard(k) in shards_list
+            )
+            dup = tuple(self.dup.items())
+            return XState(kv=kv, dup=dup)
+        finally:
+            self.mu.release()
+
+    # ----------------------------------------------------------- RPC surface
+
+    def get(self, key: str, cid: int, cseq: int):
+        return self._serve(Op("get", key, "", cid, cseq, None))
+
+    def put_append(self, key: str, kind: str, value: str, cid: int, cseq: int):
+        return self._serve(Op(kind, key, value, cid, cseq, None))
+
+    def _serve(self, op: Op):
+        with self.mu:
+            if self.dead:
+                raise RPCError("dead")
+            seen, reply = self.dup.get(op.cid, (-1, None))
+            if op.cseq <= seen:
+                return reply
+            if not self._owns(op.key):
+                return (ErrWrongGroup, "")
+            return self._sync(op)
+
+    def kill(self):
+        with self.mu:
+            self.dead = True
+        self.px.kill()
+
+
+class Clerk:
+    """shardkv/client.go:89-163: route by key2shard through the latest config;
+    on ErrWrongGroup or dead group, re-Query and retry with the same cseq."""
+
+    def __init__(self, sm_servers, directory: dict, net: FlakyNet | None = None):
+        self.smck = shardmaster.Clerk(sm_servers)
+        self.directory = directory
+        self.net = net or FlakyNet()
+        self.cid = fresh_cid()
+        self.cseq = 0
+        self.mu = threading.Lock()
+        self.config = Config.initial()
+
+    def _next(self):
+        with self.mu:
+            self.cseq += 1
+            return self.cseq
+
+    def _loop(self, fn_name, key, *args, timeout=None):
+        cseq = self._next()
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            shard = key2shard(key)
+            gid = self.config.shards[shard]
+            names = self.config.groups_dict().get(gid, ())
+            for name in names:
+                srv = self.directory.get(name)
+                if srv is None:
+                    continue
+                try:
+                    fn = getattr(srv, fn_name)
+                    err, val = self.net.call(srv, fn, key, *args, self.cid, cseq)
+                except RPCError:
+                    continue
+                if err == ErrWrongGroup:
+                    break
+                return err, val
+            if deadline and time.monotonic() >= deadline:
+                raise RPCError("clerk timeout")
+            time.sleep(0.02)
+            self.config = self.smck.query(-1)
+
+    def get(self, key: str, timeout=None) -> str:
+        err, val = self._loop("get", key, timeout=timeout)
+        return val if err == OK else ""
+
+    def put(self, key: str, value: str, timeout=None):
+        self._loop("put_append", key, "put", value, timeout=timeout)
+
+    def append(self, key: str, value: str, timeout=None):
+        self._loop("put_append", key, "append", value, timeout=timeout)
+
+
+class ShardSystem:
+    """Test/deployment harness: one fabric hosting the shardmaster group and
+    `ngroups` shardkv replica groups as fabric lanes."""
+
+    def __init__(self, ngroups=2, nreplicas=3, ninstances=32, base_gid=100):
+        self.fabric = PaxosFabric(
+            ngroups=1 + ngroups, npeers=nreplicas, ninstances=ninstances,
+            auto_step=True,
+        )
+        self.sm_servers = [
+            shardmaster.ShardMasterServer(self.fabric, 0, p) for p in range(nreplicas)
+        ]
+        self.directory: dict[str, ShardKVServer] = {}
+        self.groups: dict[int, list[ShardKVServer]] = {}
+        self.gids = []
+        for i in range(ngroups):
+            gid = base_gid + i
+            fg = 1 + i
+            self.groups[gid] = [
+                ShardKVServer(self.fabric, fg, gid, p, self.sm_servers, self.directory)
+                for p in range(nreplicas)
+            ]
+            self.gids.append(gid)
+
+    def sm_clerk(self):
+        return shardmaster.Clerk(self.sm_servers)
+
+    def clerk(self, net=None):
+        return Clerk(self.sm_servers, self.directory, net=net)
+
+    def join(self, gid: int):
+        self.sm_clerk().join(gid, [s.name for s in self.groups[gid]])
+
+    def leave(self, gid: int):
+        self.sm_clerk().leave(gid)
+
+    def shutdown(self):
+        for s in self.sm_servers:
+            s.dead = True
+        for grp in self.groups.values():
+            for s in grp:
+                s.dead = True
+        self.fabric.stop_clock()
