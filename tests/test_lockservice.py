@@ -1,0 +1,86 @@
+"""lockservice tests — the reference suite's at-most-once scenarios
+(`lockservice/test_test.go`): basic lock/unlock (implemented here, unlike the
+reference stub), primary crash, fail-just-before-reply, retried RPCs must not
+double-execute."""
+
+import pytest
+
+from tpu6824.services.lockservice import Clerk, make_pair
+from tpu6824.utils.errors import RPCError
+
+
+@pytest.fixture
+def pair():
+    return make_pair()
+
+
+def test_basic_lock_unlock(pair):
+    p, b = pair
+    ck = Clerk(p, b)
+    assert ck.lock("a") is True       # acquired
+    assert ck.lock("a") is False      # already held
+    assert ck.unlock("a") is True     # released
+    assert ck.unlock("a") is False    # wasn't held
+    assert ck.lock("a") is True       # reacquirable
+
+
+def test_distinct_locks_independent(pair):
+    p, b = pair
+    ck = Clerk(p, b)
+    assert ck.lock("x") is True
+    assert ck.lock("y") is True
+    assert ck.unlock("x") is True
+    assert ck.lock("x") is True
+
+
+def test_two_clerks_contend(pair):
+    p, b = pair
+    ck1, ck2 = Clerk(p, b), Clerk(p, b)
+    assert ck1.lock("l") is True
+    assert ck2.lock("l") is False
+    assert ck1.unlock("l") is True
+    assert ck2.lock("l") is True
+
+
+def test_primary_crash_backup_consistent(pair):
+    p, b = pair
+    ck = Clerk(p, b)
+    assert ck.lock("a") is True
+    p.kill()
+    # backup knows the lock is held
+    assert ck.lock("a") is False
+    assert ck.unlock("a") is True
+
+
+def test_fail_just_before_reply_no_double_execute(pair):
+    """The DeafConn scenario (lockservice/server.go:75-87,122-156): primary
+    executes the op, forwards to backup, dies before replying.  The clerk's
+    retry at the backup must observe the op already executed — Lock returns
+    the FIRST execution's answer, not a re-execution."""
+    p, b = pair
+    ck = Clerk(p, b)
+    p.die_after_next_deaf()
+    # This lock executes at primary (+backup), reply is lost, clerk retries
+    # at backup: must still report acquisition success exactly once.
+    assert ck.lock("L") is True
+    assert ck.lock("L") is False  # genuinely held, not re-acquired
+
+
+def test_unlock_retry_at_most_once(pair):
+    p, b = pair
+    ck = Clerk(p, b)
+    assert ck.lock("m") is True
+    p.die_after_next_deaf()
+    assert ck.unlock("m") is True   # executed once despite lost reply
+    # A second clerk locking now succeeds (uses backup after primary death):
+    ck2 = Clerk(p, b)
+    assert ck2.lock("m") is True
+
+
+def test_both_dead_raises(pair):
+    p, b = pair
+    ck = Clerk(p, b)
+    p.kill()
+    b.kill()
+    with pytest.raises(RPCError):
+        ck.lock("z")

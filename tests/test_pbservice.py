@@ -1,0 +1,180 @@
+"""pbservice tests — the reference suite's scenarios
+(`pbservice/test_test.go`): basic ops + failover with state transfer
+(:139-422), at-most-once under lossy nets (checkAppends :424-444), stale
+primary cannot serve after partition (:956-1150), repeated crash churn."""
+
+import threading
+import time
+
+import pytest
+
+from tpu6824.services.common import FlakyNet
+from tpu6824.services.pbservice import Clerk, PBServer
+from tpu6824.services.viewservice import ViewServer
+from tpu6824.utils.errors import RPCError
+from tpu6824.utils.timing import wait_until
+
+TICK = 0.02
+
+
+class PBSystem:
+    def __init__(self, names=("p1", "p2", "p3")):
+        self.vs = ViewServer(ping_interval=TICK)
+        self.net = FlakyNet(seed=7)
+        self.directory: dict[str, PBServer] = {}
+        self.servers = {n: PBServer(n, self.vs, self.net, self.directory,
+                                    tick_interval=TICK) for n in names}
+
+    def clerk(self):
+        return Clerk(self.vs, self.directory, net=self.net)
+
+    def wait_view(self, pred, timeout=5.0):
+        ok = wait_until(lambda: pred(self.vs.get()), timeout)
+        assert ok, self.vs.get()
+        return self.vs.get()
+
+    def restart(self, name):
+        """Crash + reboot: a brand-new empty server under the same name."""
+        srv = self.servers.pop(name, None)
+        if srv:
+            srv.kill()
+        self.servers[name] = PBServer(name, self.vs, self.net, self.directory,
+                                      tick_interval=TICK)
+
+    def shutdown(self):
+        for s in list(self.servers.values()):
+            s.kill()
+        self.vs.kill()
+
+
+@pytest.fixture
+def sys3():
+    s = PBSystem()
+    s.wait_view(lambda v: v.primary != "" and v.backup != "")
+    yield s
+    s.shutdown()
+
+
+def test_basic_ops(sys3):
+    ck = sys3.clerk()
+    ck.put("a", "1", timeout=10.0)
+    assert ck.get("a", timeout=10.0) == "1"
+    ck.append("a", "2", timeout=10.0)
+    assert ck.get("a", timeout=10.0) == "12"
+    assert ck.get("none", timeout=10.0) == ""
+
+
+def test_failover_keeps_data(sys3):
+    ck = sys3.clerk()
+    ck.put("k", "before", timeout=10.0)
+    old = sys3.vs.get()
+    sys3.servers[old.primary].kill()
+    del sys3.servers[old.primary]
+    sys3.wait_view(lambda v: v.primary == old.backup)
+    assert ck.get("k", timeout=10.0) == "before"
+    ck.append("k", "+after", timeout=10.0)
+    assert ck.get("k", timeout=10.0) == "before+after"
+
+
+def test_restarted_primary_rejoins_empty_then_recovers(sys3):
+    """Crash+reboot the primary: it must NOT come back as primary (it reboots
+    empty); after the survivors fail in turn, the rebooted server — refreshed
+    by state transfer — must serve the full data."""
+    ck = sys3.clerk()
+    ck.put("k", "v1", timeout=10.0)
+    old = sys3.vs.get()
+    sys3.restart(old.primary)
+    sys3.wait_view(lambda v: v.primary == old.backup)
+    assert ck.get("k", timeout=10.0) == "v1"
+    ck.append("k", "v2", timeout=10.0)
+    # Kill the new primary: the third server takes over; the rebooted one
+    # becomes its backup and receives a state transfer.
+    cur = sys3.vs.get()
+    sys3.servers[cur.primary].kill()
+    del sys3.servers[cur.primary]
+    sys3.wait_view(lambda v: v.primary not in ("", cur.primary)
+                   and v.backup == old.primary, timeout=10.0)
+    assert ck.get("k", timeout=10.0) == "v1v2"  # forces backup co-sign
+    # Kill that primary too: only the rebooted server remains.
+    cur2 = sys3.vs.get()
+    sys3.servers[cur2.primary].kill()
+    del sys3.servers[cur2.primary]
+    sys3.wait_view(lambda v: v.primary == old.primary)
+    assert ck.get("k", timeout=10.0) == "v1v2"
+
+
+def test_concurrent_appends_exactly_once(sys3):
+    """checkAppends under an unreliable clerk↔server leg
+    (pbservice/test_test.go:424-444,671-893)."""
+    for s in sys3.servers.values():
+        sys3.net.set_unreliable(s, True)
+    nclients, nops = 3, 8
+    errs: list = []
+
+    def client(idx):
+        try:
+            ck = sys3.clerk()
+            for j in range(nops):
+                ck.append("k", f"x {idx} {j} y", timeout=30.0)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=client, args=(i,)) for i in range(nclients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    for s in sys3.servers.values():
+        sys3.net.set_unreliable(s, False)
+
+    final = sys3.clerk().get("k", timeout=10.0)
+    for i in range(nclients):
+        last = -1
+        for j in range(nops):
+            marker = f"x {i} {j} y"
+            pos = final.find(marker)
+            assert pos >= 0, f"missing {marker!r}"
+            assert final.find(marker, pos + 1) < 0, f"dup {marker!r}"
+            assert pos > last
+            last = pos
+
+
+def test_stale_primary_cannot_serve(sys3):
+    """pbservice/test_test.go:956-1150: a primary partitioned from the
+    viewservice keeps thinking it's primary, but its ex-backup (promoted)
+    refuses to co-sign reads, so clients can never see stale data."""
+    ck = sys3.clerk()
+    ck.put("k", "fresh", timeout=10.0)
+    old = sys3.vs.get()
+    stale = sys3.servers[old.primary]
+
+    # Partition `stale` from the viewservice only: stop its ticks.
+    stale.dead = True           # stops tick loop and RPC serving...
+    time.sleep(0.01)
+    stale.dead = False          # ...but we revive serving: it keeps its old view
+    # (tick thread has exited: it will never learn the new view)
+
+    sys3.wait_view(lambda v: v.primary == old.backup)
+    ck2 = sys3.clerk()
+    ck2.put("k", "new-value", timeout=10.0)
+
+    # A client talking straight to the stale primary must get an error, not
+    # stale data.
+    err, val = stale.get("k", cid=999999, cseq=1)
+    assert err != "OK" or val == "new-value"
+
+
+def test_viewservice_rpc_budget(sys3):
+    """pbservice/test_test.go:107-128: servers/clients must cache views; the
+    viewservice must not be hammered during a burst of puts."""
+    ck = sys3.clerk()
+    ck.put("warm", "x", timeout=10.0)
+    base = sys3.vs.get_rpccount()
+    t0 = time.monotonic()
+    for i in range(100):
+        ck.put(f"k{i}", str(i), timeout=10.0)
+    dt = time.monotonic() - t0
+    used = sys3.vs.get_rpccount() - base
+    budget = 2 * (dt / TICK) + 40
+    assert used <= budget, (used, budget)

@@ -1,0 +1,120 @@
+"""viewservice tests — the reference suite's view-transition scenarios
+(`viewservice/test_test.go`): first primary, backup recruitment, failover,
+restarted-primary-is-dead, idle promotion, and the ack gate."""
+
+import time
+
+import pytest
+
+from tpu6824.services.viewservice import DEAD_PINGS, Clerk, View, ViewServer
+from tpu6824.utils.timing import wait_until
+
+TICK = 0.02
+
+
+@pytest.fixture
+def vs():
+    s = ViewServer(ping_interval=TICK)
+    yield s
+    s.kill()
+
+
+def ping_until(ck, pred, timeout=5.0):
+    """Drive a server's ping loop (the reference's servers ping every
+    PingInterval) until pred(view) or timeout."""
+    deadline = time.monotonic() + timeout
+    view = View(0, "", "")
+    while time.monotonic() < deadline:
+        view = ck.ping(view.viewnum)
+        if pred(view):
+            return view
+        time.sleep(TICK)
+    return view
+
+
+def test_first_primary(vs):
+    ck1 = Clerk("s1", vs)
+    v = ping_until(ck1, lambda v: v.primary == "s1")
+    assert v.viewnum == 1 and v.backup == ""
+
+
+def test_backup_recruited(vs):
+    ck1, ck2 = Clerk("s1", vs), Clerk("s2", vs)
+    ping_until(ck1, lambda v: v.primary == "s1")
+    # s1 keeps pinging (acks) while s2 joins
+    deadline = time.monotonic() + 5.0
+    v = vs.get()
+    while v.backup != "s2" and time.monotonic() < deadline:
+        v1 = ck1.ping(v.viewnum)
+        ck2.ping(0 if v.backup != "s2" else v.viewnum)
+        v = v1
+        time.sleep(TICK)
+    assert v.primary == "s1" and v.backup == "s2"
+
+
+def drive(vs, clerks, views=None, dead=(), stop_pred=None, timeout=5.0):
+    """Ping loop for several servers; `views` carries each server's last-seen
+    view across phases (a fresh dict would make continuing servers ping 0 and
+    trip restart detection)."""
+    deadline = time.monotonic() + timeout
+    if views is None:
+        views = {ck.me: View(0, "", "") for ck in clerks}
+    while time.monotonic() < deadline:
+        for ck in clerks:
+            if ck.me in dead:
+                continue
+            views[ck.me] = ck.ping(views[ck.me].viewnum)
+        v = vs.get()
+        if stop_pred and stop_pred(v):
+            return v
+        time.sleep(TICK)
+    return vs.get()
+
+
+def test_failover_promotes_backup(vs):
+    cks = [Clerk(f"s{i}", vs) for i in (1, 2, 3)]
+    views = {ck.me: View(0, "", "") for ck in cks}
+    v = drive(vs, cks, views,
+              stop_pred=lambda v: v.primary == "s1" and v.backup == "s2")
+    assert v.backup == "s2"
+    # let s1 ack the current view (a dead-before-ack primary wedges the view
+    # by design)
+    drive(vs, cks, views, stop_pred=lambda v: vs.acked)
+    # s1 dies: s2 must become primary, s3 the new backup.
+    v = drive(vs, cks, views, dead={"s1"},
+              stop_pred=lambda v: v.primary == "s2" and v.backup == "s3")
+    assert v.primary == "s2" and v.backup == "s3"
+
+
+def test_restarted_primary_treated_as_dead(vs):
+    cks = [Clerk(f"s{i}", vs) for i in (1, 2)]
+    views = {ck.me: View(0, "", "") for ck in cks}
+    drive(vs, cks, views,
+          stop_pred=lambda v: v.primary == "s1" and v.backup == "s2")
+    drive(vs, cks, views, stop_pred=lambda v: vs.acked)
+    # s1 "restarts": pings 0 — must be replaced even though it's pinging.
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and vs.get().primary != "s2":
+        cks[0].ping(0)  # restarted: always viewnum 0
+        views["s2"] = cks[1].ping(views["s2"].viewnum)
+        time.sleep(TICK)
+    assert vs.get().primary == "s2"
+
+
+def test_no_advance_until_acked(vs):
+    ck1, ck2 = Clerk("s1", vs), Clerk("s2", vs)
+    v = ck1.ping(0)
+    assert v.viewnum == 1
+    # s1 NEVER acks view 1 (keeps pinging 0 is restart; just stop pinging).
+    # s2 appears; the view must stay 1/s1 even after s1's TTL expires,
+    # because view 1 was never acked (viewservice/test_test.go 'viewserver
+    # waits for primary to ack').
+    for _ in range(DEAD_PINGS * 3):
+        ck2.ping(0)
+        time.sleep(TICK)
+    v = vs.get()
+    assert v.viewnum == 1 and v.primary == "s1"
+
+
+def test_uninitialized_fresh_start(vs):
+    assert vs.get() == View(0, "", "")

@@ -1,0 +1,117 @@
+"""lockservice — primary/backup lock server (the at-most-once warm-up lab).
+
+Capability parity with the reference (`lockservice/server.go`,
+`lockservice/client.go`): Lock(name) returns whether the lock was acquired;
+Unlock(name) releases it; the primary forwards every op to the backup so a
+client can fail over; retried RPCs must not double-execute (the reference's
+`DeafConn`/`dying` machinery, server.go:75-87,122-156, exists to test exactly
+the reply-lost case).
+
+The reference fork left `Unlock` as a stub on both sides
+(`lockservice/server.go:51-56`, `client.go:88-93`); it is implemented for
+real here.  At-most-once uses the per-client monotonic filter.
+
+Fault knobs for tests: `die_after_next_deaf()` makes the server process one
+more request, drop the reply, then die — the fail-just-before-reply scenario.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpu6824.services.common import fresh_cid
+from tpu6824.utils.errors import RPCError
+
+
+class LockServer:
+    def __init__(self, am_primary: bool, backup: "LockServer | None" = None):
+        self.am_primary = am_primary
+        self.backup = backup
+        self.mu = threading.Lock()
+        self.locks: dict[str, bool] = {}
+        self.dup: dict[int, tuple[int, object]] = {}
+        self.dead = False
+        self.dying = False  # serve one more op deafly, then die
+
+    def _apply(self, kind: str, name: str, cid: int, cseq: int) -> bool:
+        seen, reply = self.dup.get(cid, (-1, None))
+        if cseq <= seen:
+            return reply
+        held = self.locks.get(name, False)
+        if kind == "lock":
+            reply = not held
+            self.locks[name] = True
+        else:  # unlock
+            reply = held
+            self.locks[name] = False
+        self.dup[cid] = (cseq, reply)
+        return reply
+
+    def _serve(self, kind: str, name: str, cid: int, cseq: int) -> bool:
+        with self.mu:
+            if self.dead:
+                raise RPCError("dead")
+            dying = self.dying
+            if self.am_primary and self.backup is not None:
+                try:
+                    self.backup._serve(kind, name, cid, cseq)
+                except RPCError:
+                    pass  # backup gone; keep serving
+            out = self._apply(kind, name, cid, cseq)
+            if dying:
+                self.dead = True
+                raise RPCError("reply lost (server died)")
+            return out
+
+    def lock(self, name: str, cid: int, cseq: int) -> bool:
+        return self._serve("lock", name, cid, cseq)
+
+    def unlock(self, name: str, cid: int, cseq: int) -> bool:
+        return self._serve("unlock", name, cid, cseq)
+
+    def die_after_next_deaf(self):
+        """Process one more request, discard its reply, then die — the
+        DeafConn + dying path (lockservice/server.go:75-87,122-156)."""
+        with self.mu:
+            self.dying = True
+
+    def kill(self):
+        with self.mu:
+            self.dead = True
+
+
+class Clerk:
+    """lockservice/client.go:42-93: primary first, then backup; same (cid,
+    cseq) on the retry so the op executes at most once."""
+
+    def __init__(self, primary: LockServer, backup: LockServer):
+        self.servers = (primary, backup)
+        self.cid = fresh_cid()
+        self.cseq = 0
+        self.mu = threading.Lock()
+
+    def _next(self):
+        with self.mu:
+            self.cseq += 1
+            return self.cseq
+
+    def _call_both(self, fn_name: str, name: str) -> bool:
+        cseq = self._next()
+        for srv in self.servers:
+            try:
+                return getattr(srv, fn_name)(name, self.cid, cseq)
+            except RPCError:
+                continue
+        raise RPCError("both lock servers unreachable")
+
+    def lock(self, name: str) -> bool:
+        return self._call_both("lock", name)
+
+    def unlock(self, name: str) -> bool:
+        return self._call_both("unlock", name)
+
+
+def make_pair() -> tuple[LockServer, LockServer]:
+    backup = LockServer(am_primary=False)
+    primary = LockServer(am_primary=True, backup=backup)
+    return primary, backup

@@ -1,0 +1,257 @@
+"""pbservice — primary/backup replicated KV on top of the viewservice.
+
+Capability parity with the reference Lab 2B (`pbservice/server.go`,
+`pbservice/client.go`): the primary forwards every operation to the backup
+before replying; reads also go through the backup (the backup's answer is the
+trusted one, `pbservice/server.go:108-149`) — that is what defeats the
+stale-primary partition scenario: a primary cut off from the viewservice
+cannot get its ex-backup (now promoted) to co-sign, so it cannot serve stale
+data (`pbservice/test_test.go:956-1150`).  A new backup is bootstrapped with a
+full state transfer (`InitState`, server.go:274-296).
+
+At-most-once uses the per-client monotonic filter (the reference's
+OpID+10s-TTL cache, server.go:23,57-92, has timing races by construction);
+filter state rides the state transfer so retries survive failover.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tpu6824.services import viewservice
+from tpu6824.services.common import FlakyNet, fresh_cid
+from tpu6824.utils.errors import (
+    OK,
+    ErrNoKey,
+    ErrUninitServer,
+    ErrWrongServer,
+    RPCError,
+)
+
+
+class PBServer:
+    def __init__(self, me: str, vs: viewservice.ViewServer, net: FlakyNet,
+                 directory: dict, tick_interval: float | None = None):
+        self.me = me
+        self.vck = viewservice.Clerk(me, vs)
+        self.net = net
+        self.directory = directory
+        directory[me] = self
+        self.mu = threading.RLock()
+        self.view = viewservice.View(0, "", "")
+        self.kv: dict[str, str] | None = None  # None = uninitialized backup
+        self.dup: dict[int, tuple[int, object]] = {}
+        self.dead = False
+        self.tick_interval = tick_interval or vs.ping_interval
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker.start()
+
+    # ------------------------------------------------------------- helpers
+
+    def _backup_srv(self):
+        b = self.view.backup
+        return self.directory.get(b) if b else None
+
+    def _apply(self, kind: str, key: str, value: str, cid: int, cseq: int):
+        seen, reply = self.dup.get(cid, (-1, None))
+        if cseq <= seen:
+            return reply
+        if kind == "get":
+            reply = (OK, self.kv[key]) if key in self.kv else (ErrNoKey, "")
+        elif kind == "put":
+            self.kv[key] = value
+            reply = (OK, "")
+        elif kind == "append":
+            self.kv[key] = self.kv.get(key, "") + value
+            reply = (OK, "")
+        self.dup[cid] = (cseq, reply)
+        return reply
+
+    # ------------------------------------------------------------- primary
+
+    def get(self, key: str, cid: int, cseq: int):
+        with self.mu:
+            self._check()
+            if self.view.primary != self.me or self.kv is None:
+                return (ErrWrongServer, "")
+            bk = self._backup_srv()
+            if bk is not None:
+                # Read through the backup; its answer is the trusted one
+                # (pbservice/server.go:129-141).
+                try:
+                    err, val = self.net.call(
+                        bk, bk.backup_get, self.view.viewnum, key, cid, cseq
+                    )
+                except RPCError:
+                    return (ErrWrongServer, "")
+                if err == ErrUninitServer:
+                    self._transfer_state_locked()
+                    return (ErrWrongServer, "")  # client retries
+                if err == ErrWrongServer:
+                    return (ErrWrongServer, "")
+                return (err, val)
+            return self._apply("get", key, cid=cid, cseq=cseq, value="")
+
+    def put_append(self, key: str, kind: str, value: str, cid: int, cseq: int):
+        """pbservice/server.go:196-272: forward to backup, then apply."""
+        with self.mu:
+            self._check()
+            if self.view.primary != self.me or self.kv is None:
+                return (ErrWrongServer, "")
+            seen, reply = self.dup.get(cid, (-1, None))
+            if cseq <= seen:
+                return reply
+            bk = self._backup_srv()
+            if bk is not None:
+                try:
+                    err, _ = self.net.call(
+                        bk, bk.backup_put_append,
+                        self.view.viewnum, key, kind, value, cid, cseq,
+                    )
+                except RPCError:
+                    return (ErrWrongServer, "")
+                if err == ErrUninitServer:
+                    self._transfer_state_locked()
+                    return (ErrWrongServer, "")
+                if err != OK:
+                    return (ErrWrongServer, "")
+            return self._apply(kind, key, value, cid, cseq)
+
+    # ------------------------------------------------------------- backup
+
+    def backup_get(self, viewnum: int, key: str, cid: int, cseq: int):
+        with self.mu:
+            self._check()
+            if self.view.backup != self.me or viewnum < self.view.viewnum:
+                return (ErrWrongServer, "")
+            if self.kv is None:
+                return (ErrUninitServer, "")
+            return self._apply("get", key, "", cid, cseq)
+
+    def backup_put_append(self, viewnum: int, key: str, kind: str, value: str,
+                          cid: int, cseq: int):
+        with self.mu:
+            self._check()
+            if self.view.backup != self.me or viewnum < self.view.viewnum:
+                return (ErrWrongServer, "")
+            if self.kv is None:
+                return (ErrUninitServer, "")
+            return self._apply(kind, key, value, cid, cseq)
+
+    def init_state(self, viewnum: int, kv: dict, dup: dict):
+        """pbservice/server.go:45-55: full-state bootstrap of a new backup."""
+        with self.mu:
+            self._check()
+            if self.view.backup != self.me:
+                return (ErrWrongServer, "")
+            self.kv = dict(kv)
+            self.dup = dict(dup)
+            return (OK, "")
+
+    def _transfer_state_locked(self):
+        bk = self._backup_srv()
+        if bk is None:
+            return
+        try:
+            self.net.call(bk, bk.init_state, self.view.viewnum,
+                          dict(self.kv), dict(self.dup))
+        except RPCError:
+            pass
+
+    # ------------------------------------------------------------- liveness
+
+    def _tick_loop(self):
+        while not self.dead:
+            time.sleep(self.tick_interval)
+            self.tick()
+
+    def tick(self):
+        """pbservice/server.go:334-352: ping the viewservice; on becoming
+        primary with a fresh backup, push state."""
+        with self.mu:
+            if self.dead:
+                return
+            old = self.view
+            try:
+                view = self.vck.ping(self.view.viewnum)
+            except RPCError:
+                return
+            self.view = view
+            if view.primary == self.me and self.kv is None:
+                # First primary of the system starts empty.
+                if view.viewnum == 1 or old.viewnum == 0:
+                    self.kv = {}
+            if (
+                view.primary == self.me
+                and view.backup
+                and view.backup != old.backup
+                and self.kv is not None
+            ):
+                self._transfer_state_locked()
+
+    def _check(self):
+        if self.dead:
+            raise RPCError("dead")
+
+    def kill(self):
+        with self.mu:
+            self.dead = True
+            del self.directory[self.me]
+
+
+class Clerk:
+    """pbservice/client.go:67-115: cache the view; refresh from the
+    viewservice on error; retry forever (at-most-once via cid/cseq)."""
+
+    def __init__(self, vs: viewservice.ViewServer, directory: dict,
+                 net: FlakyNet | None = None):
+        self.vs = vs
+        self.directory = directory
+        self.net = net or FlakyNet()
+        self.cid = fresh_cid()
+        self.cseq = 0
+        self.primary = ""
+        self.mu = threading.Lock()
+
+    def _next(self):
+        with self.mu:
+            self.cseq += 1
+            return self.cseq
+
+    def _refresh(self):
+        try:
+            self.primary = self.vs.get().primary
+        except RPCError:
+            pass
+
+    def _loop(self, fn_name, *args, timeout=None):
+        cseq = self._next()
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            if not self.primary:
+                self._refresh()
+            srv = self.directory.get(self.primary)
+            if srv is not None:
+                try:
+                    err, val = self.net.call(
+                        srv, getattr(srv, fn_name), *args, self.cid, cseq
+                    )
+                    if err != ErrWrongServer:
+                        return err, val
+                except RPCError:
+                    pass
+            if deadline and time.monotonic() >= deadline:
+                raise RPCError("clerk timeout")
+            time.sleep(0.01)
+            self._refresh()
+
+    def get(self, key: str, timeout=None) -> str:
+        err, val = self._loop("get", key, timeout=timeout)
+        return val if err == OK else ""
+
+    def put(self, key: str, value: str, timeout=None):
+        self._loop("put_append", key, "put", value, timeout=timeout)
+
+    def append(self, key: str, value: str, timeout=None):
+        self._loop("put_append", key, "append", value, timeout=timeout)

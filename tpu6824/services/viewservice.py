@@ -1,0 +1,162 @@
+"""viewservice — non-replicated view server for primary/backup replication.
+
+Capability parity with the reference Lab 2A (`viewservice/server.go`,
+`viewservice/client.go`, `viewservice/common.go:36-48`): numbered
+`View{viewnum, primary, backup}`; servers Ping every PING_INTERVAL; a server
+missing DEAD_PINGS pings is dead; a restarted server (Ping(0) from the
+current primary) is treated as dead; the view NEVER advances until the
+current primary has acked (pinged with) the current viewnum.
+
+Also fixes the reference's compile bug (`viewservice/server.go:158` assigns an
+undeclared identifier) by not porting it.
+
+This is pure control plane — no device work (SURVEY §2.2: "tiny host FSM").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import NamedTuple
+
+from tpu6824.utils.errors import RPCError
+
+PING_INTERVAL = 0.1  # viewservice/common.go:43 (100ms)
+DEAD_PINGS = 5       # viewservice/common.go:48
+
+
+class View(NamedTuple):
+    viewnum: int
+    primary: str
+    backup: str
+
+
+class ViewServer:
+    def __init__(self, ping_interval: float = PING_INTERVAL):
+        self.mu = threading.Lock()
+        self.view = View(0, "", "")
+        self.acked = False          # primary has pinged the current viewnum
+        self.ttl: dict[str, int] = {}      # server -> remaining pings
+        self.idle: set[str] = set()        # pinged, not in the view
+        self.restarted: set[str] = set()   # primary pinged 0 (crash+restart)
+        self.dead = False
+        self.rpccount = 0
+        self.ping_interval = ping_interval
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker.start()
+
+    # ------------------------------------------------------------- RPCs
+
+    def ping(self, me: str, viewnum: int) -> View:
+        """viewservice/server.go:56-112."""
+        with self.mu:
+            if self.dead:
+                raise RPCError("dead")
+            self.rpccount += 1
+            self.ttl[me] = DEAD_PINGS
+
+            if self.view.viewnum == 0:
+                # First pinger becomes primary of view 1.
+                self.view = View(1, me, "")
+                self.acked = False
+            elif me == self.view.primary:
+                if viewnum == 0 and self.view.viewnum > 0:
+                    # Restarted primary: treat as dead (restart detection,
+                    # server.go:72-78) — but only once acked.
+                    self.restarted.add(me)
+                elif viewnum == self.view.viewnum:
+                    self.acked = True
+            elif me == self.view.backup:
+                if viewnum == 0 and self.view.viewnum > 0:
+                    self.restarted.add(me)
+            else:
+                self.idle.add(me)
+            self._advance_locked()
+            return self.view
+
+    def get(self) -> View:
+        """viewservice/server.go:117-127 — no liveness side effects."""
+        with self.mu:
+            if self.dead:
+                raise RPCError("dead")
+            self.rpccount += 1
+            return self.view
+
+    # ------------------------------------------------------------- FSM
+
+    def _alive_locked(self, who: str) -> bool:
+        return who != "" and self.ttl.get(who, 0) > 0 and who not in self.restarted
+
+    def _advance_locked(self):
+        """View-transition rules (viewservice/server.go:157-221): only when
+        the current view is acked may it change."""
+        if self.view.viewnum == 0 or not self.acked:
+            return
+        v = self.view
+        primary, backup = v.primary, v.backup
+        changed = False
+        if not self._alive_locked(primary):
+            # Promote backup; a dead/never-acked primary without backup
+            # wedges the service forever (by design).
+            if self._alive_locked(backup):
+                primary, backup, changed = backup, "", True
+            else:
+                return
+        if not self._alive_locked(backup):
+            if backup != "":
+                backup, changed = "", True
+            cand = next(
+                (s for s in sorted(self.idle)
+                 if self._alive_locked(s) and s != primary),
+                "",
+            )
+            if cand:
+                backup, changed = cand, True
+                self.idle.discard(cand)
+        if changed:
+            self.restarted.clear()
+            self.view = View(v.viewnum + 1, primary, backup)
+            self.acked = False
+
+    def _tick_loop(self):
+        while not self.dead:
+            time.sleep(self.ping_interval)
+            self.tick()
+
+    def tick(self):
+        """viewservice/server.go:199-221 — decrement TTLs, maybe advance."""
+        with self.mu:
+            if self.dead:
+                return
+            for s in list(self.ttl):
+                self.ttl[s] -= 1
+            self.idle = {s for s in self.idle if self._alive_locked(s)}
+            self._advance_locked()
+
+    def kill(self):
+        with self.mu:
+            self.dead = True
+
+    def get_rpccount(self) -> int:
+        with self.mu:
+            return self.rpccount
+
+
+class Clerk:
+    """viewservice/client.go:56-88."""
+
+    def __init__(self, me: str, vs: ViewServer):
+        self.me = me
+        self.vs = vs
+
+    def ping(self, viewnum: int) -> View:
+        return self.vs.ping(self.me, viewnum)
+
+    def get(self) -> View:
+        return self.vs.get()
+
+    def primary(self) -> str:
+        try:
+            return self.get().primary
+        except RPCError:
+            return ""
