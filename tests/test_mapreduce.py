@@ -1,0 +1,137 @@
+"""MapReduce tests — reference invariants (`mapreduce/test_test.go`): output
+equals sorted input (:45-83), basic one/many workers, worker death mid-stream
+(:151-191), repeated churn with replacement workers, word-count correctness
+(main/test-wc.sh golden check, recomputed independently here), and the
+device-batched partitioner matching the scalar hash."""
+
+import collections
+import random
+import threading
+import time
+
+from tpu6824.ops.hashing import ihash, partition_keys
+from tpu6824.services.mapreduce import (
+    Master,
+    Worker,
+    merge,
+    run_distributed,
+    run_sequential,
+    split_text,
+    wc_map,
+    wc_reduce,
+)
+
+NNUMBERS = 1000
+
+
+def numbers_input():
+    nums = list(range(NNUMBERS))
+    random.Random(0).shuffle(nums)
+    return "\n".join(str(n) for n in nums) + "\n"
+
+
+def ident_map(chunk):
+    for line in chunk.splitlines():
+        if line.strip():
+            yield (line.strip(), "")
+
+
+def ident_reduce(key, values):
+    return ""
+
+
+def check_sorted_numbers(out):
+    """mapreduce/test_test.go:45-83: every input number present exactly once,
+    output sorted by key."""
+    keys = [k for k, _ in out]
+    assert len(keys) == NNUMBERS
+    assert sorted(keys) == keys
+    assert sorted(int(k) for k in keys) == list(range(NNUMBERS))
+
+
+def test_sequential():
+    out = run_sequential(numbers_input(), nmap=7, nreduce=5,
+                         map_fn=ident_map, reduce_fn=ident_reduce)
+    check_sorted_numbers(out)
+
+
+def test_split_preserves_text():
+    text = numbers_input()
+    assert "".join(split_text(text, 7)) == text
+
+
+def test_distributed_basic():
+    out = run_distributed(numbers_input(), nmap=7, nreduce=5,
+                          map_fn=ident_map, reduce_fn=ident_reduce, nworkers=2)
+    check_sorted_numbers(out)
+
+
+def test_one_failure():
+    """mapreduce/test_test.go:151-168: one worker dies after 10 tasks; the
+    re-enqueue path must finish the job."""
+    m = Master(numbers_input(), nmap=10, nreduce=5)
+    m.register(Worker("dies", ident_map, ident_reduce, nrpc=10))
+    m.register(Worker("lives", ident_map, ident_reduce))
+    out = m.run()
+    check_sorted_numbers(out)
+    assert m.stats["lives"] > 0
+
+
+def test_many_failures_with_replacements():
+    """mapreduce/test_test.go:170-191: workers keep dying; fresh ones keep
+    registering."""
+    m = Master(numbers_input(), nmap=12, nreduce=6)
+    stop = threading.Event()
+
+    def spawner():
+        i = 0
+        while not stop.is_set():
+            m.register(Worker(f"mortal{i}", ident_map, ident_reduce, nrpc=2))
+            i += 1
+            time.sleep(0.01)
+
+    t = threading.Thread(target=spawner, daemon=True)
+    t.start()
+    try:
+        out = m.run()
+    finally:
+        stop.set()
+        t.join()
+    check_sorted_numbers(out)
+
+
+def test_wordcount_matches_reference_counts():
+    corpus = (
+        "the quick brown fox jumps over the lazy dog\n"
+        "the dog barks; the fox runs.  Fox!\n" * 5
+    )
+    out = run_distributed(corpus, nmap=4, nreduce=3,
+                          map_fn=wc_map, reduce_fn=wc_reduce, nworkers=3)
+    # independent recomputation (the golden file of main/test-wc.sh)
+    expect = collections.Counter()
+    word = []
+    for ch in corpus:
+        if ch.isalpha():
+            word.append(ch)
+        else:
+            if word:
+                expect["".join(word)] += 1
+            word = []
+    got = {k: int(v) for k, v in out}
+    assert got == dict(expect)
+
+
+def test_partition_keys_matches_scalar_hash():
+    keys = [f"key-{i}" for i in range(300)] + ["", "a", "Ω≈ç√"]
+    parts = partition_keys(keys, 7)
+    for k, b in zip(keys, parts):
+        assert int(b) == ihash(k) % 7
+
+
+def test_worker_job_counts_reported():
+    m = Master(numbers_input(), nmap=6, nreduce=3)
+    w1, w2 = Worker("a", ident_map, ident_reduce), Worker("b", ident_map, ident_reduce)
+    m.register(w1)
+    m.register(w2)
+    m.run()
+    assert m.stats["a"] + m.stats["b"] == 9  # 6 map + 3 reduce tasks

@@ -22,11 +22,10 @@ NSHARDS = 10  # shardmaster/common.go:35
 
 def ihash(key: str) -> int:
     """FNV-1a 32-bit of the UTF-8 bytes of `key` (mapreduce/mapreduce.go:185-189)."""
-    h = FNV_OFFSET32
+    h = int(FNV_OFFSET32)
     for b in key.encode("utf-8"):
-        h = np.uint32(h ^ np.uint32(b))
-        h = np.uint32(h * FNV_PRIME32)
-    return int(h)
+        h = ((h ^ b) * int(FNV_PRIME32)) & 0xFFFFFFFF
+    return h
 
 
 def key2shard(key: str, nshards: int = NSHARDS) -> int:
@@ -66,3 +65,20 @@ def ihash_batch(keys_u8: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
 def key2shard_batch(first_bytes: jnp.ndarray, nshards: int = NSHARDS) -> jnp.ndarray:
     """Vectorized key2shard: (B,) uint8 first bytes -> (B,) int32 shard ids."""
     return (first_bytes.astype(jnp.int32)) % nshards
+
+
+def partition_keys(keys: list[str], nreduce: int) -> np.ndarray:
+    """Route a batch of string keys to reduce buckets: ihash(key) % nreduce
+    (mapreduce/mapreduce.go:222) computed for the whole batch in one device
+    call.  Returns (B,) int64 bucket ids, bit-identical to the scalar path."""
+    if not keys:
+        return np.zeros((0,), np.int64)
+    raw = [k.encode("utf-8") for k in keys]
+    L = max(1, max(len(b) for b in raw))
+    mat = np.zeros((len(raw), L), np.uint8)
+    lengths = np.zeros((len(raw),), np.int32)
+    for i, b in enumerate(raw):
+        mat[i, : len(b)] = np.frombuffer(b, np.uint8)
+        lengths[i] = len(b)
+    h = np.asarray(ihash_batch(jnp.asarray(mat), jnp.asarray(lengths)))
+    return (h.astype(np.int64)) % nreduce

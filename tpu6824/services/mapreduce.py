@@ -1,0 +1,227 @@
+"""MapReduce — single-machine master/worker MapReduce with fault tolerance.
+
+Capability parity with the reference Lab 1 (`mapreduce/mapreduce.go`,
+`master.go`, `worker.go`): split the input into nmap map tasks, hash-partition
+map output into nreduce buckets (FNV-1a, `mapreduce.go:185-189`), reduce each
+bucket over sorted keys, merge to one sorted output; the master hands tasks to
+dynamically-registering workers and re-enqueues a task whose worker failed
+(`master.go:50-53`); a worker can be configured to die after N tasks
+(`worker.go:60-92`) for churn tests; a sequential mode runs everything inline
+(`mapreduce.go:344-356`).
+
+TPU-shaped difference: the per-key partition hashing is a batched device op
+(`ops/hashing.ihash_batch`) — one kernel call per map task instead of a
+per-key host loop, and the same code path scales to batch-of-tasks on a mesh.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import defaultdict
+
+from tpu6824.ops.hashing import ihash, partition_keys
+from tpu6824.utils.errors import RPCError
+
+
+# --------------------------------------------------------------- data plane
+
+
+def split_text(text: str, nmap: int) -> list[str]:
+    """Split on line boundaries into ~equal byte chunks
+    (mapreduce/mapreduce.go:141-179 Split)."""
+    if nmap <= 1:
+        return [text]
+    target = max(1, len(text) // nmap)
+    chunks, cur, size = [], [], 0
+    for line in text.splitlines(keepends=True):
+        cur.append(line)
+        size += len(line)
+        if size >= target and len(chunks) < nmap - 1:
+            chunks.append("".join(cur))
+            cur, size = [], 0
+    chunks.append("".join(cur))
+    while len(chunks) < nmap:
+        chunks.append("")
+    return chunks
+
+
+def do_map(chunk: str, map_fn, nreduce: int, use_device: bool = True):
+    """Run map_fn over a chunk and hash-partition the emitted pairs into
+    nreduce buckets (DoMap, mapreduce/mapreduce.go:193-231)."""
+    pairs = list(map_fn(chunk))
+    buckets = [[] for _ in range(nreduce)]
+    if use_device and len(pairs) >= 64:
+        parts = partition_keys([k for k, _ in pairs], nreduce)
+        for (k, v), b in zip(pairs, parts):
+            buckets[int(b)].append((k, v))
+    else:
+        for k, v in pairs:
+            buckets[ihash(k) % nreduce].append((k, v))
+    return buckets
+
+
+def do_reduce(bucket_pairs, reduce_fn):
+    """Group by key, sort keys, apply reduce_fn (DoReduce,
+    mapreduce/mapreduce.go:239-280)."""
+    grouped: dict[str, list] = defaultdict(list)
+    for k, v in bucket_pairs:
+        grouped[k].append(v)
+    return [(k, reduce_fn(k, grouped[k])) for k in sorted(grouped)]
+
+
+def merge(reduce_outputs) -> list:
+    """Merge the per-bucket sorted outputs into one sorted list
+    (Merge, mapreduce/mapreduce.go:284-321)."""
+    out = [kv for part in reduce_outputs for kv in part]
+    out.sort(key=lambda kv: kv[0])
+    return out
+
+
+def run_sequential(text: str, nmap: int, nreduce: int, map_fn, reduce_fn):
+    """RunSingle (mapreduce/mapreduce.go:344-356)."""
+    chunks = split_text(text, nmap)
+    maps = [do_map(c, map_fn, nreduce) for c in chunks]
+    reduces = []
+    for r in range(nreduce):
+        bucket = [kv for m in maps for kv in m[r]]
+        reduces.append(do_reduce(bucket, reduce_fn))
+    return merge(reduces)
+
+
+# --------------------------------------------------------------- workers
+
+
+class Worker:
+    """A map/reduce worker; `nrpc` >= 0 makes it die after that many task
+    RPCs (worker.go:60-92) so the master's failure handling is exercised."""
+
+    def __init__(self, name: str, map_fn, reduce_fn, nrpc: int = -1):
+        self.name = name
+        self.map_fn = map_fn
+        self.reduce_fn = reduce_fn
+        self.mu = threading.Lock()
+        self.nrpc = nrpc
+        self.njobs = 0
+        self.dead = False
+
+    def do_job(self, kind: str, payload, nreduce: int):
+        with self.mu:
+            if self.dead or self.nrpc == 0:
+                self.dead = True
+                raise RPCError(f"worker {self.name} dead")
+            if self.nrpc > 0:
+                self.nrpc -= 1
+            self.njobs += 1
+        if kind == "map":
+            return do_map(payload, self.map_fn, nreduce)
+        return do_reduce(payload, self.reduce_fn)
+
+    def shutdown(self) -> int:
+        """Returns the number of jobs performed (checked by the reference's
+        `checkWorker`, mapreduce/test_test.go:87-93)."""
+        with self.mu:
+            self.dead = True
+            return self.njobs
+
+
+# --------------------------------------------------------------- master
+
+
+class Master:
+    """RunMaster (mapreduce/master.go:29-88): a dispatcher loop over an idle-
+    worker pool; a failed task RPC re-enqueues the task and retires the
+    worker."""
+
+    def __init__(self, text: str, nmap: int, nreduce: int):
+        self.text = text
+        self.nmap = nmap
+        self.nreduce = nreduce
+        self.workers: "queue.Queue[Worker]" = queue.Queue()
+        self.stats: dict[str, int] = {}
+        self._registered: list[Worker] = []
+        self._mu = threading.Lock()
+
+    def register(self, w: Worker):
+        """Workers announce themselves at any time (the registration RPC
+        server, mapreduce/mapreduce.go:92-133)."""
+        with self._mu:
+            self._registered.append(w)
+        self.workers.put(w)
+
+    def _run_phase(self, kind: str, tasks: list):
+        """Dispatch `tasks` to workers; barrier until all complete.  Failed
+        RPC → task back on the queue (master.go:50-53)."""
+        results: list = [None] * len(tasks)
+        task_q: "queue.Queue[int]" = queue.Queue()
+        for i in range(len(tasks)):
+            task_q.put(i)
+        done = threading.Semaphore(0)
+        ndone = 0
+
+        def dispatch():
+            while True:
+                try:
+                    i = task_q.get_nowait()
+                except queue.Empty:
+                    return
+                w = self.workers.get()  # blocks for an idle/registering worker
+                try:
+                    results[i] = w.do_job(kind, tasks[i], self.nreduce)
+                except RPCError:
+                    task_q.put(i)  # re-enqueue; w is NOT returned to the pool
+                    continue
+                self.workers.put(w)
+                done.release()
+
+        threads = [threading.Thread(target=dispatch, daemon=True) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for _ in range(len(tasks)):
+            done.acquire()
+        for t in threads:
+            t.join()
+        return results
+
+    def run(self):
+        """Run() master side (mapreduce/mapreduce.go:369-380 + master.go)."""
+        chunks = split_text(self.text, self.nmap)
+        maps = self._run_phase("map", chunks)
+        buckets = []
+        for r in range(self.nreduce):
+            buckets.append([kv for m in maps for kv in m[r]])
+        reduces = self._run_phase("reduce", buckets)
+        with self._mu:
+            self.stats = {w.name: w.njobs for w in self._registered}
+        return merge(reduces)
+
+
+def run_distributed(text, nmap, nreduce, map_fn, reduce_fn, nworkers=3,
+                    worker_nrpc=-1):
+    """Boot a master + workers (the wc.go master/worker modes,
+    main/wc.go:17-58)."""
+    m = Master(text, nmap, nreduce)
+    for i in range(nworkers):
+        m.register(Worker(f"w{i}", map_fn, reduce_fn, nrpc=worker_nrpc))
+    return m.run()
+
+
+# --------------------------------------------------------------- apps
+
+
+def wc_map(chunk: str):
+    """Word count mapper (main/wc.go semantics: words are runs of letters)."""
+    word = []
+    for ch in chunk:
+        if ch.isalpha():
+            word.append(ch)
+        else:
+            if word:
+                yield ("".join(word), "1")
+            word = []
+    if word:
+        yield ("".join(word), "1")
+
+
+def wc_reduce(key: str, values: list) -> str:
+    return str(sum(int(v) for v in values))
