@@ -340,6 +340,15 @@ class PaxosFabric:
             self._dead[g, p] = True
             self._apply_dead_locked(g)
 
+    def revive(self, g: int, p: int):
+        """Reboot a crashed peer (diskv's restart path): clears the dead flag
+        and restores its links, leaving other peers' crash state intact."""
+        with self._lock:
+            self._dead[g, p] = False
+            self._link[g, p, :] = True
+            self._link[g, :, p] = True
+            self._apply_dead_locked(g)
+
     def is_dead(self, g: int, p: int) -> bool:
         with self._lock:
             return bool(self._dead[g, p])

@@ -76,6 +76,7 @@ class ShardKVServer:
         sm_clerk_servers,
         directory: dict,
         op_timeout: float = 8.0,
+        start_ticker: bool = True,
     ):
         self.px = PaxosPeer(fabric, fg, me)
         self.gid = gid
@@ -91,6 +92,11 @@ class ShardKVServer:
         self.applied = -1
         self.op_timeout = op_timeout
         self.dead = False
+        self._ticker = None
+        if start_ticker:
+            self._start_ticker()
+
+    def _start_ticker(self):
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
         self._ticker.start()
 
