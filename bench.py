@@ -1,0 +1,107 @@
+"""Benchmark: decided Paxos instances/sec across 1024 groups (the north-star
+metric from BASELINE.md) on whatever accelerator jax.devices() offers (the
+real TPU chip under the driver).
+
+Pipeline measured: each kernel step recycles every instance slot (apply_starts
+with full reset + restart) and runs one full prepare/accept/decide round over
+the (G=1024, I, P=3) universe — i.e. the steady-state throughput of the
+consensus engine with the host completely out of the loop (a lax.scan of
+steps), which is how the batched services drive it.
+
+vs_baseline: the reference decides O(10^3) instances/sec on one machine
+(dial-per-call Unix-socket RPC + 10ms→1s backoff polling,
+kvpaxos/server.go:73-77; see BASELINE.md) — vs_baseline = value / 1000.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def accelerator_usable(timeout=120.0) -> bool:
+    """Probe the default (axon/TPU) backend in a subprocess: if the relay is
+    wedged, backend init hangs forever and would take the bench down with it.
+    The kill-able probe lets us fall back to CPU and still emit the JSON
+    line."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") or not accelerator_usable():
+        print("bench: accelerator backend unusable; falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from tpu6824.core.kernel import apply_starts, init_state, paxos_step
+
+    G = int(os.environ.get("BENCH_GROUPS", 1024))
+    I = int(os.environ.get("BENCH_INSTANCES", 64))
+    P = 3
+    STEPS = 20
+
+    state = init_state(G, I, P)
+    sa = jnp.asarray(np.broadcast_to(np.arange(P) == 0, (G, I, P)))
+    sv = jnp.asarray(
+        np.where(np.arange(P) == 0, np.arange(G * I).reshape(G, I, 1) + 1, -1).astype(
+            np.int32
+        )
+    )
+    reset_all = jnp.ones((G, I), bool)
+    link = jnp.ones((G, P, P), bool)
+    done = jnp.full((G, P), -1, jnp.int32)
+    dr = jnp.zeros((G, P, P), jnp.float32)
+
+    def cycle(state, key):
+        state = apply_starts(state, reset_all, sa, sv)
+        state, io = paxos_step(state, link, done, key, dr, dr)
+        return state, io.decided.min()
+
+    @jax.jit
+    def run(state, key):
+        keys = jax.random.split(key, STEPS)
+        return jax.lax.scan(cycle, state, keys)
+
+    # warmup / compile
+    state, mins = run(state, jax.random.key(0))
+    jax.block_until_ready(mins)
+    assert int(np.asarray(mins).min()) >= 0, "agreement failed"
+
+    t0 = time.perf_counter()
+    reps = 5
+    for r in range(reps):
+        state, mins = run(state, jax.random.key(r + 1))
+    jax.block_until_ready(mins)
+    dt = time.perf_counter() - t0
+
+    decided = G * I * STEPS * reps
+    rate = decided / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"decided_paxos_instances_per_sec@{G}groups",
+                "value": round(rate, 1),
+                "unit": "instances/sec",
+                "vs_baseline": round(rate / 1000.0, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+from tpu6824.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    state_shardings,
+    sharded_step,
+    step_args_shardings,
+)

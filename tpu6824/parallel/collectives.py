@@ -1,0 +1,68 @@
+"""Explicit-collective building blocks (shard_map + psum/all_gather).
+
+The auto-sharded kernel (parallel/mesh.py) lets XLA place the collectives;
+these are the same primitives written explicitly with `shard_map`, for the
+places where manual placement beats the compiler and as the reference
+implementation of the communication pattern:
+
+  - `quorum_counts`: each device holds a (local peers)-slice of per-peer
+    boolean votes; the majority check is a psum over the 'p' axis — riding
+    ICI, this is the reference's "count acks > npeers/2"
+    (`paxos/paxos.go:181,267`) as one collective.
+  - `exchange_peer_axis`: materialize the (src peer, dst peer) exchange matrix
+    from a peer-sharded message vector — an all_gather over 'p', i.e. the
+    kernel's message fan-out without ever leaving the device fabric.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def quorum_counts(votes: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
+    """votes: (G, I, P) bool, sharded over ('g','i','p').  Returns (G, I)
+    int32 vote totals, computed with an explicit psum over the peer axis."""
+
+    def local(v):
+        part = v.sum(-1).astype(jnp.int32)  # local peers only
+        return jax.lax.psum(part, axis_name="p")
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P("g", "i", "p"),
+        out_specs=P("g", "i"),
+    )
+    return fn(votes)
+
+
+def majority(votes: jnp.ndarray, npeers: int, mesh: Mesh) -> jnp.ndarray:
+    """(G, I) bool: strict majority of npeers voted yes."""
+    return quorum_counts(votes, mesh) * 2 > npeers
+
+
+def exchange_peer_axis(msgs: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
+    """msgs: (G, I, P) values 'sent' by each peer, sharded over 'p'.
+    Returns (G, I, P, P) where [..., src, dst] replicates each source's
+    message to every destination — an all_gather over the peer axis followed
+    by a broadcast, the tensor form of sendPrepareToAll's fan-out
+    (`paxos/paxos.go:161-190`)."""
+
+    def local(m):
+        allm = jax.lax.all_gather(m, axis_name="p", axis=2, tiled=True)  # (G,I,P)
+        # dst axis stays local: each device holds its slice of destinations.
+        loc = m.shape[2]
+        return jnp.broadcast_to(
+            allm[:, :, :, None], (*allm.shape, loc)
+        )
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P("g", "i", "p"),
+        out_specs=P("g", "i", None, "p"),
+    )
+    return fn(msgs)

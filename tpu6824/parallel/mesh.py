@@ -1,0 +1,83 @@
+"""Device-mesh construction and sharding specs for the consensus kernel.
+
+The distribution model (SURVEY §2.3): the reference's N-process Unix-socket
+topology becomes axes of one device mesh —
+  - 'g' (groups)    ≈ data parallelism: independent Paxos groups in lanes;
+  - 'i' (instances) ≈ sequence parallelism: the sliding window of log slots;
+  - 'p' (peers)     ≈ tensor parallelism: the replica axis; quorum counting
+                      reduces over it, which XLA lowers to psum over ICI when
+                      'p' spans devices.
+Multi-host scale-out uses the same named axes over a process mesh (DCN
+between hosts, ICI within) — no code change, just a bigger mesh.
+
+Shardings are annotated with NamedSharding + jit; XLA inserts the collectives
+(all-reduces for the sum/max over 'p', all-gathers where the (p, q) exchange
+matrices need both axes) — nothing here hand-schedules communication.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu6824.core.kernel import PaxosState, paxos_step
+
+
+def _factor3(n: int) -> tuple[int, int, int]:
+    """Split n devices into (g, i, p) mesh dims, preferring the group axis."""
+    best = (n, 1, 1)
+    for p in (1, 2):
+        for i in (1, 2, 4):
+            if n % (p * i) == 0:
+                g = n // (p * i)
+                best = max(best, (g, i, p), key=lambda t: (t[0] > 1, t[2], t[1]))
+    g, i, p = best
+    assert g * i * p == n
+    return g, i, p
+
+
+def make_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    g, i, p = _factor3(len(devices))
+    return Mesh(np.asarray(devices).reshape(g, i, p), axis_names=("g", "i", "p"))
+
+
+def state_shardings(mesh: Mesh) -> PaxosState:
+    """PartitionSpecs for every PaxosState leaf."""
+    s3 = NamedSharding(mesh, P("g", "i", "p"))
+    sdv = NamedSharding(mesh, P("g", "p", None))
+    return PaxosState(
+        np_=s3, na=s3, va=s3, decided=s3, active=s3, propv=s3, maxseen=s3,
+        done_view=sdv,
+    )
+
+
+def step_args_shardings(mesh: Mesh):
+    """Shardings for (link, done, key, drop_req, drop_rep)."""
+    rep = NamedSharding(mesh, P())
+    return (
+        NamedSharding(mesh, P("g", None, None)),  # link
+        NamedSharding(mesh, P("g", "p")),          # done
+        rep,                                        # PRNG key
+        NamedSharding(mesh, P("g", None, None)),  # drop_req
+        NamedSharding(mesh, P("g", None, None)),  # drop_rep
+    )
+
+
+def sharded_step(mesh: Mesh):
+    """jit paxos_step with explicit input/output shardings over the mesh."""
+    st = state_shardings(mesh)
+    args = step_args_shardings(mesh)
+    return jax.jit(
+        paxos_step.__wrapped__,
+        in_shardings=(st, *args),
+        out_shardings=None,
+        donate_argnums=(0,),
+    )
+
+
+def place_state(state: PaxosState, mesh: Mesh) -> PaxosState:
+    sh = state_shardings(mesh)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
