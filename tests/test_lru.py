@@ -1,0 +1,109 @@
+"""LRU cache tests — reference semantics from `lru/lru.go` (groupcache-style):
+capacity eviction of the least-recent, Get promotes / Peek doesn't,
+ContainsOrAdd, Remove, Keys ordering, thread-safety smoke."""
+
+import threading
+
+import pytest
+
+from tpu6824.native.lru import LRUCache
+
+
+def test_native_backend_compiled():
+    c = LRUCache(4)
+    assert c.native, "C++ LRU failed to build; fallback in use"
+
+
+def test_put_get_basic():
+    c = LRUCache(3)
+    c.put("a", "1")
+    c.put("b", "2")
+    assert c.get("a") == "1"
+    assert c.get("b") == "2"
+    assert c.get("zz") is None
+    assert len(c) == 2
+
+
+def test_eviction_order():
+    c = LRUCache(3)
+    for k in "abc":
+        c.put(k, k)
+    c.put("d", "d")  # evicts a (least recent)
+    assert c.get("a") is None
+    assert c.get("b") == "b"
+
+
+def test_get_promotes_peek_does_not():
+    c = LRUCache(3)
+    for k in "abc":
+        c.put(k, k)
+    c.get("a")       # a is now most recent
+    c.put("d", "d")  # evicts b
+    assert c.get("a") == "a"
+    assert c.get("b") is None
+
+    c2 = LRUCache(3)
+    for k in "abc":
+        c2.put(k, k)
+    c2.peek("a")      # NO promotion
+    c2.put("d", "d")  # evicts a
+    assert c2.get("a") is None
+
+
+def test_overwrite_updates_value_and_recency():
+    c = LRUCache(2)
+    c.put("a", "1")
+    c.put("b", "2")
+    c.put("a", "9")
+    c.put("c", "3")  # evicts b
+    assert c.get("a") == "9"
+    assert c.get("b") is None
+
+
+def test_contains_or_add():
+    c = LRUCache(2)
+    assert c.contains_or_add("x", "1") is False
+    assert c.contains_or_add("x", "2") is True
+    assert c.get("x") == "1"
+    assert c.contains("x") is True
+
+
+def test_remove_and_keys():
+    c = LRUCache(4)
+    for k in "abcd":
+        c.put(k, k)
+    assert c.remove("b") is True
+    assert c.remove("b") is False
+    c.get("a")  # promote a
+    assert c.keys()[0] == "a"
+    assert set(c.keys()) == {"a", "c", "d"}
+
+
+def test_unicode_and_empty_values():
+    c = LRUCache(2)
+    c.put("Ω", "√∫")
+    c.put("empty", "")
+    assert c.get("Ω") == "√∫"
+    assert c.get("empty") == ""
+
+
+def test_thread_safety_smoke():
+    c = LRUCache(64)
+    errs = []
+
+    def worker(base):
+        try:
+            for i in range(500):
+                c.put(f"k{base}-{i % 100}", str(i))
+                c.get(f"k{base}-{(i * 7) % 100}")
+                len(c)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(b,)) for b in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    assert len(c) <= 64

@@ -1,0 +1,1 @@
+from tpu6824.native.lru import LRUCache  # noqa: F401

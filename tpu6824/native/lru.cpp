@@ -1,0 +1,145 @@
+// Thread-safe fixed-capacity LRU cache — native runtime component.
+//
+// Capability parity with the reference's lru package (groupcache-derived,
+// lru/lru.go:17-186): Put/Get/Peek/Contains/ContainsOrAdd/Remove/Keys/Len,
+// where Get refreshes recency and Peek does not.  The reference implements it
+// in Go with container/list; this is the C++ equivalent (intrusive doubly-
+// linked list + hash map, one mutex per cache) exposed through a C ABI for
+// ctypes.
+//
+// Build: g++ -O2 -shared -fPIC -o liblru6824.so lru.cpp  (driven by lru.py)
+
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Entry {
+  std::string key;
+  std::string val;
+};
+
+struct Cache {
+  explicit Cache(size_t cap) : capacity(cap) {}
+  size_t capacity;
+  std::mutex mu;
+  std::list<Entry> order;  // front = most recent
+  std::unordered_map<std::string, std::list<Entry>::iterator> index;
+
+  void touch(std::list<Entry>::iterator it) { order.splice(order.begin(), order, it); }
+
+  void evict_to_capacity() {
+    while (index.size() > capacity && !order.empty()) {
+      index.erase(order.back().key);
+      order.pop_back();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* lru_new(uint64_t capacity) { return new Cache(capacity ? capacity : 1); }
+
+void lru_free(void* h) { delete static_cast<Cache*>(h); }
+
+// Returns 1 if the put evicted nothing & key was new, 0 if it replaced or
+// evicted (parity with lru.go Put's eviction report).
+int32_t lru_put(void* h, const char* key, int32_t klen, const char* val,
+                int32_t vlen) {
+  auto* c = static_cast<Cache*>(h);
+  std::string k(key, klen), v(val, vlen);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->index.find(k);
+  if (it != c->index.end()) {
+    it->second->val = std::move(v);
+    c->touch(it->second);
+    return 0;
+  }
+  c->order.push_front(Entry{k, std::move(v)});
+  c->index[k] = c->order.begin();
+  size_t before = c->index.size();
+  c->evict_to_capacity();
+  return c->index.size() == before ? 1 : 0;
+}
+
+// Returns value length (and copies min(vlen, buflen) bytes into buf), or -1
+// if absent.  promote != 0 → Get semantics (refresh recency); 0 → Peek.
+int32_t lru_get(void* h, const char* key, int32_t klen, char* buf,
+                int32_t buflen, int32_t promote) {
+  auto* c = static_cast<Cache*>(h);
+  std::string k(key, klen);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->index.find(k);
+  if (it == c->index.end()) return -1;
+  if (promote) c->touch(it->second);
+  const std::string& v = it->second->val;
+  int32_t n = static_cast<int32_t>(v.size());
+  if (buf && buflen > 0) std::memcpy(buf, v.data(), std::min<int32_t>(n, buflen));
+  return n;
+}
+
+int32_t lru_contains(void* h, const char* key, int32_t klen) {
+  auto* c = static_cast<Cache*>(h);
+  std::string k(key, klen);
+  std::lock_guard<std::mutex> g(c->mu);
+  return c->index.count(k) ? 1 : 0;
+}
+
+// Returns 1 if key was already present (no change), else adds and returns 0.
+int32_t lru_contains_or_add(void* h, const char* key, int32_t klen,
+                            const char* val, int32_t vlen) {
+  auto* c = static_cast<Cache*>(h);
+  std::string k(key, klen);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (c->index.count(k)) return 1;
+  c->order.push_front(Entry{k, std::string(val, vlen)});
+  c->index[k] = c->order.begin();
+  c->evict_to_capacity();
+  return 0;
+}
+
+int32_t lru_remove(void* h, const char* key, int32_t klen) {
+  auto* c = static_cast<Cache*>(h);
+  std::string k(key, klen);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->index.find(k);
+  if (it == c->index.end()) return 0;
+  c->order.erase(it->second);
+  c->index.erase(it);
+  return 1;
+}
+
+uint64_t lru_len(void* h) {
+  auto* c = static_cast<Cache*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return c->index.size();
+}
+
+// Copies up to `max` keys (most-recent first) as len-prefixed records into
+// buf; returns bytes written, or the required size if buf is null.
+int64_t lru_keys(void* h, char* buf, int64_t buflen) {
+  auto* c = static_cast<Cache*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  int64_t need = 0;
+  for (const auto& e : c->order) need += 4 + static_cast<int64_t>(e.key.size());
+  if (!buf) return need;
+  if (buflen < need) return -1;
+  char* p = buf;
+  for (const auto& e : c->order) {
+    int32_t n = static_cast<int32_t>(e.key.size());
+    std::memcpy(p, &n, 4);
+    p += 4;
+    std::memcpy(p, e.key.data(), n);
+    p += n;
+  }
+  return need;
+}
+
+}  // extern "C"

@@ -1,0 +1,183 @@
+"""ctypes bindings for the native LRU cache (lru.cpp), with a pure-Python
+fallback when no C++ toolchain is available.
+
+Capability parity with the reference's lru package (`lru/lru.go:17-186`):
+Put/Get/Peek/Contains/ContainsOrAdd/Remove/Keys/Len; Get promotes recency,
+Peek does not.  The shared library is built on first import into
+`<repo>/build/` and cached."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from collections import OrderedDict
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "build")
+_SO = os.path.join(_BUILD, "liblru6824.so")
+_SRC = os.path.join(_HERE, "lru.cpp")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                os.makedirs(_BUILD, exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     "-o", _SO, _SRC],
+                    check=True, capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.CalledProcessError):
+            _lib = False  # toolchain unavailable → python fallback
+            return _lib
+        lib.lru_new.restype = ctypes.c_void_p
+        lib.lru_new.argtypes = [ctypes.c_uint64]
+        lib.lru_free.argtypes = [ctypes.c_void_p]
+        lib.lru_put.restype = ctypes.c_int32
+        lib.lru_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32]
+        lib.lru_get.restype = ctypes.c_int32
+        lib.lru_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int32, ctypes.c_char_p,
+                                ctypes.c_int32, ctypes.c_int32]
+        lib.lru_contains.restype = ctypes.c_int32
+        lib.lru_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+        lib.lru_contains_or_add.restype = ctypes.c_int32
+        lib.lru_contains_or_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int32,
+        ]
+        lib.lru_remove.restype = ctypes.c_int32
+        lib.lru_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+        lib.lru_len.restype = ctypes.c_uint64
+        lib.lru_len.argtypes = [ctypes.c_void_p]
+        lib.lru_keys.restype = ctypes.c_int64
+        lib.lru_keys.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+class LRUCache:
+    """str→str LRU with the reference lru package's API surface."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        lib = _load()
+        if lib:
+            self._h = lib.lru_new(capacity)
+            self._lib = lib
+            self._py = None
+        else:  # pragma: no cover — fallback path
+            self._h = None
+            self._lib = None
+            self._py = OrderedDict()
+            self._mu = threading.Lock()
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None and self._lib is not False
+
+    def __del__(self):
+        if getattr(self, "_lib", None) and self._h:
+            self._lib.lru_free(self._h)
+            self._h = None
+
+    # -------------------------------------------------------------- API
+
+    def put(self, key: str, value: str):
+        if self._py is not None:
+            with self._mu:
+                self._py.pop(key, None)
+                self._py[key] = value
+                while len(self._py) > self.capacity:
+                    self._py.popitem(last=False)
+            return
+        k, v = key.encode(), value.encode()
+        self._lib.lru_put(self._h, k, len(k), v, len(v))
+
+    def _get(self, key: str, promote: int):
+        if self._py is not None:
+            with self._mu:
+                if key not in self._py:
+                    return None
+                v = self._py[key]
+                if promote:
+                    self._py.move_to_end(key)
+                return v
+        k = key.encode()
+        n = self._lib.lru_get(self._h, k, len(k), None, 0, promote)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(n)
+        self._lib.lru_get(self._h, k, len(k), buf, n, 0)
+        return buf.raw[:n].decode()
+
+    def get(self, key: str):
+        """Promotes recency (lru.go Get :92-101)."""
+        return self._get(key, 1)
+
+    def peek(self, key: str):
+        """No recency change (lru.go Peek :104-113)."""
+        return self._get(key, 0)
+
+    def contains(self, key: str) -> bool:
+        if self._py is not None:
+            with self._mu:
+                return key in self._py
+        k = key.encode()
+        return bool(self._lib.lru_contains(self._h, k, len(k)))
+
+    def contains_or_add(self, key: str, value: str) -> bool:
+        """True if already present; else adds (lru.go ContainsOrAdd)."""
+        if self._py is not None:
+            with self._mu:
+                if key in self._py:
+                    return True
+                self._py[key] = value
+                while len(self._py) > self.capacity:
+                    self._py.popitem(last=False)
+                return False
+        k, v = key.encode(), value.encode()
+        return bool(self._lib.lru_contains_or_add(self._h, k, len(k), v, len(v)))
+
+    def remove(self, key: str) -> bool:
+        if self._py is not None:
+            with self._mu:
+                return self._py.pop(key, None) is not None
+        k = key.encode()
+        return bool(self._lib.lru_remove(self._h, k, len(k)))
+
+    def keys(self) -> list[str]:
+        """Most-recent first (lru.go Keys)."""
+        if self._py is not None:
+            with self._mu:
+                return list(reversed(self._py.keys()))
+        need = self._lib.lru_keys(self._h, None, 0)
+        buf = ctypes.create_string_buffer(int(need))
+        wrote = self._lib.lru_keys(self._h, buf, need)
+        out, off = [], 0
+        raw = buf.raw[:wrote]
+        while off < len(raw):
+            n = int.from_bytes(raw[off:off + 4], "little")
+            off += 4
+            out.append(raw[off:off + n].decode())
+            off += n
+        return out
+
+    def __len__(self) -> int:
+        if self._py is not None:
+            with self._mu:
+                return len(self._py)
+        return int(self._lib.lru_len(self._h))
