@@ -107,3 +107,20 @@ def test_thread_safety_smoke():
         t.join()
     assert not errs
     assert len(c) <= 64
+
+
+def test_invalid_capacity_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        LRUCache(0)
+    with pytest.raises(ValueError):
+        LRUCache(-1)
+
+
+def test_large_value_roundtrip():
+    # exercises the grow-and-retry read path (values > the 256B first buffer)
+    c = LRUCache(4)
+    big = "x" * 100_000
+    c.put("big", big)
+    assert c.get("big") == big

@@ -33,11 +33,20 @@ def _load():
                 os.path.getmtime(_SO) < os.path.getmtime(_SRC)
             ):
                 os.makedirs(_BUILD, exist_ok=True)
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     "-o", _SO, _SRC],
-                    check=True, capture_output=True,
-                )
+                # Build to a per-pid temp path, then atomically rename: two
+                # concurrent processes may both compile, but neither can ever
+                # CDLL a half-written library.
+                tmp = f"{_SO}.{os.getpid()}.tmp"
+                try:
+                    subprocess.run(
+                        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                         "-o", tmp, _SRC],
+                        check=True, capture_output=True,
+                    )
+                    os.replace(tmp, _SO)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
             lib = ctypes.CDLL(_SO)
         except (OSError, subprocess.CalledProcessError):
             _lib = False  # toolchain unavailable → python fallback
@@ -73,6 +82,8 @@ class LRUCache:
     """str→str LRU with the reference lru package's API surface."""
 
     def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         lib = _load()
         if lib:
@@ -117,12 +128,18 @@ class LRUCache:
                     self._py.move_to_end(key)
                 return v
         k = key.encode()
-        n = self._lib.lru_get(self._h, k, len(k), None, 0, promote)
-        if n < 0:
-            return None
-        buf = ctypes.create_string_buffer(n)
-        self._lib.lru_get(self._h, k, len(k), buf, n, 0)
-        return buf.raw[:n].decode()
+        # Single locked native call per attempt: lru_get copies min(n, buflen)
+        # bytes and returns the value's true length, so a value that grew
+        # under a concurrent put just triggers a retry — never a torn read.
+        cap = 256
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.lru_get(self._h, k, len(k), buf, cap, promote)
+            if n < 0:
+                return None
+            if n <= cap:
+                return buf.raw[:n].decode()
+            cap = n
 
     def get(self, key: str):
         """Promotes recency (lru.go Get :92-101)."""
@@ -164,9 +181,16 @@ class LRUCache:
         if self._py is not None:
             with self._mu:
                 return list(reversed(self._py.keys()))
-        need = self._lib.lru_keys(self._h, None, 0)
-        buf = ctypes.create_string_buffer(int(need))
-        wrote = self._lib.lru_keys(self._h, buf, need)
+        # lru_keys returns -1 if the cache outgrew the buffer between the
+        # size query and the copy; headroom + retry keeps the read atomic.
+        need = int(self._lib.lru_keys(self._h, None, 0))
+        while True:
+            cap = need + 1024
+            buf = ctypes.create_string_buffer(cap)
+            wrote = int(self._lib.lru_keys(self._h, buf, cap))
+            if wrote >= 0:
+                break
+            need = int(self._lib.lru_keys(self._h, None, 0))
         out, off = [], 0
         raw = buf.raw[:wrote]
         while off < len(raw):

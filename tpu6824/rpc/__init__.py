@@ -1,0 +1,10 @@
+from tpu6824.rpc.transport import (
+    Proxy,
+    Server,
+    call,
+    connect,
+    link_alias,
+    unlink_alias,
+)
+
+__all__ = ["Proxy", "Server", "call", "connect", "link_alias", "unlink_alias"]

@@ -1,0 +1,293 @@
+"""L0 transport — dial-per-call RPC over Unix-domain sockets.
+
+Capability parity with the reference's transport layer: the `call()` helper
+duplicated in every package (`paxos/rpc.go:24-42`, `lockservice/client.go:42-57`,
+…) plus the per-server accept loops that double as the fault-injection point
+(`paxos/paxos.go:524-552`).  Properties the reference's tests depend on, all
+reproduced here:
+
+  - `call()` fails on dial/IO error; "no reply" does NOT mean "not executed" —
+    at-most-once is built ABOVE the transport, never in it
+    (`lockservice/client.go:26-40` spells out the contract).
+  - Server identity is a filesystem pathname, which makes network topology
+    mutable via the filesystem: unlink a server's socket to deafen it
+    (`paxos/test_test.go:194-195`), hard-link per-(src,dst) alias paths to
+    build asymmetric partitions (`paxos/test_test.go:712-751`).
+  - Unreliable mode lives in the accept loop: a fraction of connections is
+    discarded unprocessed, and a further fraction is processed but the reply
+    is discarded by shutting down the write side (`paxos/paxos.go:528-544`,
+    SHUT_WR — the executed-but-unacked case).
+
+Wire format (ours, not the reference's gob): 4-byte big-endian length prefix +
+pickled `(rpcname, args)` request, pickled `(ok, payload)` reply.  The codec is
+host-control-plane only — consensus payloads on the TPU path travel as
+interned int32 ids, never through this socket (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import random
+import socket
+import struct
+import threading
+
+from tpu6824.utils.errors import RPCError
+
+# Reference accept-loop fault rates (paxos/paxos.go:528-544).
+REQ_DROP = 0.10
+REP_DROP = 0.20
+
+_LEN = struct.Struct(">I")
+_MAX_FRAME = 64 << 20
+
+
+def _send_frame(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(data) > _MAX_FRAME:
+        raise RPCError(f"frame too large to send: {len(data)}")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise RPCError("connection closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_FRAME:
+        raise RPCError(f"frame too large: {n}")
+    data = _recv_exact(sock, n)
+    try:
+        return pickle.loads(data)
+    except Exception as e:  # corrupt frame or a non-round-trippable payload
+        raise RPCError(f"undecodable frame: {e!r}") from e
+
+
+def call(addr: str, rpcname: str, *args, timeout: float = 10.0):
+    """Dial `addr`, invoke `rpcname(*args)`, return the result.
+
+    Raises RPCError on any failure — dial error, connection reset, reply
+    discarded by an unreliable server.  Per the transport contract the op may
+    or may not have executed when this raises (`lockservice/client.go:26-40`).
+    Application-level errors raised by the handler are re-raised verbatim.
+    """
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    try:
+        try:
+            sock.connect(addr)
+            _send_frame(sock, (rpcname, args))
+            ok, payload = _recv_frame(sock)
+        except RPCError:
+            raise
+        except OSError as e:
+            raise RPCError(f"call {rpcname}@{addr}: {e}") from e
+        if ok:
+            return payload
+        if isinstance(payload, BaseException):
+            raise payload
+        raise RPCError(f"{rpcname}@{addr}: {payload}")
+    finally:
+        sock.close()
+
+
+class Server:
+    """One RPC endpoint on a Unix socket; the accept loop is the
+    fault-injection point, exactly as in the reference (§ docstring above)."""
+
+    def __init__(self, addr: str, seed: int | None = None):
+        self.addr = addr
+        try:
+            os.unlink(addr)
+        except FileNotFoundError:
+            pass
+        os.makedirs(os.path.dirname(addr) or ".", exist_ok=True)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(addr)
+        self._sock.listen(128)
+        self._handlers: dict[str, callable] = {}
+        self._dead = threading.Event()
+        self._unreliable = False
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.rpc_count = 0  # accepted connections (paxos/paxos.go:539-542)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def register(self, name: str, fn) -> "Server":
+        self._handlers[name] = fn
+        return self
+
+    # Lifecycle / fault-injection methods must never be dialable (Go's
+    # net/rpc excludes them via its method-signature filter; we use an
+    # explicit denylist + opt-in RPC_METHODS).
+    _NEVER_EXPORT = frozenset(
+        {"kill", "start", "stop", "deafen", "revive",
+         "set_unreliable", "die_after_next_deaf"}
+    )
+
+    def register_obj(self, obj, methods: list[str] | None = None) -> "Server":
+        """Expose an object's methods as RPCs (the net/rpc
+        `rpcs.Register(px)` pattern, `paxos/paxos.go:496-516`).  Precedence:
+        explicit `methods` > the object's `RPC_METHODS` attribute > all
+        public callables minus the lifecycle denylist."""
+        names = methods or getattr(obj, "RPC_METHODS", None) or [
+            m for m in dir(obj)
+            if not m.startswith("_")
+            and m not in self._NEVER_EXPORT
+            and callable(getattr(obj, m))
+        ]
+        for m in names:
+            self._handlers[m] = getattr(obj, m)
+        return self
+
+    def start(self) -> "Server":
+        self._thread.start()
+        return self
+
+    def kill(self) -> None:
+        """Clean shutdown: atomic dead flag + close listener
+        (`paxos/paxos.go:456-461`)."""
+        self._dead.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.addr)
+        except FileNotFoundError:
+            pass
+
+    # ------------------------------------------------------- fault injection
+
+    def set_unreliable(self, flag: bool) -> None:
+        with self._lock:
+            self._unreliable = flag
+
+    def deafen(self) -> None:
+        """Remove the socket path out from under the live server: existing
+        inode keeps listening but nobody can dial it
+        (`paxos/test_test.go:194-195`)."""
+        try:
+            os.unlink(self.addr)
+        except FileNotFoundError:
+            pass
+
+    # ------------------------------------------------------------- serving
+
+    def _accept_loop(self) -> None:
+        while not self._dead.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                if not self._dead.is_set():
+                    # Fail-stop, not zombie: without this, the listener's
+                    # backlog keeps accepting connects that then hang until
+                    # the client timeout.
+                    self.kill()
+                return
+            if self._dead.is_set():
+                conn.close()
+                return
+            with self._lock:
+                self.rpc_count += 1
+                unrel = self._unreliable
+                r1 = self._rng.random()
+                r2 = self._rng.random()
+            if unrel and r1 < REQ_DROP:
+                conn.close()  # discard unprocessed (op NOT executed)
+                continue
+            discard_reply = unrel and r2 < REP_DROP
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn, discard_reply), daemon=True
+            )
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket, discard_reply: bool) -> None:
+        try:
+            conn.settimeout(30.0)
+            rpcname, args = _recv_frame(conn)
+            fn = self._handlers.get(rpcname)
+            if fn is None:
+                reply = (False, f"no such rpc: {rpcname}")
+            else:
+                try:
+                    reply = (True, fn(*args))
+                except RPCError:
+                    raise
+                except Exception as e:  # app-level error travels to the caller
+                    reply = (False, e)
+            if discard_reply:
+                # Processed, but the client sees a dead connection — the
+                # SHUT_WR trick (paxos/paxos.go:535-538).
+                conn.shutdown(socket.SHUT_WR)
+            else:
+                try:
+                    _send_frame(conn, reply)
+                except OSError:
+                    raise  # peer gone / stream broken — nothing to salvage
+                except Exception as e:
+                    # Unpicklable or oversized reply: dumps/size-check fail
+                    # before any bytes move, so the stream is still clean —
+                    # degrade to a string error instead of a silent hang.
+                    _send_frame(
+                        conn, (False, f"unserializable reply ({e!r:.100}): "
+                                      f"{reply[1]!r:.200}")
+                    )
+        except (RPCError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+def link_alias(real: str, alias: str) -> None:
+    """Create `alias` → `real` so dialing the alias reaches the server.  The
+    reference hard-links per-(src,dst) socket paths to build asymmetric
+    partitions and re-points them live (`paxos/test_test.go:712-751`)."""
+    try:
+        os.unlink(alias)
+    except FileNotFoundError:
+        pass
+    try:
+        os.link(real, alias)
+    except OSError:
+        os.symlink(real, alias)
+
+
+def unlink_alias(alias: str) -> None:
+    try:
+        os.unlink(alias)
+    except FileNotFoundError:
+        pass
+
+
+class Proxy:
+    """Make a remote server usable where clerks expect a server object:
+    `proxy.method(*args)` → `call(addr, "method", *args)`.  RPCError
+    propagates, which is exactly the failure clerks already handle."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self._addr = addr
+        self._timeout = timeout
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def rpc(*args):
+            return call(self._addr, name, *args, timeout=self._timeout)
+
+        return rpc
+
+
+def connect(addr: str, timeout: float = 10.0) -> Proxy:
+    return Proxy(addr, timeout)
