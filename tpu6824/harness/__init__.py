@@ -1,0 +1,3 @@
+from tpu6824.harness.cluster import Deployment, make_sockdir
+
+__all__ = ["Deployment", "make_sockdir"]

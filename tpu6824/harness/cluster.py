@@ -1,0 +1,91 @@
+"""Cluster harness — place service objects behind real Unix sockets.
+
+This is the equivalent of the reference suites' fixture layer: the `port()`
+naming scheme (`/var/tmp/824-<uid>/<svc>-<pid>-<tag>-<i>`,
+`paxos/test_test.go:21-30`), per-server accept loops, and the filesystem
+surgery hooks.  A `Deployment` owns one rpc.Server per service object and
+hands out `Proxy` handles; because clerks and servers reach peers through
+`net.call(obj, obj.method, ...)` and catch RPCError, a Proxy drops in
+anywhere an in-process server object is expected — same service code runs
+in-process or over the wire.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+
+from tpu6824.rpc import Proxy, Server, connect
+
+
+def make_sockdir(tag: str = "") -> str:
+    """Short, unique socket dir (AF_UNIX caps sun_path at ~108 bytes)."""
+    d = os.path.join(
+        f"/var/tmp/tpu824-{os.getuid()}",
+        (tag + "-" if tag else "") + uuid.uuid4().hex[:8],
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class Deployment:
+    """A set of named services behind sockets, with harness fault hooks."""
+
+    def __init__(self, tag: str = "", timeout: float = 10.0):
+        self.dir = make_sockdir(tag)
+        self.timeout = timeout
+        self._servers: dict[str, Server] = {}
+        self._objs: dict[str, object] = {}
+
+    def addr(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def serve(self, name: str, obj, methods: list[str] | None = None,
+              seed: int | None = None) -> Proxy:
+        """Expose `obj` at a socket; returns a Proxy to it."""
+        srv = Server(self.addr(name), seed=seed).register_obj(obj, methods)
+        srv.start()
+        self._servers[name] = srv
+        self._objs[name] = obj
+        return self.proxy(name)
+
+    def proxy(self, name: str) -> Proxy:
+        return connect(self.addr(name), timeout=self.timeout)
+
+    def obj(self, name: str):
+        return self._objs[name]
+
+    def server(self, name: str) -> Server:
+        return self._servers[name]
+
+    # ------------------------------------------------------- fault hooks
+
+    def set_unreliable(self, name: str, flag: bool) -> None:
+        self._servers[name].set_unreliable(flag)
+
+    def deafen(self, name: str) -> None:
+        self._servers[name].deafen()
+
+    def kill(self, name: str) -> None:
+        """Socket teardown + object kill() if it has one."""
+        srv = self._servers.pop(name, None)
+        if srv:
+            srv.kill()
+        obj = self._objs.pop(name, None)
+        if obj is not None and hasattr(obj, "kill"):
+            obj.kill()
+
+    def rpc_count(self, name: str) -> int:
+        return self._servers[name].rpc_count
+
+    def shutdown(self) -> None:
+        for name in list(self._servers):
+            self.kill(name)
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def __enter__(self) -> "Deployment":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

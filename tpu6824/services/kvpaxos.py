@@ -38,6 +38,8 @@ class Op(NamedTuple):
 
 
 class KVPaxosServer:
+    RPC_METHODS = ["get", "put_append"]  # wire surface (rpc.Server)
+
     def __init__(self, fabric: PaxosFabric, g: int, me: int, op_timeout: float = 8.0):
         self.px = PaxosPeer(fabric, g, me)
         self.me = me

@@ -24,6 +24,8 @@ from tpu6824.utils.errors import RPCError
 
 
 class LockServer:
+    RPC_METHODS = ["lock", "unlock"]  # wire surface (rpc.Server)
+
     def __init__(self, am_primary: bool, backup: "LockServer | None" = None):
         self.am_primary = am_primary
         self.backup = backup

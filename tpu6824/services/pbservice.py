@@ -31,6 +31,9 @@ from tpu6824.utils.errors import (
 
 
 class PBServer:
+    RPC_METHODS = ["get", "put_append", "backup_get", "backup_put_append",
+                   "init_state"]  # wire surface (rpc.Server)
+
     def __init__(self, me: str, vs: viewservice.ViewServer, net: FlakyNet,
                  directory: dict, tick_interval: float | None = None):
         self.me = me

@@ -67,6 +67,8 @@ class XState(NamedTuple):
 
 
 class ShardKVServer:
+    RPC_METHODS = ["get", "put_append", "transfer_state"]  # wire surface
+
     def __init__(
         self,
         fabric: PaxosFabric,

@@ -51,6 +51,8 @@ class Op(NamedTuple):
 
 
 class ShardMasterServer:
+    RPC_METHODS = ["join", "leave", "move", "query"]  # wire surface (rpc.Server)
+
     def __init__(self, fabric: PaxosFabric, g: int, me: int, op_timeout: float = 8.0):
         self.px = PaxosPeer(fabric, g, me)
         self.me = me

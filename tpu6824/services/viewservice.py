@@ -32,6 +32,8 @@ class View(NamedTuple):
 
 
 class ViewServer:
+    RPC_METHODS = ["ping", "get", "get_rpccount"]  # wire surface (rpc.Server)
+
     def __init__(self, ping_interval: float = PING_INTERVAL):
         self.mu = threading.Lock()
         self.view = View(0, "", "")
