@@ -30,3 +30,9 @@ jax.config.update("jax_platforms", "cpu")
 # SIGILL risk when reloaded).
 
 assert jax.devices()[0].platform == "cpu"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running integration tests"
+    )

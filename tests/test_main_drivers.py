@@ -1,0 +1,140 @@
+"""L4 driver tests — the `main/` CLI surface (wc, toy-rpc, daemons/clients),
+mirroring the reference's `main/wc.go`, `main/toy-rpc.go`, `main/lockd|lockc`,
+`main/viewd|pbd|pbc` and the golden-output check of `main/test-wc.sh`."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu6824.harness import make_sockdir
+
+ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+)
+
+CORPUS = """the quick brown fox jumps over the lazy dog
+the dog barks and the fox runs
+a quick dog and a lazy fox
+"""
+# Hand-counted golden (words are runs of letters):
+GOLDEN = {
+    "the": 4, "dog": 3, "fox": 3, "a": 2, "and": 2, "quick": 2, "lazy": 2,
+    "brown": 1, "jumps": 1, "over": 1, "barks": 1, "runs": 1,
+}
+
+
+def run_cli(mod, *args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", mod, *args],
+        env=ENV, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def spawn(mod, *args):
+    return subprocess.Popen(
+        [sys.executable, "-m", mod, *args],
+        env=ENV, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+def wait_socket(addr, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(addr):
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"socket {addr} never appeared")
+
+
+@pytest.mark.parametrize("mode", ["sequential", "master"])
+def test_wc_cli_golden(tmp_path, mode):
+    """Both execution modes produce identical, correct, key-sorted counts
+    (the mr-testout.txt golden-check shape, main/test-wc.sh:1-10)."""
+    f = tmp_path / "corpus.txt"
+    f.write_text(CORPUS)
+    r = run_cli("tpu6824.main.wc", mode, str(f), "--nmap", "3", "--nreduce", "2")
+    assert r.returncode == 0, r.stderr
+    got = {}
+    for line in r.stdout.splitlines():
+        k, v = line.rsplit(" ", 1)
+        got[k] = int(v)
+    assert got == GOLDEN
+    keys = [line.rsplit(" ", 1)[0] for line in r.stdout.splitlines()]
+    assert keys == sorted(keys), "merge output must be key-sorted"
+
+
+def test_wc_cli_top(tmp_path):
+    f = tmp_path / "corpus.txt"
+    f.write_text(CORPUS)
+    r = run_cli("tpu6824.main.wc", "sequential", str(f), "--top", "3")
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert len(lines) == 3
+    assert lines[-1] == "the: 4"  # most frequent last, test-wc.sh shape
+
+
+def test_toy_rpc_demo():
+    r = run_cli("tpu6824.main.toy_rpc")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "toy_rpc demo OK" in r.stdout
+    assert "returned before slow_echo" in r.stdout
+
+
+@pytest.mark.slow
+def test_lockd_lockc_processes():
+    d = make_sockdir("lockd")
+    lp, lb = os.path.join(d, "lp"), os.path.join(d, "lb")
+    procs = [spawn("tpu6824.main.lockd", "--addr", lb, "--ttl", "60")]
+    wait_socket(lb)
+    procs.append(spawn("tpu6824.main.lockd", "--addr", lp, "--primary",
+                       "--backup-addr", lb, "--ttl", "60"))
+    wait_socket(lp)
+    try:
+        base = ["--primary", lp, "--backup", lb]
+        assert run_cli("tpu6824.main.lockc", *base, "lock", "a").stdout.strip() == "true"
+        assert run_cli("tpu6824.main.lockc", *base, "lock", "a").stdout.strip() == "false"
+        assert run_cli("tpu6824.main.lockc", *base, "unlock", "a").stdout.strip() == "true"
+        # Kill the primary: the clerk CLI fails over to the backup, which
+        # learned the lock state through forwarding.
+        assert run_cli("tpu6824.main.lockc", *base, "lock", "b").stdout.strip() == "true"
+        procs[1].kill()
+        procs[1].wait()
+        assert run_cli("tpu6824.main.lockc", *base, "lock", "b").stdout.strip() == "false"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+@pytest.mark.slow
+def test_viewd_pbd_pbc_processes():
+    d = make_sockdir("pbd")
+    vs = os.path.join(d, "vs")
+    pb = {n: os.path.join(d, n) for n in ("pb1", "pb2")}
+    procs = [spawn("tpu6824.main.viewd", "--addr", vs, "--ttl", "120")]
+    wait_socket(vs)
+    for n in pb:
+        peers = [x for m, x in (("pb1", pb["pb1"]), ("pb2", pb["pb2"]))]
+        args = ["--addr", pb[n], "--name", n, "--vs", vs, "--ttl", "120"]
+        for m, a in pb.items():
+            args += ["--peer", f"{m}={a}"]
+        procs.append(spawn("tpu6824.main.pbd", *args))
+    for a in pb.values():
+        wait_socket(a)
+    try:
+        base = ["--vs", vs] + [x for m, a in pb.items() for x in ("--peer", f"{m}={a}")]
+        r = run_cli("tpu6824.main.pbc", *base, "--timeout", "30", "put", "k", "hello")
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = run_cli("tpu6824.main.pbc", *base, "--timeout", "30", "append", "k", "+world")
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = run_cli("tpu6824.main.pbc", *base, "--timeout", "30", "get", "k")
+        assert r.stdout.strip() == "hello+world"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

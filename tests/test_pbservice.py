@@ -33,6 +33,14 @@ class PBSystem:
         assert ok, self.vs.get()
         return self.vs.get()
 
+    def wait_acked(self, timeout=5.0):
+        """Killing a primary that never acked its view wedges the FSM (by
+        design, viewservice/server.go:90-95); the reference tests sleep
+        DeadPings*PingInterval before kills for the same reason."""
+        ok = wait_until(lambda: self.vs.acked, timeout)
+        assert ok, self.vs.get()
+        return self.vs.get()
+
     def restart(self, name):
         """Crash + reboot: a brand-new empty server under the same name."""
         srv = self.servers.pop(name, None)
@@ -67,7 +75,7 @@ def test_basic_ops(sys3):
 def test_failover_keeps_data(sys3):
     ck = sys3.clerk()
     ck.put("k", "before", timeout=10.0)
-    old = sys3.vs.get()
+    old = sys3.wait_acked()
     sys3.servers[old.primary].kill()
     del sys3.servers[old.primary]
     sys3.wait_view(lambda v: v.primary == old.backup)
@@ -82,21 +90,21 @@ def test_restarted_primary_rejoins_empty_then_recovers(sys3):
     by state transfer — must serve the full data."""
     ck = sys3.clerk()
     ck.put("k", "v1", timeout=10.0)
-    old = sys3.vs.get()
+    old = sys3.wait_acked()
     sys3.restart(old.primary)
     sys3.wait_view(lambda v: v.primary == old.backup)
     assert ck.get("k", timeout=10.0) == "v1"
     ck.append("k", "v2", timeout=10.0)
     # Kill the new primary: the third server takes over; the rebooted one
     # becomes its backup and receives a state transfer.
-    cur = sys3.vs.get()
+    cur = sys3.wait_acked()
     sys3.servers[cur.primary].kill()
     del sys3.servers[cur.primary]
     sys3.wait_view(lambda v: v.primary not in ("", cur.primary)
                    and v.backup == old.primary, timeout=10.0)
     assert ck.get("k", timeout=10.0) == "v1v2"  # forces backup co-sign
     # Kill that primary too: only the rebooted server remains.
-    cur2 = sys3.vs.get()
+    cur2 = sys3.wait_acked()
     sys3.servers[cur2.primary].kill()
     del sys3.servers[cur2.primary]
     sys3.wait_view(lambda v: v.primary == old.primary)

@@ -1,0 +1,41 @@
+"""Fabric service — the device-owning runtime process, served over RPC.
+
+This is the control-plane split of SURVEY §2.3 made concrete: exactly one
+process owns the TPU arrays and the step clock (`PaxosFabric`); every other
+process — replica daemons, clerks, the test harness — drives it through the
+`Make/Start/Status/Done/Min/Max` contract over the L0 socket transport.  The
+reference instead gives every server process its own Paxos peer and a socket
+listener (`paxos/paxos.go:488-557`); here peers are (group, index) lanes of
+one batched device kernel, so "a server process" holds coordinates, not state.
+
+Wire surface = the fabric's public API plus the harness fault hooks (the
+filesystem/socket surgery of `paxos/test_test.go` maps to `partition/deafen/
+set_unreliable/kill/revive` on the serving side).
+"""
+
+from __future__ import annotations
+
+from tpu6824.core.fabric import PaxosFabric
+from tpu6824.rpc import Proxy, Server, connect
+
+FABRIC_RPCS = [
+    # paxos contract (per peer-lane)
+    "start", "status", "done", "peer_min", "peer_max",
+    # harness / fault injection
+    "ndecided", "set_unreliable", "partition", "heal", "deafen",
+    "set_link", "kill", "revive", "is_dead",
+    # introspection
+    "dims",
+]
+
+
+def serve_fabric(fabric: PaxosFabric, addr: str, seed: int | None = None) -> Server:
+    # `dims` lets remote processes size make_group()-style loops.
+    fabric.dims = lambda: (fabric.G, fabric.I, fabric.P)
+    return Server(addr, seed=seed).register_obj(fabric, FABRIC_RPCS).start()
+
+
+def remote_fabric(addr: str, timeout: float = 30.0) -> Proxy:
+    """A PaxosFabric-shaped handle over the wire; drop-in for PaxosPeer and
+    the services (same method names, RPCError on transport failure)."""
+    return connect(addr, timeout=timeout)

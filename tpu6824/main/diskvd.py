@@ -1,0 +1,61 @@
+"""diskvd — one persistent shardkv (diskv) replica as a daemon.
+
+The process-granular deployment the reference tests demand for Lab 5: the
+harness compiles and `os.StartProcess`es a real daemon per replica so a kill
+is a REAL crash and a removed directory is REAL disk loss
+(`diskv/test_test.go:62-233`, `main/diskvd.go:30-74`).
+
+    python -m tpu6824.main.diskvd --addr .../g500-0 --fabric .../fabric \
+        --fg 1 --gid 500 --me 0 --sm .../sm0 --sm .../sm1 \
+        --peer g500-1=.../g500-1 --peer g500-2=.../g500-2 \
+        --dir /data/g500-0 [--restart] [--ttl 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="diskvd")
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--fabric", required=True)
+    ap.add_argument("--fg", type=int, required=True, help="fabric group lane")
+    ap.add_argument("--gid", type=int, required=True)
+    ap.add_argument("--me", type=int, required=True)
+    ap.add_argument("--sm", action="append", required=True,
+                    help="shardmaster replica addr (repeat)")
+    ap.add_argument("--peer", action="append", default=[],
+                    help="name=addr of a peer replica (repeat)")
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--restart", action="store_true")
+    ap.add_argument("--ttl", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    from tpu6824.core.fabric_service import remote_fabric
+    from tpu6824.rpc import Server, connect
+    from tpu6824.services.diskv import DisKVServer
+
+    directory = {}
+    for spec in args.peer:
+        name, _, addr = spec.partition("=")
+        directory[name] = connect(addr)
+    sm_proxies = [connect(a) for a in args.sm]
+
+    kv = DisKVServer(
+        remote_fabric(args.fabric), args.fg, args.gid, args.me,
+        sm_proxies, directory, dir=args.dir, restart=args.restart,
+    )
+    srv = Server(args.addr).register_obj(kv).start()
+    print(f"diskvd: g{args.gid}-{args.me} at {args.addr} "
+          f"(dir={args.dir}, restart={args.restart})", flush=True)
+    try:
+        time.sleep(args.ttl)
+    finally:
+        kv.dead = True
+        srv.kill()
+
+
+if __name__ == "__main__":
+    main()

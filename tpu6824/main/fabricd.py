@@ -1,0 +1,48 @@
+"""fabricd — run the device-owning fabric runtime as a daemon.
+
+The TPU-native analog of the reference's per-process Paxos listeners
+(`paxos/paxos.go:488-557`): one process owns the (G, I, P) consensus arrays
+and the step clock; replica daemons (shardmasterd, diskvd) dial in.
+
+    python -m tpu6824.main.fabricd --addr /var/tmp/.../fabric \
+        --groups 3 --peers 3 --instances 32 [--ttl 600]
+
+`--ttl` is the suicide timer the reference's diskvd daemon carries so
+orphaned test processes die on their own (`main/diskvd.go:64-74`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="fabricd")
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--groups", type=int, default=1)
+    ap.add_argument("--peers", type=int, default=3)
+    ap.add_argument("--instances", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ttl", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    from tpu6824.core.fabric import PaxosFabric
+    from tpu6824.core.fabric_service import serve_fabric
+
+    fabric = PaxosFabric(
+        ngroups=args.groups, npeers=args.peers, ninstances=args.instances,
+        seed=args.seed, auto_step=True,
+    )
+    srv = serve_fabric(fabric, args.addr)
+    print(f"fabricd: serving (G={args.groups}, I={args.instances}, "
+          f"P={args.peers}) at {args.addr}", flush=True)
+    try:
+        time.sleep(args.ttl)
+    finally:
+        srv.kill()
+        fabric.stop_clock()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""lockc — lock client CLI (the reference's `main/lockc.go`).
+
+    python -m tpu6824.main.lockc --primary .../lp --backup .../lb lock name
+    python -m tpu6824.main.lockc --primary .../lp --backup .../lb unlock name
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="lockc")
+    ap.add_argument("--primary", required=True)
+    ap.add_argument("--backup", required=True)
+    ap.add_argument("op", choices=["lock", "unlock"])
+    ap.add_argument("name")
+    args = ap.parse_args(argv)
+
+    from tpu6824.rpc import connect
+    from tpu6824.services.lockservice import Clerk
+
+    ck = Clerk(connect(args.primary), connect(args.backup))
+    ok = ck.lock(args.name) if args.op == "lock" else ck.unlock(args.name)
+    print("true" if ok else "false")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

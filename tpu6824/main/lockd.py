@@ -1,0 +1,40 @@
+"""lockd — lock server daemon (the reference's `main/lockd.go`).
+
+Primary mode forwards to the backup:
+
+    python -m tpu6824.main.lockd --addr .../lp --primary --backup-addr .../lb
+    python -m tpu6824.main.lockd --addr .../lb
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="lockd")
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--primary", action="store_true")
+    ap.add_argument("--backup-addr", default="",
+                    help="backup's socket (primary mode only)")
+    ap.add_argument("--ttl", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    from tpu6824.rpc import Server, connect
+    from tpu6824.services.lockservice import LockServer
+
+    backup = connect(args.backup_addr) if args.backup_addr else None
+    ls = LockServer(am_primary=args.primary, backup=backup)
+    srv = Server(args.addr).register_obj(ls).start()
+    role = "primary" if args.primary else "backup"
+    print(f"lockd: {role} at {args.addr}", flush=True)
+    try:
+        time.sleep(args.ttl)
+    finally:
+        ls.kill()
+        srv.kill()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""pbc — primary/backup KV client CLI (the reference's `main/pbc.go`).
+
+    python -m tpu6824.main.pbc --vs .../vs --peer pb1=.../pb1 --peer pb2=.../pb2 \
+        get k
+    ... put k v   |   ... append k v
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="pbc")
+    ap.add_argument("--vs", required=True)
+    ap.add_argument("--peer", action="append", default=[],
+                    help="name=addr of a pb server (repeat)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("op", choices=["get", "put", "append"])
+    ap.add_argument("key")
+    ap.add_argument("value", nargs="?", default="")
+    args = ap.parse_args(argv)
+
+    from tpu6824.rpc import connect
+    from tpu6824.services.pbservice import Clerk
+
+    directory = {}
+    for spec in args.peer:
+        name, _, addr = spec.partition("=")
+        directory[name] = connect(addr)
+    ck = Clerk(connect(args.vs), directory)
+    if args.op == "get":
+        print(ck.get(args.key, timeout=args.timeout))
+    elif args.op == "put":
+        ck.put(args.key, args.value, timeout=args.timeout)
+    else:
+        ck.append(args.key, args.value, timeout=args.timeout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""pbd — primary/backup KV server daemon (the reference's `main/pbd.go`).
+
+    python -m tpu6824.main.pbd --addr /var/tmp/.../pb1 --name pb1 \
+        --vs /var/tmp/.../vs --peer pb2=/var/tmp/.../pb2 [--ttl 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="pbd")
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--name", required=True,
+                    help="this server's identity in the view (directory key)")
+    ap.add_argument("--vs", required=True, help="viewservice addr")
+    ap.add_argument("--peer", action="append", default=[],
+                    help="name=addr of a peer pb server (repeat)")
+    ap.add_argument("--ttl", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    from tpu6824.rpc import Server, connect
+    from tpu6824.services.common import FlakyNet
+    from tpu6824.services.pbservice import PBServer
+
+    directory = {}
+    for spec in args.peer:
+        name, _, addr = spec.partition("=")
+        directory[name] = connect(addr)
+    pb = PBServer(args.name, connect(args.vs), FlakyNet(), directory)
+    srv = Server(args.addr).register_obj(pb).start()
+    print(f"pbd: {args.name} at {args.addr}", flush=True)
+    try:
+        time.sleep(args.ttl)
+    finally:
+        pb.kill()
+        srv.kill()
+
+
+if __name__ == "__main__":
+    main()

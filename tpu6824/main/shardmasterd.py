@@ -1,0 +1,37 @@
+"""shardmasterd — one shardmaster replica as a daemon.
+
+    python -m tpu6824.main.shardmasterd --addr /var/tmp/.../sm0 \
+        --fabric /var/tmp/.../fabric --g 0 --me 0 [--ttl 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="shardmasterd")
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--fabric", required=True)
+    ap.add_argument("--g", type=int, default=0, help="fabric group lane")
+    ap.add_argument("--me", type=int, required=True)
+    ap.add_argument("--ttl", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    from tpu6824.core.fabric_service import remote_fabric
+    from tpu6824.rpc import Server
+    from tpu6824.services.shardmaster import ShardMasterServer
+
+    sm = ShardMasterServer(remote_fabric(args.fabric), args.g, args.me)
+    srv = Server(args.addr).register_obj(sm).start()
+    print(f"shardmasterd: replica {args.me} at {args.addr}", flush=True)
+    try:
+        time.sleep(args.ttl)
+    finally:
+        sm.kill()
+        srv.kill()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""toy_rpc — a minimal RPC library in ~100 lines, for pedagogy.
+
+The capability mirror of the reference's `main/toy-rpc.go:12-160`: a client
+multiplexes concurrent calls over ONE duplex byte stream by tagging each
+request with a transaction id (xid) and matching replies back to the waiting
+caller; the server handles requests concurrently so replies can return out of
+order.  Demonstrates the core idea under every `call()` in the framework.
+
+Run the demo:  python -m tpu6824.main.toy_rpc
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import socket
+import struct
+import threading
+
+_LEN = struct.Struct(">I")
+
+
+def _send(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv(sock):
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            raise EOFError
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class ToyServer:
+    """Serves one connection; each request handled in its own thread so a
+    slow call does not block later ones (toy-rpc.go's per-request goroutine)."""
+
+    def __init__(self, sock, handlers: dict):
+        self.sock = sock
+        self.handlers = handlers
+        self._wlock = threading.Lock()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        try:
+            while True:
+                xid, name, args = _recv(self.sock)
+                threading.Thread(
+                    target=self._handle, args=(xid, name, args), daemon=True
+                ).start()
+        except (EOFError, OSError):
+            pass
+
+    def _handle(self, xid, name, args):
+        try:
+            result = (True, self.handlers[name](*args))
+        except Exception as e:
+            result = (False, str(e))
+        with self._wlock:
+            try:
+                _send(self.sock, (xid, result))
+            except OSError:
+                pass
+
+
+class ToyClient:
+    """xid-matching client: concurrent call() from many threads over one
+    stream; a reader thread routes each reply to its waiting caller."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self._wlock = threading.Lock()
+        self._xids = itertools.count(1)
+        self._pending: dict[int, list] = {}
+        self._mu = threading.Lock()
+        threading.Thread(target=self._reader, daemon=True).start()
+
+    def _reader(self):
+        try:
+            while True:
+                xid, result = _recv(self.sock)
+                with self._mu:
+                    slot = self._pending.get(xid)
+                if slot is not None:
+                    slot[1] = result
+                    slot[0].set()
+        except (EOFError, OSError):
+            pass
+
+    def call(self, name, *args, timeout=10.0):
+        xid = next(self._xids)
+        slot = [threading.Event(), None]
+        with self._mu:
+            self._pending[xid] = slot
+        with self._wlock:
+            _send(self.sock, (xid, name, args))
+        if not slot[0].wait(timeout):
+            raise TimeoutError(f"toy rpc {name} timed out")
+        with self._mu:
+            del self._pending[xid]
+        ok, payload = slot[1]
+        if not ok:
+            raise RuntimeError(payload)
+        return payload
+
+
+def demo():
+    import time
+
+    a, b = socket.socketpair()
+    ToyServer(b, {
+        "add": lambda x, y: x + y,
+        "slow_echo": lambda s: (time.sleep(0.2), s)[1],
+    })
+    cli = ToyClient(a)
+
+    results = {}
+    # Out-of-order completion: the slow call is issued first, finishes last.
+    t = threading.Thread(target=lambda: results.update(slow=cli.call("slow_echo", "tortoise")))
+    t.start()
+    results["fast"] = cli.call("add", 2, 3)
+    t.join()
+    print(f"add(2,3) = {results['fast']}  (returned before slow_echo)")
+    print(f"slow_echo = {results['slow']!r}")
+    assert results == {"fast": 5, "slow": "tortoise"}
+    print("toy_rpc demo OK")
+
+
+if __name__ == "__main__":
+    demo()

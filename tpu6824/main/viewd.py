@@ -1,0 +1,32 @@
+"""viewd — viewservice daemon (the reference's `main/viewd.go`).
+
+    python -m tpu6824.main.viewd --addr /var/tmp/.../vs [--ttl 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="viewd")
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--ttl", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    from tpu6824.rpc import Server
+    from tpu6824.services.viewservice import ViewServer
+
+    vs = ViewServer()
+    srv = Server(args.addr).register_obj(vs).start()
+    print(f"viewd: serving at {args.addr}", flush=True)
+    try:
+        time.sleep(args.ttl)
+    finally:
+        vs.kill()
+        srv.kill()
+
+
+if __name__ == "__main__":
+    main()

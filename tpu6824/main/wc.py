@@ -1,0 +1,59 @@
+"""wc — word-count MapReduce application (the reference's `main/wc.go`).
+
+Words are maximal runs of letters; counts are merged across map tasks and the
+final output is key-sorted.  `--top N` prints the N most frequent words in
+`word: count` form — the shape `main/test-wc.sh` checks against its golden
+top-10 (`main/mr-testout.txt`); the corpus itself (`main/kjv12.txt`) is not
+shipped in the reference fork either.
+
+    python -m tpu6824.main.wc sequential <file> [--nmap 4] [--nreduce 3]
+    python -m tpu6824.main.wc master <file> [--workers 3] [--top 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def run(mode: str, text: str, nmap: int, nreduce: int, nworkers: int):
+    from tpu6824.services.mapreduce import (
+        run_distributed,
+        run_sequential,
+        wc_map,
+        wc_reduce,
+    )
+
+    if mode == "sequential":
+        return run_sequential(text, nmap, nreduce, wc_map, wc_reduce)
+    return run_distributed(text, nmap, nreduce, wc_map, wc_reduce,
+                           nworkers=nworkers)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="wc")
+    ap.add_argument("mode", choices=["sequential", "master"])
+    ap.add_argument("file")
+    ap.add_argument("--nmap", type=int, default=4)
+    ap.add_argument("--nreduce", type=int, default=3)
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--top", type=int, default=0,
+                    help="print only the N most frequent words")
+    args = ap.parse_args(argv)
+
+    with open(args.file, encoding="utf-8") as f:
+        text = f.read()
+    counts = run(args.mode, text, args.nmap, args.nreduce, args.workers)
+    if args.top:
+        # test-wc.sh shape: sort by count (stable on key), take the top N.
+        top = sorted(counts, key=lambda kv: (int(kv[1]), kv[0]))[-args.top:]
+        for k, v in top:
+            print(f"{k}: {v}")
+    else:
+        for k, v in counts:
+            print(f"{k} {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,6 @@ accept-loop rates (`paxos/paxos.go:528-544`)."""
 
 from __future__ import annotations
 
-import itertools
 import random
 import threading
 
@@ -19,14 +18,15 @@ from tpu6824.utils.errors import RPCError
 REQ_DROP = 0.10
 REP_DROP = 0.20
 
-_cid_counter = itertools.count(1)
-_cid_lock = threading.Lock()
+_sysrand = random.SystemRandom()
 
 
 def fresh_cid() -> int:
-    """Unique client id (the reference uses nrand(), 62-bit random)."""
-    with _cid_lock:
-        return next(_cid_counter)
+    """Unique client id — 62-bit random, exactly the reference's nrand()
+    (`kvpaxos/client.go` et al).  Must NOT be a per-process counter: clerks
+    in different OS processes would collide (cid=1, 2, ...) and each other's
+    ops would be swallowed by the servers' duplicate filters."""
+    return _sysrand.getrandbits(62)
 
 
 class FlakyNet:

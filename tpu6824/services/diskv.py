@@ -56,6 +56,9 @@ def _atomic_write(path: str, data: bytes):
 
 
 class DisKVServer(ShardKVServer):
+    RPC_METHODS = ["get", "put_append", "transfer_state", "full_snapshot",
+                   "disk_bytes"]  # wire surface (rpc.Server)
+
     def __init__(self, fabric, fg, gid, me, sm_clerk_servers, directory,
                  dir: str, restart: bool = False, **kw):
         self.dir = dir
@@ -145,12 +148,15 @@ class DisKVServer(ShardKVServer):
 
     def _snapshot_from_peer(self) -> bool:
         """Full-state recovery from a live replica of this group (the rejoin
-        path the reference's Test5RejoinMix scenarios demand)."""
+        path the reference's Test5RejoinMix scenarios demand).  Peers are
+        selected by directory NAME (g<gid>-<p>), not object attributes, so
+        entries may be in-process servers or socket proxies alike."""
+        prefix = f"g{self.gid}-"
         for name, srv in list(self.directory.items()):
-            if srv is self or getattr(srv, "gid", None) != self.gid or srv.dead:
+            if name == self.name or not name.startswith(prefix):
                 continue
             try:
-                snap = srv.full_snapshot(min_applied=self.applied + 1)
+                snap = srv.full_snapshot(self.applied + 1)
             except RPCError:
                 continue
             if snap is None:

@@ -55,8 +55,10 @@ class LockServer:
                 raise RPCError("dead")
             dying = self.dying
             if self.am_primary and self.backup is not None:
+                # Forward through the backup's PUBLIC wire surface so the
+                # backup may be an in-process object or a socket Proxy alike.
                 try:
-                    self.backup._serve(kind, name, cid, cseq)
+                    getattr(self.backup, kind)(name, cid, cseq)
                 except RPCError:
                     pass  # backup gone; keep serving
             out = self._apply(kind, name, cid, cseq)

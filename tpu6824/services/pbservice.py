@@ -46,7 +46,13 @@ class PBServer:
         self.kv: dict[str, str] | None = None  # None = uninitialized backup
         self.dup: dict[int, tuple[int, object]] = {}
         self.dead = False
-        self.tick_interval = tick_interval or vs.ping_interval
+        if tick_interval is None:
+            # vs may be a socket Proxy, where attribute access yields an RPC
+            # stub rather than a number — fall back to the protocol constant.
+            tick_interval = getattr(vs, "ping_interval", None)
+            if not isinstance(tick_interval, (int, float)):
+                tick_interval = viewservice.PING_INTERVAL
+        self.tick_interval = tick_interval
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
         self._ticker.start()
 
