@@ -1,0 +1,93 @@
+"""Tracing, event log, stats, and config layer (SURVEY §5 build notes: the
+reference has only compile-time DPrintf consts and no config system)."""
+
+import json
+import os
+import subprocess
+import sys
+
+from tpu6824.config import Config, FabricConfig, MeshConfig
+from tpu6824.core.fabric import PaxosFabric
+from tpu6824.utils.trace import EventLog
+
+
+def test_eventlog_counters_and_ring():
+    log = EventLog(capacity=4)
+    for i in range(6):
+        log.record("step", n=i)
+    log.bump("decided", 3)
+    log.bump("decided", 2)
+    evs = log.events("step")
+    assert len(evs) == 4  # bounded ring keeps the newest
+    assert [e[2]["n"] for e in evs] == [2, 3, 4, 5]
+    assert log.counters() == {"decided": 5}
+    assert log.rates()["decided"] > 0
+
+
+def test_fabric_stats_count_decisions():
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=8)
+    try:
+        for g in range(2):
+            for s in range(4):
+                fab.start(g, 0, s, f"v{g}-{s}")
+        fab.step(6)
+        st = fab.stats()
+        assert st["steps"] == 6
+        assert st["groups"] == 2 and st["peers"] == 3
+        # 2 groups × 4 instances × 3 peers fully decided
+        assert st["decided_cells"] == 24
+        assert st["msgs"] > 0
+        assert st["rates"]["decided_cells"] > 0
+    finally:
+        fab.stop_clock()
+
+
+def test_dprintf_env_gated():
+    code = (
+        "from tpu6824.utils.trace import dprintf;"
+        "dprintf('paxos', 'visible %d', 7);"
+        "dprintf('other', 'hidden')"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        env=dict(os.environ, TPU6824_DEBUG="paxos",
+                 PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        capture_output=True, text=True,
+    )
+    assert "visible 7" in r.stderr
+    assert "hidden" not in r.stderr
+
+
+def test_config_roundtrip_and_env(tmp_path):
+    cfg = Config(backend="cpu",
+                 fabric=FabricConfig(ngroups=4, npeers=5, ninstances=16),
+                 mesh=MeshConfig(2, 2, 2))
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg.to_dict()))
+    loaded = Config.from_json(str(p))
+    assert loaded == cfg
+    assert loaded.mesh.ndevices == 8
+
+    env_backup = dict(os.environ)
+    try:
+        os.environ["TPU6824_CONFIG"] = str(p)
+        os.environ["TPU6824_NGROUPS"] = "9"
+        os.environ["TPU6824_MESH"] = "1,2,4"
+        got = Config.from_env()
+        assert got.fabric.ngroups == 9  # env override wins
+        assert got.fabric.npeers == 5   # json value survives
+        assert got.mesh == MeshConfig(1, 2, 4)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+def test_config_builds_fabric():
+    cfg = Config(fabric=FabricConfig(ngroups=1, npeers=3, ninstances=4,
+                                     auto_step=False))
+    fab = cfg.make_fabric()
+    try:
+        assert (fab.G, fab.I, fab.P) == (1, 4, 3)
+        assert cfg.select_backend() in ("cpu", "tpu")
+    finally:
+        fab.stop_clock()

@@ -1,0 +1,109 @@
+"""Framework configuration.
+
+The reference has no config system — compile-time consts (`NShards=10`
+`shardmaster/common.go:35`, `PingInterval/DeadPings` `viewservice/common.go:
+43-48`, `FilterLife` `pbservice/server.go:23`) plus argv flags in the main/
+daemons (`main/diskvd.go:39-63`).  SURVEY §5 calls for a real config layer:
+fabric geometry, mesh shape, backend selection, fault-injection rates —
+loadable from env / JSON and passable to every constructor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+
+@dataclasses.dataclass
+class FabricConfig:
+    """Geometry + behavior of the consensus fabric."""
+
+    ngroups: int = 1
+    npeers: int = 3
+    ninstances: int = 64
+    seed: int = 0
+    auto_step: bool = True
+    step_sleep: float = 0.0
+    # reference accept-loop fault rates (paxos/paxos.go:528-544)
+    unreliable_req_drop: float = 0.10
+    unreliable_rep_drop: float = 0.20
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Device-mesh axes for the sharded step: g=group/data, i=instance/
+    sequence, p=peer/tensor parallelism (tpu6824/parallel/mesh.py)."""
+
+    g: int = 1
+    i: int = 1
+    p: int = 1
+
+    @property
+    def ndevices(self) -> int:
+        return self.g * self.i * self.p
+
+
+@dataclasses.dataclass
+class Config:
+    backend: str = "auto"  # auto | tpu | cpu
+    fabric: FabricConfig = dataclasses.field(default_factory=FabricConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    # ------------------------------------------------------------ loading
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        return cls(
+            backend=d.get("backend", "auto"),
+            fabric=FabricConfig(**d.get("fabric", {})),
+            mesh=MeshConfig(**d.get("mesh", {})),
+        )
+
+    @classmethod
+    def from_json(cls, path: str) -> "Config":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    @classmethod
+    def from_env(cls, prefix: str = "TPU6824_") -> "Config":
+        """TPU6824_CONFIG=/path.json wins; otherwise individual overrides
+        like TPU6824_BACKEND / TPU6824_NGROUPS / TPU6824_NPEERS /
+        TPU6824_NINSTANCES / TPU6824_MESH=g,i,p."""
+        path = os.environ.get(prefix + "CONFIG")
+        cfg = cls.from_json(path) if path else cls()
+        if prefix + "BACKEND" in os.environ:
+            cfg.backend = os.environ[prefix + "BACKEND"]
+        for name in ("ngroups", "npeers", "ninstances", "seed"):
+            key = prefix + name.upper()
+            if key in os.environ:
+                setattr(cfg.fabric, name, int(os.environ[key]))
+        if prefix + "MESH" in os.environ:
+            g, i, p = (int(x) for x in os.environ[prefix + "MESH"].split(","))
+            cfg.mesh = MeshConfig(g, i, p)
+        return cfg
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    # ------------------------------------------------------------ apply
+
+    def select_backend(self) -> str:
+        """Resolve 'auto' → cpu/tpu based on what jax actually offers."""
+        if self.backend != "auto":
+            return self.backend
+        import jax
+
+        try:
+            return jax.devices()[0].platform
+        except RuntimeError:
+            return "cpu"
+
+    def make_fabric(self):
+        from tpu6824.core.fabric import PaxosFabric
+
+        f = self.fabric
+        return PaxosFabric(
+            ngroups=f.ngroups, npeers=f.npeers, ninstances=f.ninstances,
+            seed=f.seed, auto_step=f.auto_step, step_sleep=f.step_sleep,
+        )

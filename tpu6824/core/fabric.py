@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from tpu6824.core.intern import Intern
 from tpu6824.core.kernel import NO_VAL, apply_starts, init_state, paxos_step
+from tpu6824.utils.trace import EventLog, dprintf
 
 # Reference unreliable-network rates: 10% of requests dropped before
 # processing, a further ~20% processed but the reply discarded
@@ -71,6 +72,9 @@ class PaxosFabric:
         self._max_seq = np.full((G, P), -1, np.int64)  # Max() running high-water
         self.msgs_total = 0
         self.steps_total = 0
+        # Observability (SURVEY §5 build note): per-step event log + counters.
+        self.events = EventLog()
+        self._decided_cells = 0  # running count of decided (g, i, p) cells
 
         # Slot management (host only): which absolute seq lives in each slot.
         self._slot_seq = np.full((G, I), -1, np.int64)
@@ -171,6 +175,15 @@ class PaxosFabric:
             self.m_done_view = done_view.astype(np.int64)
             self.msgs_total += int(msgs)
             self.steps_total += 1
+            ndec = int((self.m_decided >= 0).sum())
+            newly = ndec - self._decided_cells
+            self._decided_cells = ndec
+            self.events.bump("steps")
+            self.events.bump("msgs", int(msgs))
+            if newly > 0:
+                self.events.bump("decided_cells", newly)
+                dprintf("fabric", "step %d: +%d decided cells, %d msgs",
+                        self.steps_total, newly, int(msgs))
             # Max() bookkeeping: highest seq this peer has participated in.
             seqs = np.where(touched, self._slot_seq[:, :, None], -1)  # (G,I,P)
             self._max_seq = np.maximum(self._max_seq, seqs.max(axis=1))
@@ -354,6 +367,21 @@ class PaxosFabric:
             return bool(self._dead[g, p])
 
     # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Live counters: steps, remote messages, decided cells, and their
+        per-second rates — the decided/sec counter SURVEY §5 asks for."""
+        with self._lock:
+            out = {
+                "steps": self.steps_total,
+                "msgs": self.msgs_total,
+                "decided_cells": self._decided_cells,
+                "groups": self.G,
+                "instances": self.I,
+                "peers": self.P,
+            }
+        out["rates"] = self.events.rates()
+        return out
 
     def ndecided(self, g: int, seq: int) -> int:
         """Test helper mirroring paxos/test_test.go:32-49: asserts agreement

@@ -1,0 +1,71 @@
+"""Tracing / observability.
+
+The reference's tracing is a compile-time `Debug` const + `DPrintf` per
+package (`paxos/paxos.go:35-40`, `kvpaxos/server.go:18-23`, ...).  SURVEY §5
+says the TPU framework should do better: env-gated structured tracing plus a
+per-kernel-step event log with decided/sec counters.
+
+- `dprintf(tag, fmt, ...)` — per-subsystem debug logging, enabled by
+  TPU6824_DEBUG="paxos,kvpaxos" or "all" (runtime, not compile-time).
+- `EventLog` — bounded ring of (ts, tag, payload) records with named
+  counters; the fabric keeps one and exposes `stats()`.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import threading
+import time
+
+_enabled: set[str] | None = None
+_lock = threading.Lock()
+
+
+def _tags() -> set[str]:
+    global _enabled
+    if _enabled is None:
+        raw = os.environ.get("TPU6824_DEBUG", "")
+        _enabled = {t.strip() for t in raw.split(",") if t.strip()}
+    return _enabled
+
+
+def dprintf(tag: str, fmt: str, *args) -> None:
+    """DPrintf analog: prints only when `tag` (or 'all') is enabled."""
+    tags = _tags()
+    if "all" in tags or tag in tags:
+        msg = fmt % args if args else fmt
+        print(f"[{tag} {time.monotonic():.3f}] {msg}", file=sys.stderr, flush=True)
+
+
+class EventLog:
+    """Thread-safe bounded event ring + monotonic counters."""
+
+    def __init__(self, capacity: int = 4096):
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._counters: collections.Counter = collections.Counter()
+        self._mu = threading.Lock()
+        self._t0 = time.monotonic()
+
+    def record(self, tag: str, **payload) -> None:
+        with self._mu:
+            self._ring.append((time.monotonic(), tag, payload))
+
+    def bump(self, counter: str, n: int = 1) -> None:
+        with self._mu:
+            self._counters[counter] += n
+
+    def events(self, tag: str | None = None) -> list:
+        with self._mu:
+            evs = list(self._ring)
+        return evs if tag is None else [e for e in evs if e[1] == tag]
+
+    def counters(self) -> dict[str, int]:
+        with self._mu:
+            return dict(self._counters)
+
+    def rates(self) -> dict[str, float]:
+        """Counters per second since creation."""
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        return {k: v / dt for k, v in self.counters().items()}
