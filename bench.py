@@ -48,7 +48,10 @@ def main():
 
     import jax.numpy as jnp
 
-    from tpu6824.core.kernel import apply_starts, init_state, paxos_step
+    from tpu6824.core.kernel import apply_starts, init_state
+    from tpu6824.core.pallas_kernel import get_step
+
+    paxos_step = get_step(os.environ.get("BENCH_KERNEL"))
 
     G = int(os.environ.get("BENCH_GROUPS", 1024))
     I = int(os.environ.get("BENCH_INSTANCES", 64))

@@ -1,0 +1,154 @@
+"""Pallas fused-round kernel ≡ XLA reference path.
+
+With drop probabilities at zero both paths consume identical delivery masks
+(same jax.random splits), so every state field must match bit-for-bit across
+arbitrary schedules — including partitions and mixed Start patterns.  Under
+message loss the realizations differ only through mask draws; we check the
+safety invariant (single decided value per instance) instead.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu6824.core.kernel import (
+    NO_VAL,
+    apply_starts,
+    init_state,
+    paxos_step,
+)
+from tpu6824.core.pallas_kernel import get_step, paxos_step_pallas
+
+
+def _armed_state(G, I, P, pattern="all"):
+    state = init_state(G, I, P)
+    sa = np.zeros((G, I, P), bool)
+    sv = np.full((G, I, P), NO_VAL, np.int32)
+    if pattern == "all":  # every peer proposes a distinct value
+        sa[:] = True
+        sv[:] = (np.arange(G * I * P).reshape(G, I, P) + 1)
+    elif pattern == "one":  # single proposer per cell
+        sa[:, :, 0] = True
+        sv[:, :, 0] = np.arange(G * I).reshape(G, I) + 1
+    elif pattern == "mixed":  # proposer count varies by instance
+        for i in range(I):
+            sa[:, i, : (i % P) + 1] = True
+            sv[:, i, : (i % P) + 1] = i + 1
+    return apply_starts(
+        state, jnp.zeros((G, I), bool), jnp.asarray(sa), jnp.asarray(sv)
+    )
+
+
+def _args(G, P, link=None):
+    link = jnp.ones((G, P, P), bool) if link is None else jnp.asarray(link)
+    done = jnp.full((G, P), -1, jnp.int32)
+    dr = jnp.zeros((G, P, P), jnp.float32)
+    return link, done, dr, dr
+
+
+def _fork(state):
+    """paxos_step donates its input buffers; give each path its own copy."""
+    return (jax.tree.map(jnp.copy, state), jax.tree.map(jnp.copy, state))
+
+
+def _assert_states_equal(a, b):
+    for name, x, y in zip(a._fields, a, b):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"field {name}"
+        )
+
+
+@pytest.mark.parametrize("P", [3, 5])
+@pytest.mark.parametrize("pattern", ["one", "all", "mixed"])
+def test_bitwise_equivalence_reliable(P, pattern):
+    G, I = 2, 8
+    link, done, dr, _ = _args(G, P)
+    sx, sp = _fork(_armed_state(G, I, P, pattern))
+    key = jax.random.key(7)
+    for step in range(4):
+        key, sub = jax.random.split(key)
+        sx, iox = paxos_step(sx, link, done, sub, dr, dr)
+        sp, iop = paxos_step_pallas(sp, link, done, sub, dr, dr, interpret=True)
+        _assert_states_equal(sx, sp)
+        assert int(iox.msgs) == int(iop.msgs), f"step {step}"
+    assert (np.asarray(sx.decided) >= 0).all()
+
+
+def test_bitwise_equivalence_partitioned():
+    G, I, P = 1, 8, 5
+    link = np.ones((G, P, P), bool)
+    link[0] = False
+    for part in ([0, 1, 2], [3, 4]):  # majority + minority
+        for a in part:
+            for b in part:
+                link[0, a, b] = True
+    link, done, dr, _ = _args(G, P, link)
+    sx, sp = _fork(_armed_state(G, I, P, "all"))
+    key = jax.random.key(3)
+    for _ in range(4):
+        key, sub = jax.random.split(key)
+        sx, _ = paxos_step(sx, link, done, sub, dr, dr)
+        sp, _ = paxos_step_pallas(sp, link, done, sub, dr, dr, interpret=True)
+        _assert_states_equal(sx, sp)
+    dec = np.asarray(sx.decided)
+    assert (dec[0, :, :3] >= 0).all()      # majority side decides
+    assert (dec[0, :, 3:] < 0).all()       # minority blocked
+
+
+def test_padding_non_multiple_of_lanes():
+    # N = G*I = 12 — forces lane padding inside the wrapper.
+    G, I, P = 3, 4, 3
+    link, done, dr, _ = _args(G, P)
+    sx, sp = _fork(_armed_state(G, I, P, "all"))
+    key = jax.random.key(11)
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        sx, _ = paxos_step(sx, link, done, sub, dr, dr)
+        sp, _ = paxos_step_pallas(sp, link, done, sub, dr, dr, interpret=True)
+        _assert_states_equal(sx, sp)
+    assert (np.asarray(sx.decided) >= 0).all()
+
+
+def test_done_view_propagates():
+    G, I, P = 1, 4, 3
+    link, _, dr, _ = _args(G, P)
+    done = jnp.asarray(np.array([[5, 2, 7]], np.int32))
+    sp = _armed_state(G, I, P, "one")
+    sp, io = paxos_step_pallas(sp, link, done, jax.random.key(0), dr, dr,
+                               interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(io.done_view)[0], np.broadcast_to([5, 2, 7], (P, P))
+    )
+
+
+def test_unreliable_safety():
+    """Under 10%/20% loss the Pallas path must still never double-decide."""
+    G, I, P = 2, 8, 3
+    link, done, _, _ = _args(G, P)
+    drop_req = jnp.full((G, P, P), 0.10, jnp.float32)
+    drop_rep = jnp.full((G, P, P), 0.20, jnp.float32)
+    sp = _armed_state(G, I, P, "all")
+    key = jax.random.key(42)
+    for _ in range(20):
+        key, sub = jax.random.split(key)
+        sp, _ = paxos_step_pallas(sp, link, done, sub, drop_req, drop_rep,
+                                  interpret=True)
+    dec = np.asarray(sp.decided)
+    assert (dec >= 0).all(), "liveness under loss"
+    for g in range(G):
+        for i in range(I):
+            vals = dec[g, i][dec[g, i] >= 0]
+            assert (vals == vals[0]).all(), f"disagreement at {(g, i)}"
+
+
+def test_get_step_dispatch(monkeypatch):
+    from tpu6824.core.kernel import paxos_step as xla_step
+
+    assert get_step("xla") is xla_step
+    monkeypatch.setenv("TPU6824_KERNEL", "pallas")
+    fn = get_step()
+    assert fn is not xla_step
+    with pytest.raises(ValueError):
+        get_step("cuda")

@@ -25,6 +25,10 @@ class FabricConfig:
     seed: int = 0
     auto_step: bool = True
     step_sleep: float = 0.0
+    # step kernel: "xla" (fused-by-compiler, kernel.py) or "pallas"
+    # (hand-fused round, pallas_kernel.py); None → $TPU6824_KERNEL,
+    # else pallas on TPU / xla elsewhere
+    kernel: str | None = None
     # reference accept-loop fault rates (paxos/paxos.go:528-544)
     unreliable_req_drop: float = 0.10
     unreliable_rep_drop: float = 0.20
@@ -106,4 +110,6 @@ class Config:
         return PaxosFabric(
             ngroups=f.ngroups, npeers=f.npeers, ninstances=f.ninstances,
             seed=f.seed, auto_step=f.auto_step, step_sleep=f.step_sleep,
+            kernel=f.kernel, unreliable_req_drop=f.unreliable_req_drop,
+            unreliable_rep_drop=f.unreliable_rep_drop,
         )

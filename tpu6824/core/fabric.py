@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu6824.core.intern import Intern
-from tpu6824.core.kernel import NO_VAL, apply_starts, init_state, paxos_step
+from tpu6824.core.kernel import NO_VAL, apply_starts, init_state
 from tpu6824.utils.trace import EventLog, dprintf
 
 # Reference unreliable-network rates: 10% of requests dropped before
@@ -55,7 +55,15 @@ class PaxosFabric:
         seed: int = 0,
         auto_step: bool = False,
         step_sleep: float = 0.0,
+        kernel: str | None = None,
+        unreliable_req_drop: float = UNRELIABLE_REQ_DROP,
+        unreliable_rep_drop: float = UNRELIABLE_REP_DROP,
     ):
+        from tpu6824.core.pallas_kernel import get_step
+
+        self._step_fn = get_step(kernel)
+        self._req_drop = unreliable_req_drop
+        self._rep_drop = unreliable_rep_drop
         self.G, self.I, self.P = ngroups, ninstances, npeers
         G, I, P = self.G, self.I, self.P
         self._state = init_state(G, I, P)
@@ -70,9 +78,9 @@ class PaxosFabric:
         self.m_decided = np.full((G, I, P), NO_VAL, np.int64)
         self.m_done_view = np.full((G, P, P), -1, np.int64)
         self._max_seq = np.full((G, P), -1, np.int64)  # Max() running high-water
-        self.msgs_total = 0
-        self.steps_total = 0
         # Observability (SURVEY §5 build note): per-step event log + counters.
+        # The EventLog counters are the single source of truth for steps/msgs;
+        # steps_total/msgs_total below are read-through views.
         self.events = EventLog()
         self._decided_cells = 0  # running count of decided (g, i, p) cells
 
@@ -142,11 +150,11 @@ class PaxosFabric:
             unrel = self._unreliable.astype(np.float32)  # (G, P)
             drop_req = jnp.asarray(
                 np.broadcast_to(unrel[:, None, :], (self.G, self.P, self.P))
-                * UNRELIABLE_REQ_DROP
+                * self._req_drop
             )
             drop_rep = jnp.asarray(
                 np.broadcast_to(unrel[:, None, :], (self.G, self.P, self.P))
-                * UNRELIABLE_REP_DROP
+                * self._rep_drop
             )
             self._key, sub = jax.random.split(self._key)
 
@@ -164,7 +172,7 @@ class PaxosFabric:
                 state, jnp.asarray(reset), jnp.asarray(sa), jnp.asarray(sv)
             )
 
-        state, io = paxos_step(state, link, done, sub, drop_req, drop_rep)
+        state, io = self._step_fn(state, link, done, sub, drop_req, drop_rep)
         self._state = state
         decided, done_view, touched, msgs = jax.device_get(
             (io.decided, io.done_view, io.touched, io.msgs)
@@ -173,9 +181,9 @@ class PaxosFabric:
         with self._lock:
             self.m_decided = decided.astype(np.int64)
             self.m_done_view = done_view.astype(np.int64)
-            self.msgs_total += int(msgs)
-            self.steps_total += 1
             ndec = int((self.m_decided >= 0).sum())
+            # _decided_cells was decremented by GC for wiped cells, so this
+            # delta counts decisions landing in recycled slots too.
             newly = ndec - self._decided_cells
             self._decided_cells = ndec
             self.events.bump("steps")
@@ -189,6 +197,14 @@ class PaxosFabric:
             self._max_seq = np.maximum(self._max_seq, seqs.max(axis=1))
             self._gc_locked()
             self._stepped.notify_all()
+
+    @property
+    def steps_total(self) -> int:
+        return self.events.counters().get("steps", 0)
+
+    @property
+    def msgs_total(self) -> int:
+        return self.events.counters().get("msgs", 0)
 
     def wait_steps(self, n: int, timeout: float = 30.0):
         """Block until the fabric has advanced n more steps."""
@@ -221,6 +237,10 @@ class PaxosFabric:
                 self._slot_vids[g][slot] = []
                 self._pending_resets.append((g, slot))
                 # Mirrors must stop reporting the old tenant immediately.
+                # Deduct the wiped cells from the running decided count so the
+                # decided_cells counter keeps crediting decisions that land in
+                # recycled slots (steady-state windowed throughput).
+                self._decided_cells -= int((self.m_decided[g, slot, :] >= 0).sum())
                 self.m_decided[g, slot, :] = NO_VAL
 
     # ---------------------------------------------------------------- API
@@ -371,10 +391,11 @@ class PaxosFabric:
     def stats(self) -> dict:
         """Live counters: steps, remote messages, decided cells, and their
         per-second rates — the decided/sec counter SURVEY §5 asks for."""
+        counters = self.events.counters()
         with self._lock:
             out = {
-                "steps": self.steps_total,
-                "msgs": self.msgs_total,
+                "steps": counters.get("steps", 0),
+                "msgs": counters.get("msgs", 0),
                 "decided_cells": self._decided_cells,
                 "groups": self.G,
                 "instances": self.I,

@@ -19,16 +19,17 @@ import sys
 import threading
 import time
 
-_enabled: set[str] | None = None
-_lock = threading.Lock()
-
-
 def _tags() -> set[str]:
-    global _enabled
-    if _enabled is None:
-        raw = os.environ.get("TPU6824_DEBUG", "")
-        _enabled = {t.strip() for t in raw.split(",") if t.strip()}
-    return _enabled
+    # Re-read every call so a long-lived daemon can have tags toggled at
+    # runtime (via set_debug_tags or by mutating os.environ) — genuinely
+    # "runtime, not compile-time", unlike the reference's Debug const.
+    raw = os.environ.get("TPU6824_DEBUG", "")
+    return {t.strip() for t in raw.split(",") if t.strip()}
+
+
+def set_debug_tags(*tags: str) -> None:
+    """Enable dprintf for the given subsystem tags ('all' for everything)."""
+    os.environ["TPU6824_DEBUG"] = ",".join(tags)
 
 
 def dprintf(tag: str, fmt: str, *args) -> None:
@@ -47,6 +48,7 @@ class EventLog:
         self._counters: collections.Counter = collections.Counter()
         self._mu = threading.Lock()
         self._t0 = time.monotonic()
+        self._rate_snap: tuple[float, dict] = (self._t0, {})
 
     def record(self, tag: str, **payload) -> None:
         with self._mu:
@@ -66,6 +68,13 @@ class EventLog:
             return dict(self._counters)
 
     def rates(self) -> dict[str, float]:
-        """Counters per second since creation."""
-        dt = max(time.monotonic() - self._t0, 1e-9)
-        return {k: v / dt for k, v in self.counters().items()}
+        """Counters per second over the interval since the previous `rates()`
+        call (since creation, on the first call) — a live rate for pollers,
+        not a lifetime average that decays with uptime."""
+        now = time.monotonic()
+        cur = self.counters()
+        with self._mu:
+            prev_t, prev = self._rate_snap
+            self._rate_snap = (now, cur)
+        dt = max(now - prev_t, 1e-9)
+        return {k: (v - prev.get(k, 0)) / dt for k, v in cur.items()}
