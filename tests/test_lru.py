@@ -124,3 +124,85 @@ def test_large_value_roundtrip():
     big = "x" * 100_000
     c.put("big", big)
     assert c.get("big") == big
+
+
+# ---------------------------------------------------------------- intern
+
+
+def _intern_backends():
+    from tpu6824.core.intern import NativeIntern, PyIntern, _load_native
+
+    backends = [PyIntern()]
+    lib = _load_native()
+    if lib is not None:
+        backends.append(NativeIntern(lib))
+    return backends
+
+
+def test_intern_native_backend_selected():
+    """The C++ toolchain is baked into this image, so the factory must pick
+    the native store here (fallback covered separately)."""
+    from tpu6824.core.intern import Intern, NativeIntern
+
+    assert isinstance(Intern(), NativeIntern)
+
+
+def test_intern_dedup_refcount_free():
+    for store in _intern_backends():
+        a = store.put("payload-A")
+        a2 = store.put("payload-A")  # dedup: same id, refcount 2
+        b = store.put({"k": [1, 2, 3]})
+        assert a == a2 and a != b
+        assert store.get(a) == "payload-A"
+        assert store.get(b) == {"k": [1, 2, 3]}
+        assert store.nlive == 2
+        store.decref(a)
+        assert store.nlive == 2  # one ref left
+        store.decref(a)
+        assert store.nlive == 1  # freed
+        c = store.put("payload-C")  # free-list reuse is invisible to users
+        assert store.get(c) == "payload-C"
+        assert store.get(b) == {"k": [1, 2, 3]}
+
+
+def test_intern_bytes_reclaimed():
+    for store in _intern_backends():
+        big = store.put("x" * 100_000)
+        peak = store.approx_bytes()
+        assert peak >= 100_000
+        store.decref(big)
+        assert store.approx_bytes() < peak / 2
+
+
+def test_intern_incref():
+    for store in _intern_backends():
+        v = store.put("v")
+        store.incref(v)
+        store.decref(v)
+        assert store.nlive == 1
+        store.decref(v)
+        assert store.nlive == 0
+
+
+def test_intern_threaded_hammer():
+    import threading
+
+    for store in _intern_backends():
+        errs = []
+
+        def worker(idx):
+            try:
+                for j in range(200):
+                    vid = store.put(f"val-{idx}-{j % 10}")
+                    assert store.get(vid) == f"val-{idx}-{j % 10}"
+                    store.decref(vid)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert store.nlive == 0

@@ -247,3 +247,18 @@ def test_many_groups_lockstep():
             assert fate == Fate.DECIDED and v == f"g{g}"
     finally:
         f.stop_clock()
+
+
+def test_rpc_budget_concurrent(fab3):
+    """TestRPCCount's concurrent half (paxos/test_test.go:562-570): with all
+    three peers proposing the same instances at once, stay within the
+    reference's ≤ 45-RPCs-per-agreement envelope."""
+    pxa = make_group(fab3)
+    base = fab3.msgs_total
+    ninst = 5
+    for seq in range(ninst):
+        for p in range(3):
+            pxa[p].start(seq, seq * 10 + p)
+        waitn(fab3, 0, seq, 3)
+    total = fab3.msgs_total - base
+    assert total <= ninst * 45, f"too chatty: {total} msgs for {ninst} agreements"

@@ -222,3 +222,37 @@ def test_register_obj_excludes_lifecycle(sockdir):
         assert call(a, "lock", "x", 1, 1) is True
     finally:
         srv.kill()
+
+
+def test_delay_proxy_slows_and_restores(sockdir):
+    """Delayed-delivery proxy (pbservice/test_test.go:897-954): interpose a
+    byte-copying proxy with a delay knob in front of a live server without
+    the dialer noticing, turn the knob mid-flight, then remove it."""
+    import time
+
+    from tpu6824.harness.cluster import Deployment
+
+    class Echo:
+        def echo(self, x):
+            return x
+
+    with Deployment(tag="delay") as dep:
+        proxy_handle = dep.serve("echo", Echo())
+        assert proxy_handle.echo("hi") == "hi"
+
+        delay = dep.interpose_delay("echo", delay=0.4)
+        t0 = time.monotonic()
+        assert dep.proxy("echo").echo("slow") == "slow"
+        slow_dt = time.monotonic() - t0
+        # request + reply legs each sleep >= 0.4s per chunk
+        assert slow_dt >= 0.4, f"delay not applied: {slow_dt:.3f}s"
+
+        delay.set_delay(0.0)
+        t0 = time.monotonic()
+        assert dep.proxy("echo").echo("quick") == "quick"
+        assert time.monotonic() - t0 < 0.3
+
+        dep.remove_delay("echo")
+        assert dep.proxy("echo").echo("direct") == "direct"
+        with pytest.raises(RuntimeError):
+            dep.remove_delay("echo")

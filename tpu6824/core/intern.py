@@ -6,15 +6,24 @@ values — the reference gob-encodes interface{} values the same way,
 window GC recycles an instance slot, its payload references are dropped — the
 moral equivalent of `doMemShrink` freeing forgotten instances
 (`paxos/paxos.go:362-378`) and the property the reference's TestForgetMem
-asserts (`paxos/test_test.go:371-454`)."""
+asserts (`paxos/test_test.go:371-454`).
+
+Two backends with one API: the native C++ store (`native/intern.cpp` — dedup
+index, refcounts, free-list and byte accounting under a C++ mutex; Python
+keeps only an id→value mirror for O(1) `get` without re-serialization), and
+a pure-Python fallback when no toolchain is available.  `Intern()` picks.
+"""
 
 from __future__ import annotations
 
+import ctypes
 import pickle
 import threading
 
 
-class Intern:
+class PyIntern:
+    """Pure-Python reference implementation (and toolchain-less fallback)."""
+
     def __init__(self):
         self._lock = threading.Lock()
         self._by_key: dict[bytes, int] = {}
@@ -68,3 +77,90 @@ class Intern:
         """Rough payload footprint — enough for memory-reclamation tests."""
         with self._lock:
             return sum(len(k) for k in self._keys if k is not None)
+
+
+def _load_native():
+    import os
+
+    from tpu6824.native import build
+
+    lib = build.load(
+        "libintern6824.so",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "native", "intern.cpp"),
+    )
+    if lib is None or getattr(lib, "_intern_bound", False):
+        return lib
+    lib.intern_new.restype = ctypes.c_void_p
+    lib.intern_destroy.argtypes = [ctypes.c_void_p]
+    lib.intern_put.restype = ctypes.c_int32
+    lib.intern_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
+    lib.intern_incref.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.intern_decref.restype = ctypes.c_int32
+    lib.intern_decref.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.intern_nlive.restype = ctypes.c_int64
+    lib.intern_nlive.argtypes = [ctypes.c_void_p]
+    lib.intern_bytes.restype = ctypes.c_int64
+    lib.intern_bytes.argtypes = [ctypes.c_void_p]
+    lib.intern_refcount.restype = ctypes.c_int64
+    lib.intern_refcount.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib._intern_bound = True
+    return lib
+
+
+class NativeIntern:
+    """C++-backed store: serialization stays in Python (pickle), bookkeeping
+    (dedup/refcount/free-list/bytes) lives in native code."""
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._h = lib.intern_new()
+        self._mu = threading.Lock()
+        self._vals: dict[int, object] = {}  # id → live value mirror
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.intern_destroy(h)
+
+    def put(self, value) -> int:
+        key = pickle.dumps(value, protocol=4)
+        is_new = ctypes.c_int32(0)
+        # The mirror update must be atomic with the native call: a decref
+        # freeing this vid (or a racing put reusing a freed vid) between the
+        # two would desync id↔value.
+        with self._mu:
+            vid = self._lib.intern_put(self._h, key, len(key),
+                                       ctypes.byref(is_new))
+            if is_new.value:
+                self._vals[vid] = value
+        return vid
+
+    def get(self, vid: int):
+        with self._mu:
+            return self._vals[vid]
+
+    def incref(self, vid: int):
+        self._lib.intern_incref(self._h, vid)
+
+    def decref(self, vid: int):
+        with self._mu:
+            if self._lib.intern_decref(self._h, vid):
+                self._vals.pop(vid, None)
+
+    def refcount(self, vid: int) -> int:
+        return int(self._lib.intern_refcount(self._h, vid))
+
+    @property
+    def nlive(self) -> int:
+        return int(self._lib.intern_nlive(self._h))
+
+    def approx_bytes(self) -> int:
+        return int(self._lib.intern_bytes(self._h))
+
+
+def Intern():
+    """Build the native store when the toolchain allows, else pure Python."""
+    lib = _load_native()
+    return NativeIntern(lib) if lib is not None else PyIntern()

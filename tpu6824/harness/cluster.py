@@ -16,7 +16,8 @@ import os
 import shutil
 import uuid
 
-from tpu6824.rpc import Proxy, Server, connect
+from tpu6824.rpc import DelayProxy, Proxy, Server, connect
+from tpu6824.rpc.transport import link_alias, unlink_alias
 
 
 def make_sockdir(tag: str = "") -> str:
@@ -37,6 +38,7 @@ class Deployment:
         self.timeout = timeout
         self._servers: dict[str, Server] = {}
         self._objs: dict[str, object] = {}
+        self._proxies: dict[str, DelayProxy] = {}
 
     def addr(self, name: str) -> str:
         return os.path.join(self.dir, name)
@@ -79,7 +81,36 @@ class Deployment:
     def rpc_count(self, name: str) -> int:
         return self._servers[name].rpc_count
 
+    def interpose_delay(self, name: str, delay: float = 0.0) -> DelayProxy:
+        """Swap a DelayProxy in front of a live service, transparently to
+        dialers: the public path now reaches the proxy, which forwards to
+        the real socket via a hidden alias (the socket-rename trick,
+        `pbservice/test_test.go:897-954`)."""
+        if name in self._proxies:
+            raise RuntimeError(f"{name} already has a delay proxy")
+        public = self.addr(name)
+        hidden = public + ".real"
+        link_alias(public, hidden)  # keep the server dialable for the proxy
+        proxy = DelayProxy(public + ".proxy", hidden, delay).start()
+        link_alias(proxy.addr, public)  # dialers now reach the proxy
+        self._proxies[name] = proxy
+        return proxy
+
+    def remove_delay(self, name: str) -> None:
+        """Undo interpose_delay: point the public path back at the server."""
+        proxy = self._proxies.pop(name, None)
+        if proxy is None:
+            raise RuntimeError(f"{name} has no delay proxy")
+        public = self.addr(name)
+        hidden = public + ".real"
+        link_alias(hidden, public)
+        unlink_alias(hidden)
+        proxy.kill()
+
     def shutdown(self) -> None:
+        for proxy in self._proxies.values():
+            proxy.kill()
+        self._proxies.clear()
         for name in list(self._servers):
             self.kill(name)
         shutil.rmtree(self.dir, ignore_errors=True)

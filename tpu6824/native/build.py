@@ -1,0 +1,49 @@
+"""Shared build-and-load helper for the native C++ runtime components.
+
+Each component is a single .cpp with a C ABI, compiled on first import into
+`<repo>/build/` and loaded with ctypes; compile-to-temp + atomic rename keeps
+concurrent processes from ever dlopening a half-written library.  Returns
+None when no toolchain is available so callers can fall back to Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+BUILD_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "build")
+
+_cache: dict[str, "ctypes.CDLL | None"] = {}
+_lock = threading.Lock()
+
+
+def load(so_name: str, src: str) -> "ctypes.CDLL | None":
+    """Compile `src` (if stale) to BUILD_DIR/so_name and dlopen it."""
+    with _lock:
+        if so_name in _cache:
+            return _cache[so_name]
+        so = os.path.join(BUILD_DIR, so_name)
+        try:
+            if (not os.path.exists(so)) or (
+                os.path.getmtime(so) < os.path.getmtime(src)
+            ):
+                os.makedirs(BUILD_DIR, exist_ok=True)
+                tmp = f"{so}.{os.getpid()}.tmp"
+                try:
+                    subprocess.run(
+                        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                         "-o", tmp, src],
+                        check=True, capture_output=True,
+                    )
+                    os.replace(tmp, so)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+            lib = ctypes.CDLL(so)
+        except (OSError, subprocess.CalledProcessError):
+            lib = None  # toolchain unavailable → caller's python fallback
+        _cache[so_name] = lib
+        return lib

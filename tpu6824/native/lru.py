@@ -10,13 +10,10 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from collections import OrderedDict
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_BUILD = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "build")
-_SO = os.path.join(_BUILD, "liblru6824.so")
 _SRC = os.path.join(_HERE, "lru.cpp")
 
 _lib = None
@@ -28,28 +25,11 @@ def _load():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        try:
-            if (not os.path.exists(_SO)) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-            ):
-                os.makedirs(_BUILD, exist_ok=True)
-                # Build to a per-pid temp path, then atomically rename: two
-                # concurrent processes may both compile, but neither can ever
-                # CDLL a half-written library.
-                tmp = f"{_SO}.{os.getpid()}.tmp"
-                try:
-                    subprocess.run(
-                        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                         "-o", tmp, _SRC],
-                        check=True, capture_output=True,
-                    )
-                    os.replace(tmp, _SO)
-                finally:
-                    if os.path.exists(tmp):
-                        os.unlink(tmp)
-            lib = ctypes.CDLL(_SO)
-        except (OSError, subprocess.CalledProcessError):
-            _lib = False  # toolchain unavailable → python fallback
+        from tpu6824.native import build
+
+        lib = build.load("liblru6824.so", _SRC)
+        if lib is None:
+            _lib = False  # toolchain unavailable -> python fallback
             return _lib
         lib.lru_new.restype = ctypes.c_void_p
         lib.lru_new.argtypes = [ctypes.c_uint64]

@@ -1,4 +1,5 @@
 from tpu6824.rpc.transport import (
+    DelayProxy,
     Proxy,
     Server,
     call,
@@ -7,4 +8,4 @@ from tpu6824.rpc.transport import (
     unlink_alias,
 )
 
-__all__ = ["Proxy", "Server", "call", "connect", "link_alias", "unlink_alias"]
+__all__ = ["DelayProxy", "Proxy", "Server", "call", "connect", "link_alias", "unlink_alias"]

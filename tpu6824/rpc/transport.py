@@ -32,6 +32,7 @@ import random
 import socket
 import struct
 import threading
+import time
 
 from tpu6824.utils.errors import RPCError
 
@@ -247,6 +248,108 @@ class Server:
             pass
         finally:
             conn.close()
+
+
+class DelayProxy:
+    """Byte-copying proxy with an atomic delay knob — the reference swaps
+    one of these in front of a live server (by renaming sockets) to test
+    slow-network behavior without loss (`pbservice/test_test.go:897-954`).
+
+    Each accepted connection dials `backend_addr` and copies bytes both
+    ways; every chunk waits the current delay before being forwarded.  The
+    knob can be turned while connections are in flight."""
+
+    def __init__(self, listen_addr: str, backend_addr: str, delay: float = 0.0):
+        self.addr = listen_addr
+        self.backend = backend_addr
+        self._delay = delay
+        self._lock = threading.Lock()
+        self._dead = threading.Event()
+        self._live: set[socket.socket] = set()  # in-flight pump sockets
+        try:
+            os.unlink(listen_addr)
+        except FileNotFoundError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(listen_addr)
+        self._sock.listen(128)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+
+    def start(self) -> "DelayProxy":
+        self._thread.start()
+        return self
+
+    def set_delay(self, seconds: float) -> None:
+        with self._lock:
+            self._delay = seconds
+
+    @property
+    def delay(self) -> float:
+        with self._lock:
+            return self._delay
+
+    def kill(self) -> None:
+        self._dead.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.addr)
+        except FileNotFoundError:
+            pass
+        # Unblock pump threads stuck in recv on stalled peers.
+        with self._lock:
+            live, self._live = list(self._live), set()
+        for s in live:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._dead.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                if not self._dead.is_set():
+                    self.kill()  # fail-stop, not zombie (cf. Server above)
+                return
+            conn.settimeout(30.0)
+            try:
+                up = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                up.settimeout(30.0)
+                up.connect(self.backend)
+            except OSError:
+                conn.close()
+                continue
+            with self._lock:
+                self._live.update((conn, up))
+            for src, dst in ((conn, up), (up, conn)):
+                threading.Thread(
+                    target=self._pump, args=(src, dst), daemon=True
+                ).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while not self._dead.is_set():
+                data = src.recv(65536)
+                if not data:
+                    break
+                time.sleep(self.delay)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            # Half-close so the peer sees EOF for this direction only; the
+            # other pump thread owns the reverse direction.
+            for s, how in ((dst, socket.SHUT_WR), (src, socket.SHUT_RD)):
+                try:
+                    s.shutdown(how)
+                except OSError:
+                    pass
+            with self._lock:
+                self._live.discard(src)
 
 
 def link_alias(real: str, alias: str) -> None:
