@@ -1,0 +1,264 @@
+"""Go `encoding/gob` codec — golden vectors and round trips.
+
+No Go toolchain exists in this image, so the golden byte strings are
+hand-derived from the gob specification (gob/doc.go); each derivation is
+written out in the test that uses it.  The spec's own worked example — the
+int value 7 encodes as `03 04 00 0e` (3-byte message; type id int=2 encoded
+as signed 4; zero singleton delta; 7<<1=0x0e) — anchors the arithmetic.
+"""
+
+import io
+
+import pytest
+
+from tpu6824.shim.gob import (
+    BOOL, BYTES, FLOAT, INT, STRING, UINT, INTERFACE,
+    Array, Decoder, Encoder, GobError, Map, Registry, Slice, Struct,
+    complete, enc_int, enc_uint, zero_of,
+)
+
+
+def roundtrip(schema, value, registry=None):
+    buf = bytearray()
+    enc = Encoder(buf.extend, registry)
+    enc.encode(schema, value)
+    stream = io.BytesIO(bytes(buf))
+
+    def read(n):
+        b = stream.read(n)
+        if len(b) != n:
+            raise GobError("eof")
+        return b
+
+    dec = Decoder(read)
+    _, v = dec.next()
+    return v, bytes(buf)
+
+
+def encode_bytes(schema, value, registry=None):
+    buf = bytearray()
+    Encoder(buf.extend, registry).encode(schema, value)
+    return bytes(buf)
+
+
+# ------------------------------------------------------------ primitives
+
+
+def test_uint_wire_format():
+    # < 128 → one byte; ≥ 128 → (256 - bytecount) then big-endian bytes.
+    for u, want in [
+        (0, b"\x00"),
+        (7, b"\x07"),
+        (127, b"\x7f"),
+        (128, b"\xff\x80"),
+        (256, b"\xfe\x01\x00"),
+        (1 << 16, b"\xfd\x01\x00\x00"),
+    ]:
+        out = bytearray()
+        enc_uint(out, u)
+        assert bytes(out) == want, (u, bytes(out).hex())
+
+
+def test_int_wire_format():
+    # bit 0 is the sign: i>=0 → i<<1; i<0 → (~i)<<1|1.
+    for i, want in [
+        (0, b"\x00"),
+        (7, b"\x0e"),
+        (-1, b"\x01"),
+        (-2, b"\x03"),
+        (2, b"\x04"),
+        (-65, b"\xff\x81"),  # (~-65)<<1|1 = 64*2+1 = 129 = 0x81, >127
+        (65, b"\xff\x82"),
+    ]:
+        out = bytearray()
+        enc_int(out, i)
+        assert bytes(out) == want, (i, bytes(out).hex())
+
+
+def test_golden_int_7():
+    # The spec's worked example: Encode(int(7)) → "03 04 00 0e".
+    assert encode_bytes(INT, 7) == bytes.fromhex("0304000e")
+
+
+def test_golden_string():
+    # "ab": 5-byte message; typeid string=6 → signed 12 = 0x0c; singleton
+    # delta 00; length 2; raw bytes.
+    assert encode_bytes(STRING, "ab") == bytes.fromhex("050c00026162")
+
+
+def test_golden_bool_float():
+    assert encode_bytes(BOOL, True) == bytes.fromhex("03020001")
+    # float 17.0 = 0x4031000000000000; reversed bytes = 0x3140 → fe 31 40.
+    assert encode_bytes(FLOAT, 17.0) == bytes.fromhex("050800fe3140")
+
+
+def test_golden_struct_with_zero_field_omitted():
+    """type T struct { X, Y, Z int }; T{X:7, Z:8}.
+
+    Type-definition message (all bytes hand-derived):
+      payload = ff 81            typeid -65
+                03               wireType delta 3 → StructT (field index 2)
+                01               structType delta 1 → CommonType (embedded)
+                01 01 54         CommonType.Name = "T"
+                01 ff 82         CommonType.Id   = 65
+                00               end CommonType
+                01 03            structType.Field, slice len 3
+                01 01 58 01 04 00   {Name:"X", Id:int=2}
+                01 01 59 01 04 00   {Name:"Y", Id:2}
+                01 01 5a 01 04 00   {Name:"Z", Id:2}
+                00 00            end structType, end wireType
+      framed with its byte count 0x21 (33).
+    Value message: 07  ff 82  01 0e  02 10  00
+      (len 7; typeid 65; delta 1 → X=7; delta 2 skips zero Y → Z=8; end).
+    """
+    t = Struct("T", [("X", INT), ("Y", INT), ("Z", INT)])
+    got = encode_bytes(t, {"X": 7, "Y": 0, "Z": 8})
+    want = bytes.fromhex(
+        "21"
+        "ff81" "03" "01" "010154" "01ff82" "00"
+        "0103"
+        "010158010400" "010159010400" "01015a010400"
+        "0000"
+        "07" "ff82" "010e" "0210" "00"
+    )
+    assert got == want, got.hex()
+
+
+def test_decode_golden_struct():
+    data = encode_bytes(Struct("T", [("X", INT), ("Y", INT), ("Z", INT)]),
+                        {"X": 7, "Y": 0, "Z": 8})
+    stream = io.BytesIO(data)
+    dec = Decoder(lambda n: stream.read(n))
+    _, v = dec.next()
+    assert v == {"X": 7, "Z": 8}  # zero Y omitted on the wire
+    t = Struct("T", [("X", INT), ("Y", INT), ("Z", INT)])
+    assert complete(t, v) == {"X": 7, "Y": 0, "Z": 8}
+
+
+# ------------------------------------------------------------ round trips
+
+
+CASES = [
+    (BOOL, False),
+    (BOOL, True),
+    (INT, -1234567890123),
+    (UINT, 2**63 + 11),
+    (FLOAT, 3.14159),
+    (FLOAT, -0.0),
+    (STRING, "hello, 世界"),
+    (BYTES, b"\x00\xff\x10"),
+    (Slice(STRING), ["a", "", "c"]),
+    (Slice(INT), []),
+    (Array(4, INT), [5, 0, -5, 9]),
+    (Map(STRING, STRING), {"k": "v", "": ""}),
+    (Map(INT, Slice(STRING)), {100: ["s1", "s2"], -7: []}),
+    (Struct("Empty", []), {}),
+]
+
+
+@pytest.mark.parametrize("schema,value", CASES, ids=lambda x: repr(x)[:40])
+def test_roundtrip(schema, value):
+    got, _ = roundtrip(schema, value)
+    assert complete(schema, got) == complete(schema, value)
+
+
+def test_roundtrip_nested_struct():
+    view = Struct("View", [("Viewnum", UINT), ("Primary", STRING),
+                           ("Backup", STRING)])
+    reply = Struct("PingReply", [("View", view)])
+    v = {"View": {"Viewnum": 3, "Primary": "p", "Backup": ""}}
+    got, _ = roundtrip(reply, v)
+    assert complete(reply, got) == complete(reply, v)
+
+
+def test_roundtrip_config():
+    # shardmaster.Config (shardmaster/common.go:37-41): array + int64 map.
+    cfg = Struct("Config", [
+        ("Num", INT),
+        ("Shards", Array(10, INT)),
+        ("Groups", Map(INT, Slice(STRING))),
+    ])
+    v = {"Num": 4, "Shards": [1, 1, 2, 2, 2, 1, 1, 2, 1, 2],
+         "Groups": {1: ["a", "b", "c"], 2: ["d", "e"]}}
+    got, _ = roundtrip(cfg, v)
+    assert complete(cfg, got) == v
+
+
+def test_multiple_values_one_stream_defines_types_once():
+    t = Struct("P", [("X", INT)])
+    buf = bytearray()
+    enc = Encoder(buf.extend)
+    enc.encode(t, {"X": 1})
+    n1 = len(buf)
+    enc.encode(t, {"X": 2})
+    n2 = len(buf) - n1
+    assert n2 < n1  # second message carries no type definition
+    stream = io.BytesIO(bytes(buf))
+    dec = Decoder(lambda n: stream.read(n))
+    assert dec.next()[1] == {"X": 1}
+    assert dec.next()[1] == {"X": 2}
+
+
+# ------------------------------------------------------------ interfaces
+
+
+def test_interface_roundtrip():
+    # The reference ships kvpaxos.Op structs inside PrepareArgs.Value
+    # interface{} (kvpaxos/server.go:25-33, paxos/rpc.go:61).
+    op = Struct("Op", [("Kind", STRING), ("Key", STRING), ("Value", STRING),
+                       ("OpID", INT)])
+    reg = Registry().register("kvpaxos.Op", op)
+    holder = Struct("PrepareReply", [
+        ("Err", STRING), ("Instance", INT), ("Proposal", INT),
+        ("Value", INTERFACE),
+    ])
+    v = {"Err": "OK", "Instance": 3, "Proposal": 7,
+         "Value": ("kvpaxos.Op", {"Kind": "Put", "Key": "k", "Value": "v",
+                                  "OpID": 99})}
+    got, _ = roundtrip(holder, v, registry=reg)
+    got = complete(holder, got)
+    name, inner = got["Value"]
+    assert name == "kvpaxos.Op"
+    assert complete(op, inner) == v["Value"][1]
+    assert got["Err"] == "OK" and got["Proposal"] == 7
+
+
+def test_nil_interface():
+    holder = Struct("H", [("N", INT), ("Value", INTERFACE)])
+    got, _ = roundtrip(holder, {"N": 1, "Value": None})
+    assert complete(holder, got) == {"N": 1, "Value": None}
+
+
+def test_interface_builtin_concrete():
+    reg = Registry().register("int", INT)
+    holder = Struct("H", [("Value", INTERFACE)])
+    got, _ = roundtrip(holder, {"Value": ("int", 42)}, registry=reg)
+    assert got["Value"] == ("int", 42)
+
+
+def test_unregistered_interface_name_raises():
+    holder = Struct("H", [("Value", INTERFACE)])
+    with pytest.raises(GobError):
+        encode_bytes(holder, {"Value": ("nope.Nope", {})})
+
+
+# ------------------------------------------------------------ misc
+
+
+def test_zero_of():
+    t = Struct("T", [("A", INT), ("B", Slice(STRING)), ("C", Array(2, INT))])
+    assert zero_of(t) == {"A": 0, "B": [], "C": [0, 0]}
+
+
+def test_truncated_stream_raises():
+    data = encode_bytes(INT, 7)[:-1]
+    stream = io.BytesIO(data)
+
+    def read(n):
+        b = stream.read(n)
+        if len(b) != n:
+            raise GobError("eof")
+        return b
+
+    with pytest.raises(GobError):
+        Decoder(read).next()

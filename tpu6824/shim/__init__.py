@@ -1,0 +1,10 @@
+"""shim/ — SURVEY §7 layer 5: wire-compatible Go `net/rpc` + `encoding/gob`
+endpoints backed by the TPU runtime, so the reference's unmodified Go clerks
+(`paxos/rpc.go:24-42` and the `call()` clones in every package) can drive this
+framework over the same Unix-domain sockets.
+
+  gob.py      — Go `encoding/gob` stream codec (encode + decode)
+  netrpc.py   — Go `net/rpc` connection protocol (Request/Response framing)
+  wire.py     — the reference's exact wire structs as gob schemas
+  endpoints.py— per-service adapters mapping Go RPC names onto our services
+"""

@@ -1,0 +1,241 @@
+"""Per-service gob/net-rpc endpoints — SURVEY §7 layer 5.
+
+Each `serve_*` wraps one of our running service objects in a `GobRpcServer`
+on a Unix socket, registered under the exact method names the reference's Go
+clerks dial ("KVPaxos.Get", "ShardMaster.Join", "ViewServer.Ping", ... —
+grep of client.go call sites), translating between the Go wire structs
+(`shim/wire.py`) and our Python service surfaces.
+
+Semantics preserved in translation:
+
+  - **At-most-once ids.**  Go clerks stamp ops with a random `OpID int64`
+    (kvpaxos/common.go:26, pbservice/common.go:26) or a `(CID string, Seq
+    int)` pair (shardkv/common.go:23-24).  Our services key duplicate
+    filters on `(cid, cseq)`; an OpID maps to `(OpID, 0)` — same uniqueness,
+    same replay behavior on retries.
+  - **Errors in-band.**  Go services report `Err` inside replies, not as RPC
+    failures; adapters catch our RPCError only where the reference's server
+    would itself have answered in-band (ErrNotReady on TransferState).
+    A dead/timed-out server surfaces as a transport failure — which is what
+    the Go clerk's `call()` sees from a dead reference server too.
+  - **Config translation.**  Our `Config` (gid tuples, UNASSIGNED=0) maps
+    onto Go's `{Num, Shards [10]int64, Groups map[int64][]string}` with
+    identical gid numbering (shardmaster/common.go:37-41).
+
+The Paxos peer protocol itself ("Paxos.Prepare"/"Accept"/"Decided",
+paxos/rpc.go) deliberately has NO gob endpoint: inter-peer consensus traffic
+rides the device plane as masked tensor exchanges (SURVEY §2.3), not
+host RPC.  The schemas exist in wire.py for completeness and for any future
+mixed Go-peer deployment.
+"""
+
+from __future__ import annotations
+
+from tpu6824.services.common import fresh_cid
+from tpu6824.shim import wire
+from tpu6824.shim.netrpc import GobRpcServer
+from tpu6824.utils.errors import OK, ErrNotReady, RPCError
+
+
+# ------------------------------------------------------------- kvpaxos
+
+
+def serve_kvpaxos(server, addr: str, seed: int | None = None) -> GobRpcServer:
+    """kvpaxos clerk surface (kvpaxos/client.go:75,98)."""
+    s = GobRpcServer(addr, seed=seed, registry=wire.default_registry())
+
+    def get(a):
+        err, value = server.get(a["Key"], a["OpID"], 0)
+        return {"Err": err, "Value": value}
+
+    def put_append(a):
+        kind = a["Op"].lower()  # Go "Put"/"Append" → ours "put"/"append"
+        err, _ = server.put_append(kind, a["Key"], a["Value"], a["OpID"], 0)
+        return {"Err": err}
+
+    s.register_method("KVPaxos.Get", get, wire.KV_GET_ARGS, wire.KV_GET_REPLY)
+    s.register_method("KVPaxos.PutAppend", put_append,
+                      wire.KV_PUTAPPEND_ARGS, wire.KV_PUTAPPEND_REPLY)
+    return s.start()
+
+
+# --------------------------------------------------------- viewservice
+
+
+def serve_viewservice(server, addr: str, seed: int | None = None) -> GobRpcServer:
+    """viewservice surface (viewservice/client.go:64,75)."""
+    s = GobRpcServer(addr, seed=seed)
+
+    def _view_dict(v):
+        return {"Viewnum": v.viewnum, "Primary": v.primary, "Backup": v.backup}
+
+    def ping(a):
+        v = server.ping(a["Me"], a["Viewnum"])
+        return {"View": _view_dict(v)}
+
+    def get(_a):
+        return {"View": _view_dict(server.get())}
+
+    s.register_method("ViewServer.Ping", ping, wire.PING_ARGS, wire.PING_REPLY)
+    s.register_method("ViewServer.Get", get, wire.VS_GET_ARGS, wire.VS_GET_REPLY)
+    return s.start()
+
+
+# ----------------------------------------------------------- pbservice
+
+
+def serve_pbservice(server, addr: str, seed: int | None = None) -> GobRpcServer:
+    """pbservice clerk surface (pbservice/client.go:104,128).  The
+    replica-internal RPCs (BackupGet/BackupPutAppend/InitState) stay on the
+    framework's own replica channel — a Go CLIENT never dials them."""
+    s = GobRpcServer(addr, seed=seed)
+
+    def get(a):
+        err, value = server.get(a["Key"], a["OpID"], 0)
+        return {"Err": err, "Value": value}
+
+    def put_append(a):
+        kind = a["Method"].lower()
+        err, _ = server.put_append(a["Key"], kind, a["Value"], a["OpID"], 0)
+        return {"Err": err}
+
+    s.register_method("PBServer.Get", get, wire.PB_GET_ARGS, wire.PB_GET_REPLY)
+    s.register_method("PBServer.PutAppend", put_append,
+                      wire.PB_PUTAPPEND_ARGS, wire.PB_PUTAPPEND_REPLY)
+    return s.start()
+
+
+# --------------------------------------------------------- lockservice
+
+
+def serve_lockservice(server, addr: str, seed: int | None = None) -> GobRpcServer:
+    """lockservice clerk surface (lockservice/client.go:73 + the Unlock the
+    reference left stubbed).  Go's LockArgs carries no client id — each RPC
+    is a fresh op, so a fresh cid preserves the reference behavior."""
+    s = GobRpcServer(addr, seed=seed)
+
+    def lock(a):
+        return {"OK": bool(server.lock(a["Lockname"], fresh_cid(), 0))}
+
+    def unlock(a):
+        return {"OK": bool(server.unlock(a["Lockname"], fresh_cid(), 0))}
+
+    s.register_method("LockServer.Lock", lock, wire.LOCK_ARGS, wire.LOCK_REPLY)
+    s.register_method("LockServer.Unlock", unlock,
+                      wire.UNLOCK_ARGS, wire.UNLOCK_REPLY)
+    return s.start()
+
+
+# --------------------------------------------------------- shardmaster
+
+
+def config_to_wire(cfg) -> dict:
+    """Our Config → Go shardmaster.Config (shardmaster/common.go:37-41)."""
+    return {
+        "Num": cfg.num,
+        "Shards": list(cfg.shards),  # UNASSIGNED == 0 == Go's invalid gid
+        "Groups": {gid: list(srvs) for gid, srvs in cfg.groups},
+    }
+
+
+def serve_shardmaster(server, addr: str, seed: int | None = None) -> GobRpcServer:
+    """shardmaster clerk surface (shardmaster/client.go:63-113).  Go args
+    carry no dedup ids (each RPC is a fresh op in the reference too), so
+    adapters stamp a fresh cid per call."""
+    s = GobRpcServer(addr, seed=seed)
+
+    def join(a):
+        server.join(a["GID"], tuple(a["Servers"]), fresh_cid(), 0)
+        return {}
+
+    def leave(a):
+        server.leave(a["GID"], fresh_cid(), 0)
+        return {}
+
+    def move(a):
+        server.move(a["Shard"], a["GID"], fresh_cid(), 0)
+        return {}
+
+    def query(a):
+        cfg = server.query(a["Num"], fresh_cid(), 0)
+        return {"Config": config_to_wire(cfg)}
+
+    s.register_method("ShardMaster.Join", join, wire.SM_JOIN_ARGS,
+                      wire.SM_JOIN_REPLY)
+    s.register_method("ShardMaster.Leave", leave, wire.SM_LEAVE_ARGS,
+                      wire.SM_LEAVE_REPLY)
+    s.register_method("ShardMaster.Move", move, wire.SM_MOVE_ARGS,
+                      wire.SM_MOVE_REPLY)
+    s.register_method("ShardMaster.Query", query, wire.SM_QUERY_ARGS,
+                      wire.SM_QUERY_REPLY)
+    return s.start()
+
+
+# ------------------------------------------------------------- shardkv
+
+
+def serve_shardkv(server, addr: str, seed: int | None = None) -> GobRpcServer:
+    """shardkv surface (shardkv/client.go:109,148 + the cross-group
+    TransferState, server.go:331).  CID is a string on this wire
+    (shardkv/common.go:23); our dup filter keys on it unchanged."""
+    s = GobRpcServer(addr, seed=seed)
+
+    def get(a):
+        err, value = server.get(a["Key"], a["CID"], a["Seq"])
+        return {"Err": err, "Value": value}
+
+    def put_append(a):
+        kind = a["Op"].lower()
+        err, _ = server.put_append(a["Key"], kind, a["Value"], a["CID"],
+                                   a["Seq"])
+        return {"Err": err}
+
+    def transfer_state(a):
+        empty = {"KVStore": {}, "MRRSMap": {}, "Replies": {}}
+        try:
+            xs = server.transfer_state(a["ConfigNum"], (a["Shard"],))
+        except RPCError as e:
+            # The donor answers ErrNotReady in-band (shardkv/server.go:344).
+            if ErrNotReady in str(e):
+                return {"Err": ErrNotReady, "XState": empty}
+            raise
+        replies, mrrs = {}, {}
+        for cid, (cseq, reply) in xs.dup:
+            err, value = reply if isinstance(reply, tuple) else (OK, "")
+            mrrs[str(cid)] = cseq
+            replies[str(cid)] = {"Err": err, "Value": value or ""}
+        return {"Err": OK, "XState": {
+            "KVStore": dict(xs.kv), "MRRSMap": mrrs, "Replies": replies,
+        }}
+
+    s.register_method("ShardKV.Get", get, wire.SKV_GET_ARGS,
+                      wire.SKV_GET_REPLY)
+    s.register_method("ShardKV.PutAppend", put_append,
+                      wire.SKV_PUTAPPEND_ARGS, wire.SKV_PUTAPPEND_REPLY)
+    s.register_method("ShardKV.TransferState", transfer_state,
+                      wire.SKV_TRANSFER_ARGS, wire.SKV_TRANSFER_REPLY)
+    return s.start()
+
+
+# --------------------------------------------------------------- diskv
+
+
+def serve_diskv(server, addr: str, seed: int | None = None) -> GobRpcServer:
+    """diskv clerk surface (diskv/client.go:104,143) — same shapes as
+    shardkv's clerk wire."""
+    s = GobRpcServer(addr, seed=seed)
+
+    def get(a):
+        err, value = server.get(a["Key"], a["CID"], a["Seq"])
+        return {"Err": err, "Value": value}
+
+    def put_append(a):
+        kind = a["Op"].lower()
+        err, _ = server.put_append(a["Key"], kind, a["Value"], a["CID"],
+                                   a["Seq"])
+        return {"Err": err}
+
+    s.register_method("DisKV.Get", get, wire.DKV_GET_ARGS, wire.DKV_GET_REPLY)
+    s.register_method("DisKV.PutAppend", put_append,
+                      wire.DKV_PUTAPPEND_ARGS, wire.DKV_PUTAPPEND_REPLY)
+    return s.start()

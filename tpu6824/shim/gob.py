@@ -1,0 +1,756 @@
+"""Go `encoding/gob` stream codec — pure Python, no Go required.
+
+Implements the gob wire format (the encoding under Go's `net/rpc`, which is
+the reference's transport codec everywhere — `paxos/rpc.go:25` dials with
+`rpc.Dial`, whose connections speak gob) precisely enough that an unmodified
+Go clerk can exchange every wire struct in the reference with this framework.
+
+Format summary (derived from Go's encoding/gob specification, gob/doc.go):
+
+  - **Unsigned int**: value < 128 → one byte.  Otherwise one byte holding
+    ``256 - n`` (n = minimal big-endian byte count) followed by those bytes.
+  - **Signed int**: bit 0 is the sign; ``i >= 0 → u = i<<1``,
+    ``i < 0 → u = (~i)<<1 | 1``, then unsigned encoding.
+  - **Bool**: uint 0/1.  **Float**: float64 bits byte-reversed, as uint.
+  - **String / []byte**: uint length + raw bytes.
+  - **Slice**: uint count + elements.  **Array**: uint count (== fixed len) +
+    elements.  **Map**: uint count + alternating key, value.
+  - **Struct**: (uint field-delta, field value)... terminated by uint 0.
+    Field deltas start from index -1; zero-valued fields are omitted.
+  - **Top-level non-struct values** are preceded by a single 0x00 "delta"
+    byte (Go's `decodeSingle` requires a zero delta).
+  - **Stream**: a sequence of messages, each a uint byte-count + payload.
+    Payload starts with a signed type id.  Negative id → a type *definition*
+    (a `wireType` meta-struct) for ``-id``; the value follows in a later
+    message.  Positive id → a value of that type.  Ids < 64 are predefined
+    (bool=1 int=2 uint=3 float=4 bytes=5 string=6 complex=7 interface=8);
+    user-defined compound types are assigned 65, 66, ... per stream, each
+    defined before first use.
+  - **Interface values**: uint name length + registered concrete-type name,
+    signed concrete type id, uint byte-count, then the concrete value encoded
+    as a top-level body.  Type definitions needed by the concrete type are
+    emitted as separate messages *before* the message containing the
+    interface value.  A nil interface is a zero-length name.
+
+Named non-struct Go types (`type Err string`, `uint64`, `int64`) collapse to
+their builtin base type, exactly as Go's type system does — so `Err` travels
+as string (id 6) and `Seq uint64` as uint (id 3).
+
+Python value mapping: struct ↔ dict keyed by Go field name, map ↔ dict,
+slice/array ↔ list, string ↔ str, bytes ↔ bytes, interface ↔
+``(registered_name, value)`` tuple or ``None``.
+
+No Go toolchain exists in this image, so the golden byte vectors in
+`tests/test_gob.py` are hand-derived from the specification rather than
+captured from a live Go encoder; the derivations are spelled out there.
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+import threading
+
+__all__ = [
+    "BOOL", "INT", "UINT", "FLOAT", "BYTES", "STRING", "INTERFACE",
+    "Slice", "Array", "Map", "Struct",
+    "GobError", "Encoder", "Decoder", "Registry", "zero_of", "complete",
+]
+
+_MAX_MESSAGE = 64 << 20
+
+BOOL_ID = 1
+INT_ID = 2
+UINT_ID = 3
+FLOAT_ID = 4
+BYTES_ID = 5
+STRING_ID = 6
+COMPLEX_ID = 7
+INTERFACE_ID = 8
+_FIRST_USER_ID = 65
+
+
+class GobError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------
+# schemas
+
+
+class GobType:
+    """Base schema node.  `key()` is a structural identity — two schema nodes
+    with equal keys describe the same Go type and share one wire type id,
+    mirroring Go's per-reflect-type id assignment."""
+
+    def key(self):
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return isinstance(other, GobType) and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+
+class _Builtin(GobType):
+    def __init__(self, name: str, tid: int):
+        self.name = name
+        self.id = tid
+
+    def key(self):
+        return ("builtin", self.id)
+
+    def __repr__(self):
+        return self.name
+
+
+BOOL = _Builtin("BOOL", BOOL_ID)
+INT = _Builtin("INT", INT_ID)
+UINT = _Builtin("UINT", UINT_ID)
+FLOAT = _Builtin("FLOAT", FLOAT_ID)
+BYTES = _Builtin("BYTES", BYTES_ID)
+STRING = _Builtin("STRING", STRING_ID)
+INTERFACE = _Builtin("INTERFACE", INTERFACE_ID)
+
+
+class Slice(GobType):
+    def __init__(self, elem: GobType):
+        self.elem = elem
+
+    def key(self):
+        return ("slice", self.elem.key())
+
+    def __repr__(self):
+        return f"Slice({self.elem!r})"
+
+
+class Array(GobType):
+    def __init__(self, length: int, elem: GobType):
+        self.length = length
+        self.elem = elem
+
+    def key(self):
+        return ("array", self.length, self.elem.key())
+
+    def __repr__(self):
+        return f"Array({self.length}, {self.elem!r})"
+
+
+class Map(GobType):
+    def __init__(self, kt: GobType, vt: GobType):
+        self.kt = kt
+        self.vt = vt
+
+    def key(self):
+        return ("map", self.kt.key(), self.vt.key())
+
+    def __repr__(self):
+        return f"Map({self.kt!r}, {self.vt!r})"
+
+
+class Struct(GobType):
+    def __init__(self, name: str, fields: list[tuple[str, GobType]]):
+        self.name = name
+        self.fields = list(fields)
+
+    def key(self):
+        return ("struct", self.name, tuple((n, t.key()) for n, t in self.fields))
+
+    def __repr__(self):
+        return f"Struct({self.name!r})"
+
+
+def zero_of(t: GobType):
+    """Go's zero value for a schema node, in the Python mapping."""
+    if t is BOOL:
+        return False
+    if t in (INT, UINT):
+        return 0
+    if t is FLOAT:
+        return 0.0
+    if t is BYTES:
+        return b""
+    if t is STRING:
+        return ""
+    if t is INTERFACE:
+        return None
+    if isinstance(t, Slice):
+        return []
+    if isinstance(t, Array):
+        return [zero_of(t.elem) for _ in range(t.length)]
+    if isinstance(t, Map):
+        return {}
+    if isinstance(t, Struct):
+        return {n: zero_of(ft) for n, ft in t.fields}
+    raise GobError(f"no zero for {t!r}")
+
+
+def _is_zero(t: GobType, v) -> bool:
+    if t is BOOL:
+        return not v
+    if t in (INT, UINT):
+        return v == 0
+    if t is FLOAT:
+        return v == 0.0
+    if t in (BYTES, STRING):
+        return len(v) == 0
+    if t is INTERFACE:
+        return v is None
+    if isinstance(t, (Slice, Map)):
+        return v is None or len(v) == 0
+    if isinstance(t, Array):
+        return all(_is_zero(t.elem, e) for e in v)
+    if isinstance(t, Struct):
+        return all(_is_zero(ft, _field_of(v, n, ft)) for n, ft in t.fields)
+    raise GobError(f"no zero-check for {t!r}")
+
+
+def _field_of(v, name: str, ft: GobType):
+    """Struct field access for both value conventions (dict or object)."""
+    if isinstance(v, dict):
+        return v.get(name, zero_of(ft))
+    return getattr(v, name)
+
+
+def complete(t: GobType, v):
+    """Fill gob's omitted-zero-field holes: recursively supply Go zero values
+    for struct fields absent from a decoded dict."""
+    if isinstance(t, Struct):
+        return {
+            n: complete(ft, v[n]) if n in v else zero_of(ft)
+            for n, ft in t.fields
+        }
+    if isinstance(t, (Slice, Array)):
+        return [complete(t.elem, e) for e in v]
+    if isinstance(t, Map):
+        return {k: complete(t.vt, e) for k, e in v.items()}
+    return v
+
+
+class Registry:
+    """Concrete types transmittable inside interface values — the analog of
+    `gob.Register` (the reference registers its Op structs so they can ride
+    `PrepareArgs.Value interface{}`, e.g. kvpaxos's `gob.Register(Op{})`)."""
+
+    def __init__(self):
+        self._by_name: dict[str, GobType] = {}
+
+    def register(self, name: str, t: GobType) -> "Registry":
+        self._by_name[name] = t
+        return self
+
+    def lookup(self, name: str) -> GobType:
+        try:
+            return self._by_name[name]
+        except KeyError:
+            raise GobError(f"unregistered interface concrete type {name!r}")
+
+
+# --------------------------------------------------------------------------
+# primitive (de)serializers
+
+
+def enc_uint(out: bytearray, u: int) -> None:
+    if u < 0 or u >= 1 << 64:
+        raise GobError(f"uint out of range: {u}")  # Go caps at uint64
+    if u < 128:
+        out.append(u)
+        return
+    raw = u.to_bytes((u.bit_length() + 7) // 8, "big")
+    out.append(256 - len(raw))
+    out += raw
+
+
+def enc_int(out: bytearray, i: int) -> None:
+    enc_uint(out, (i << 1) if i >= 0 else ((~i) << 1) | 1)
+
+
+def enc_float(out: bytearray, f: float) -> None:
+    enc_uint(out, int.from_bytes(_struct.pack(">d", f)[::-1], "big"))
+
+
+def enc_string(out: bytearray, s) -> None:
+    raw = s.encode("utf-8") if isinstance(s, str) else bytes(s)
+    enc_uint(out, len(raw))
+    out += raw
+
+
+class _Reader:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise GobError("truncated gob data")
+        b = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def uint(self) -> int:
+        b = self.take(1)[0]
+        if b < 128:
+            return b
+        n = 256 - b
+        if n > 8:
+            raise GobError(f"bad uint byte count {n}")
+        return int.from_bytes(self.take(n), "big")
+
+    def int_(self) -> int:
+        u = self.uint()
+        return ~(u >> 1) if (u & 1) else (u >> 1)
+
+    def float_(self) -> float:
+        u = self.uint()
+        return _struct.unpack(">d", u.to_bytes(8, "big")[::-1])[0]
+
+    def string(self) -> str:
+        return self.take(self.uint()).decode("utf-8")
+
+    def done(self) -> bool:
+        return self.pos >= len(self.data)
+
+
+# --------------------------------------------------------------------------
+# wire type definitions (the meta level)
+#
+# A type-definition message carries a `wireType` meta-struct value.  Field
+# layout of the meta structs, per gob/type.go (ids 16-23 are reserved for
+# them but never appear on the wire — the wireType structure is implied):
+#
+#   wireType   { ArrayT *arrayType; SliceT *sliceType; StructT *structType;
+#                MapT *mapType; ... }           (field indices 0,1,2,3)
+#   CommonType { Name string; Id int }
+#   arrayType  { CommonType; Elem int; Len int }
+#   sliceType  { CommonType; Elem int }
+#   structType { CommonType; Field []fieldType }
+#   fieldType  { Name string; Id int }
+#   mapType    { CommonType; Key int; Elem int }
+
+
+class _WireDef:
+    """A decoded type definition: exactly one of array/slice/strct/mapp."""
+
+    __slots__ = ("kind", "name", "elem", "length", "kt", "vt", "fields")
+
+    def __init__(self, kind, name="", elem=None, length=0, kt=None, vt=None,
+                 fields=None):
+        self.kind = kind        # "array" | "slice" | "struct" | "map"
+        self.name = name
+        self.elem = elem        # type id (array/slice)
+        self.length = length    # array
+        self.kt = kt            # map key type id
+        self.vt = vt            # map value type id
+        self.fields = fields or []  # [(name, type id)] (struct)
+
+
+def _dec_common(r: _Reader) -> tuple[str, int]:
+    name, tid = "", 0
+    f = -1
+    while True:
+        d = r.uint()
+        if d == 0:
+            return name, tid
+        f += d
+        if f == 0:
+            name = r.string()
+        elif f == 1:
+            tid = r.int_()
+        else:
+            raise GobError(f"bad CommonType field {f}")
+
+
+def _dec_typedef(r: _Reader) -> _WireDef:
+    """Parse a wireType meta-struct value into a _WireDef."""
+    f = -1
+    d = r.uint()
+    if d == 0:
+        raise GobError("empty wireType")
+    f += d
+    if f == 0:  # ArrayT
+        name, elem, length = "", 0, 0
+        g = -1
+        while True:
+            d = r.uint()
+            if d == 0:
+                break
+            g += d
+            if g == 0:
+                name, _tid = _dec_common(r)
+            elif g == 1:
+                elem = r.int_()
+            elif g == 2:
+                length = r.int_()
+            else:
+                raise GobError(f"bad arrayType field {g}")
+        wd = _WireDef("array", name=name, elem=elem, length=length)
+    elif f == 1:  # SliceT
+        name, elem = "", 0
+        g = -1
+        while True:
+            d = r.uint()
+            if d == 0:
+                break
+            g += d
+            if g == 0:
+                name, _tid = _dec_common(r)
+            elif g == 1:
+                elem = r.int_()
+            else:
+                raise GobError(f"bad sliceType field {g}")
+        wd = _WireDef("slice", name=name, elem=elem)
+    elif f == 2:  # StructT
+        name, fields = "", []
+        g = -1
+        while True:
+            d = r.uint()
+            if d == 0:
+                break
+            g += d
+            if g == 0:
+                name, _tid = _dec_common(r)
+            elif g == 1:
+                for _ in range(r.uint()):
+                    fname, ftid = "", 0
+                    h = -1
+                    while True:
+                        d2 = r.uint()
+                        if d2 == 0:
+                            break
+                        h += d2
+                        if h == 0:
+                            fname = r.string()
+                        elif h == 1:
+                            ftid = r.int_()
+                        else:
+                            raise GobError(f"bad fieldType field {h}")
+                    fields.append((fname, ftid))
+            else:
+                raise GobError(f"bad structType field {g}")
+        wd = _WireDef("struct", name=name, fields=fields)
+    elif f == 3:  # MapT
+        name, kt, vt = "", 0, 0
+        g = -1
+        while True:
+            d = r.uint()
+            if d == 0:
+                break
+            g += d
+            if g == 0:
+                name, _tid = _dec_common(r)
+            elif g == 1:
+                kt = r.int_()
+            elif g == 2:
+                vt = r.int_()
+            else:
+                raise GobError(f"bad mapType field {g}")
+        wd = _WireDef("map", name=name, kt=kt, vt=vt)
+    else:
+        raise GobError(f"unsupported wireType variant (field {f}) — "
+                       "GobEncoder/BinaryMarshaler payloads not supported")
+    if r.uint() != 0:
+        raise GobError("wireType not terminated")
+    return wd
+
+
+# --------------------------------------------------------------------------
+# Encoder
+
+
+class Encoder:
+    """One gob stream (one direction of one connection).  Thread-safe;
+    type-definition state persists for the stream's lifetime, as in Go."""
+
+    def __init__(self, sink, registry: Registry | None = None):
+        """`sink(bytes)` transmits; `registry` resolves interface values."""
+        self._sink = sink
+        self._registry = registry or Registry()
+        self._ids: dict[tuple, int] = {}
+        self._next = _FIRST_USER_ID
+        self._pending: list[bytes] = []  # framed type-def messages
+        self._lock = threading.Lock()
+
+    # -- type ids ----------------------------------------------------------
+
+    def _type_id(self, t: GobType) -> int:
+        if isinstance(t, _Builtin):
+            return t.id
+        k = t.key()
+        tid = self._ids.get(k)
+        if tid is not None:
+            return tid
+        # Define component types first (Go emits inner defs before outer).
+        if isinstance(t, (Slice, Array)):
+            elem_id = self._type_id(t.elem)
+        elif isinstance(t, Map):
+            kt_id = self._type_id(t.kt)
+            vt_id = self._type_id(t.vt)
+        elif isinstance(t, Struct):
+            field_ids = [self._type_id(ft) for _, ft in t.fields]
+        else:
+            raise GobError(f"cannot assign id to {t!r}")
+        tid = self._next
+        self._next += 1
+        self._ids[k] = tid
+
+        body = bytearray()
+        enc_int(body, -tid)
+        if isinstance(t, Array):
+            enc_uint(body, 1)                       # wireType.ArrayT
+            self._enc_common(body, "", tid)
+            enc_uint(body, 1)                       # .Elem
+            enc_int(body, elem_id)
+            enc_uint(body, 1)                       # .Len
+            enc_int(body, t.length)
+            enc_uint(body, 0)
+        elif isinstance(t, Slice):
+            enc_uint(body, 2)                       # wireType.SliceT
+            self._enc_common(body, "", tid)
+            enc_uint(body, 1)                       # .Elem
+            enc_int(body, elem_id)
+            enc_uint(body, 0)
+        elif isinstance(t, Struct):
+            enc_uint(body, 3)                       # wireType.StructT
+            self._enc_common(body, t.name, tid)
+            enc_uint(body, 1)                       # .Field
+            enc_uint(body, len(t.fields))
+            for (fname, _), fid in zip(t.fields, field_ids):
+                enc_uint(body, 1)                   # fieldType.Name
+                enc_string(body, fname)
+                enc_uint(body, 1)                   # fieldType.Id
+                enc_int(body, fid)
+                enc_uint(body, 0)
+            enc_uint(body, 0)
+        else:  # Map
+            enc_uint(body, 4)                       # wireType.MapT
+            self._enc_common(body, "", tid)
+            enc_uint(body, 1)                       # .Key
+            enc_int(body, kt_id)
+            enc_uint(body, 1)                       # .Elem
+            enc_int(body, vt_id)
+            enc_uint(body, 0)
+        enc_uint(body, 0)                           # end wireType
+        self._pending.append(self._frame(bytes(body)))
+        return tid
+
+    @staticmethod
+    def _enc_common(out: bytearray, name: str, tid: int) -> None:
+        """CommonType as the first (embedded) field of a *Type struct:
+        field delta 1, then {Name?, Id}, then its terminator."""
+        enc_uint(out, 1)
+        if name:
+            enc_uint(out, 1)                        # CommonType.Name
+            enc_string(out, name)
+            enc_uint(out, 1)                        # CommonType.Id (delta 1)
+        else:
+            enc_uint(out, 2)                        # skip zero Name
+        enc_int(out, tid)
+        enc_uint(out, 0)
+
+    @staticmethod
+    def _frame(payload: bytes) -> bytes:
+        head = bytearray()
+        enc_uint(head, len(payload))
+        return bytes(head) + payload
+
+    # -- values ------------------------------------------------------------
+
+    def _enc_value(self, out: bytearray, t: GobType, v, top: bool) -> None:
+        if isinstance(t, Struct):
+            prev = -1
+            for idx, (fname, ft) in enumerate(t.fields):
+                fv = _field_of(v, fname, ft)
+                if _is_zero(ft, fv):
+                    continue
+                enc_uint(out, idx - prev)
+                prev = idx
+                self._enc_value(out, ft, fv, top=False)
+            enc_uint(out, 0)
+            return
+        if top:
+            out.append(0)  # singleton zero delta (gob decodeSingle)
+        self._enc_nonstruct(out, t, v)
+
+    def _enc_nonstruct(self, out: bytearray, t: GobType, v) -> None:
+        if t is BOOL:
+            enc_uint(out, 1 if v else 0)
+        elif t is INT:
+            enc_int(out, int(v))
+        elif t is UINT:
+            enc_uint(out, int(v))
+        elif t is FLOAT:
+            enc_float(out, float(v))
+        elif t is BYTES:
+            enc_string(out, bytes(v))
+        elif t is STRING:
+            enc_string(out, v)
+        elif t is INTERFACE:
+            self._enc_interface(out, v)
+        elif isinstance(t, (Slice, Array)):
+            v = list(v or [])
+            if isinstance(t, Array) and len(v) != t.length:
+                raise GobError(f"array length {len(v)} != {t.length}")
+            enc_uint(out, len(v))
+            for e in v:
+                self._enc_value(out, t.elem, e, top=False)
+        elif isinstance(t, Map):
+            v = v or {}
+            enc_uint(out, len(v))
+            for k, e in v.items():
+                self._enc_value(out, t.kt, k, top=False)
+                self._enc_value(out, t.vt, e, top=False)
+        elif isinstance(t, Struct):
+            self._enc_value(out, t, v, top=False)
+        else:
+            raise GobError(f"cannot encode {t!r}")
+
+    def _enc_interface(self, out: bytearray, v) -> None:
+        if v is None:
+            enc_uint(out, 0)  # nil interface: empty concrete-type name
+            return
+        try:
+            name, inner = v
+        except (TypeError, ValueError):
+            raise GobError(
+                "interface value must be (registered_name, value) or None")
+        t = self._registry.lookup(name)
+        enc_string(out, name)
+        tid = self._type_id(t)  # defs (if new) go to self._pending
+        enc_int(out, tid)
+        sub = bytearray()
+        self._enc_value(sub, t, inner, top=True)
+        enc_uint(out, len(sub))
+        out += sub
+
+    def encode(self, t: GobType, v) -> None:
+        """Transmit one value, preceded by any new type definitions —
+        the equivalent of Go's `Encoder.Encode`."""
+        with self._lock:
+            body = bytearray()
+            tid = self._type_id(t)
+            enc_int(body, tid)
+            self._enc_value(body, t, v, top=True)
+            pending, self._pending = self._pending, []
+            self._sink(b"".join(pending) + self._frame(bytes(body)))
+
+
+# --------------------------------------------------------------------------
+# Decoder
+
+
+class Decoder:
+    """One gob stream, decoding generically from the sender's type
+    definitions (field matching by name happens above, in `complete` /
+    the net/rpc layer), exactly how Go's decoder is wire-driven."""
+
+    def __init__(self, read):
+        """`read(n)` returns exactly n bytes or raises EOFError/GobError.
+
+        No registry: decoding is wire-driven (the sender's type-definition
+        messages carry everything), so interface concrete types decode to
+        ``(name, value)`` without local registration — matching is the
+        caller's concern."""
+        self._read = read
+        self._wire: dict[int, _WireDef] = {}
+
+    def _read_uint(self) -> int:
+        b = self._read(1)[0]
+        if b < 128:
+            return b
+        n = 256 - b
+        if n > 8:
+            raise GobError(f"bad uint byte count {n}")
+        return int.from_bytes(self._read(n), "big")
+
+    def next(self):
+        """Decode the next *value* message → (type_id, value).  Type
+        definitions are absorbed along the way.  Struct values arrive as
+        dicts keyed by the sender's field names (zero fields absent —
+        pass through `complete()` to fill them)."""
+        while True:
+            size = self._read_uint()
+            if size > _MAX_MESSAGE:
+                raise GobError(f"gob message too large: {size}")
+            r = _Reader(self._read(size))
+            tid = r.int_()
+            if tid < 0:
+                self._wire[-tid] = _dec_typedef(r)
+                if not r.done():
+                    raise GobError("trailing bytes after type definition")
+                continue
+            v = self._dec_value(r, tid, top=True)
+            if not r.done():
+                raise GobError("trailing bytes after value")
+            return tid, v
+
+    # -- value decoding ----------------------------------------------------
+
+    def _dec_value(self, r: _Reader, tid: int, top: bool):
+        wd = self._wire.get(tid)
+        if wd is not None and wd.kind == "struct":
+            return self._dec_struct(r, wd)
+        if top:
+            if r.uint() != 0:
+                raise GobError("non-zero delta for singleton value")
+        return self._dec_nonstruct(r, tid, wd)
+
+    def _dec_struct(self, r: _Reader, wd: _WireDef) -> dict:
+        out = {}
+        f = -1
+        while True:
+            d = r.uint()
+            if d == 0:
+                return out
+            f += d
+            if f >= len(wd.fields):
+                raise GobError(
+                    f"field index {f} out of range for struct {wd.name!r}")
+            fname, ftid = wd.fields[f]
+            out[fname] = self._dec_value(r, ftid, top=False)
+
+    def _dec_nonstruct(self, r: _Reader, tid: int, wd: _WireDef | None):
+        if wd is None:
+            if tid == BOOL_ID:
+                return r.uint() != 0
+            if tid == INT_ID:
+                return r.int_()
+            if tid == UINT_ID:
+                return r.uint()
+            if tid == FLOAT_ID:
+                return r.float_()
+            if tid == BYTES_ID:
+                return r.take(r.uint())
+            if tid == STRING_ID:
+                return r.string()
+            if tid == COMPLEX_ID:
+                return complex(r.float_(), r.float_())
+            if tid == INTERFACE_ID:
+                return self._dec_interface(r)
+            raise GobError(f"value of undefined type id {tid}")
+        if wd.kind in ("slice", "array"):
+            n = r.uint()
+            if wd.kind == "array" and n != wd.length:
+                raise GobError(f"array count {n} != declared {wd.length}")
+            return [self._dec_value(r, wd.elem, top=False) for _ in range(n)]
+        if wd.kind == "map":
+            out = {}
+            for _ in range(r.uint()):
+                k = self._dec_value(r, wd.kt, top=False)
+                out[k] = self._dec_value(r, wd.vt, top=False)
+            return out
+        raise GobError(f"cannot decode wire kind {wd.kind!r}")
+
+    def _dec_interface(self, r: _Reader):
+        nlen = r.uint()
+        if nlen == 0:
+            return None
+        name = r.take(nlen).decode("utf-8")
+        tid = r.int_()
+        blen = r.uint()
+        sub = _Reader(r.take(blen))
+        v = self._dec_value(sub, tid, top=True)
+        if not sub.done():
+            raise GobError("trailing bytes inside interface value")
+        return (name, v)

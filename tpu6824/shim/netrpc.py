@@ -1,0 +1,159 @@
+"""Go `net/rpc` connection protocol over Unix sockets, speaking gob.
+
+This is the exact wire conversation Go's `rpc.Dial("unix", srv)` +
+`c.Call(name, args, reply)` has with an `rpc.Server` — the transport under
+every `call()` in the reference (`paxos/rpc.go:24-42` and its clones).  One
+connection carries, per call:
+
+  client → server:  Request{ServiceMethod string; Seq uint64}, then args
+  server → client:  Response{ServiceMethod string; Seq uint64; Error string},
+                    then the reply value (an empty struct when Error is set,
+                    net/rpc's `invalidRequest`)
+
+Each direction is its own gob stream (type definitions sent once per
+direction per connection).  Dial-per-call clients send one request with
+Seq 1 (Go's net/rpc client numbers from 1), but the server loop supports
+pipelined sequential calls the way net/rpc does.
+
+The server reuses the L0 accept-loop fault-injection semantics
+(`tpu6824/rpc/transport.py`, mirroring `paxos/paxos.go:524-552`): unreliable
+mode drops 10% of connections unprocessed and discards 20% of replies after
+executing the call (SHUT_WR — executed-but-unacked), and the socket path
+tricks (deafen / link_alias) apply unchanged since identity is still a
+filesystem pathname.
+"""
+
+from __future__ import annotations
+
+import socket
+
+from tpu6824.rpc import transport
+from tpu6824.shim import gob
+from tpu6824.utils.errors import RPCError
+
+# net/rpc's header structs (rpc/server.go: Request, Seq is uint64).
+REQUEST = gob.Struct("Request", [
+    ("ServiceMethod", gob.STRING),
+    ("Seq", gob.UINT),
+])
+RESPONSE = gob.Struct("Response", [
+    ("ServiceMethod", gob.STRING),
+    ("Seq", gob.UINT),
+    ("Error", gob.STRING),
+])
+# net/rpc's `invalidRequest = struct{}{}` reply body on error.
+INVALID = gob.Struct("InvalidRequest", [])
+
+
+def _sock_read(conn: socket.socket):
+    def read(n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise EOFError("connection closed")
+            buf += chunk
+        return bytes(buf)
+
+    return read
+
+
+class GobRpcServer(transport.Server):
+    """A `transport.Server` whose connections speak Go net/rpc + gob instead
+    of the framework's native pickle framing.  Handlers are registered under
+    Go method names ("KVPaxos.Get") with their gob schemas; a handler takes
+    the zero-completed args dict and returns the reply dict (or raises — the
+    error text travels in Response.Error, as net/rpc does)."""
+
+    def __init__(self, addr: str, seed: int | None = None,
+                 registry: gob.Registry | None = None):
+        super().__init__(addr, seed=seed)
+        self.registry = registry or gob.Registry()
+        self._methods: dict[str, tuple] = {}
+
+    def register_method(self, name: str, fn,
+                        args_schema: gob.Struct,
+                        reply_schema: gob.Struct) -> "GobRpcServer":
+        self._methods[name] = (fn, args_schema, reply_schema)
+        return self
+
+    # transport.Server's accept loop calls this per connection.
+    def _serve_conn(self, conn: socket.socket, discard_reply: bool) -> None:
+        try:
+            conn.settimeout(30.0)
+            dec = gob.Decoder(_sock_read(conn))
+            enc = gob.Encoder(conn.sendall, self.registry)
+            while True:
+                try:
+                    _, req = dec.next()
+                except (EOFError, OSError):
+                    return
+                req = gob.complete(REQUEST, req)
+                method = req["ServiceMethod"]
+                entry = self._methods.get(method)
+                if entry is None:
+                    dec.next()  # consume and discard the args body
+                    self._respond(enc, method, req["Seq"],
+                                  f"rpc: can't find method {method}",
+                                  INVALID, {}, conn, discard_reply)
+                    if discard_reply:
+                        return  # one deaf reply per unreliable connection
+                    continue
+                fn, args_schema, reply_schema = entry
+                _, args = dec.next()
+                args = gob.complete(args_schema, args)
+                try:
+                    reply = fn(args)
+                    err = ""
+                except Exception as e:  # app error → Response.Error
+                    reply, reply_schema, err = {}, INVALID, str(e) or repr(e)
+                self._respond(enc, method, req["Seq"], err,
+                              reply_schema, reply, conn, discard_reply)
+                if discard_reply:
+                    return  # one deaf reply per unreliable connection
+        except (gob.GobError, RPCError, OSError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _respond(enc, method, seq, err, reply_schema, reply, conn,
+                 discard_reply) -> None:
+        if discard_reply:
+            # Executed, but the client sees a dead connection — the SHUT_WR
+            # trick (paxos/paxos.go:535-538).
+            conn.shutdown(socket.SHUT_WR)
+            return
+        enc.encode(RESPONSE, {"ServiceMethod": method, "Seq": seq,
+                              "Error": err})
+        enc.encode(reply_schema, reply)
+
+
+def gob_call(addr: str, method: str, args_schema: gob.Struct, args: dict,
+             reply_schema: gob.Struct | None = None,
+             registry: gob.Registry | None = None,
+             timeout: float = 10.0) -> dict:
+    """One dial-per-call net/rpc invocation — the client half of the
+    reference's `call()` (`paxos/rpc.go:24-42`), with the same contract:
+    raises RPCError when the server can't be reached or the reply is lost
+    (the op may still have executed); a Response.Error becomes an RPCError
+    too, matching `call()` returning false on `c.Call` error."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    try:
+        try:
+            sock.connect(addr)
+            enc = gob.Encoder(sock.sendall, registry)
+            enc.encode(REQUEST, {"ServiceMethod": method, "Seq": 1})
+            enc.encode(args_schema, args or {})
+            dec = gob.Decoder(_sock_read(sock))
+            _, resp = dec.next()
+            resp = gob.complete(RESPONSE, resp)
+            _, reply = dec.next()
+        except (OSError, EOFError, gob.GobError) as e:
+            raise RPCError(f"gob call {method}@{addr}: {e}") from e
+        if resp["Error"]:
+            raise RPCError(f"{method}@{addr}: {resp['Error']}")
+        return gob.complete(reply_schema, reply) if reply_schema else reply
+    finally:
+        sock.close()

@@ -1,0 +1,140 @@
+"""The reference's exact RPC wire structs, as gob schemas.
+
+Field names, order, and Go types are copied from the reference's common.go /
+rpc.go files (citations inline) — field ORDER matters because gob type
+definitions list fields positionally, and NAMES matter because gob decoders
+match wire fields to local struct fields by name.  Named Go string types
+(`Err`) and sized ints (`int64`, `uint`, `uint64`) collapse to gob's builtin
+string/int/uint ids, exactly as Go's encoder treats them.
+"""
+
+from tpu6824.shim.gob import (
+    BOOL, INT, INTERFACE, STRING, UINT, Array, Map, Registry, Slice, Struct,
+)
+
+# --------------------------------------------------------------- paxos
+# paxos/rpc.go:52-84.  Value is interface{} — the application's Op struct
+# rides inside (kvpaxos gob-registers its Op; see REGISTRY below).
+
+PREPARE_ARGS = Struct("PrepareArgs", [("Instance", INT), ("Proposal", INT)])
+PREPARE_REPLY = Struct("PrepareReply", [
+    ("Err", STRING), ("Instance", INT), ("Proposal", INT),
+    ("Value", INTERFACE),
+])
+ACCEPT_ARGS = Struct("AcceptArgs", [
+    ("Instance", INT), ("Proposal", INT), ("Value", INTERFACE),
+])
+ACCEPT_REPLY = Struct("AcceptReply", [("Err", STRING)])
+DECIDED_ARGS = Struct("DecidedArgs", [
+    ("Sender", INT), ("DoneIns", INT), ("Instance", INT),
+    ("Value", INTERFACE),
+])
+DECIDED_REPLY = Struct("DecidedReply", [])
+
+# ------------------------------------------------------------- kvpaxos
+# kvpaxos/common.go:17-42.
+
+KV_PUTAPPEND_ARGS = Struct("PutAppendArgs", [
+    ("Key", STRING), ("Value", STRING), ("Op", STRING), ("OpID", INT),
+])
+KV_PUTAPPEND_REPLY = Struct("PutAppendReply", [("Err", STRING)])
+KV_GET_ARGS = Struct("GetArgs", [("Key", STRING), ("OpID", INT)])
+KV_GET_REPLY = Struct("GetReply", [("Err", STRING), ("Value", STRING)])
+
+# kvpaxos/server.go:25-33 — the Op logged through Paxos, gob-registered so
+# it can travel in PrepareReply.Value etc.
+KV_OP = Struct("Op", [
+    ("Me", INT), ("OpID", INT), ("Op", STRING), ("Key", STRING),
+    ("Value", STRING),
+])
+
+# --------------------------------------------------------- viewservice
+# viewservice/common.go:36-40, 58-80.
+
+VIEW = Struct("View", [
+    ("Viewnum", UINT), ("Primary", STRING), ("Backup", STRING),
+])
+PING_ARGS = Struct("PingArgs", [("Me", STRING), ("Viewnum", UINT)])
+PING_REPLY = Struct("PingReply", [("View", VIEW)])
+VS_GET_ARGS = Struct("GetArgs", [])
+VS_GET_REPLY = Struct("GetReply", [("View", VIEW)])
+
+# ----------------------------------------------------------- pbservice
+# pbservice/common.go:21-47, 76-88.
+
+PB_PUTAPPEND_ARGS = Struct("PutAppendArgs", [
+    ("Key", STRING), ("Value", STRING), ("OpID", INT), ("Method", STRING),
+])
+PB_PUTAPPEND_REPLY = Struct("PutAppendReply", [("Err", STRING)])
+PB_GET_ARGS = Struct("GetArgs", [("Key", STRING), ("OpID", INT)])
+PB_GET_REPLY = Struct("GetReply", [("Err", STRING), ("Value", STRING)])
+PB_INITSTATE_ARGS = Struct("InitStateArgs", [("State", Map(STRING, STRING))])
+PB_INITSTATE_REPLY = Struct("InitStateReply", [("Err", STRING)])
+
+# --------------------------------------------------------- lockservice
+# lockservice/common.go:14-33.
+
+LOCK_ARGS = Struct("LockArgs", [("Lockname", STRING)])
+LOCK_REPLY = Struct("LockReply", [("OK", BOOL)])
+UNLOCK_ARGS = Struct("UnlockArgs", [("Lockname", STRING)])
+UNLOCK_REPLY = Struct("UnlockReply", [("OK", BOOL)])
+
+# --------------------------------------------------------- shardmaster
+# shardmaster/common.go:35-69.  Shards is [10]int64; Groups map[int64][]string.
+
+CONFIG = Struct("Config", [
+    ("Num", INT), ("Shards", Array(10, INT)),
+    ("Groups", Map(INT, Slice(STRING))),
+])
+SM_JOIN_ARGS = Struct("JoinArgs", [("GID", INT), ("Servers", Slice(STRING))])
+SM_JOIN_REPLY = Struct("JoinReply", [])
+SM_LEAVE_ARGS = Struct("LeaveArgs", [("GID", INT)])
+SM_LEAVE_REPLY = Struct("LeaveReply", [])
+SM_MOVE_ARGS = Struct("MoveArgs", [("Shard", INT), ("GID", INT)])
+SM_MOVE_REPLY = Struct("MoveReply", [])
+SM_QUERY_ARGS = Struct("QueryArgs", [("Num", INT)])
+SM_QUERY_REPLY = Struct("QueryReply", [("Config", CONFIG)])
+
+# ------------------------------------------------------------- shardkv
+# shardkv/common.go:21-56; Rep and XState from shardkv/server.go:60-80.
+
+SKV_GET_ARGS = Struct("GetArgs", [
+    ("Key", STRING), ("CID", STRING), ("Seq", INT),
+])
+SKV_GET_REPLY = Struct("GetReply", [("Err", STRING), ("Value", STRING)])
+SKV_PUTAPPEND_ARGS = Struct("PutAppendArgs", [
+    ("Key", STRING), ("Value", STRING), ("Op", STRING), ("CID", STRING),
+    ("Seq", INT),
+])
+SKV_PUTAPPEND_REPLY = Struct("PutAppendReply", [("Err", STRING)])
+REP = Struct("Rep", [("Err", STRING), ("Value", STRING)])
+XSTATE = Struct("XState", [
+    ("KVStore", Map(STRING, STRING)),
+    ("MRRSMap", Map(STRING, INT)),
+    ("Replies", Map(STRING, REP)),
+])
+SKV_TRANSFER_ARGS = Struct("TransferStateArgs", [
+    ("ConfigNum", INT), ("Shard", INT),
+])
+SKV_TRANSFER_REPLY = Struct("TransferStateReply", [
+    ("Err", STRING), ("XState", XSTATE),
+])
+
+# --------------------------------------------------------------- diskv
+# diskv/common.go mirrors shardkv's args (CID string, Seq int).
+
+DKV_GET_ARGS = SKV_GET_ARGS
+DKV_GET_REPLY = SKV_GET_REPLY
+DKV_PUTAPPEND_ARGS = SKV_PUTAPPEND_ARGS
+DKV_PUTAPPEND_REPLY = SKV_PUTAPPEND_REPLY
+
+
+def default_registry() -> Registry:
+    """Concrete types Go registers for interface{} transport —
+    the analog of the reference's `gob.Register(Op{})` calls."""
+    return (
+        Registry()
+        .register("kvpaxos.Op", KV_OP)
+        .register("string", STRING)
+        .register("int", INT)
+    )
