@@ -46,6 +46,10 @@ def main():
         print("bench: accelerator backend unusable; falling back to CPU",
               file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # The probe detects a wedged accelerator, not the absence of one —
+        # a CPU-only jax install passes it and must still get the small shape.
+        on_cpu = all(d.platform == "cpu" for d in jax.devices())
 
     import jax.numpy as jnp
 

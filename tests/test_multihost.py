@@ -1,0 +1,90 @@
+"""Multi-host process-mesh layout (parallel/multihost.py).
+
+Real DCN needs multiple hosts; the layout policy is pure logic, so fake
+devices with `process_index` attributes exercise the multi-host shapes, and
+the degenerate single-process case runs end-to-end on the virtual 8-device
+CPU mesh (consensus step included)."""
+
+from dataclasses import dataclass
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu6824.parallel import multihost
+from tpu6824.parallel.mesh import make_mesh, place_state, sharded_step
+
+
+@dataclass(frozen=True)
+class FakeDev:
+    id: int
+    process_index: int
+
+
+def hostset(n_hosts: int, per_host: int):
+    return [FakeDev(h * per_host + i, h)
+            for h in range(n_hosts) for i in range(per_host)]
+
+
+def test_arrange_two_hosts_host_boundary_on_g():
+    devs = hostset(2, 4)
+    arr = multihost.arrange_for_hosts(devs)
+    g, i, p = arr.shape
+    assert g * i * p == 8
+    # hosts stack along 'g': each g-slice is single-host
+    for gi in range(g):
+        procs = {d.process_index for d in arr[gi].flat}
+        assert len(procs) == 1
+    # both hosts present overall
+    assert {d.process_index for d in arr.flat} == {0, 1}
+
+
+def test_arrange_four_hosts_quorum_axis_local():
+    devs = hostset(4, 8)
+    arr = multihost.arrange_for_hosts(devs)
+    # every ('i','p') tile lives on one host → psum over 'p' rides ICI
+    for gi in range(arr.shape[0]):
+        assert len({d.process_index for d in arr[gi].flat}) == 1
+
+
+def test_ragged_hosts_rejected():
+    devs = hostset(2, 4) + [FakeDev(99, 2)]
+    with pytest.raises(ValueError, match="ragged"):
+        multihost.arrange_for_hosts(devs)
+
+
+def test_dcn_safe_detects_bad_layout():
+    devs = hostset(2, 4)
+    good = multihost.arrange_for_hosts(devs)
+    assert multihost.dcn_safe(
+        type("M", (), {"devices": good})())
+    # Deliberately lay hosts across the 'p' axis: quorum traffic over DCN.
+    bad = np.asarray(devs, dtype=object).reshape(2, 2, 2)  # p pairs split hosts
+    bad = np.moveaxis(bad, 0, 2)  # host boundary now on last ('p') axis
+    assert not multihost.dcn_safe(type("M", (), {"devices": bad})())
+
+
+def test_single_process_mesh_runs_consensus():
+    """Degenerate (1-host) multihost mesh == the normal mesh: the full
+    sharded consensus step must run on it unchanged."""
+    mesh = multihost.make_multihost_mesh(jax.devices())
+    assert dict(mesh.shape).keys() == {"g", "i", "p"}
+    assert multihost.dcn_safe(mesh)
+    assert mesh.devices.size == len(jax.devices())
+
+    # same entry path as __graft_entry__.dryrun_multichip, on this mesh
+    import __graft_entry__ as ge
+
+    gd, idim, pd = (mesh.shape[a] for a in ("g", "i", "p"))
+    G, I, P = 2 * gd, 2 * idim, max(3, pd) if pd == 1 else 2 * pd
+    state, (link, done, key, dr, _) = ge._example_state_and_args(G, I, P)
+    state = place_state(state, mesh)
+    new_state, io = sharded_step(mesh)(state, link, done, key, dr, dr)
+    assert (np.asarray(new_state.decided) >= 0).all()
+
+
+def test_multihost_mesh_same_axes_as_make_mesh():
+    m1 = make_mesh(jax.devices())
+    m2 = multihost.make_multihost_mesh(jax.devices())
+    assert dict(m1.shape) == dict(m2.shape)

@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu6824.core.kernel import PaxosState, paxos_step
 
 
-def _factor3(n: int) -> tuple[int, int, int]:
+def factor3(n: int) -> tuple[int, int, int]:
     """Split n devices into (g, i, p) mesh dims, preferring the group axis."""
     best = (n, 1, 1)
     for p in (1, 2):
@@ -40,7 +40,7 @@ def _factor3(n: int) -> tuple[int, int, int]:
 
 def make_mesh(devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
-    g, i, p = _factor3(len(devices))
+    g, i, p = factor3(len(devices))
     return Mesh(np.asarray(devices).reshape(g, i, p), axis_names=("g", "i", "p"))
 
 

@@ -1,0 +1,104 @@
+"""Multi-host scale-out: process meshes over ICI + DCN.
+
+The reference scales by adding OS processes connected over Unix sockets
+(every `StartServer` in §L3 boots another process on the same machine).  The
+TPU-native equivalent is a **process mesh**: each host contributes its local
+devices, `jax.distributed.initialize` glues the processes into one logical
+runtime, and the same `('g', 'i', 'p')` mesh axes from `parallel/mesh.py`
+span all hosts — collectives ride ICI within a host/slice and DCN between
+hosts, inserted by XLA from the same NamedShardings (SURVEY §2.3: "multi-host
+scale-out uses the same collectives over DCN with a process mesh").
+
+Axis placement policy (the scaling-book recipe — bandwidth-hungry axes on
+the fastest interconnect):
+
+  - 'p' (peers/quorum) reduces every step — it must NEVER span DCN.
+  - 'i' (instance window) exchanges nothing across itself; safe anywhere.
+  - 'g' (groups) is embarrassingly parallel — independent Paxos groups
+    never communicate, so 'g' is the ONLY axis laid across hosts.
+
+`arrange_for_hosts` enforces exactly that: the device array is built so the
+host boundary falls on the leading 'g' axis, and 'i'/'p' tile each host's
+local devices.  This is pure layout logic (testable without hardware);
+`init_multihost` is the thin runtime glue.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from tpu6824.parallel.mesh import factor3
+
+
+def init_multihost(coordinator_address: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None) -> None:
+    """Join this host into the process mesh — the analog of a reference
+    server process binding its Unix socket and learning its peers[] list
+    (`paxos/paxos.go:488-557` takes `peers, me`).  Here: one call per host,
+    all devices become visible in `jax.devices()`, and every host must then
+    build the SAME mesh (same device order) before running the same jitted
+    step.  No-op when the process runtime is already initialized (jax
+    raises on double-initialize)."""
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def group_by_process(devices) -> dict[int, list]:
+    """Bucket devices by owning process (host), preserving order."""
+    by_proc: dict[int, list] = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    return by_proc
+
+
+def arrange_for_hosts(devices) -> np.ndarray:
+    """Arrange devices into a (g, i, p) array whose host boundaries fall on
+    the leading 'g' axis only.
+
+    Every host must contribute the same number of devices (the usual TPU
+    pod/slice shape); 'i' and 'p' factor each host's local device count, and
+    hosts stack along 'g'.  Raises ValueError on ragged contributions."""
+    by_proc = group_by_process(devices)
+    counts = {len(v) for v in by_proc.values()}
+    if len(counts) != 1:
+        raise ValueError(f"ragged device counts per host: "
+                         f"{ {k: len(v) for k, v in by_proc.items()} }")
+    (per_host,) = counts
+    gl, il, pl = factor3(per_host)  # local split; hosts multiply 'g'
+    stacked = [
+        np.asarray(by_proc[pid], dtype=object).reshape(gl, il, pl)
+        for pid in sorted(by_proc)
+    ]
+    return np.concatenate(stacked, axis=0)
+
+
+def make_multihost_mesh(devices=None) -> Mesh:
+    """The multi-host counterpart of `mesh.make_mesh`: same axis names, so
+    `state_shardings` / `sharded_step` work unchanged — a bigger mesh is the
+    whole upgrade, exactly as promised in mesh.py's module docstring."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(arrange_for_hosts(devices), axis_names=("g", "i", "p"))
+
+
+def dcn_safe(mesh: Mesh) -> bool:
+    """True iff no quorum ('p') or window ('i') neighbor pair crosses a host
+    boundary — i.e. every step's reduce/exchange traffic stays on ICI and
+    only the never-communicating 'g' axis spans DCN.  Cheap static check to
+    run after mesh construction on a new topology."""
+    arr = mesh.devices
+    for axis in (1, 2):  # 'i', 'p'
+        a = np.moveaxis(arr, axis, 0)
+        first = np.vectorize(lambda d: d.process_index)(a[0])
+        for sl in a[1:]:
+            if (np.vectorize(lambda d: d.process_index)(sl) != first).any():
+                return False
+    return True
