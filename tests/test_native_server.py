@@ -1,0 +1,219 @@
+"""Native C++ epoll transport server (tpu6824/rpc/native_server.py) —
+the same L0 contract test_rpc.py pins for the Python accept loop, driven
+through the unchanged client side (`transport.call`)."""
+
+import threading
+
+import pytest
+
+from tpu6824.rpc import transport
+from tpu6824.rpc.native_server import NativeServer, make_server, native_available
+from tpu6824.utils.errors import RPCError
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain")
+
+
+@pytest.fixture
+def addr(tmp_path):
+    return str(tmp_path / "nsrv")
+
+
+def test_basic_call(addr):
+    s = NativeServer(addr).register("echo", lambda x: x + 1).start()
+    try:
+        assert transport.call(addr, "echo", 41) == 42
+    finally:
+        s.kill()
+
+
+def test_register_obj_and_methods(addr):
+    class Svc:
+        RPC_METHODS = ["ping"]
+
+        def ping(self, v):
+            return ("pong", v)
+
+        def hidden(self):  # not in RPC_METHODS
+            return "no"
+
+    s = NativeServer(addr).register_obj(Svc()).start()
+    try:
+        assert transport.call(addr, "ping", 7) == ("pong", 7)
+        with pytest.raises(RPCError, match="no such rpc"):
+            transport.call(addr, "hidden")
+    finally:
+        s.kill()
+
+
+def test_app_exception_travels(addr):
+    def boom():
+        raise ValueError("kapow")
+
+    s = NativeServer(addr).register("boom", boom).start()
+    try:
+        with pytest.raises(ValueError, match="kapow"):
+            transport.call(addr, "boom")
+    finally:
+        s.kill()
+
+
+def test_concurrent_calls(addr):
+    ev = threading.Event()
+
+    def slow():
+        ev.wait(5.0)
+        return "slow"
+
+    def fast():
+        return "fast"
+
+    s = NativeServer(addr).register("slow", slow).register("fast", fast).start()
+    try:
+        results = {}
+
+        def call_slow():
+            results["slow"] = transport.call(addr, "slow")
+
+        t = threading.Thread(target=call_slow)
+        t.start()
+        # A slow handler must not stall the loop: fast calls complete first.
+        assert transport.call(addr, "fast") == "fast"
+        ev.set()
+        t.join()
+        assert results["slow"] == "slow"
+    finally:
+        s.kill()
+
+
+def test_many_sequential_dials(addr):
+    s = NativeServer(addr).register("n", lambda i: i * 2).start()
+    try:
+        for i in range(200):
+            assert transport.call(addr, "n", i) == 2 * i
+        assert s.rpc_count == 200
+    finally:
+        s.kill()
+
+
+def test_unreliable_drops_and_serves(addr):
+    """Reference accept-loop rates (paxos/paxos.go:528-544): some calls fail
+    (dropped conn or discarded reply), the rest succeed; every accepted dial
+    counts."""
+    calls = []
+    s = NativeServer(addr, seed=7).register(
+        "inc", lambda: calls.append(1) or len(calls)).start()
+    try:
+        s.set_unreliable(True)
+        ok = fail = 0
+        for _ in range(120):
+            try:
+                transport.call(addr, "inc", timeout=3.0)
+                ok += 1
+            except RPCError:
+                fail += 1
+        assert ok > 50, (ok, fail)
+        assert fail > 5, (ok, fail)  # ~28% expected failure rate
+        # reply-discard means executed-but-unacked: handler ran more often
+        # than the client saw acks.
+        assert len(calls) > ok
+        assert s.rpc_count == 120
+        s.set_unreliable(False)
+        assert transport.call(addr, "inc") == len(calls)
+    finally:
+        s.kill()
+
+
+def test_deafen_then_kill(addr):
+    s = NativeServer(addr).register("x", lambda: 1).start()
+    try:
+        assert transport.call(addr, "x") == 1
+        s.deafen()
+        with pytest.raises(RPCError):
+            transport.call(addr, "x", timeout=2.0)
+    finally:
+        s.kill()
+    with pytest.raises(RPCError):
+        transport.call(addr, "x", timeout=2.0)
+
+
+def test_kill_idempotent(addr):
+    s = NativeServer(addr).register("x", lambda: 1).start()
+    s.kill()
+    s.kill()  # second kill is a no-op
+
+
+def test_make_server_prefers_native(addr):
+    s = make_server(addr)
+    try:
+        assert isinstance(s, NativeServer)
+    finally:
+        s.kill()
+
+
+def test_make_server_python_fallback(addr):
+    s = make_server(addr, prefer_native=False)
+    try:
+        assert isinstance(s, transport.Server)
+        s.register("y", lambda: "py")
+        s.start()
+        assert transport.call(addr, "y") == "py"
+    finally:
+        s.kill()
+
+
+def test_post_kill_surface_stays_safe(addr):
+    """transport.Server allows rpc_count/set_unreliable/deafen after kill;
+    the native server must too (reference tests tally counts after
+    shutdown)."""
+    s = NativeServer(addr).register("x", lambda: 1).start()
+    assert transport.call(addr, "x") == 1
+    count = s.rpc_count
+    s.kill()
+    assert s.rpc_count == count  # final count survives kill
+    s.set_unreliable(True)  # no-ops, no crash
+    s.deafen()
+    s.kill()
+
+
+def test_unseeded_servers_get_independent_fault_streams(tmp_path):
+    """Two unseeded unreliable servers must not drop the same k-th
+    connection pattern (Random(None)-style independence)."""
+    outcomes = []
+    for name in ("a", "b"):
+        addr = str(tmp_path / name)
+        s = NativeServer(addr).register("p", lambda: 1).start()
+        s.set_unreliable(True)
+        pattern = []
+        for _ in range(60):
+            try:
+                transport.call(addr, "p", timeout=2.0)
+                pattern.append(True)
+            except RPCError:
+                pattern.append(False)
+        outcomes.append(tuple(pattern))
+        s.kill()
+    assert outcomes[0] != outcomes[1]
+
+
+def test_proxy_against_native(addr):
+    class KV:
+        RPC_METHODS = ["put", "get"]
+
+        def __init__(self):
+            self.d = {}
+
+        def put(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k, "")
+
+    s = NativeServer(addr).register_obj(KV()).start()
+    try:
+        p = transport.connect(addr)
+        p.put("a", "1")
+        assert p.get("a") == "1"
+    finally:
+        s.kill()

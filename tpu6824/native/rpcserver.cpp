@@ -1,0 +1,321 @@
+// Native L0 transport server — epoll event loop for the Unix-socket RPC
+// endpoints (the runtime under tpu6824/rpc/native_server.py).
+//
+// The reference's per-server accept loop is its runtime kernel: it owns the
+// listening socket, injects faults (drop 10% of connections unprocessed,
+// serve-but-discard 20% of replies via SHUT_WR), and counts RPCs
+// (paxos/paxos.go:524-552).  This is that loop as a native event loop:
+// one epoll thread per server handles accept/read/write for every
+// connection; request payloads are handed to the embedding runtime through
+// a callback; replies come back on ANY thread via rpcsrv_reply (eventfd
+// wakeup), so slow handlers never stall the loop.
+//
+// Framing matches tpu6824/rpc/transport.py: 4-byte big-endian length prefix,
+// opaque payload (the codec lives above).  Semantics mirrored from the
+// Python Server: rpc_count increments per accepted connection (including
+// dropped ones), one request served per connection (dial-per-call), the
+// reply-discard path executes the handler then SHUT_WR so the client sees
+// a dead connection after the op ran — the executed-but-unacked case the
+// at-most-once machinery upstairs is tested against.
+//
+// C ABI only; loaded via ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr size_t kMaxFrame = 64ull << 20;
+constexpr double kReqDrop = 0.10;  // paxos/paxos.go:528-531
+constexpr double kRepDrop = 0.20;  // paxos/paxos.go:535-538
+
+using Callback = void (*)(uint64_t conn_id, const uint8_t* data,
+                          int64_t len);
+
+struct Conn {
+  int fd = -1;
+  bool discard_reply = false;
+  bool handed_off = false;   // one request per connection
+  bool want_write = false;
+  std::vector<uint8_t> rbuf;
+  std::vector<uint8_t> wbuf;
+  size_t woff = 0;
+};
+
+struct Reply {
+  uint64_t conn_id;
+  std::vector<uint8_t> data;
+};
+
+struct Server {
+  int lfd = -1, epfd = -1, evfd = -1;
+  std::string path;
+  std::atomic<bool> dead{false};
+  std::atomic<bool> unreliable{false};
+  std::atomic<int64_t> rpc_count{0};
+  uint64_t rng;
+  Callback cb;
+  std::thread loop;
+  std::mutex mu;  // guards pending
+  std::deque<Reply> pending;
+  std::unordered_map<uint64_t, Conn> conns;
+  uint64_t next_id = 1;
+};
+
+double next_unit(uint64_t& s) {  // xorshift64*, uniform in [0,1)
+  s ^= s >> 12;
+  s ^= s << 25;
+  s ^= s >> 27;
+  return double((s * 2685821657736338717ull) >> 11) / double(1ull << 53);
+}
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void epoll_mod(Server* s, uint64_t id, Conn& c) {
+  epoll_event ev{};
+  ev.events = (c.handed_off ? 0u : unsigned(EPOLLIN)) |
+              (c.want_write ? unsigned(EPOLLOUT) : 0u);
+  ev.data.u64 = id;
+  epoll_ctl(s->epfd, EPOLL_CTL_MOD, c.fd, &ev);
+}
+
+void close_conn(Server* s, uint64_t id) {
+  auto it = s->conns.find(id);
+  if (it == s->conns.end()) return;
+  epoll_ctl(s->epfd, EPOLL_CTL_DEL, it->second.fd, nullptr);
+  close(it->second.fd);
+  s->conns.erase(it);
+}
+
+void handle_accept(Server* s) {
+  for (;;) {
+    int fd = accept4(s->lfd, nullptr, nullptr, SOCK_NONBLOCK);
+    if (fd < 0) return;
+    s->rpc_count.fetch_add(1, std::memory_order_relaxed);
+    bool unrel = s->unreliable.load(std::memory_order_relaxed);
+    double r1 = next_unit(s->rng), r2 = next_unit(s->rng);
+    if (unrel && r1 < kReqDrop) {  // discard unprocessed: op NOT executed
+      close(fd);
+      continue;
+    }
+    uint64_t id = s->next_id++;
+    Conn& c = s->conns[id];
+    c.fd = fd;
+    c.discard_reply = unrel && r2 < kRepDrop;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = id;
+    epoll_ctl(s->epfd, EPOLL_CTL_ADD, fd, &ev);
+  }
+}
+
+void handle_read(Server* s, uint64_t id) {
+  auto it = s->conns.find(id);
+  if (it == s->conns.end()) return;
+  Conn& c = it->second;
+  uint8_t buf[65536];
+  for (;;) {
+    ssize_t n = read(c.fd, buf, sizeof buf);
+    if (n > 0) {
+      c.rbuf.insert(c.rbuf.end(), buf, buf + n);
+      if (c.rbuf.size() > kMaxFrame + 4) {
+        close_conn(s, id);
+        return;
+      }
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    close_conn(s, id);  // EOF or error before a full frame
+    return;
+  }
+  if (c.handed_off || c.rbuf.size() < 4) return;
+  size_t len = (size_t(c.rbuf[0]) << 24) | (size_t(c.rbuf[1]) << 16) |
+               (size_t(c.rbuf[2]) << 8) | size_t(c.rbuf[3]);
+  if (len > kMaxFrame) {
+    close_conn(s, id);
+    return;
+  }
+  if (c.rbuf.size() < 4 + len) return;
+  c.handed_off = true;  // one request per connection (dial-per-call)
+  epoll_mod(s, id, c);
+  s->cb(id, c.rbuf.data() + 4, int64_t(len));
+}
+
+void handle_write(Server* s, uint64_t id) {
+  auto it = s->conns.find(id);
+  if (it == s->conns.end()) return;
+  Conn& c = it->second;
+  while (c.woff < c.wbuf.size()) {
+    ssize_t n = write(c.fd, c.wbuf.data() + c.woff, c.wbuf.size() - c.woff);
+    if (n > 0) {
+      c.woff += size_t(n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+    close_conn(s, id);
+    return;
+  }
+  close_conn(s, id);  // reply fully written → connection done
+}
+
+void drain_replies(Server* s) {
+  std::deque<Reply> batch;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    batch.swap(s->pending);
+  }
+  for (Reply& r : batch) {
+    auto it = s->conns.find(r.conn_id);
+    if (it == s->conns.end()) continue;  // client gone meanwhile
+    Conn& c = it->second;
+    if (r.data.empty()) {  // close-only marker: drop without replying
+      close_conn(s, r.conn_id);
+      continue;
+    }
+    if (c.discard_reply) {
+      // Executed, but the client sees a dead connection — SHUT_WR
+      // (paxos/paxos.go:535-538).
+      shutdown(c.fd, SHUT_WR);
+      close_conn(s, r.conn_id);
+      continue;
+    }
+    uint32_t len = uint32_t(r.data.size());
+    c.wbuf.resize(4 + r.data.size());
+    c.wbuf[0] = uint8_t(len >> 24);
+    c.wbuf[1] = uint8_t(len >> 16);
+    c.wbuf[2] = uint8_t(len >> 8);
+    c.wbuf[3] = uint8_t(len);
+    memcpy(c.wbuf.data() + 4, r.data.data(), r.data.size());
+    c.want_write = true;
+    epoll_mod(s, r.conn_id, c);
+    handle_write(s, r.conn_id);  // opportunistic immediate flush
+  }
+}
+
+void loop_body(Server* s) {
+  epoll_event evs[64];
+  while (!s->dead.load(std::memory_order_acquire)) {
+    int n = epoll_wait(s->epfd, evs, 64, 200);
+    for (int i = 0; i < n; i++) {
+      uint64_t id = evs[i].data.u64;
+      if (id == 0) {  // listener
+        handle_accept(s);
+      } else if (id == 1) {  // eventfd: replies pending
+        uint64_t junk;
+        while (read(s->evfd, &junk, 8) == 8) {
+        }
+        drain_replies(s);
+      } else {
+        if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+          close_conn(s, id);
+          continue;
+        }
+        if (evs[i].events & EPOLLIN) handle_read(s, id);
+        if (evs[i].events & EPOLLOUT) handle_write(s, id);
+      }
+    }
+  }
+  for (auto& [id, c] : s->conns) close(c.fd);
+  s->conns.clear();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rpcsrv_start(const char* path, uint64_t seed, Callback cb) {
+  auto* s = new Server;
+  s->path = path;
+  s->rng = seed ? seed : 0x9e3779b97f4a7c15ull;
+  s->cb = cb;
+  unlink(path);
+  s->lfd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+  if (s->lfd < 0 || bind(s->lfd, (sockaddr*)&addr, sizeof addr) != 0 ||
+      listen(s->lfd, 128) != 0) {
+    if (s->lfd >= 0) close(s->lfd);
+    delete s;
+    return nullptr;
+  }
+  s->epfd = epoll_create1(0);
+  s->evfd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  // listener sentinel
+  epoll_ctl(s->epfd, EPOLL_CTL_ADD, s->lfd, &ev);
+  ev.data.u64 = 1;  // eventfd sentinel
+  epoll_ctl(s->epfd, EPOLL_CTL_ADD, s->evfd, &ev);
+  s->next_id = 2;
+  s->loop = std::thread(loop_body, s);
+  return s;
+}
+
+void rpcsrv_reply(void* srv, uint64_t conn_id, const uint8_t* data,
+                  int64_t len) {
+  auto* s = static_cast<Server*>(srv);
+  if (s->dead.load(std::memory_order_acquire)) return;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->pending.push_back(
+        Reply{conn_id, std::vector<uint8_t>(data, data + len)});
+  }
+  uint64_t one = 1;
+  ssize_t ignored = write(s->evfd, &one, 8);
+  (void)ignored;
+}
+
+void rpcsrv_set_unreliable(void* srv, int flag) {
+  static_cast<Server*>(srv)->unreliable.store(flag != 0,
+                                              std::memory_order_relaxed);
+}
+
+int64_t rpcsrv_rpc_count(void* srv) {
+  return static_cast<Server*>(srv)->rpc_count.load(
+      std::memory_order_relaxed);
+}
+
+void rpcsrv_deafen(void* srv) {
+  // Remove the socket path out from under the live server: the inode keeps
+  // listening but nobody can dial it (paxos/test_test.go:194-195).
+  unlink(static_cast<Server*>(srv)->path.c_str());
+}
+
+void rpcsrv_kill(void* srv) {
+  // Stops the loop and closes sockets; does NOT free — the embedder calls
+  // rpcsrv_free after it has guaranteed no thread can still call
+  // rpcsrv_reply (the Python wrapper serializes reply/kill/free on a lock).
+  auto* s = static_cast<Server*>(srv);
+  if (s->dead.exchange(true, std::memory_order_acq_rel)) return;
+  uint64_t one = 1;
+  ssize_t ignored = write(s->evfd, &one, 8);
+  (void)ignored;
+  if (s->loop.joinable()) s->loop.join();
+  close(s->lfd);
+  close(s->epfd);
+  close(s->evfd);
+  unlink(s->path.c_str());
+}
+
+void rpcsrv_free(void* srv) { delete static_cast<Server*>(srv); }
+
+}  // extern "C"

@@ -1,0 +1,205 @@
+"""Native L0 transport server — the C++ epoll event loop behind the same
+surface as `transport.Server`.
+
+The reference's runtime is its per-server accept loop (`paxos/paxos.go:
+524-552`); `tpu6824/native/rpcserver.cpp` is that loop as a native epoll
+event loop (fault injection, rpc counting, framing all in C++), while the
+codec and handlers stay in Python: the loop hands each request payload to a
+callback, a handler thread computes the reply, and the reply re-enters the
+loop through an eventfd — so slow handlers never stall accepts and many
+connections are multiplexed without a thread per socket.
+
+Drop-in: `NativeServer` exposes the `transport.Server` API and contract —
+register → start → serve; kill() is final but rpc_count/set_unreliable/
+deafen stay safe to call afterwards; one handler thread per in-flight
+request (the Python loop's thread-per-connection semantics); unseeded
+servers get independent OS-entropy fault streams.  It speaks the same wire
+format, so `transport.call`, `Proxy`, the harness's partition/alias tricks,
+and the DelayProxy all work unchanged against it.  Falls back to
+`transport.Server` when no C++ toolchain is available (`native_available()`
+/ `make_server`)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import threading
+
+from tpu6824.native.build import load
+from tpu6824.rpc import transport
+from tpu6824.utils.errors import RPCError
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "rpcserver.cpp")
+
+_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64,
+                       ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64)
+
+_lib = None
+_lib_once = threading.Lock()
+
+
+def _get_lib():
+    global _lib
+    with _lib_once:
+        if _lib is None:
+            lib = load("rpcserver.so", _SRC)
+            if lib is not None:
+                lib.rpcsrv_start.restype = ctypes.c_void_p
+                lib.rpcsrv_start.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_uint64, _CB]
+                lib.rpcsrv_reply.argtypes = [
+                    ctypes.c_void_p, ctypes.c_uint64,
+                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                ]
+                lib.rpcsrv_set_unreliable.argtypes = [ctypes.c_void_p,
+                                                      ctypes.c_int]
+                lib.rpcsrv_rpc_count.restype = ctypes.c_int64
+                lib.rpcsrv_rpc_count.argtypes = [ctypes.c_void_p]
+                lib.rpcsrv_deafen.argtypes = [ctypes.c_void_p]
+                lib.rpcsrv_kill.argtypes = [ctypes.c_void_p]
+                lib.rpcsrv_free.argtypes = [ctypes.c_void_p]
+            _lib = lib or False
+    return _lib or None
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+class NativeServer:
+    """transport.Server's surface, backed by the C++ event loop.  The
+    socket binds in `start()` (register handlers first, then expose — the
+    reference order, so a dialer never sees a live socket with no
+    handlers)."""
+
+    def __init__(self, addr: str, seed: int | None = None):
+        lib = _get_lib()
+        if lib is None:
+            raise RPCError("native transport unavailable (no C++ toolchain)")
+        self.addr = addr
+        os.makedirs(os.path.dirname(addr) or ".", exist_ok=True)
+        self._lib = lib
+        self._handlers: dict[str, callable] = {}
+        self._lock = threading.Lock()  # serializes reply vs kill/free
+        self._dead = False
+        self._srv = None
+        self._final_rpc_count = 0
+        self._unreliable = False
+        # Unseeded servers must have INDEPENDENT fault streams (the Python
+        # loop uses Random(None) per server); xorshift state must be nonzero.
+        s = seed if seed is not None else int.from_bytes(os.urandom(8), "little")
+        self._seed = (s & (2**64 - 1)) or 1
+        # The CFUNCTYPE object must outlive the server (C holds the pointer).
+        self._cb = _CB(self._on_request)
+
+    # ------------------------------------------------------------ surface
+
+    def register(self, name: str, fn) -> "NativeServer":
+        self._handlers[name] = fn
+        return self
+
+    def register_obj(self, obj, methods: list[str] | None = None) -> "NativeServer":
+        for m in transport.exported_methods(obj, methods):
+            self._handlers[m] = getattr(obj, m)
+        return self
+
+    def start(self) -> "NativeServer":
+        with self._lock:
+            if self._dead or self._srv is not None:
+                return self
+            self._srv = self._lib.rpcsrv_start(self.addr.encode(),
+                                               self._seed, self._cb)
+            if not self._srv:
+                raise RPCError(f"native transport failed to bind {self.addr}")
+            if self._unreliable:  # flag set before start
+                self._lib.rpcsrv_set_unreliable(self._srv, 1)
+        return self
+
+    def set_unreliable(self, flag: bool) -> None:
+        with self._lock:
+            self._unreliable = bool(flag)
+            if self._srv is not None and not self._dead:
+                self._lib.rpcsrv_set_unreliable(self._srv, 1 if flag else 0)
+
+    @property
+    def rpc_count(self) -> int:
+        with self._lock:
+            if self._srv is not None and not self._dead:
+                return int(self._lib.rpcsrv_rpc_count(self._srv))
+            return self._final_rpc_count  # post-kill reads stay valid
+
+    def deafen(self) -> None:
+        with self._lock:
+            if self._srv is not None and not self._dead:
+                self._lib.rpcsrv_deafen(self._srv)
+
+    def kill(self) -> None:
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            if self._srv is not None:
+                self._final_rpc_count = int(
+                    self._lib.rpcsrv_rpc_count(self._srv))
+                self._lib.rpcsrv_kill(self._srv)
+                # kill joined the loop → no new callbacks; the lock ensures
+                # no in-flight _send_reply still holds the old pointer.
+                self._lib.rpcsrv_free(self._srv)
+                self._srv = None
+
+    # ------------------------------------------------------------ plumbing
+
+    def _on_request(self, conn_id: int, data, length: int) -> None:
+        # Runs on the C++ loop thread (ctypes grabs the GIL): copy out and
+        # hand off so the loop returns to epoll immediately.  One thread per
+        # in-flight request — the Python accept loop's semantics, so N
+        # concurrently blocking handlers never starve request N+1.
+        payload = ctypes.string_at(data, length)
+        threading.Thread(target=self._serve, args=(conn_id, payload),
+                         daemon=True).start()
+
+    def _serve(self, conn_id: int, payload: bytes) -> None:
+        try:
+            rpcname, args = pickle.loads(payload)
+            fn = self._handlers.get(rpcname)
+            if fn is None:
+                reply = (False, f"no such rpc: {rpcname}")
+            else:
+                try:
+                    reply = (True, fn(*args))
+                except RPCError:
+                    # Drop the connection without replying, as
+                    # transport.Server does (zero-length = close marker).
+                    self._send_reply(conn_id, b"")
+                    return
+                except Exception as e:
+                    reply = (False, e)
+        except Exception:
+            self._send_reply(conn_id, b"")  # undecodable frame: drop
+            return
+        try:
+            raw = pickle.dumps(reply, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:
+            raw = pickle.dumps(
+                (False, f"unserializable reply ({e!r:.100})"),
+                protocol=pickle.HIGHEST_PROTOCOL)
+        self._send_reply(conn_id, raw)
+
+    def _send_reply(self, conn_id: int, raw: bytes) -> None:
+        buf = (ctypes.c_uint8 * len(raw)).from_buffer_copy(raw)
+        with self._lock:
+            if self._dead or self._srv is None:
+                return
+            self._lib.rpcsrv_reply(self._srv, conn_id, buf, len(raw))
+
+
+def make_server(addr: str, seed: int | None = None, prefer_native=True):
+    """Native event-loop server when the toolchain allows, else the Python
+    accept-loop server — same surface either way.  NOT yet started: register
+    handlers, then call .start() (register-before-expose, so a dialer never
+    reaches a socket with no handlers behind it)."""
+    if prefer_native and native_available():
+        return NativeServer(addr, seed=seed)
+    return transport.Server(addr, seed=seed)

@@ -100,6 +100,19 @@ def call(addr: str, rpcname: str, *args, timeout: float = 10.0):
         sock.close()
 
 
+def exported_methods(obj, methods: list[str] | None = None) -> list[str]:
+    """The RPC export policy, shared by every server backend.  Precedence:
+    explicit `methods` > the object's `RPC_METHODS` attribute > all public
+    callables minus the lifecycle denylist (Go's net/rpc excludes lifecycle
+    methods via its signature filter; we use an explicit denylist)."""
+    return methods or getattr(obj, "RPC_METHODS", None) or [
+        m for m in dir(obj)
+        if not m.startswith("_")
+        and m not in Server._NEVER_EXPORT
+        and callable(getattr(obj, m))
+    ]
+
+
 class Server:
     """One RPC endpoint on a Unix socket; the accept loop is the
     fault-injection point, exactly as in the reference (§ docstring above)."""
@@ -138,16 +151,8 @@ class Server:
 
     def register_obj(self, obj, methods: list[str] | None = None) -> "Server":
         """Expose an object's methods as RPCs (the net/rpc
-        `rpcs.Register(px)` pattern, `paxos/paxos.go:496-516`).  Precedence:
-        explicit `methods` > the object's `RPC_METHODS` attribute > all
-        public callables minus the lifecycle denylist."""
-        names = methods or getattr(obj, "RPC_METHODS", None) or [
-            m for m in dir(obj)
-            if not m.startswith("_")
-            and m not in self._NEVER_EXPORT
-            and callable(getattr(obj, m))
-        ]
-        for m in names:
+        `rpcs.Register(px)` pattern, `paxos/paxos.go:496-516`)."""
+        for m in exported_methods(obj, methods):
             self._handlers[m] = getattr(obj, m)
         return self
 
