@@ -143,6 +143,37 @@ def test_kill_idempotent(addr):
     s.kill()  # second kill is a no-op
 
 
+def test_send_then_shutwr_client_is_served(addr):
+    """A dialer may legally send the frame, shut down its write side, and
+    wait for the reply — the buffered frame must still be served."""
+    import pickle
+    import socket
+    import struct
+
+    s = NativeServer(addr).register("echo", lambda x: x).start()
+    try:
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.settimeout(5.0)
+        c.connect(addr)
+        payload = pickle.dumps(("echo", ("hi",)))
+        c.sendall(struct.pack(">I", len(payload)) + payload)
+        c.shutdown(socket.SHUT_WR)
+        (n,) = struct.unpack(">I", c.recv(4))
+        data = b""
+        while len(data) < n:
+            data += c.recv(n - len(data))
+        assert pickle.loads(data) == (True, "hi")
+        c.close()
+    finally:
+        s.kill()
+
+
+def test_overlong_socket_path_rejected(tmp_path):
+    long_addr = str(tmp_path / ("x" * 200))
+    with pytest.raises(RPCError, match="bind"):
+        NativeServer(long_addr).start()
+
+
 def test_make_server_prefers_native(addr):
     s = make_server(addr)
     try:

@@ -1,0 +1,166 @@
+"""gob endpoints for the sharded services (serve_shardkv / serve_diskv) —
+the cross-group TransferState wire conversion included.
+
+Complements test_shim.py (kvpaxos/viewservice/shardmaster/lockservice): the
+shardkv wire carries (CID string, Seq int) dedup pairs and the XState
+{KVStore, MRRSMap, Replies} struct (shardkv/common.go:21-56,
+server.go:60-80)."""
+
+import pytest
+
+from tpu6824.ops.hashing import key2shard
+from tpu6824.services.common import fresh_cid
+from tpu6824.services.shardkv import ShardSystem
+from tpu6824.shim import endpoints, wire
+from tpu6824.shim.netrpc import gob_call
+from tpu6824.utils.errors import OK, ErrNotReady, ErrWrongGroup, RPCError
+from tpu6824.utils.timing import wait_until
+
+
+@pytest.fixture
+def system(tmp_path):
+    s = ShardSystem(ngroups=2, nreplicas=3, ninstances=32)
+    eps = {}
+    for gid in s.gids:
+        for i, srv in enumerate(s.groups[gid]):
+            eps[(gid, i)] = endpoints.serve_shardkv(
+                srv, str(tmp_path / f"skv-{gid}-{i}"))
+    yield s, eps
+    for e in eps.values():
+        e.kill()
+    s.shutdown()
+
+
+def _retrying(call_once, deadline_s=30.0):
+    """The Go clerk's loop (shardkv/client.go:89-163): retry the same op —
+    same CID/Seq — while the group answers ErrWrongGroup (config not yet
+    reached) or the transport fails."""
+    import time
+
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            r = call_once()
+            if r["Err"] != ErrWrongGroup:
+                return r
+        except RPCError:
+            pass
+        if time.monotonic() >= deadline:
+            raise AssertionError("clerk retry loop timed out")
+        time.sleep(0.1)
+
+
+def skv_put(addr, key, value, cid, seq, op="Put"):
+    return _retrying(lambda: gob_call(
+        addr, "ShardKV.PutAppend", wire.SKV_PUTAPPEND_ARGS,
+        {"Key": key, "Value": value, "Op": op, "CID": cid, "Seq": seq},
+        wire.SKV_PUTAPPEND_REPLY, timeout=30.0))
+
+
+def skv_get(addr, key, cid, seq):
+    return _retrying(lambda: gob_call(
+        addr, "ShardKV.Get", wire.SKV_GET_ARGS,
+        {"Key": key, "CID": cid, "Seq": seq},
+        wire.SKV_GET_REPLY, timeout=30.0))
+
+
+def test_shardkv_go_wire_ops(system):
+    s, eps = system
+    g0 = s.gids[0]
+    s.join(g0)
+    addr = eps[(g0, 0)].addr
+    cid = f"goclerk-{fresh_cid()}"
+    assert skv_put(addr, "a", "va", cid, 1)["Err"] == OK
+    assert skv_put(addr, "a", "+1", cid, 2, op="Append")["Err"] == OK
+    r = skv_get(addr, "a", cid, 3)
+    assert (r["Err"], r["Value"]) == (OK, "va+1")
+    # duplicate Seq replays the cached reply, not a second append
+    assert skv_put(addr, "a", "+1", cid, 2, op="Append")["Err"] == OK
+    assert skv_get(addr, "a", cid, 4)["Value"] == "va+1"
+
+
+def test_shardkv_wrong_group_in_band(system):
+    """A group that doesn't own the shard answers ErrWrongGroup in the reply
+    (shardkv/server.go:205-242), not a transport error."""
+    s, eps = system
+    g0, g1 = s.gids
+    s.join(g0)  # g1 never joins: owns nothing
+    cid = f"c-{fresh_cid()}"
+    r = gob_call(eps[(g1, 0)].addr, "ShardKV.PutAppend",
+                 wire.SKV_PUTAPPEND_ARGS,
+                 {"Key": "a", "Value": "x", "Op": "Put", "CID": cid,
+                  "Seq": 1}, wire.SKV_PUTAPPEND_REPLY, timeout=30.0)
+    assert r["Err"] == ErrWrongGroup
+
+
+def test_transfer_state_wire_conversion(system):
+    """Donor-side TransferState over gob: XState carries the shard's keys
+    and the per-client dedup state (shardkv/server.go:340-367)."""
+    s, eps = system
+    g0 = s.gids[0]
+    s.join(g0)
+    addr = eps[(g0, 0)].addr
+    cid = f"c-{fresh_cid()}"
+    keys = [chr(ord("a") + i) for i in range(6)]
+    for i, k in enumerate(keys):
+        assert skv_put(addr, k, f"v{i}", cid, i + 1)["Err"] == OK
+
+    cfgnum = s.sm_clerk().query(-1).num
+    donor = s.groups[g0][0]
+    assert wait_until(lambda: donor.config.num >= cfgnum, timeout=30.0)
+
+    shard = key2shard(keys[0])
+    r = gob_call(addr, "ShardKV.TransferState", wire.SKV_TRANSFER_ARGS,
+                 {"ConfigNum": cfgnum, "Shard": shard},
+                 wire.SKV_TRANSFER_REPLY, timeout=30.0)
+    assert r["Err"] == OK
+    xs = r["XState"]
+    mine = {k for k in keys if key2shard(k) == shard}
+    assert mine and mine <= set(xs["KVStore"])
+    for k in xs["KVStore"]:
+        assert key2shard(k) == shard  # only the requested shard travels
+    assert xs["MRRSMap"].get(cid) == len(keys)  # dedup state travels too
+    assert xs["Replies"][cid]["Err"] == OK
+
+
+def test_transfer_state_not_ready_in_band(system):
+    """Asking a donor for a config it hasn't reached answers ErrNotReady
+    in-band (shardkv/server.go:344) — the config lattice gate."""
+    s, eps = system
+    g0 = s.gids[0]
+    s.join(g0)
+    addr = eps[(g0, 0)].addr
+    r = gob_call(addr, "ShardKV.TransferState", wire.SKV_TRANSFER_ARGS,
+                 {"ConfigNum": 999, "Shard": 0},
+                 wire.SKV_TRANSFER_REPLY, timeout=30.0)
+    assert r["Err"] == ErrNotReady
+    assert r["XState"]["KVStore"] == {}
+
+
+def test_diskv_go_wire_ops(tmp_path):
+    from tpu6824.services.diskv import DisKVSystem
+
+    s = DisKVSystem(str(tmp_path / "disks"), ngroups=1, nreplicas=3,
+                    ninstances=32)
+    eps = []
+    try:
+        gid = s.gids[0]
+        s.sm_clerk().join(gid, [f"g{gid}-{p}" for p in range(3)])
+        for i, srv in enumerate(s.groups[gid]):
+            eps.append(endpoints.serve_diskv(
+                srv, str(tmp_path / f"dkv-{i}")))
+        cid = f"c-{fresh_cid()}"
+        r = _retrying(lambda: gob_call(
+            eps[0].addr, "DisKV.PutAppend", wire.DKV_PUTAPPEND_ARGS,
+            {"Key": "k", "Value": "disk", "Op": "Put", "CID": cid, "Seq": 1},
+            wire.DKV_PUTAPPEND_REPLY, timeout=30.0))
+        assert r["Err"] == OK
+        r = _retrying(lambda: gob_call(
+            eps[1].addr, "DisKV.Get", wire.DKV_GET_ARGS,
+            {"Key": "k", "CID": cid, "Seq": 2},
+            wire.DKV_GET_REPLY, timeout=30.0))
+        assert (r["Err"], r["Value"]) == (OK, "disk")
+    finally:
+        for e in eps:
+            e.kill()
+        s.shutdown()

@@ -44,14 +44,13 @@ class Deployment:
         return os.path.join(self.dir, name)
 
     def serve(self, name: str, obj, methods: list[str] | None = None,
-              seed: int | None = None, native: bool | None = None) -> Proxy:
+              seed: int | None = None, native: bool = True) -> Proxy:
         """Expose `obj` at a socket; returns a Proxy to it.  Uses the C++
         epoll event loop (rpc/native_server.py) when the toolchain allows —
         pass native=False to force the Python accept loop."""
         from tpu6824.rpc.native_server import make_server
 
-        prefer = native if native is not None else True
-        srv = make_server(self.addr(name), seed=seed, prefer_native=prefer)
+        srv = make_server(self.addr(name), seed=seed, prefer_native=native)
         srv.register_obj(obj, methods)
         srv.start()  # register-before-expose
         self._servers[name] = srv

@@ -31,6 +31,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include <chrono>
+
 #include <fcntl.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
@@ -43,6 +45,13 @@ namespace {
 constexpr size_t kMaxFrame = 64ull << 20;
 constexpr double kReqDrop = 0.10;  // paxos/paxos.go:528-531
 constexpr double kRepDrop = 0.20;  // paxos/paxos.go:535-538
+constexpr int64_t kConnTimeoutMs = 30'000;  // transport.py settimeout(30.0)
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 using Callback = void (*)(uint64_t conn_id, const uint8_t* data,
                           int64_t len);
@@ -52,6 +61,7 @@ struct Conn {
   bool discard_reply = false;
   bool handed_off = false;   // one request per connection
   bool want_write = false;
+  int64_t deadline_ms = 0;   // absolute steady-clock ms; 30s per conn
   std::vector<uint8_t> rbuf;
   std::vector<uint8_t> wbuf;
   size_t woff = 0;
@@ -120,6 +130,7 @@ void handle_accept(Server* s) {
     Conn& c = s->conns[id];
     c.fd = fd;
     c.discard_reply = unrel && r2 < kRepDrop;
+    c.deadline_ms = now_ms() + kConnTimeoutMs;
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.u64 = id;
@@ -132,6 +143,7 @@ void handle_read(Server* s, uint64_t id) {
   if (it == s->conns.end()) return;
   Conn& c = it->second;
   uint8_t buf[65536];
+  bool eof = false;
   for (;;) {
     ssize_t n = read(c.fd, buf, sizeof buf);
     if (n > 0) {
@@ -143,20 +155,24 @@ void handle_read(Server* s, uint64_t id) {
       continue;
     }
     if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
-    close_conn(s, id);  // EOF or error before a full frame
-    return;
+    eof = true;  // a buffered complete frame is still served (the client
+    break;       // may legally send-then-SHUT_WR and wait for the reply)
   }
-  if (c.handed_off || c.rbuf.size() < 4) return;
-  size_t len = (size_t(c.rbuf[0]) << 24) | (size_t(c.rbuf[1]) << 16) |
-               (size_t(c.rbuf[2]) << 8) | size_t(c.rbuf[3]);
-  if (len > kMaxFrame) {
-    close_conn(s, id);
-    return;
+  if (!c.handed_off && c.rbuf.size() >= 4) {
+    size_t len = (size_t(c.rbuf[0]) << 24) | (size_t(c.rbuf[1]) << 16) |
+                 (size_t(c.rbuf[2]) << 8) | size_t(c.rbuf[3]);
+    if (len > kMaxFrame) {
+      close_conn(s, id);
+      return;
+    }
+    if (c.rbuf.size() >= 4 + len) {
+      c.handed_off = true;  // one request per connection (dial-per-call)
+      epoll_mod(s, id, c);
+      s->cb(id, c.rbuf.data() + 4, int64_t(len));
+      return;
+    }
   }
-  if (c.rbuf.size() < 4 + len) return;
-  c.handed_off = true;  // one request per connection (dial-per-call)
-  epoll_mod(s, id, c);
-  s->cb(id, c.rbuf.data() + 4, int64_t(len));
+  if (eof) close_conn(s, id);  // hung up before a full frame
 }
 
 void handle_write(Server* s, uint64_t id) {
@@ -210,10 +226,24 @@ void drain_replies(Server* s) {
   }
 }
 
+void sweep_stale(Server* s) {
+  int64_t now = now_ms();
+  std::vector<uint64_t> stale;
+  for (auto& [id, c] : s->conns)
+    if (now >= c.deadline_ms) stale.push_back(id);
+  for (uint64_t id : stale) close_conn(s, id);  // handler replies for a
+  // swept conn are dropped harmlessly in drain_replies (conn not found).
+}
+
 void loop_body(Server* s) {
   epoll_event evs[64];
+  int64_t next_sweep = now_ms() + 1000;
   while (!s->dead.load(std::memory_order_acquire)) {
     int n = epoll_wait(s->epfd, evs, 64, 200);
+    if (now_ms() >= next_sweep) {
+      sweep_stale(s);
+      next_sweep = now_ms() + 1000;
+    }
     for (int i = 0; i < n; i++) {
       uint64_t id = evs[i].data.u64;
       if (id == 0) {  // listener
@@ -242,13 +272,15 @@ void loop_body(Server* s) {
 extern "C" {
 
 void* rpcsrv_start(const char* path, uint64_t seed, Callback cb) {
+  sockaddr_un addr{};
+  if (strlen(path) >= sizeof(addr.sun_path)) return nullptr;  // would
+  // silently truncate and bind a different path than requested
   auto* s = new Server;
   s->path = path;
   s->rng = seed ? seed : 0x9e3779b97f4a7c15ull;
   s->cb = cb;
   unlink(path);
   s->lfd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
-  sockaddr_un addr{};
   addr.sun_family = AF_UNIX;
   strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
   if (s->lfd < 0 || bind(s->lfd, (sockaddr*)&addr, sizeof addr) != 0 ||
