@@ -1,0 +1,174 @@
+"""Decentralized host Paxos peers over the real gob wire
+(core/hostpeer.py) — the reference suite's invariants at per-message RPC
+granularity (`paxos/test_test.go`): agreement (ndecided cross-check),
+concurrent proposers, minority deafness, Done/Min window GC, unreliable
+nets, and the RPC budget."""
+
+import threading
+
+import pytest
+
+from tpu6824.core.hostpeer import make_host_cluster
+from tpu6824.core.peer import Fate
+from tpu6824.utils.timing import wait_until
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    peers = make_host_cluster(str(tmp_path), npeers=3, seed=11)
+    yield peers
+    for p in peers:
+        p.kill()
+
+
+def ndecided(peers, seq):
+    """paxos/test_test.go:32-49 — every decided peer agrees."""
+    count, value = 0, None
+    for p in peers:
+        fate, v = p.status(seq)
+        if fate == Fate.DECIDED:
+            if count > 0:
+                assert v == value, f"divergent decisions at {seq}"
+            count, value = count + 1, v
+    return count, value
+
+
+def waitn(peers, seq, want, timeout=15.0):
+    assert wait_until(lambda: ndecided(peers, seq)[0] >= want,
+                      timeout=timeout), f"instance {seq} never reached {want}"
+
+
+def test_basic_agreement(cluster):
+    """paxos/test_test.go:114-172."""
+    cluster[0].start(0, "hello")
+    waitn(cluster, 0, 3)
+    assert ndecided(cluster, 0) == (3, "hello")
+    assert all(p.max() == 0 for p in cluster)
+
+
+def test_many_instances_and_ints(cluster):
+    for seq in range(5):
+        cluster[seq % 3].start(seq, 100 + seq)
+    for seq in range(5):
+        waitn(cluster, seq, 3)
+        assert ndecided(cluster, seq)[1] == 100 + seq
+
+
+def test_concurrent_proposers_single_value(cluster):
+    """All peers propose different values for one instance; exactly one
+    value wins everywhere (test_test.go's TestMany/TestOld shape)."""
+    for rounds in range(5):
+        seq = rounds
+        for i, p in enumerate(cluster):
+            p.start(seq, f"v{i}-{seq}")
+        waitn(cluster, seq, 3)
+        n, v = ndecided(cluster, seq)
+        assert n == 3 and v in {f"v{i}-{seq}" for i in range(3)}
+
+
+def test_minority_deaf_still_decides(cluster):
+    """Deafen one of three: the majority still agrees
+    (test_test.go:174-220 deaf test)."""
+    cluster[2].deafen()
+    cluster[0].start(0, "maj")
+    waitn(cluster[:2], 0, 2)
+    assert ndecided(cluster[:2], 0) == (2, "maj")
+
+
+def test_done_min_forgets(cluster):
+    """Done/Min window GC (paxos.go:352-425, test_test.go:222-369):
+    Min advances only after every peer calls Done AND the piggyback has
+    propagated via a later decide; forgotten state is gone."""
+    for seq in range(3):
+        cluster[0].start(seq, f"x{seq}")
+        waitn(cluster, seq, 3)
+    assert all(p.min() == 0 for p in cluster)
+    for p in cluster:
+        p.done(1)
+    # piggyback travels on the NEXT decided broadcast from each peer
+    for i, p in enumerate(cluster):
+        p.start(3 + i, f"gc{i}")
+    for i in range(3):
+        waitn(cluster, 3 + i, 3)
+    assert wait_until(lambda: all(p.min() == 2 for p in cluster),
+                      timeout=10.0), [p.min() for p in cluster]
+    fate, _ = cluster[0].status(0)
+    assert fate == Fate.FORGOTTEN
+    fate, v = cluster[0].status(2)
+    assert (fate, v) == (Fate.DECIDED, "x2")
+
+
+def test_unreliable_still_decides(cluster):
+    """Accept-loop drops at reference rates; proposer rounds retry through
+    (test_test.go unreliable suites)."""
+    for p in cluster:
+        p.set_unreliable(True)
+    for seq in range(4):
+        cluster[seq % 3].start(seq, f"u{seq}")
+    for seq in range(4):
+        waitn(cluster, seq, 3, timeout=60.0)
+    for p in cluster:
+        p.set_unreliable(False)
+    n, _ = ndecided(cluster, 3)
+    assert n == 3
+
+
+def test_rpc_budget_serial(cluster):
+    """The reference bounds serial agreement at ≤ 9 RPCs for 3 peers
+    (test_test.go:535-543: 3 prepare + 3 accept + 3 decide).  Self-calls
+    bypass the wire here exactly as there, so the remote budget is 6."""
+    for seq in range(5):
+        cluster[0].start(seq, f"b{seq}")
+        waitn(cluster, seq, 3)
+    total = sum(p.rpc_count for p in cluster)
+    assert total <= 9 * 5, total
+
+
+def test_forgotten_start_ignored(cluster):
+    cluster[0].start(0, "first")
+    waitn(cluster, 0, 3)
+    for p in cluster:
+        p.done(0)
+    for i, p in enumerate(cluster):
+        p.start(1 + i, f"adv{i}")
+    for i in range(3):
+        waitn(cluster, 1 + i, 3)
+    assert wait_until(lambda: all(p.min() == 1 for p in cluster),
+                      timeout=10.0)
+    cluster[0].start(0, "resurrect")  # below Min: no-op
+    fate, _ = cluster[0].status(0)
+    assert fate == Fate.FORGOTTEN
+
+
+def test_none_value_adopted_from_acceptances(cluster):
+    """Paxos safety with None values: a majority accepted (n, None) but the
+    Decided broadcast never happened (proposer died).  A later proposer's
+    Prepare phase must ADOPT the accepted None — keying adoption on the
+    value being non-None instead of on an acceptance existing would decide
+    the usurper value and diverge."""
+    for p in cluster[:2]:  # majority accepts (4, None); no Decided
+        assert p._rpc_prepare({"Instance": 0, "Proposal": 4})["Err"] == "OK"
+        assert p._rpc_accept(
+            {"Instance": 0, "Proposal": 4, "Value": None})["Err"] == "OK"
+    cluster[2].start(0, "usurper")
+    waitn(cluster, 0, 3)
+    assert ndecided(cluster, 0)[1] is None  # the accepted None won
+
+
+def test_concurrent_start_threads(cluster):
+    """Hammer Start from many threads (TestMany shape)."""
+    nseq = 12
+
+    def spam(i):
+        for seq in range(nseq):
+            cluster[i].start(seq, f"t{i}-{seq}")
+
+    ts = [threading.Thread(target=spam, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for seq in range(nseq):
+        waitn(cluster, seq, 3, timeout=30.0)
+        n, v = ndecided(cluster, seq)
+        assert n == 3 and v.startswith("t")

@@ -1,0 +1,319 @@
+"""HostPaxosPeer — the reference's decentralized runtime model, on the
+reference's exact wire.
+
+The fabric kernel (`core/fabric.py`) is the TPU path: all groups' consensus
+advances as one batched tensor step.  This module is the complementary
+*decentralized* path — one acceptor per process, a proposer loop per Start,
+and real per-message `Paxos.Prepare`/`Paxos.Accept`/`Paxos.Decided` RPCs
+over gob Unix sockets (`paxos/rpc.go:52-84` wire structs via `shim/wire.py`)
+— so a deployment can mix these peers with the reference's own Go peers,
+and the per-message fault machinery (accept-loop drops, socket surgery)
+applies at message granularity exactly as in the reference.
+
+Semantics follow `paxos/paxos.go` with the fork's defects fixed:
+  - proposal numbers are globally unique: n = round·P + me + 1
+    (fixes SURVEY §2.4.6 — the reference's highest-seen+1 can collide);
+  - no goroutine leak per accept round (§2.4.5) — one proposer thread per
+    undecided instance, exiting on decision;
+  - acceptor grants Prepare iff n > prep_n (`paxos.go:244-257`) and Accept
+    iff n >= prep_n (`paxos.go:300-313`);
+  - Decided broadcasts piggyback the sender's Done sequence
+    (`rpc.go:74-80`, `paxos.go:328-341`), driving the Min() window GC
+    (`paxos.go:352-425`): state below Min is forgotten everywhere.
+
+Values travel as gob interface values: plain str/int are auto-wrapped with
+their Go-registered names; anything else must be a ``(registered_name,
+value)`` pair with the name in the peer's registry (the `gob.Register`
+contract).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from tpu6824.core.peer import Fate
+from tpu6824.shim import wire
+from tpu6824.shim.gob import Registry
+from tpu6824.shim.netrpc import GobRpcServer, gob_call
+from tpu6824.utils.errors import OK, RPCError
+
+_REJECTED = "ErrRejected"  # paxos/rpc.go:47
+
+
+def _wrap(value):
+    if value is None or isinstance(value, tuple):
+        return value
+    if isinstance(value, str):
+        return ("string", value)
+    if isinstance(value, bool):
+        raise ValueError("bool consensus values are not wire-mapped")
+    if isinstance(value, int):
+        return ("int", value)
+    raise ValueError(
+        f"value {value!r} is not (registered_name, value) or str/int")
+
+
+class _Acc:
+    __slots__ = ("prep_n", "acc_n", "acc_v")
+
+    def __init__(self):
+        self.prep_n = 0
+        self.acc_n = 0
+        self.acc_v = None  # wrapped (name, value) or None
+
+
+class HostPaxosPeer:
+    """One peer = one gob endpoint + acceptor state + proposer loops, with
+    the reference's public contract: Make/Start/Status/Done/Min/Max."""
+
+    def __init__(self, peers: list[str], me: int,
+                 registry: Registry | None = None,
+                 seed: int | None = None, backoff: float = 0.02):
+        self.peers = list(peers)
+        self.me = me
+        self.addr = peers[me]
+        self.P = len(peers)
+        self.mu = threading.Lock()
+        self.acc: dict[int, _Acc] = {}
+        self.values: dict[int, tuple | None] = {}  # decided (wrapped)
+        self.done_seqs = [-1] * self.P             # paxos.go doneSeqs
+        self.max_seq = -1
+        self.dead = False
+        self.backoff = backoff
+        self._rng = random.Random(seed)
+        self._proposing: set[int] = set()
+        reg = registry or wire.default_registry()
+        self.server = GobRpcServer(self.addr, seed=seed, registry=reg)
+        self.server.register_method("Paxos.Prepare", self._rpc_prepare,
+                                    wire.PREPARE_ARGS, wire.PREPARE_REPLY)
+        self.server.register_method("Paxos.Accept", self._rpc_accept,
+                                    wire.ACCEPT_ARGS, wire.ACCEPT_REPLY)
+        self.server.register_method("Paxos.Decided", self._rpc_decided,
+                                    wire.DECIDED_ARGS, wire.DECIDED_REPLY)
+        self._registry = reg
+        self.server.start()
+
+    # ------------------------------------------------- public contract
+
+    def start(self, seq: int, value) -> None:
+        """Async agreement on instance seq (paxos/paxos.go:99-109)."""
+        v = _wrap(value)
+        with self.mu:
+            if self.dead or seq < self._min_locked():
+                return
+            self.max_seq = max(self.max_seq, seq)
+            if seq in self.values or seq in self._proposing:
+                return
+            self._proposing.add(seq)
+        threading.Thread(target=self._propose, args=(seq, v),
+                         daemon=True).start()
+
+    def status(self, seq: int):
+        """Local-only read (paxos/paxos.go:434-447)."""
+        with self.mu:
+            if seq < self._min_locked():
+                return Fate.FORGOTTEN, None
+            if seq in self.values:
+                return Fate.DECIDED, _unwrap(self.values[seq])
+            return Fate.PENDING, None
+
+    def done(self, seq: int) -> None:
+        with self.mu:
+            if seq > self.done_seqs[self.me]:
+                self.done_seqs[self.me] = seq
+
+    def min(self) -> int:
+        with self.mu:
+            return self._min_locked()
+
+    def max(self) -> int:
+        with self.mu:
+            return self.max_seq
+
+    def kill(self) -> None:
+        with self.mu:
+            self.dead = True
+        self.server.kill()
+
+    # fault hooks delegate to the endpoint (the reference's accept loop).
+    def set_unreliable(self, flag: bool) -> None:
+        self.server.set_unreliable(flag)
+
+    def deafen(self) -> None:
+        self.server.deafen()
+
+    @property
+    def rpc_count(self) -> int:
+        return self.server.rpc_count
+
+    # ------------------------------------------------- acceptor (RPCs)
+
+    def _rpc_prepare(self, a: dict) -> dict:
+        """paxos.go:230-257 — grant iff n > prep_n; reply carries the
+        highest accepted (n, v) on grant, highest seen n on reject."""
+        seq, n = a["Instance"], a["Proposal"]
+        with self.mu:
+            self.max_seq = max(self.max_seq, seq)
+            st = self.acc.setdefault(seq, _Acc())
+            if n > st.prep_n:
+                st.prep_n = n
+                return {"Err": OK, "Instance": seq, "Proposal": st.acc_n,
+                        "Value": st.acc_v}
+            return {"Err": _REJECTED, "Instance": seq,
+                    "Proposal": st.prep_n, "Value": None}
+
+    def _rpc_accept(self, a: dict) -> dict:
+        """paxos.go:287-313 — grant iff n >= prep_n."""
+        seq, n, v = a["Instance"], a["Proposal"], a["Value"]
+        with self.mu:
+            self.max_seq = max(self.max_seq, seq)
+            st = self.acc.setdefault(seq, _Acc())
+            if n >= st.prep_n:
+                st.prep_n = st.acc_n = n
+                st.acc_v = v
+                return {"Err": OK}
+            return {"Err": _REJECTED}
+
+    def _rpc_decided(self, a: dict) -> dict:
+        """paxos.go:334-344 — record the decision; absorb the sender's
+        piggybacked Done sequence and shrink below the new Min."""
+        with self.mu:
+            self.values[a["Instance"]] = a["Value"]
+            self.max_seq = max(self.max_seq, a["Instance"])
+            sender = a["Sender"]
+            if 0 <= sender < self.P:
+                if a["DoneIns"] > self.done_seqs[sender]:
+                    self.done_seqs[sender] = a["DoneIns"]
+            self._shrink_locked()
+        return {}
+
+    # ------------------------------------------------- proposer loop
+
+    def _propose(self, seq: int, v) -> None:
+        """paxos.go:122-152 — retry rounds until decided, with randomized
+        backoff (ties are systematic in lockstep otherwise)."""
+        try:
+            max_seen = 0
+            while True:
+                with self.mu:
+                    if self.dead or seq in self.values or \
+                            seq < self._min_locked():
+                        return
+                k = max_seen // self.P + 1
+                n = k * self.P + self.me + 1  # globally unique
+                ok, max_seen, v1 = self._phase_prepare(seq, n, max_seen, v)
+                if ok and self._phase_accept(seq, n, v1):
+                    self._broadcast_decided(seq, v1)
+                    return
+                time.sleep(self.backoff * (0.5 + self._rng.random()))
+        except Exception:
+            if not self.dead:
+                raise
+        finally:
+            with self.mu:
+                self._proposing.discard(seq)
+
+    def _call(self, peer: int, method, args, args_schema, reply_schema):
+        if peer == self.me:  # self-calls bypass RPC (paxos.go:214-228)
+            handler = {"Paxos.Prepare": self._rpc_prepare,
+                       "Paxos.Accept": self._rpc_accept,
+                       "Paxos.Decided": self._rpc_decided}[method]
+            return handler(args)
+        return gob_call(self.peers[peer], method, args_schema, args,
+                        reply_schema, registry=self._registry, timeout=5.0)
+
+    def _phase_prepare(self, seq, n, max_seen, v):
+        grants, best_n, best_v = 0, 0, None
+        for p in range(self.P):
+            try:
+                r = self._call(p, "Paxos.Prepare",
+                               {"Instance": seq, "Proposal": n},
+                               wire.PREPARE_ARGS, wire.PREPARE_REPLY)
+            except RPCError:
+                continue
+            if r["Err"] == OK:
+                grants += 1
+                # An acceptance exists iff Proposal > 0 (real proposal
+                # numbers start at 1) — keying on the VALUE being non-None
+                # would let a legitimately accepted None be overridden,
+                # breaking agreement.
+                if r["Proposal"] > best_n:
+                    best_n, best_v = r["Proposal"], r["Value"]
+            else:
+                max_seen = max(max_seen, r["Proposal"])
+        v1 = best_v if best_n > 0 else v
+        return grants * 2 > self.P, max(max_seen, n), v1
+
+    def _phase_accept(self, seq, n, v1) -> bool:
+        grants = 0
+        for p in range(self.P):
+            try:
+                r = self._call(p, "Paxos.Accept",
+                               {"Instance": seq, "Proposal": n, "Value": v1},
+                               wire.ACCEPT_ARGS, wire.ACCEPT_REPLY)
+            except RPCError:
+                continue
+            if r["Err"] == OK:
+                grants += 1
+        return grants * 2 > self.P
+
+    def _broadcast_decided(self, seq, v1) -> None:
+        """Unlike the reference's fire-and-forget `go call` (paxos.go:
+        315-320) — which can strand a learner forever when the one Decided
+        message is dropped — delivery is retried per peer until the RPC
+        reply acks it.  Costs nothing on a reliable net (one acked send)."""
+        pending = set(range(self.P))
+        sleep = self.backoff
+        while True:
+            with self.mu:
+                if self.dead or seq < self._min_locked():
+                    return  # nobody needs this instance anymore
+                done = self.done_seqs[self.me]
+            for p in sorted(pending):
+                try:
+                    self._call(p, "Paxos.Decided",
+                               {"Sender": self.me, "DoneIns": done,
+                                "Instance": seq, "Value": v1},
+                               wire.DECIDED_ARGS, wire.DECIDED_REPLY)
+                    pending.discard(p)
+                except RPCError:
+                    pass  # dropped/deaf/partitioned: retry below
+            if not pending:
+                return
+            # Keep retrying until the peer heals, dies, or the window moves
+            # past seq — a partition outliving any fixed retry cap would
+            # otherwise re-strand the learner.  Backoff caps at 1s.
+            time.sleep(sleep * (0.5 + self._rng.random()))
+            sleep = min(sleep * 1.5, 1.0)
+
+    # ------------------------------------------------- window GC
+
+    def _min_locked(self) -> int:
+        return min(self.done_seqs) + 1
+
+    def _shrink_locked(self) -> None:
+        """doMemShrink (paxos.go:362-378): drop state below Min."""
+        mn = self._min_locked()
+        for seq in [s for s in self.acc if s < mn]:
+            del self.acc[seq]
+        for seq in [s for s in self.values if s < mn]:
+            del self.values[seq]
+
+
+def _unwrap(v):
+    if isinstance(v, tuple) and len(v) == 2:
+        return v[1]
+    return v
+
+
+def make_host_cluster(sockdir: str, npeers: int = 3,
+                      registry: Registry | None = None,
+                      seed: int | None = None) -> list[HostPaxosPeer]:
+    """Boot npeers decentralized peers on real gob sockets — the
+    reference's `Make(peers, me, nil)` per process (paxos/paxos.go:488)."""
+    addrs = [f"{sockdir}/px-{i}" for i in range(npeers)]
+    return [HostPaxosPeer(addrs, i, registry=registry,
+                          seed=None if seed is None else seed + i)
+            for i in range(npeers)]
