@@ -112,11 +112,19 @@ class HostPaxosPeer:
 
     def status(self, seq: int):
         """Local-only read (paxos/paxos.go:434-447)."""
+        fate, wrapped = self.status_wrapped(seq)
+        return fate, _unwrap(wrapped)
+
+    def status_wrapped(self, seq: int):
+        """status() keeping the gob interface wrapping: DECIDED values come
+        back as the ``(registered_name, value)`` pair, so typed consumers
+        (e.g. the kvpaxos Op adapter) can check what's in the log instead
+        of assuming."""
         with self.mu:
             if seq < self._min_locked():
                 return Fate.FORGOTTEN, None
             if seq in self.values:
-                return Fate.DECIDED, _unwrap(self.values[seq])
+                return Fate.DECIDED, self.values[seq]
             return Fate.PENDING, None
 
     def done(self, seq: int) -> None:

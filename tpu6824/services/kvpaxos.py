@@ -40,8 +40,15 @@ class Op(NamedTuple):
 class KVPaxosServer:
     RPC_METHODS = ["get", "put_append"]  # wire surface (rpc.Server)
 
-    def __init__(self, fabric: PaxosFabric, g: int, me: int, op_timeout: float = 8.0):
-        self.px = PaxosPeer(fabric, g, me)
+    def __init__(self, fabric: PaxosFabric | None, g: int, me: int,
+                 op_timeout: float = 8.0, px=None):
+        """`px` overrides the consensus backend: anything with the PaxosPeer
+        contract (start/status/done/min/max/kill) — the batched TPU fabric
+        peer by default, or a decentralized `HostOpPeer` (see
+        `make_host_cluster`) for per-message-RPC deployments."""
+        if fabric is None and px is None:
+            raise ValueError("KVPaxosServer needs a fabric or an explicit px")
+        self.px = px if px is not None else PaxosPeer(fabric, g, me)
         self.me = me
         self.mu = threading.RLock()
         self.kv: dict[str, str] = {}
@@ -212,3 +219,87 @@ def make_cluster(nservers=3, ninstances=64, fabric=None, g=0, **kw):
                              auto_step=True)
     servers = [KVPaxosServer(fabric, g, p, **kw) for p in range(nservers)]
     return fabric, servers
+
+
+# ---------------------------------------------------------------------------
+# Decentralized backend: the same RSM over per-message gob RPC
+# (core/hostpeer.py) — the reference's own runtime model, so this service
+# can be deployed one-replica-per-process with no shared fabric.
+# (shim.gob is stdlib-only, so importing it here costs nothing next to the
+# jax-backed fabric import above.)
+
+from tpu6824.shim.gob import INT, STRING, Struct, complete as _gob_complete
+
+KVOP_WIRE = Struct("KVOp", [
+    ("Kind", STRING), ("Key", STRING), ("Value", STRING),
+    ("CID", INT), ("Seq", INT),
+])
+KVOP_NAME = "tpu6824.KVOp"
+
+
+class HostOpPeer:
+    """PaxosPeer contract over a decentralized HostPaxosPeer, with Op values
+    travelling as registered gob structs (the reference's
+    `gob.Register(Op{})`, kvpaxos/server.go)."""
+
+    def __init__(self, host_peer):
+        self.hp = host_peer
+
+    def start(self, seq: int, op: Op) -> None:
+        self.hp.start(seq, (KVOP_NAME, {
+            "Kind": op.kind, "Key": op.key, "Value": op.value,
+            "CID": op.cid, "Seq": op.cseq,
+        }))
+
+    def status(self, seq: int):
+        fate, wrapped = self.hp.status_wrapped(seq)
+        if wrapped is None:
+            return fate, None
+        name, v = wrapped
+        if name != KVOP_NAME:
+            raise TypeError(
+                f"non-KVOp value in this group's log: {name!r} — this "
+                "adapter only shares a log with KVOp proposers")
+        d = _gob_complete(KVOP_WIRE, v)  # gob omits zero fields on the wire
+        return fate, Op(d["Kind"], d["Key"], d["Value"], d["CID"], d["Seq"])
+
+    def done(self, seq: int) -> None:
+        self.hp.done(seq)
+
+    def min(self) -> int:
+        return self.hp.min()
+
+    def max(self) -> int:
+        return self.hp.max()
+
+    def kill(self) -> None:
+        self.hp.kill()
+
+
+def make_host_replica(sockdir: str, nservers: int, me: int,
+                      seed: int | None = None, **kw):
+    """One decentralized replica — peer endpoint + RSM server — suitable
+    for one-replica-per-OS-process deployment (the reference's model:
+    every server process embeds its own Paxos peer,
+    kvpaxos/server.go StartServer).  Returns (host_peer, server)."""
+    from tpu6824.core.hostpeer import HostPaxosPeer
+    from tpu6824.shim.wire import default_registry
+
+    registry = default_registry().register(KVOP_NAME, KVOP_WIRE)
+    addrs = [f"{sockdir}/px-{i}" for i in range(nservers)]
+    peer = HostPaxosPeer(addrs, me, registry=registry, seed=seed)
+    server = KVPaxosServer(None, 0, me, px=HostOpPeer(peer), **kw)
+    return peer, server
+
+
+def make_host_cluster(sockdir: str, nservers: int = 3, seed: int | None = None,
+                      **kw):
+    """kvpaxos on the decentralized wire path: one gob Paxos endpoint per
+    replica, consensus by per-message Prepare/Accept/Decided RPC — the
+    reference's deployment model end to end."""
+    pairs = [
+        make_host_replica(sockdir, nservers, i,
+                          seed=None if seed is None else seed + i, **kw)
+        for i in range(nservers)
+    ]
+    return [p for p, _ in pairs], [s for _, s in pairs]
