@@ -1,0 +1,54 @@
+"""shardmaster on the decentralized host-Paxos backend: the replicated
+config service with consensus as per-message gob RPC (cf.
+tests/test_shardmaster.py for the fabric-backed invariants)."""
+
+import pytest
+
+from tpu6824.ops.hashing import NSHARDS
+from tpu6824.services.shardmaster import Clerk, make_host_cluster
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    peers, servers = make_host_cluster(str(tmp_path), nservers=3, seed=21)
+    yield servers
+    for s in servers:
+        s.kill()
+
+
+def test_join_balance_query(cluster):
+    ck = Clerk(cluster)
+    ck.join(1, ["a", "b", "c"])
+    ck.join(2, ["d", "e", "f"])
+    cfg = ck.query(-1)
+    counts = [cfg.shards.count(g) for g in (1, 2)]
+    assert sum(counts) == NSHARDS
+    assert max(counts) - min(counts) <= 1  # balance ±1
+    assert sorted(cfg.groups_dict()) == [1, 2]
+
+
+def test_every_replica_serves_same_configs(cluster):
+    ck = Clerk(cluster)
+    ck.join(1, ["a"])
+    ck.join(2, ["b"])
+    ck.leave(1)
+    latest = ck.query(-1)
+    assert set(latest.shards) == {2}
+    for s in cluster:
+        assert Clerk([s]).query(-1) == latest
+        # historical configs identical too
+        assert Clerk([s]).query(1).shards == ck.query(1).shards
+
+
+def test_move_is_real_move_on_all_replicas(cluster):
+    """The reference replays Move as Leave on non-queried replicas
+    (shardmaster/server.go:82); here Move must be a Move everywhere."""
+    ck = Clerk(cluster)
+    ck.join(1, ["a"])
+    ck.join(2, ["b"])
+    target = ck.query(-1).shards[4] % 2 + 1  # the other group
+    ck.move(4, target)
+    for s in cluster:
+        cfg = Clerk([s]).query(-1)
+        assert cfg.shards[4] == target
+        assert set(cfg.groups_dict()) == {1, 2}  # nobody left

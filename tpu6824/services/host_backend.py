@@ -1,0 +1,86 @@
+"""Decentralized consensus backend plumbing shared by the services.
+
+`StructOpPeer` adapts a `core.hostpeer.HostPaxosPeer` (per-message gob RPC
+consensus) to the PaxosPeer contract the services program against, shipping
+each service's NamedTuple ops as registered gob structs — the exact shape of
+the reference's `gob.Register(Op{})` calls that let Op values ride the
+`interface{}` fields of the Paxos wire (`paxos/rpc.go:61,67,79`).
+
+A service adds a wire schema + two converters and gains one-replica-per-
+OS-process deployment with no shared fabric (see `kvpaxos.make_host_replica`
+and `shardmaster.make_host_cluster`)."""
+
+from __future__ import annotations
+
+from tpu6824.shim.gob import Struct, complete
+
+
+class StructOpPeer:
+    """PaxosPeer contract over a HostPaxosPeer with typed struct values.
+
+    `to_wire(op) -> dict` and `from_wire(dict) -> op` must round-trip
+    exactly (the RSM layers compare decided ops to proposed ops for
+    ownership, e.g. kvpaxos/server.go:69-113's "mine?" check)."""
+
+    def __init__(self, host_peer, name: str, schema: Struct,
+                 to_wire, from_wire):
+        self.hp = host_peer
+        self.name = name
+        self.schema = schema
+        self.to_wire = to_wire
+        self.from_wire = from_wire
+
+    def start(self, seq: int, op) -> None:
+        self.hp.start(seq, (self.name, self.to_wire(op)))
+
+    def status(self, seq: int):
+        fate, wrapped = self.hp.status_wrapped(seq)
+        if wrapped is None:
+            return fate, None
+        name, v = wrapped
+        if name != self.name:
+            raise TypeError(
+                f"value of type {name!r} in this group's log — this adapter "
+                f"only shares a log with {self.name!r} proposers")
+        # gob omits zero-valued fields on the wire; restore before decoding.
+        return fate, self.from_wire(complete(self.schema, v))
+
+    def done(self, seq: int) -> None:
+        self.hp.done(seq)
+
+    def min(self) -> int:
+        return self.hp.min()
+
+    def max(self) -> int:
+        return self.hp.max()
+
+    def kill(self) -> None:
+        self.hp.kill()
+
+
+def make_host_replica(sockdir: str, prefix: str, name: str, schema: Struct,
+                      make_server, nservers: int, me: int,
+                      seed: int | None = None):
+    """One decentralized replica: a gob Paxos peer endpoint at
+    `{sockdir}/{prefix}-{me}` plus the service RSM built by
+    `make_server(host_op_peer)`.  Returns (host_peer, server)."""
+    from tpu6824.core.hostpeer import HostPaxosPeer
+    from tpu6824.shim.wire import default_registry
+
+    registry = default_registry().register(name, schema)
+    addrs = [f"{sockdir}/{prefix}-{i}" for i in range(nservers)]
+    peer = HostPaxosPeer(addrs, me, registry=registry, seed=seed)
+    return peer, make_server(peer)
+
+
+def make_host_cluster(sockdir: str, prefix: str, name: str, schema: Struct,
+                      make_server, nservers: int, seed: int | None = None):
+    """All replicas in one process (tests); one-per-process deployments call
+    make_host_replica directly."""
+    pairs = [
+        make_host_replica(sockdir, prefix, name, schema, make_server,
+                          nservers, i,
+                          seed=None if seed is None else seed + i)
+        for i in range(nservers)
+    ]
+    return [p for p, _ in pairs], [s for _, s in pairs]

@@ -228,7 +228,8 @@ def make_cluster(nservers=3, ninstances=64, fabric=None, g=0, **kw):
 # (shim.gob is stdlib-only, so importing it here costs nothing next to the
 # jax-backed fabric import above.)
 
-from tpu6824.shim.gob import INT, STRING, Struct, complete as _gob_complete
+from tpu6824.services.host_backend import StructOpPeer
+from tpu6824.shim.gob import INT, STRING, Struct
 
 KVOP_WIRE = Struct("KVOp", [
     ("Kind", STRING), ("Key", STRING), ("Value", STRING),
@@ -237,43 +238,17 @@ KVOP_WIRE = Struct("KVOp", [
 KVOP_NAME = "tpu6824.KVOp"
 
 
-class HostOpPeer:
-    """PaxosPeer contract over a decentralized HostPaxosPeer, with Op values
-    travelling as registered gob structs (the reference's
+def HostOpPeer(host_peer) -> StructOpPeer:
+    """kvpaxos ops over the decentralized wire backend (the reference's
     `gob.Register(Op{})`, kvpaxos/server.go)."""
-
-    def __init__(self, host_peer):
-        self.hp = host_peer
-
-    def start(self, seq: int, op: Op) -> None:
-        self.hp.start(seq, (KVOP_NAME, {
-            "Kind": op.kind, "Key": op.key, "Value": op.value,
-            "CID": op.cid, "Seq": op.cseq,
-        }))
-
-    def status(self, seq: int):
-        fate, wrapped = self.hp.status_wrapped(seq)
-        if wrapped is None:
-            return fate, None
-        name, v = wrapped
-        if name != KVOP_NAME:
-            raise TypeError(
-                f"non-KVOp value in this group's log: {name!r} — this "
-                "adapter only shares a log with KVOp proposers")
-        d = _gob_complete(KVOP_WIRE, v)  # gob omits zero fields on the wire
-        return fate, Op(d["Kind"], d["Key"], d["Value"], d["CID"], d["Seq"])
-
-    def done(self, seq: int) -> None:
-        self.hp.done(seq)
-
-    def min(self) -> int:
-        return self.hp.min()
-
-    def max(self) -> int:
-        return self.hp.max()
-
-    def kill(self) -> None:
-        self.hp.kill()
+    return StructOpPeer(
+        host_peer, KVOP_NAME, KVOP_WIRE,
+        to_wire=lambda op: {"Kind": op.kind, "Key": op.key,
+                            "Value": op.value, "CID": op.cid,
+                            "Seq": op.cseq},
+        from_wire=lambda d: Op(d["Kind"], d["Key"], d["Value"], d["CID"],
+                               d["Seq"]),
+    )
 
 
 def make_host_replica(sockdir: str, nservers: int, me: int,
@@ -282,14 +257,11 @@ def make_host_replica(sockdir: str, nservers: int, me: int,
     for one-replica-per-OS-process deployment (the reference's model:
     every server process embeds its own Paxos peer,
     kvpaxos/server.go StartServer).  Returns (host_peer, server)."""
-    from tpu6824.core.hostpeer import HostPaxosPeer
-    from tpu6824.shim.wire import default_registry
+    from tpu6824.services.host_backend import make_host_replica as _mk
 
-    registry = default_registry().register(KVOP_NAME, KVOP_WIRE)
-    addrs = [f"{sockdir}/px-{i}" for i in range(nservers)]
-    peer = HostPaxosPeer(addrs, me, registry=registry, seed=seed)
-    server = KVPaxosServer(None, 0, me, px=HostOpPeer(peer), **kw)
-    return peer, server
+    return _mk(sockdir, "px", KVOP_NAME, KVOP_WIRE,
+               lambda p: KVPaxosServer(None, 0, p.me, px=HostOpPeer(p), **kw),
+               nservers, me, seed=seed)
 
 
 def make_host_cluster(sockdir: str, nservers: int = 3, seed: int | None = None,
@@ -297,9 +269,8 @@ def make_host_cluster(sockdir: str, nservers: int = 3, seed: int | None = None,
     """kvpaxos on the decentralized wire path: one gob Paxos endpoint per
     replica, consensus by per-message Prepare/Accept/Decided RPC — the
     reference's deployment model end to end."""
-    pairs = [
-        make_host_replica(sockdir, nservers, i,
-                          seed=None if seed is None else seed + i, **kw)
-        for i in range(nservers)
-    ]
-    return [p for p, _ in pairs], [s for _, s in pairs]
+    from tpu6824.services.host_backend import make_host_cluster as _mk
+
+    return _mk(sockdir, "px", KVOP_NAME, KVOP_WIRE,
+               lambda p: KVPaxosServer(None, 0, p.me, px=HostOpPeer(p), **kw),
+               nservers, seed=seed)

@@ -53,8 +53,15 @@ class Op(NamedTuple):
 class ShardMasterServer:
     RPC_METHODS = ["join", "leave", "move", "query"]  # wire surface (rpc.Server)
 
-    def __init__(self, fabric: PaxosFabric, g: int, me: int, op_timeout: float = 8.0):
-        self.px = PaxosPeer(fabric, g, me)
+    def __init__(self, fabric: PaxosFabric | None, g: int, me: int,
+                 op_timeout: float = 8.0, px=None):
+        """`px` overrides the consensus backend (PaxosPeer contract) — the
+        batched fabric by default, or the decentralized wire backend via
+        `make_host_cluster`."""
+        if fabric is None and px is None:
+            raise ValueError(
+                "ShardMasterServer needs a fabric or an explicit px")
+        self.px = px if px is not None else PaxosPeer(fabric, g, me)
         self.me = me
         self.mu = threading.RLock()
         self.configs: list[Config] = [Config.initial()]
@@ -260,3 +267,49 @@ def make_cluster(nservers=3, ninstances=32, fabric=None, g=0, **kw):
                              auto_step=True)
     servers = [ShardMasterServer(fabric, g, p, **kw) for p in range(nservers)]
     return fabric, servers
+
+
+# ---------------------------------------------------------------------------
+# Decentralized backend (cf. kvpaxos.make_host_cluster): the config service
+# one-replica-per-process, consensus over per-message gob RPC.
+
+from tpu6824.services.host_backend import StructOpPeer
+from tpu6824.shim.gob import INT, STRING, Slice, Struct
+
+SMOP_WIRE = Struct("SMOp", [
+    ("Kind", STRING), ("GID", INT), ("Servers", Slice(STRING)),
+    ("Shard", INT), ("CID", INT), ("Seq", INT),
+])
+SMOP_NAME = "tpu6824.SMOp"
+
+
+def HostOpPeer(host_peer) -> StructOpPeer:
+    return StructOpPeer(
+        host_peer, SMOP_NAME, SMOP_WIRE,
+        to_wire=lambda op: {"Kind": op.kind, "GID": op.gid,
+                            "Servers": list(op.servers), "Shard": op.shard,
+                            "CID": op.cid, "Seq": op.cseq},
+        from_wire=lambda d: Op(d["Kind"], d["GID"], tuple(d["Servers"]),
+                               d["Shard"], d["CID"], d["Seq"]),
+    )
+
+
+def make_host_replica(sockdir: str, nservers: int, me: int,
+                      seed: int | None = None, **kw):
+    """One decentralized shardmaster replica (peer endpoint + RSM)."""
+    from tpu6824.services.host_backend import make_host_replica as _mk
+
+    return _mk(sockdir, "smpx", SMOP_NAME, SMOP_WIRE,
+               lambda p: ShardMasterServer(None, 0, p.me, px=HostOpPeer(p),
+                                           **kw),
+               nservers, me, seed=seed)
+
+
+def make_host_cluster(sockdir: str, nservers: int = 3,
+                      seed: int | None = None, **kw):
+    from tpu6824.services.host_backend import make_host_cluster as _mk
+
+    return _mk(sockdir, "smpx", SMOP_NAME, SMOP_WIRE,
+               lambda p: ShardMasterServer(None, 0, p.me, px=HostOpPeer(p),
+                                           **kw),
+               nservers, seed=seed)
