@@ -54,7 +54,7 @@ class Op(NamedTuple):
     kind: str  # 'get' | 'put' | 'append' | 'reconf'
     key: str
     value: str
-    cid: int
+    cid: str  # string CIDs, as on the reference wire (shardkv/common.go:23)
     cseq: int
     extra: object  # reconf: (Config, xstate)
 
@@ -71,7 +71,7 @@ class ShardKVServer:
 
     def __init__(
         self,
-        fabric: PaxosFabric,
+        fabric: PaxosFabric | None,
         fg: int,
         gid: int,
         me: int,
@@ -79,8 +79,14 @@ class ShardKVServer:
         directory: dict,
         op_timeout: float = 8.0,
         start_ticker: bool = True,
+        px=None,
     ):
-        self.px = PaxosPeer(fabric, fg, me)
+        """`px` overrides the consensus backend (PaxosPeer contract) — the
+        batched fabric by default, or the decentralized wire backend via
+        `make_host_group`."""
+        if fabric is None and px is None:
+            raise ValueError("ShardKVServer needs a fabric or an explicit px")
+        self.px = px if px is not None else PaxosPeer(fabric, fg, me)
         self.gid = gid
         self.me = me
         self.name = f"g{gid}-{me}"
@@ -89,7 +95,7 @@ class ShardKVServer:
         self.smck = shardmaster.Clerk(sm_clerk_servers)
         self.mu = threading.RLock()
         self.kv: dict[str, str] = {}
-        self.dup: dict[int, tuple[int, object]] = {}
+        self.dup: dict[str, tuple[int, object]] = {}
         self.config: Config = Config.initial()
         self.applied = -1
         self.op_timeout = op_timeout
@@ -247,7 +253,7 @@ class ShardKVServer:
             kv=tuple(sorted(kv_merge.items())),
             dup=tuple(sorted(dup_merge.items())),
         )
-        op = Op("reconf", "", "", -cfg.num, cfg.num, (cfg, xstate))
+        op = Op("reconf", "", "", f"reconf-{cfg.num}", cfg.num, (cfg, xstate))
         try:
             self._sync(op)
         except RPCError:
@@ -289,10 +295,10 @@ class ShardKVServer:
 
     # ----------------------------------------------------------- RPC surface
 
-    def get(self, key: str, cid: int, cseq: int):
+    def get(self, key: str, cid: str, cseq: int):
         return self._serve(Op("get", key, "", cid, cseq, None))
 
-    def put_append(self, key: str, kind: str, value: str, cid: int, cseq: int):
+    def put_append(self, key: str, kind: str, value: str, cid: str, cseq: int):
         return self._serve(Op(kind, key, value, cid, cseq, None))
 
     def _serve(self, op: Op):
@@ -320,7 +326,10 @@ class Clerk:
         self.smck = shardmaster.Clerk(sm_servers)
         self.directory = directory
         self.net = net or FlakyNet()
-        self.cid = fresh_cid()
+        # CID is a STRING on this wire (shardkv/common.go:23) — and string
+        # cids keep the dup-filter/XState key type uniform across the gob
+        # endpoints, the wire consensus backend, and in-process clerks.
+        self.cid = str(fresh_cid())
         self.cseq = 0
         self.mu = threading.Lock()
         self.config = Config.initial()
@@ -365,7 +374,25 @@ class Clerk:
         self._loop("put_append", key, "append", value, timeout=timeout)
 
 
-class ShardSystem:
+class _ShardSystemOps:
+    """Clerk/membership surface shared by the fabric-backed and
+    decentralized system harnesses (they differ only in how the consensus
+    groups are built and torn down)."""
+
+    def sm_clerk(self):
+        return shardmaster.Clerk(self.sm_servers)
+
+    def clerk(self, net=None):
+        return Clerk(self.sm_servers, self.directory, net=net)
+
+    def join(self, gid: int):
+        self.sm_clerk().join(gid, [s.name for s in self.groups[gid]])
+
+    def leave(self, gid: int):
+        self.sm_clerk().leave(gid)
+
+
+class ShardSystem(_ShardSystemOps):
     """Test/deployment harness: one fabric hosting the shardmaster group and
     `ngroups` shardkv replica groups as fabric lanes."""
 
@@ -389,18 +416,6 @@ class ShardSystem:
             ]
             self.gids.append(gid)
 
-    def sm_clerk(self):
-        return shardmaster.Clerk(self.sm_servers)
-
-    def clerk(self, net=None):
-        return Clerk(self.sm_servers, self.directory, net=net)
-
-    def join(self, gid: int):
-        self.sm_clerk().join(gid, [s.name for s in self.groups[gid]])
-
-    def leave(self, gid: int):
-        self.sm_clerk().leave(gid)
-
     def shutdown(self):
         for s in self.sm_servers:
             s.dead = True
@@ -408,3 +423,112 @@ class ShardSystem:
             for s in grp:
                 s.dead = True
         self.fabric.stop_clock()
+
+
+# ---------------------------------------------------------------------------
+# Decentralized backend: shardkv groups with consensus as per-message gob
+# RPC (cf. kvpaxos/shardmaster).  The reconf op's (Config, XState) payload
+# travels as flattened gob maps; to_wire/from_wire are exact round-trips so
+# the RSM's "mine?" equality check works on wire-decoded ops.
+
+from tpu6824.services.host_backend import StructOpPeer
+from tpu6824.shim.gob import INT, STRING, Array, Map, Slice, Struct
+
+_SKV_CFG = Struct("Config", [
+    ("Num", INT), ("Shards", Array(NSHARDS, INT)),
+    ("Groups", Map(INT, Slice(STRING))),
+])
+
+SKVOP_NAME = "tpu6824.SKVOp"
+SKVOP_WIRE = Struct("SKVOp", [
+    ("Kind", STRING), ("Key", STRING), ("Value", STRING),
+    ("CID", STRING), ("Seq", INT),
+    ("Config", _SKV_CFG),
+    ("XKV", Map(STRING, STRING)),
+    ("XSeq", Map(STRING, INT)),
+    ("XErr", Map(STRING, STRING)),
+    ("XVal", Map(STRING, STRING)),
+])
+
+
+def _op_to_wire(op: Op) -> dict:
+    d = {"Kind": op.kind, "Key": op.key, "Value": op.value,
+         "CID": op.cid, "Seq": op.cseq,
+         "Config": {"Num": 0, "Shards": [0] * NSHARDS, "Groups": {}},
+         "XKV": {}, "XSeq": {}, "XErr": {}, "XVal": {}}
+    if op.kind == "reconf":
+        cfg, xs = op.extra
+        d["Config"] = {"Num": cfg.num, "Shards": list(cfg.shards),
+                       "Groups": {g: list(s) for g, s in cfg.groups}}
+        d["XKV"] = dict(xs.kv)
+        for cid, (cseq, reply) in xs.dup:
+            err, val = reply
+            d["XSeq"][cid] = cseq
+            d["XErr"][cid] = err
+            d["XVal"][cid] = val
+    return d
+
+
+def _op_from_wire(d: dict) -> Op:
+    extra = None
+    if d["Kind"] == "reconf":
+        c = d["Config"]
+        cfg = Config(
+            num=c["Num"], shards=tuple(c["Shards"]),
+            groups=tuple(sorted((g, tuple(s)) for g, s in c["Groups"].items())),
+        )
+        xs = XState(
+            kv=tuple(sorted(d["XKV"].items())),
+            dup=tuple(sorted(
+                (cid, (d["XSeq"][cid], (d["XErr"][cid], d["XVal"][cid])))
+                for cid in d["XSeq"]
+            )),
+        )
+        extra = (cfg, xs)
+    return Op(d["Kind"], d["Key"], d["Value"], d["CID"], d["Seq"], extra)
+
+
+def HostOpPeer(host_peer) -> StructOpPeer:
+    return StructOpPeer(host_peer, SKVOP_NAME, SKVOP_WIRE,
+                        to_wire=_op_to_wire, from_wire=_op_from_wire)
+
+
+def make_host_group(sockdir: str, gid: int, nreplicas: int, sm_servers,
+                    directory: dict, seed: int | None = None, **kw):
+    """One shardkv replica group on decentralized wire consensus."""
+    from tpu6824.services.host_backend import make_host_cluster as _mk
+
+    def mk_server(p):
+        return ShardKVServer(None, 0, gid, p.me, sm_servers, directory,
+                             px=HostOpPeer(p), **kw)
+
+    return _mk(sockdir, f"skv{gid}", SKVOP_NAME, SKVOP_WIRE, mk_server,
+               nreplicas, seed=seed)
+
+
+class HostShardSystem(_ShardSystemOps):
+    """The full sharded capstone with EVERY consensus group decentralized:
+    shardmaster replicas and each shardkv group run per-message gob RPC
+    Paxos — zero shared fabric, the reference's runtime model end to end."""
+
+    def __init__(self, sockdir: str, ngroups: int = 2, nreplicas: int = 3,
+                 base_gid: int = 100, seed: int = 0):
+        self.directory: dict = {}
+        _, self.sm_servers = shardmaster.make_host_cluster(
+            sockdir, nservers=nreplicas, seed=seed)
+        self.groups: dict[int, list[ShardKVServer]] = {}
+        self.gids = []
+        for i in range(ngroups):
+            gid = base_gid + i
+            _, servers = make_host_group(
+                sockdir, gid, nreplicas, self.sm_servers, self.directory,
+                seed=seed + 100 * (i + 1))
+            self.groups[gid] = servers
+            self.gids.append(gid)
+
+    def shutdown(self):
+        for s in self.sm_servers:
+            s.kill()
+        for grp in self.groups.values():
+            for s in grp:
+                s.kill()
