@@ -1,0 +1,50 @@
+"""kvhostd — one decentralized kvpaxos replica as an OS process.
+
+The reference's deployment model made executable (cf. `main/diskvd.go`:
+a daemon per replica wired by argv): this process embeds its own Paxos
+peer (gob endpoint at `{sockdir}/px-{me}`), runs the KV RSM over
+per-message wire consensus with its `nservers-1` sibling processes, and
+serves Go-wire clerks (`KVPaxos.Get`/`KVPaxos.PutAppend`) at
+`{sockdir}/clerk-{me}`.
+
+    python -m tpu6824.main.kvhostd --dir /var/tmp/kv --n 3 --me 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", required=True, help="socket directory")
+    ap.add_argument("--n", type=int, default=3, help="replica count")
+    ap.add_argument("--me", type=int, required=True, help="replica index")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--lifetime", type=float, default=600.0,
+                    help="suicide timer, like diskvd's (main/diskvd.go:30-74)")
+    args = ap.parse_args(argv)
+
+    from tpu6824.services.kvpaxos import make_host_replica
+    from tpu6824.shim import endpoints
+
+    peer, server = make_host_replica(args.dir, args.n, args.me,
+                                     seed=args.seed)
+    ep = endpoints.serve_kvpaxos(server, f"{args.dir}/clerk-{args.me}")
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    print(f"kvhostd ready me={args.me} clerk={ep.addr}", flush=True)
+    deadline = time.time() + args.lifetime
+    while not stop and time.time() < deadline:
+        time.sleep(0.2)
+    ep.kill()
+    server.kill()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
