@@ -155,6 +155,18 @@ def test_none_value_adopted_from_acceptances(cluster):
     assert ndecided(cluster, 0)[1] is None  # the accepted None won
 
 
+def test_observability_counters(cluster):
+    """SURVEY §5 build note applies to the wire backend too: event-log
+    counters for rounds, outbound RPCs, and decisions."""
+    cluster[0].start(0, "obs")
+    waitn(cluster, 0, 3)
+    c0 = cluster[0].events.counters()
+    assert c0.get("rounds", 0) >= 1
+    assert c0.get("proposals_won", 0) >= 1
+    assert c0.get("rpc_out", 0) >= 4  # 2 remote prepares + accepts at least
+    assert any(p.events.counters().get("decided", 0) >= 1 for p in cluster)
+
+
 def test_concurrent_start_threads(cluster):
     """Hammer Start from many threads (TestMany shape)."""
     nseq = 12

@@ -38,6 +38,7 @@ from tpu6824.shim import wire
 from tpu6824.shim.gob import Registry
 from tpu6824.shim.netrpc import GobRpcServer, gob_call
 from tpu6824.utils.errors import OK, RPCError
+from tpu6824.utils.trace import EventLog, dprintf
 
 _REJECTED = "ErrRejected"  # paxos/rpc.go:47
 
@@ -84,6 +85,9 @@ class HostPaxosPeer:
         self.backoff = backoff
         self._rng = random.Random(seed)
         self._proposing: set[int] = set()
+        # Same observability surface as the fabric (SURVEY §5 build note):
+        # counters + bounded event ring, dprintf under tag "hostpaxos".
+        self.events = EventLog()
         reg = registry or wire.default_registry()
         self.server = GobRpcServer(self.addr, seed=seed, registry=reg)
         self.server.register_method("Paxos.Prepare", self._rpc_prepare,
@@ -188,6 +192,10 @@ class HostPaxosPeer:
         """paxos.go:334-344 — record the decision; absorb the sender's
         piggybacked Done sequence and shrink below the new Min."""
         with self.mu:
+            if a["Instance"] not in self.values:
+                self.events.bump("decided")
+                dprintf("hostpaxos", "peer %d learned seq %d", self.me,
+                        a["Instance"])
             self.values[a["Instance"]] = a["Value"]
             self.max_seq = max(self.max_seq, a["Instance"])
             sender = a["Sender"]
@@ -211,8 +219,10 @@ class HostPaxosPeer:
                         return
                 k = max_seen // self.P + 1
                 n = k * self.P + self.me + 1  # globally unique
+                self.events.bump("rounds")
                 ok, max_seen, v1 = self._phase_prepare(seq, n, max_seen, v)
                 if ok and self._phase_accept(seq, n, v1):
+                    self.events.bump("proposals_won")
                     self._broadcast_decided(seq, v1)
                     return
                 time.sleep(self.backoff * (0.5 + self._rng.random()))
@@ -229,6 +239,7 @@ class HostPaxosPeer:
                        "Paxos.Accept": self._rpc_accept,
                        "Paxos.Decided": self._rpc_decided}[method]
             return handler(args)
+        self.events.bump("rpc_out")
         return gob_call(self.peers[peer], method, args_schema, args,
                         reply_schema, registry=self._registry, timeout=5.0)
 

@@ -34,7 +34,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from tpu6824.core.fabric_service import remote_fabric
-    from tpu6824.rpc import Server, connect
+    from tpu6824.rpc import connect
+    from tpu6824.rpc.native_server import make_server
     from tpu6824.services.diskv import DisKVServer
 
     directory = {}
@@ -47,7 +48,7 @@ def main(argv=None):
         remote_fabric(args.fabric), args.fg, args.gid, args.me,
         sm_proxies, directory, dir=args.dir, restart=args.restart,
     )
-    srv = Server(args.addr).register_obj(kv).start()
+    srv = make_server(args.addr).register_obj(kv).start()
     print(f"diskvd: g{args.gid}-{args.me} at {args.addr} "
           f"(dir={args.dir}, restart={args.restart})", flush=True)
     try:

@@ -21,12 +21,13 @@ def main(argv=None):
     ap.add_argument("--ttl", type=float, default=600.0)
     args = ap.parse_args(argv)
 
-    from tpu6824.rpc import Server, connect
+    from tpu6824.rpc import connect
+    from tpu6824.rpc.native_server import make_server
     from tpu6824.services.lockservice import LockServer
 
     backup = connect(args.backup_addr) if args.backup_addr else None
     ls = LockServer(am_primary=args.primary, backup=backup)
-    srv = Server(args.addr).register_obj(ls).start()
+    srv = make_server(args.addr).register_obj(ls).start()
     role = "primary" if args.primary else "backup"
     print(f"lockd: {role} at {args.addr}", flush=True)
     try:

@@ -21,7 +21,8 @@ def main(argv=None):
     ap.add_argument("--ttl", type=float, default=600.0)
     args = ap.parse_args(argv)
 
-    from tpu6824.rpc import Server, connect
+    from tpu6824.rpc import connect
+    from tpu6824.rpc.native_server import make_server
     from tpu6824.services.common import FlakyNet
     from tpu6824.services.pbservice import PBServer
 
@@ -30,7 +31,7 @@ def main(argv=None):
         name, _, addr = spec.partition("=")
         directory[name] = connect(addr)
     pb = PBServer(args.name, connect(args.vs), FlakyNet(), directory)
-    srv = Server(args.addr).register_obj(pb).start()
+    srv = make_server(args.addr).register_obj(pb).start()
     print(f"pbd: {args.name} at {args.addr}", flush=True)
     try:
         time.sleep(args.ttl)

@@ -20,11 +20,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from tpu6824.core.fabric_service import remote_fabric
-    from tpu6824.rpc import Server
+    from tpu6824.rpc.native_server import make_server
     from tpu6824.services.shardmaster import ShardMasterServer
 
     sm = ShardMasterServer(remote_fabric(args.fabric), args.g, args.me)
-    srv = Server(args.addr).register_obj(sm).start()
+    srv = make_server(args.addr).register_obj(sm).start()
     print(f"shardmasterd: replica {args.me} at {args.addr}", flush=True)
     try:
         time.sleep(args.ttl)

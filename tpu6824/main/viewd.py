@@ -15,11 +15,11 @@ def main(argv=None):
     ap.add_argument("--ttl", type=float, default=600.0)
     args = ap.parse_args(argv)
 
-    from tpu6824.rpc import Server
+    from tpu6824.rpc.native_server import make_server
     from tpu6824.services.viewservice import ViewServer
 
     vs = ViewServer()
-    srv = Server(args.addr).register_obj(vs).start()
+    srv = make_server(args.addr).register_obj(vs).start()
     print(f"viewd: serving at {args.addr}", flush=True)
     try:
         time.sleep(args.ttl)
