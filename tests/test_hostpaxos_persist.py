@@ -1,0 +1,113 @@
+"""Crash+restart durability for the wire-path Paxos peer.
+
+The reference's paxos explicitly does not survive restarts
+(`paxos/paxos.go:3-11`); Lab 5 (diskv) was meant to add durability and the
+fork left its server empty (SURVEY §2.4.7).  `HostPaxosPeer(persist_dir=...)`
+implements the real thing: promises/acceptances are fsynced BEFORE the RPC
+reply leaves (the Paxos safety requirement), decisions and the Done window
+persist, and a restarted peer resumes with its word intact."""
+
+import pytest
+
+from tpu6824.core.hostpeer import HostPaxosPeer, make_host_cluster
+from tpu6824.core.peer import Fate
+from tpu6824.utils.timing import wait_until
+
+
+def mkpeer(tmp_path, me, n=3, pdir=True):
+    addrs = [f"{tmp_path}/px-{i}" for i in range(n)]
+    return HostPaxosPeer(addrs, me, seed=9 + me,
+                         persist_dir=f"{tmp_path}/disk-{me}" if pdir else None)
+
+
+def test_promise_survives_restart(tmp_path):
+    """The acceptor's word is binding across a crash: a promise made before
+    the restart still rejects lower proposals after it — without this, two
+    different values can both 'win' the same instance."""
+    p = mkpeer(tmp_path, 0)
+    assert p._rpc_prepare({"Instance": 0, "Proposal": 10})["Err"] == "OK"
+    assert p._rpc_accept(
+        {"Instance": 0, "Proposal": 10, "Value": ("string", "sworn")}
+    )["Err"] == "OK"
+    p.kill()
+
+    p2 = mkpeer(tmp_path, 0)  # crash+restart: same disk
+    try:
+        r = p2._rpc_prepare({"Instance": 0, "Proposal": 5})
+        assert r["Err"] == "ErrRejected"  # lower than the restored promise
+        assert r["Proposal"] == 10
+        r = p2._rpc_prepare({"Instance": 0, "Proposal": 11})
+        assert r["Err"] == "OK"
+        assert r["Value"] == ("string", "sworn")  # acceptance restored too
+        assert p2._rpc_accept(
+            {"Instance": 0, "Proposal": 9, "Value": ("string", "usurper")}
+        )["Err"] == "ErrRejected"
+    finally:
+        p2.kill()
+
+
+def test_decided_values_survive_restart(tmp_path):
+    peers = [mkpeer(tmp_path, i) for i in range(3)]
+    try:
+        peers[0].start(0, "durable")
+        assert wait_until(
+            lambda: all(p.status(0)[0] == Fate.DECIDED for p in peers),
+            timeout=15.0)
+    finally:
+        for p in peers:
+            p.kill()
+
+    back = [mkpeer(tmp_path, i) for i in range(3)]  # whole-cluster reboot
+    try:
+        for p in back:
+            fate, v = p.status(0)
+            assert (fate, v) == (Fate.DECIDED, "durable")
+        assert all(p.max() >= 0 for p in back)
+        # and the cluster still agrees on NEW instances after the reboot
+        back[1].start(1, "post-reboot")
+        assert wait_until(
+            lambda: all(p.status(1)[0] == Fate.DECIDED for p in back),
+            timeout=15.0)
+        assert back[0].status(1)[1] == "post-reboot"
+    finally:
+        for p in back:
+            p.kill()
+
+
+def test_window_gc_also_cleans_disk(tmp_path):
+    import os
+
+    peers = [mkpeer(tmp_path, i) for i in range(3)]
+    try:
+        for seq in range(3):
+            peers[0].start(seq, f"v{seq}")
+            assert wait_until(
+                lambda s=seq: all(p.status(s)[0] == Fate.DECIDED
+                                  for p in peers), timeout=15.0)
+        for p in peers:
+            p.done(1)
+        for i, p in enumerate(peers):  # piggyback needs later decides
+            p.start(3 + i, f"gc{i}")
+        assert wait_until(lambda: all(p.min() == 2 for p in peers),
+                          timeout=15.0)
+        for i in range(3):
+            files = os.listdir(f"{tmp_path}/disk-{i}")
+            assert not any(
+                f in ("acc-0", "dec-0", "acc-1", "dec-1") for f in files
+            ), files  # forgotten instances are off the disk too
+    finally:
+        for p in peers:
+            p.kill()
+
+
+def test_no_persist_dir_means_reference_semantics(tmp_path):
+    """Without persist_dir the peer behaves exactly like the reference:
+    a restart forgets everything (fresh acceptor)."""
+    p = mkpeer(tmp_path, 0, pdir=False)
+    assert p._rpc_prepare({"Instance": 0, "Proposal": 10})["Err"] == "OK"
+    p.kill()
+    p2 = mkpeer(tmp_path, 0, pdir=False)
+    try:
+        assert p2._rpc_prepare({"Instance": 0, "Proposal": 5})["Err"] == "OK"
+    finally:
+        p2.kill()

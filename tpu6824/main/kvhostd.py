@@ -26,13 +26,16 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--lifetime", type=float, default=600.0,
                     help="suicide timer, like diskvd's (main/diskvd.go:30-74)")
+    ap.add_argument("--persist", default=None, metavar="DIR",
+                    help="durable consensus state: survive crash+restart")
     args = ap.parse_args(argv)
 
     from tpu6824.services.kvpaxos import make_host_replica
     from tpu6824.shim import endpoints
 
     peer, server = make_host_replica(args.dir, args.n, args.me,
-                                     seed=args.seed)
+                                     seed=args.seed,
+                                     persist_dir=args.persist)
     ep = endpoints.serve_kvpaxos(server, f"{args.dir}/clerk-{args.me}")
 
     stop = []

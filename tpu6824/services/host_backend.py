@@ -60,16 +60,20 @@ class StructOpPeer:
 
 def make_host_replica(sockdir: str, prefix: str, name: str, schema: Struct,
                       make_server, nservers: int, me: int,
-                      seed: int | None = None):
+                      seed: int | None = None,
+                      persist_dir: str | None = None):
     """One decentralized replica: a gob Paxos peer endpoint at
     `{sockdir}/{prefix}-{me}` plus the service RSM built by
-    `make_server(host_op_peer)`.  Returns (host_peer, server)."""
+    `make_server(host_op_peer)`.  With `persist_dir` the peer's consensus
+    state is crash-durable (see HostPaxosPeer).  Returns (host_peer,
+    server)."""
     from tpu6824.core.hostpeer import HostPaxosPeer
     from tpu6824.shim.wire import default_registry
 
     registry = default_registry().register(name, schema)
     addrs = [f"{sockdir}/{prefix}-{i}" for i in range(nservers)]
-    peer = HostPaxosPeer(addrs, me, registry=registry, seed=seed)
+    peer = HostPaxosPeer(addrs, me, registry=registry, seed=seed,
+                         persist_dir=persist_dir)
     return peer, make_server(peer)
 
 

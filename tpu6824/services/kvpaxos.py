@@ -252,16 +252,18 @@ def HostOpPeer(host_peer) -> StructOpPeer:
 
 
 def make_host_replica(sockdir: str, nservers: int, me: int,
-                      seed: int | None = None, **kw):
+                      seed: int | None = None,
+                      persist_dir: str | None = None, **kw):
     """One decentralized replica — peer endpoint + RSM server — suitable
     for one-replica-per-OS-process deployment (the reference's model:
     every server process embeds its own Paxos peer,
-    kvpaxos/server.go StartServer).  Returns (host_peer, server)."""
+    kvpaxos/server.go StartServer).  With `persist_dir`, the peer survives
+    crash+restart.  Returns (host_peer, server)."""
     from tpu6824.services.host_backend import make_host_replica as _mk
 
     return _mk(sockdir, "px", KVOP_NAME, KVOP_WIRE,
                lambda p: KVPaxosServer(None, 0, p.me, px=HostOpPeer(p), **kw),
-               nservers, me, seed=seed)
+               nservers, me, seed=seed, persist_dir=persist_dir)
 
 
 def make_host_cluster(sockdir: str, nservers: int = 3, seed: int | None = None,
