@@ -34,6 +34,7 @@ import pickle
 import random
 import threading
 import time
+from collections import deque
 
 from tpu6824.core.peer import Fate
 from tpu6824.shim import wire
@@ -74,7 +75,8 @@ class HostPaxosPeer:
     def __init__(self, peers: list[str], me: int,
                  registry: Registry | None = None,
                  seed: int | None = None, backoff: float = 0.02,
-                 persist_dir: str | None = None):
+                 persist_dir: str | None = None,
+                 max_proposers: int = 64):
         """With `persist_dir`, acceptor promises/acceptances, decisions,
         and Done state are written to disk BEFORE any RPC reply leaves —
         Paxos's durability requirement — and reloaded on construction, so
@@ -96,6 +98,18 @@ class HostPaxosPeer:
         self.backoff = backoff
         self._rng = random.Random(seed)
         self._proposing: set[int] = set()
+        # Bounded proposer pool: at most `max_proposers` concurrent proposer
+        # threads; further Starts queue and run as workers free up (the
+        # reference's goroutine-per-Start is fine in Go; a Python deployment
+        # with thousands of in-flight instances would thrash on threads).
+        self._max_proposers = max_proposers
+        self._prop_threads = 0
+        self._prop_q: deque[tuple[int, tuple | None]] = deque()
+        # Decided re-delivery: ONE daemon thread per unreachable peer (at
+        # most P), each draining a per-peer queue of (seq, value) — not one
+        # immortal thread per decided instance.
+        self._redeliver_q: list[deque] = [deque() for _ in range(self.P)]
+        self._redeliver_on = [False] * self.P
         # Same observability surface as the fabric (SURVEY §5 build note):
         # counters + bounded event ring, dprintf under tag "hostpaxos".
         self.events = EventLog()
@@ -126,7 +140,11 @@ class HostPaxosPeer:
             if seq in self.values or seq in self._proposing:
                 return
             self._proposing.add(seq)
-        threading.Thread(target=self._propose, args=(seq, v),
+            if self._prop_threads >= self._max_proposers:
+                self._prop_q.append((seq, v))
+                return
+            self._prop_threads += 1
+        threading.Thread(target=self._proposer_worker, args=(seq, v),
                          daemon=True).start()
 
     def status(self, seq: int):
@@ -293,6 +311,32 @@ class HostPaxosPeer:
 
     # ------------------------------------------------- proposer loop
 
+    def _proposer_worker(self, seq: int, v) -> None:
+        """Run one proposal to completion, then drain queued Starts until
+        the pool has no more work for this thread."""
+        while True:
+            try:
+                self._propose(seq, v)
+            except BaseException:
+                # Keep the pool's slot accounting honest even if a proposal
+                # dies unexpectedly (e.g. disk-full during persist): hand
+                # the slot to queued work or free it, then re-raise.
+                with self.mu:
+                    if self._prop_q and not self.dead:
+                        nxt = self._prop_q.popleft()
+                    else:
+                        self._prop_threads -= 1
+                        raise
+                threading.Thread(target=self._proposer_worker, args=nxt,
+                                 daemon=True).start()
+                raise
+            with self.mu:
+                if self._prop_q and not self.dead:
+                    seq, v = self._prop_q.popleft()
+                else:
+                    self._prop_threads -= 1
+                    return
+
     def _propose(self, seq: int, v) -> None:
         """paxos.go:122-152 — retry rounds until decided, with randomized
         backoff (ties are systematic in lockstep otherwise)."""
@@ -367,31 +411,73 @@ class HostPaxosPeer:
     def _broadcast_decided(self, seq, v1) -> None:
         """Unlike the reference's fire-and-forget `go call` (paxos.go:
         315-320) — which can strand a learner forever when the one Decided
-        message is dropped — delivery is retried per peer until the RPC
-        reply acks it.  Costs nothing on a reliable net (one acked send)."""
-        pending = set(range(self.P))
+        message is dropped — delivery is retried until the RPC reply acks
+        it.  One immediate pass here; failed peers are handed to a per-peer
+        re-delivery thread (at most P such threads exist, regardless of how
+        many instances are in flight), which retries with backoff until the
+        peer heals or the Done window moves past seq.  Costs nothing on a
+        reliable net (one acked send, no thread spawned)."""
+        with self.mu:
+            done = self.done_seqs[self.me]
+        for p in range(self.P):
+            args = {"Sender": self.me, "DoneIns": done,
+                    "Instance": seq, "Value": v1}
+            try:
+                self._call(p, "Paxos.Decided", args,
+                           wire.DECIDED_ARGS, wire.DECIDED_REPLY)
+            except RPCError:
+                with self.mu:
+                    if self.dead:
+                        return
+                    self._redeliver_q[p].append((seq, v1))
+                    if not self._redeliver_on[p]:
+                        self._redeliver_on[p] = True
+                        threading.Thread(target=self._redeliver_loop,
+                                         args=(p,), daemon=True).start()
+
+    def _redeliver_loop(self, p: int) -> None:
+        """Drain peer p's queue of unacked Decided messages.  Exits when the
+        queue is empty (or only holds forgotten instances), so a healthy
+        deployment carries zero re-delivery threads."""
+        try:
+            self._redeliver_drain(p)
+        except BaseException:
+            # Unexpected death must not leave the started-flag stuck True
+            # (that would silence re-delivery to p forever); the next failed
+            # broadcast respawns the drainer.
+            with self.mu:
+                self._redeliver_on[p] = False
+            raise
+
+    def _redeliver_drain(self, p: int) -> None:
         sleep = self.backoff
         while True:
             with self.mu:
-                if self.dead or seq < self._min_locked():
-                    return  # nobody needs this instance anymore
+                q = self._redeliver_q[p]
+                mn = self._min_locked()
+                while q and q[0][0] < mn:
+                    q.popleft()  # window moved past it: nobody needs it
+                if self.dead or not q:
+                    self._redeliver_on[p] = False
+                    return
+                seq, v1 = q[0]
                 done = self.done_seqs[self.me]
-            for p in sorted(pending):
-                try:
-                    self._call(p, "Paxos.Decided",
-                               {"Sender": self.me, "DoneIns": done,
-                                "Instance": seq, "Value": v1},
-                               wire.DECIDED_ARGS, wire.DECIDED_REPLY)
-                    pending.discard(p)
-                except RPCError:
-                    pass  # dropped/deaf/partitioned: retry below
-            if not pending:
-                return
-            # Keep retrying until the peer heals, dies, or the window moves
-            # past seq — a partition outliving any fixed retry cap would
-            # otherwise re-strand the learner.  Backoff caps at 1s.
-            time.sleep(sleep * (0.5 + self._rng.random()))
-            sleep = min(sleep * 1.5, 1.0)
+            try:
+                self._call(p, "Paxos.Decided",
+                           {"Sender": self.me, "DoneIns": done,
+                            "Instance": seq, "Value": v1},
+                           wire.DECIDED_ARGS, wire.DECIDED_REPLY)
+                with self.mu:
+                    if self._redeliver_q[p] and \
+                            self._redeliver_q[p][0] == (seq, v1):
+                        self._redeliver_q[p].popleft()
+                sleep = self.backoff
+            except RPCError:
+                # Peer still unreachable: back off (caps at 1s) and retry —
+                # a partition outliving any fixed cap would otherwise
+                # re-strand the learner.
+                time.sleep(sleep * (0.5 + self._rng.random()))
+                sleep = min(sleep * 1.5, 1.0)
 
     # ------------------------------------------------- window GC
 

@@ -221,18 +221,26 @@ void drain_replies(Server* s) {
     c.wbuf[3] = uint8_t(len);
     memcpy(c.wbuf.data() + 4, r.data.data(), r.data.size());
     c.want_write = true;
+    // Re-arm the I/O deadline for the reply-write phase: a client that
+    // stops reading must not pin the fd + buffered reply forever.
+    c.deadline_ms = now_ms() + kConnTimeoutMs;
     epoll_mod(s, r.conn_id, c);
     handle_write(s, r.conn_id);  // opportunistic immediate flush
   }
 }
 
 void sweep_stale(Server* s) {
+  // The 30s deadline bounds socket I/O phases only — request read
+  // (pre-handoff) and reply write (want_write, deadline re-armed when the
+  // reply is enqueued) — matching the Python transport's settimeout(30.0),
+  // which likewise never bounds handler execution.  A handed-off
+  // connection whose handler is still running (no reply yet) is exempt.
   int64_t now = now_ms();
   std::vector<uint64_t> stale;
   for (auto& [id, c] : s->conns)
-    if (now >= c.deadline_ms) stale.push_back(id);
-  for (uint64_t id : stale) close_conn(s, id);  // handler replies for a
-  // swept conn are dropped harmlessly in drain_replies (conn not found).
+    if ((!c.handed_off || c.want_write) && now >= c.deadline_ms)
+      stale.push_back(id);
+  for (uint64_t id : stale) close_conn(s, id);
 }
 
 void loop_body(Server* s) {

@@ -22,11 +22,12 @@ Semantics preserved in translation:
     onto Go's `{Num, Shards [10]int64, Groups map[int64][]string}` with
     identical gid numbering (shardmaster/common.go:37-41).
 
-The Paxos peer protocol itself ("Paxos.Prepare"/"Accept"/"Decided",
-paxos/rpc.go) deliberately has NO gob endpoint: inter-peer consensus traffic
-rides the device plane as masked tensor exchanges (SURVEY §2.3), not
-host RPC.  The schemas exist in wire.py for completeness and for any future
-mixed Go-peer deployment.
+The Paxos peer protocol ("Paxos.Prepare"/"Accept"/"Decided", paxos/rpc.go)
+is served over gob by `core/hostpeer.py::HostPaxosPeer` — the decentralized
+backend, which registers exactly those method names on its own socket.  On
+the fabric backend the same traffic instead rides the device plane as
+masked tensor exchanges (SURVEY §2.3), so no endpoint here wraps it; the
+schemas live in wire.py and are shared by both.
 """
 
 from __future__ import annotations

@@ -57,6 +57,12 @@ __all__ = [
 ]
 
 _MAX_MESSAGE = 64 << 20
+# Decode-nesting cap: a hostile stream can define a slice whose element id is
+# itself (or an arbitrarily deep typedef chain), which would otherwise drive
+# the recursive decoder to a Python RecursionError.  Go's decoder has the
+# same class of guard (maxIgnoreNestingDepth).  The reference's deepest real
+# struct (TransferStateReply → XState → map[string]Rep) nests 4 levels.
+_MAX_DEPTH = 64
 
 BOOL_ID = 1
 INT_ID = 2
@@ -687,16 +693,19 @@ class Decoder:
 
     # -- value decoding ----------------------------------------------------
 
-    def _dec_value(self, r: _Reader, tid: int, top: bool):
+    def _dec_value(self, r: _Reader, tid: int, top: bool, depth: int = 0):
+        if depth > _MAX_DEPTH:
+            raise GobError("gob value nesting too deep "
+                           "(self-referential or hostile type definition)")
         wd = self._wire.get(tid)
         if wd is not None and wd.kind == "struct":
-            return self._dec_struct(r, wd)
+            return self._dec_struct(r, wd, depth)
         if top:
             if r.uint() != 0:
                 raise GobError("non-zero delta for singleton value")
-        return self._dec_nonstruct(r, tid, wd)
+        return self._dec_nonstruct(r, tid, wd, depth)
 
-    def _dec_struct(self, r: _Reader, wd: _WireDef) -> dict:
+    def _dec_struct(self, r: _Reader, wd: _WireDef, depth: int) -> dict:
         out = {}
         f = -1
         while True:
@@ -708,9 +717,10 @@ class Decoder:
                 raise GobError(
                     f"field index {f} out of range for struct {wd.name!r}")
             fname, ftid = wd.fields[f]
-            out[fname] = self._dec_value(r, ftid, top=False)
+            out[fname] = self._dec_value(r, ftid, top=False, depth=depth + 1)
 
-    def _dec_nonstruct(self, r: _Reader, tid: int, wd: _WireDef | None):
+    def _dec_nonstruct(self, r: _Reader, tid: int, wd: _WireDef | None,
+                       depth: int = 0):
         if wd is None:
             if tid == BOOL_ID:
                 return r.uint() != 0
@@ -727,22 +737,29 @@ class Decoder:
             if tid == COMPLEX_ID:
                 return complex(r.float_(), r.float_())
             if tid == INTERFACE_ID:
-                return self._dec_interface(r)
+                return self._dec_interface(r, depth)
             raise GobError(f"value of undefined type id {tid}")
+        remaining = len(r.data) - r.pos
         if wd.kind in ("slice", "array"):
             n = r.uint()
             if wd.kind == "array" and n != wd.length:
                 raise GobError(f"array count {n} != declared {wd.length}")
-            return [self._dec_value(r, wd.elem, top=False) for _ in range(n)]
+            if n > remaining:  # every element costs >= 1 byte
+                raise GobError(f"{wd.kind} count {n} exceeds message size")
+            return [self._dec_value(r, wd.elem, top=False, depth=depth + 1)
+                    for _ in range(n)]
         if wd.kind == "map":
+            n = r.uint()
+            if 2 * n > remaining:  # every key+value costs >= 2 bytes
+                raise GobError(f"map count {n} exceeds message size")
             out = {}
-            for _ in range(r.uint()):
-                k = self._dec_value(r, wd.kt, top=False)
-                out[k] = self._dec_value(r, wd.vt, top=False)
+            for _ in range(n):
+                k = self._dec_value(r, wd.kt, top=False, depth=depth + 1)
+                out[k] = self._dec_value(r, wd.vt, top=False, depth=depth + 1)
             return out
         raise GobError(f"cannot decode wire kind {wd.kind!r}")
 
-    def _dec_interface(self, r: _Reader):
+    def _dec_interface(self, r: _Reader, depth: int = 0):
         nlen = r.uint()
         if nlen == 0:
             return None
@@ -750,7 +767,7 @@ class Decoder:
         tid = r.int_()
         blen = r.uint()
         sub = _Reader(r.take(blen))
-        v = self._dec_value(sub, tid, top=True)
+        v = self._dec_value(sub, tid, top=True, depth=depth + 1)
         if not sub.done():
             raise GobError("trailing bytes inside interface value")
         return (name, v)

@@ -111,7 +111,7 @@ class GobRpcServer(transport.Server):
                               reply_schema, reply, conn, discard_reply)
                 if discard_reply:
                     return  # one deaf reply per unreliable connection
-        except (gob.GobError, RPCError, OSError, EOFError):
+        except (gob.GobError, RPCError, OSError, EOFError, RecursionError):
             pass
         finally:
             conn.close()
@@ -150,7 +150,7 @@ def gob_call(addr: str, method: str, args_schema: gob.Struct, args: dict,
             _, resp = dec.next()
             resp = gob.complete(RESPONSE, resp)
             _, reply = dec.next()
-        except (OSError, EOFError, gob.GobError) as e:
+        except (OSError, EOFError, gob.GobError, RecursionError) as e:
             raise RPCError(f"gob call {method}@{addr}: {e}") from e
         if resp["Error"]:
             raise RPCError(f"{method}@{addr}: {resp['Error']}")

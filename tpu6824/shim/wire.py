@@ -42,10 +42,11 @@ KV_GET_ARGS = Struct("GetArgs", [("Key", STRING), ("OpID", INT)])
 KV_GET_REPLY = Struct("GetReply", [("Err", STRING), ("Value", STRING)])
 
 # kvpaxos/server.go:25-33 — the Op logged through Paxos, gob-registered so
-# it can travel in PrepareReply.Value etc.
+# it can travel in PrepareReply.Value etc.  Fields match the reference
+# struct exactly (OpID, Op, Key, Value) — no extras, so a Go peer's decoder
+# sees precisely the wire fields its own `gob.Register(Op{})` declared.
 KV_OP = Struct("Op", [
-    ("Me", INT), ("OpID", INT), ("Op", STRING), ("Key", STRING),
-    ("Value", STRING),
+    ("OpID", INT), ("Op", STRING), ("Key", STRING), ("Value", STRING),
 ])
 
 # --------------------------------------------------------- viewservice
