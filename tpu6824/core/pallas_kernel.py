@@ -278,24 +278,30 @@ def paxos_step_pallas(
     return new_state, io
 
 
-def get_step(impl: str | None = None):
-    """Resolve the step implementation: 'xla' or 'pallas'.
+def resolve_impl(impl: str | None = None) -> str:
+    """Resolve the step implementation name: 'xla' or 'pallas'.
 
-    Default (no arg, no $TPU6824_KERNEL): 'pallas' on TPU — measured 13.5×
-    the XLA path on v5e (73.3M vs 5.45M decided instances/sec @ 1024 groups)
-    — and 'xla' elsewhere, since off-TPU the Pallas path runs in interpret
-    mode (kept for the CPU equivalence suite, far too slow for service use).
+    Default (no arg, no $TPU6824_KERNEL): 'pallas' on TPU — measured faster
+    than the XLA path on the real chip (see bench.py) — and 'xla' elsewhere,
+    since off-TPU the Pallas path runs in interpret mode (kept for the CPU
+    equivalence suite, far too slow for service use).
     """
     import os
-
-    from tpu6824.core.kernel import paxos_step
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
     impl = impl or os.environ.get(
         "TPU6824_KERNEL", "pallas" if on_tpu else "xla"
     )
-    if impl == "xla":
-        return paxos_step
-    if impl != "pallas":
+    if impl not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel impl {impl!r}")
+    return impl
+
+
+def get_step(impl: str | None = None):
+    """Step implementation for `resolve_impl(impl)` (see its docstring)."""
+    from tpu6824.core.kernel import paxos_step
+
+    if resolve_impl(impl) == "xla":
+        return paxos_step
+    on_tpu = jax.default_backend() in ("tpu", "axon")
     return functools.partial(paxos_step_pallas, interpret=not on_tpu)
