@@ -143,6 +143,92 @@ def test_unreliable_safety():
             assert (vals == vals[0]).all(), f"disagreement at {(g, i)}"
 
 
+def test_lane_state_roundtrip():
+    from tpu6824.core.pallas_kernel import from_lane_state, to_lane_state
+
+    G, I, P = 3, 4, 3
+    s = _armed_state(G, I, P, "mixed")
+    back = from_lane_state(to_lane_state(s), s.done_view, G, I)
+    _assert_states_equal(s, back)
+
+
+def test_apply_starts_lane_matches():
+    from tpu6824.core.pallas_kernel import (
+        apply_starts_lane, from_lane_state, to_lane_state, _to_lanes, _block,
+    )
+
+    G, I, P = 2, 6, 3
+    N = G * I
+    _, Np = _block(N)
+    s = _armed_state(G, I, P, "all")
+    link, done, dr, _ = _args(G, P)
+    # advance one step so some cells are decided, then recycle those
+    s, _ = paxos_step(s, link, done, jax.random.key(0), dr, dr)
+    rng = np.random.default_rng(5)
+    reset = np.asarray(s.decided.any(-1)) & (rng.random((G, I)) < 0.5)
+    sa = rng.random((G, I, P)) < 0.4
+    sv = rng.integers(1, 100, (G, I, P)).astype(np.int32)
+    want = apply_starts(jax.tree.map(jnp.copy, s), jnp.asarray(reset),
+                        jnp.asarray(sa), jnp.asarray(sv))
+    reset_l = jnp.asarray(
+        np.pad(reset.reshape(N), (0, Np - N), constant_values=False))
+    got_lane = apply_starts_lane(
+        to_lane_state(s), reset_l,
+        _to_lanes(jnp.asarray(sa), P, N, Np, 0),
+        _to_lanes(jnp.asarray(sv), P, N, Np, NO_VAL))
+    got = from_lane_state(got_lane, want.done_view, G, I)
+    _assert_states_equal(want, got)
+
+
+def test_maskless_fast_path_equals_xla_at_drop0():
+    """masked=False must realize exactly the XLA path's drop=0 schedule on a
+    full link (where every delivery mask is all-ones regardless of key)."""
+    from tpu6824.core.pallas_kernel import (
+        from_lane_state, paxos_step_lanes, to_lane_state,
+    )
+
+    G, I, P = 2, 8, 3
+    link, _, dr, _ = _args(G, P)
+    done = jnp.asarray(np.arange(G * P).reshape(G, P).astype(np.int32) - 1)
+    sx, sp = _fork(_armed_state(G, I, P, "all"))
+    l, dv = to_lane_state(sp), sp.done_view
+    key = jax.random.key(9)
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        sx, iox = paxos_step(sx, link, done, sub, dr, dr)
+        l, dv, msgs = paxos_step_lanes(
+            l, dv, link, done, sub, dr, dr,
+            G=G, I=I, masked=False, interpret=True)
+        got = from_lane_state(l, dv, G, I)._replace(propv=sx.propv)
+        _assert_states_equal(sx, got)
+        assert int(iox.msgs) == int(msgs)
+
+
+def test_lane_resident_multistep_equals_wrapper():
+    """A lane-resident loop (state never leaves lane layout) must match the
+    per-step conversion wrapper bit-for-bit, lossy masks included."""
+    from tpu6824.core.pallas_kernel import (
+        from_lane_state, paxos_step_lanes, to_lane_state,
+    )
+
+    G, I, P = 2, 8, 3
+    link, done, _, _ = _args(G, P)
+    drop_req = jnp.full((G, P, P), 0.10, jnp.float32)
+    drop_rep = jnp.full((G, P, P), 0.20, jnp.float32)
+    sw, sl = _fork(_armed_state(G, I, P, "all"))
+    l, dv = to_lane_state(sl), sl.done_view
+    key = jax.random.key(21)
+    for _ in range(6):
+        key, sub = jax.random.split(key)
+        sw, _ = paxos_step_pallas(sw, link, done, sub, drop_req, drop_rep,
+                                  interpret=True)
+        l, dv, _ = paxos_step_lanes(
+            l, dv, link, done, sub, drop_req, drop_rep,
+            G=G, I=I, masked=True, interpret=True)
+    got = from_lane_state(l, dv, G, I)._replace(propv=sw.propv)
+    _assert_states_equal(sw, got)
+
+
 def test_get_step_dispatch(monkeypatch):
     from tpu6824.core.kernel import paxos_step as xla_step
 

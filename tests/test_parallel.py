@@ -72,3 +72,78 @@ def test_exchange_all_gather_matches_dense(mesh):
     assert out.shape == (G, I, P, P)
     for dst in range(P):
         np.testing.assert_array_equal(out[..., dst], np.asarray(msgs))
+
+
+# ---------------------------------------------------------------- pallas
+
+
+@pytest.fixture(scope="module")
+def gmesh():
+    """All 8 devices on the group axis — the mesh shape the fused Pallas
+    round shards over (quorum + window axes local, see sharded_step_pallas)."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()).reshape(8, 1, 1),
+                axis_names=("g", "i", "p"))
+
+
+def test_sharded_pallas_reliable_matches_dense(gmesh):
+    """At drop=0 the fused round has no randomness in its decisions, so the
+    g-sharded Pallas step must reproduce the dense XLA step bit-for-bit on
+    every field except done_view's heartbeat draws (identical here too,
+    since at drop=0 the heartbeat covers every live edge)."""
+    from tpu6824.parallel.mesh import sharded_step_pallas
+
+    G, I, P = 8, 4, 3
+    link = jnp.ones((G, P, P), bool)
+    done = jnp.full((G, P), -1, jnp.int32)
+    dr = jnp.zeros((G, P, P), jnp.float32)
+    key = jax.random.key(5)
+
+    dense_out, dense_io = paxos_step(_start_all(G, I, P), link, done, key,
+                                     dr, dr)
+    state_s = place_state(_start_all(G, I, P), gmesh)
+    step = sharded_step_pallas(gmesh, interpret=True)
+    shard_out, shard_io = step(state_s, link, done, key, dr, dr)
+
+    for name, a, b in zip(dense_out._fields, dense_out, shard_out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"field {name}")
+    assert int(dense_io.msgs) == int(shard_io.msgs)
+    assert (np.asarray(shard_out.decided) >= 0).all()
+
+
+def test_sharded_pallas_lossy_safety_and_liveness(gmesh):
+    """Under 10%/20% loss with dueling proposers, the sharded Pallas path
+    must keep agreement and eventually decide every instance."""
+    from tpu6824.parallel.mesh import sharded_step_pallas
+
+    G, I, P = 8, 4, 3
+    state = init_state(G, I, P)
+    sa = np.ones((G, I, P), bool)
+    sv = (np.arange(G * I * P).reshape(G, I, P) + 1).astype(np.int32)
+    state = apply_starts(state, jnp.zeros((G, I), bool), jnp.asarray(sa),
+                         jnp.asarray(sv))
+    state = place_state(state, gmesh)
+    link = jnp.ones((G, P, P), bool)
+    done = jnp.full((G, P), -1, jnp.int32)
+    dq = jnp.full((G, P, P), 0.10, jnp.float32)
+    dp = jnp.full((G, P, P), 0.20, jnp.float32)
+    step = sharded_step_pallas(gmesh, interpret=True)
+    key = jax.random.key(17)
+    for _ in range(25):
+        key, sub = jax.random.split(key)
+        state, _ = step(state, link, done, sub, dq, dp)
+    dec = np.asarray(state.decided)
+    assert (dec >= 0).all(), "liveness under loss on the sharded pallas path"
+    for g in range(G):
+        for i in range(I):
+            vals = dec[g, i][dec[g, i] >= 0]
+            assert (vals == vals[0]).all(), f"disagreement at {(g, i)}"
+
+
+def test_sharded_pallas_rejects_nonlocal_quorum(mesh):
+    from tpu6824.parallel.mesh import sharded_step_pallas
+
+    with pytest.raises(ValueError, match="local"):
+        sharded_step_pallas(mesh)

@@ -7,15 +7,24 @@ module fuses the whole round into ONE Pallas kernel:
   - cells are laid out `(P, N)` with `N = G·I` on the lane axis, so every
     per-edge exchange is an elementwise VPU op over a `(1, C)` vector of
     cells; the tiny peer axis (P = 3..7) is statically unrolled;
-  - each grid step loads a `C`-cell block of the 7 state arrays plus the 5
-    per-edge delivery masks into VMEM, runs all three phases without touching
-    HBM, and writes the 6 outputs — a single HBM round-trip per step versus
-    the XLA path's chain of fused-but-separate kernels;
-  - delivery masks (the reference harness's lossy network,
-    `paxos/paxos.go:528-544`, as per-edge Bernoulli keeps) are generated
-    host-side with EXACTLY the same `jax.random` splits as the XLA path, so
-    both paths are bit-identical under the same key when drop probabilities
-    are zero, and distributionally identical otherwise.
+  - each grid step loads a `C`-cell block of the 7 state arrays (plus, in
+    lossy mode, ONE packed delivery-mask array) into VMEM, runs all three
+    phases without touching HBM, and writes the 6 outputs — a single HBM
+    round-trip per step versus the XLA path's chain of fused-but-separate
+    kernels;
+  - the 5 per-edge delivery masks (the reference harness's lossy network,
+    `paxos/paxos.go:528-544`, as per-edge Bernoulli keeps) are packed as
+    BITPLANES of a single int32 array — one mask operand instead of five,
+    an ~5× cut in per-step mask HBM traffic.  They are generated with
+    EXACTLY the same `jax.random` splits as the XLA path, so both paths are
+    bit-identical under the same key at any drop rate;
+  - when the caller knows the network is reliable and fully connected
+    (`masked=False` — the best-case and contended bench configs), no mask
+    is materialized at all: the kernel's edge predicate folds to constant
+    True and per-step HBM traffic is just the 13 state arrays;
+  - state can stay RESIDENT in the `(P, N)` lane layout across steps
+    (`LaneState` + `paxos_step_lanes` + `apply_starts_lane`), eliminating
+    the two full-state transposes per step the conversion wrappers pay.
 
 Semantics are those of `paxos_step` (see kernel.py's docstring for the
 mapping to `paxos/paxos.go`); the only realization difference is that the
@@ -30,6 +39,7 @@ interpret mode off-TPU so the CPU test suite can verify equivalence.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -40,23 +50,42 @@ from tpu6824.core.kernel import NO_VAL, PaxosState, StepIO, _edge_masks
 I32 = jnp.int32
 LANES = 128  # TPU lane width; cell blocks are multiples of this
 
+# Bitplane assignment inside the packed mask word.
+_BIT_M1, _BIT_M2, _BIT_M3, _BIT_R1, _BIT_R2 = range(5)
 
-def _round_kernel(P: int,
-                  np_ref, na_ref, va_ref, dec_ref, act_ref, propv_ref, ms_ref,
-                  m1_ref, m2_ref, m3_ref, r1_ref, r2_ref,
-                  np_out, na_out, va_out, dec_out, ms_out, msgs_out):
+
+def _round_kernel(P: int, masked: bool, *refs):
     """One consensus round for a (P, C) block of cells.
 
-    All refs are (P, C) or (P, P, C) int32; masks are 0/1.  Every operand
-    below is a (1, C) lane vector; loops over the peer axis are unrolled at
-    trace time.
+    refs (masked):   np, na, va, dec, act, propv, ms, mask | 6 outputs
+    refs (maskless): np, na, va, dec, act, propv, ms       | 6 outputs
+    State refs are (P, C) int32; mask is (P, P, C) int32 bitplanes
+    (bit 0..4 = M1, M2, M3, R1, R2).  Every operand below is a (1, C) lane
+    vector; loops over the peer axis are unrolled at trace time.
     """
+    if masked:
+        (np_ref, na_ref, va_ref, dec_ref, act_ref, propv_ref, ms_ref,
+         mask_ref,
+         np_out, na_out, va_out, dec_out, ms_out, msgs_out) = refs
+    else:
+        (np_ref, na_ref, va_ref, dec_ref, act_ref, propv_ref, ms_ref,
+         np_out, na_out, va_out, dec_out, ms_out, msgs_out) = refs
+
+    C = np_ref.shape[1]
 
     def row(ref, p):
         return ref[p:p + 1, :]
 
-    def edge(ref, p, q):
-        return ref[p, q:q + 1, :] != 0
+    if masked:
+        def edge(bit, p, q):
+            return ((mask_ref[p, q:q + 1, :] >> bit) & 1) != 0
+    else:
+        # Reliable, fully-connected fast path: the edge predicate is the
+        # constant True vector, which Mosaic folds out of every AND below.
+        tru = jnp.ones((1, C), dtype=bool)
+
+        def edge(bit, p, q):
+            return tru
 
     np_pre = [row(np_ref, p) for p in range(P)]
     na_pre = [row(na_ref, p) for p in range(P)]
@@ -73,7 +102,8 @@ def _round_kernel(P: int,
 
     # ---- Phase 1: PREPARE --------------------------------------------------
     # Delivery: D1[p→q]; promise iff n_prop[p] > np_pre[q] (paxos.go:244-257).
-    D1 = [[edge(m1_ref, p, q) & active[p] for q in range(P)] for p in range(P)]
+    D1 = [[edge(_BIT_M1, p, q) & active[p] for q in range(P)]
+          for p in range(P)]
     np_post1 = []
     for q in range(P):
         hi = np_pre[q]
@@ -88,7 +118,7 @@ def _round_kernel(P: int,
         va_best = propv[p]
         for q in range(P):
             grant = D1[p][q] & (n_prop[p] > np_pre[q])
-            got = grant & edge(r1_ref, p, q)
+            got = grant & edge(_BIT_R1, p, q)
             cnt = cnt + got.astype(I32)
             cand = jnp.where(got, na_pre[q], -1)
             upd = cand > best_na
@@ -102,7 +132,7 @@ def _round_kernel(P: int,
     for p in range(P):
         hi = maxseen[p]
         for q in range(P):
-            rep = D1[p][q] & edge(r1_ref, p, q)
+            rep = D1[p][q] & edge(_BIT_R1, p, q)
             hi = jnp.maximum(hi, jnp.where(rep, np_post1[q], 0))
         ms_new.append(hi)
 
@@ -110,7 +140,8 @@ def _round_kernel(P: int,
     # Accept iff n >= promised; one winner per acceptor per step — the
     # highest delivered n (per-step serialization rule, kernel.py:168-173).
     send2 = [active[p] & maj1[p] for p in range(P)]
-    D2 = [[edge(m2_ref, p, q) & send2[p] for q in range(P)] for p in range(P)]
+    D2 = [[edge(_BIT_M2, p, q) & send2[p] for q in range(P)]
+          for p in range(P)]
     ok2 = [[D2[p][q] & (n_prop[p] >= np_post1[q]) for q in range(P)]
            for p in range(P)]
     win_n = []
@@ -136,11 +167,11 @@ def _round_kernel(P: int,
     for p in range(P):
         cnt = zero
         for q in range(P):
-            cnt = cnt + (win[p][q] & edge(r2_ref, p, q)).astype(I32)
+            cnt = cnt + (win[p][q] & edge(_BIT_R2, p, q)).astype(I32)
         maj2.append(cnt * 2 > P)
         hi = ms_new[p]
         for q in range(P):
-            rep = D2[p][q] & edge(r2_ref, p, q)
+            rep = D2[p][q] & edge(_BIT_R2, p, q)
             hi = jnp.maximum(hi, jnp.where(rep, np_post2[q], 0))
         ms_new[p] = hi
 
@@ -151,7 +182,8 @@ def _round_kernel(P: int,
     decider = [send2[p] & maj2[p] for p in range(P)]
     dv = [jnp.where(decider[p], v1[p], dec_pre[p]) for p in range(P)]
     send3 = [decider[p] | ((dec_pre[p] >= 0) & ~all_dec) for p in range(P)]
-    D3 = [[edge(m3_ref, p, q) & send3[p] for q in range(P)] for p in range(P)]
+    D3 = [[edge(_BIT_M3, p, q) & send3[p] for q in range(P)]
+          for p in range(P)]
     dec_new = []
     for q in range(P):
         inc = zero + NO_VAL
@@ -179,6 +211,32 @@ def _round_kernel(P: int,
     msgs_out[...] = jnp.concatenate(msgs, axis=0)
 
 
+# --------------------------------------------------------------------------
+# lane layout
+
+
+class LaneState(NamedTuple):
+    """Consensus state resident in the kernel's (P, Np) lane layout —
+    cells (g·I + i) on lanes, peers on sublanes, padded to the block size.
+    Conversions to/from PaxosState cost two full-state transposes; keep
+    state in this form across steps (bench loops, lax.scan) and convert
+    only at the boundary."""
+
+    np_: jnp.ndarray     # (P, Np) i32
+    na: jnp.ndarray      # (P, Np) i32
+    va: jnp.ndarray      # (P, Np) i32
+    dec: jnp.ndarray     # (P, Np) i32
+    act: jnp.ndarray     # (P, Np) i32 (0/1)
+    propv: jnp.ndarray   # (P, Np) i32
+    ms: jnp.ndarray      # (P, Np) i32
+
+
+def _block(N: int) -> tuple[int, int]:
+    """(block size C, padded cell count Np) for an N-cell universe."""
+    C = min(8 * LANES, max(LANES, ((N + LANES - 1) // LANES) * LANES))
+    return C, ((N + C - 1) // C) * C
+
+
 def _to_lanes(a, P, N, Np, fill):
     """(G, I, P) → (P, Np) int32, cells on lanes, padded with `fill`."""
     a = jnp.moveaxis(a, 2, 0).reshape(P, N).astype(I32)
@@ -188,7 +246,7 @@ def _to_lanes(a, P, N, Np, fill):
 
 
 def _mask_to_lanes(m, P, N, Np):
-    """(G, I, P, P) bool → (P, P, Np) int32 [src, dst, cell]."""
+    """(G, I, P, P) int32 → (P, P, Np) [src, dst, cell]."""
     m = jnp.moveaxis(m.reshape(N, P, P), 0, 2).astype(I32)
     if Np != N:
         m = jnp.pad(m, ((0, 0), (0, 0), (0, Np - N)), constant_values=0)
@@ -197,6 +255,168 @@ def _mask_to_lanes(m, P, N, Np):
 
 def _from_lanes(a, G, I, P, N):
     return jnp.moveaxis(a[:, :N].reshape(P, G, I), 0, 2)
+
+
+def to_lane_state(state: PaxosState) -> LaneState:
+    """Transpose a PaxosState into lane residency (done_view stays with the
+    caller — it is (G, P, P) host/XLA-side state, not a kernel operand)."""
+    G, I, P = state.np_.shape
+    N = G * I
+    _, Np = _block(N)
+    return LaneState(
+        np_=_to_lanes(state.np_, P, N, Np, 0),
+        na=_to_lanes(state.na, P, N, Np, 0),
+        va=_to_lanes(state.va, P, N, Np, NO_VAL),
+        dec=_to_lanes(state.decided, P, N, Np, NO_VAL),
+        act=_to_lanes(state.active, P, N, Np, 0),
+        propv=_to_lanes(state.propv, P, N, Np, NO_VAL),
+        ms=_to_lanes(state.maxseen, P, N, Np, 0),
+    )
+
+
+def from_lane_state(l: LaneState, done_view: jnp.ndarray,
+                    G: int, I: int) -> PaxosState:
+    P = l.np_.shape[0]
+    N = G * I
+    return PaxosState(
+        np_=_from_lanes(l.np_, G, I, P, N),
+        na=_from_lanes(l.na, G, I, P, N),
+        va=_from_lanes(l.va, G, I, P, N),
+        decided=_from_lanes(l.dec, G, I, P, N),
+        active=_from_lanes(l.act, G, I, P, N) != 0,
+        propv=_from_lanes(l.propv, G, I, P, N),
+        maxseen=_from_lanes(l.ms, G, I, P, N),
+        done_view=done_view,
+    )
+
+
+@jax.jit
+def apply_starts_lane(l: LaneState, reset: jnp.ndarray,
+                      start_active: jnp.ndarray,
+                      start_val: jnp.ndarray) -> LaneState:
+    """`apply_starts` (kernel.py) in lane residency.
+
+    reset: (Np,) bool — recycle these cells (window GC);
+    start_active: (P, Np) 0/1; start_val: (P, Np) i32.
+    """
+    r = reset[None, :]
+    np_ = jnp.where(r, 0, l.np_)
+    na = jnp.where(r, 0, l.na)
+    va = jnp.where(r, NO_VAL, l.va)
+    dec = jnp.where(r, NO_VAL, l.dec)
+    act = jnp.where(r, 0, l.act)
+    propv = jnp.where(r, NO_VAL, l.propv)
+    ms = jnp.where(r, 0, l.ms)
+    sa = start_active != 0
+    act = ((act != 0) | (sa & (dec < 0))).astype(I32)
+    propv = jnp.where(sa & (propv < 0), start_val, propv)
+    return LaneState(np_=np_, na=na, va=va, dec=dec, act=act,
+                     propv=propv, ms=ms)
+
+
+def _lane_round(l: LaneState, packed_mask, interpret: bool):
+    """Invoke the fused round on lane-resident state.  `packed_mask` is the
+    (P, P, Np) int32 bitplane array, or None for the reliable fast path."""
+    P, Np = l.np_.shape
+    C, _ = _block(Np)  # Np is already block-aligned
+    masked = packed_mask is not None
+
+    cell = pl.BlockSpec((P, C), lambda i: (0, i))
+    edge_spec = pl.BlockSpec((P, P, C), lambda i: (0, 0, i))
+    out_shape = jax.ShapeDtypeStruct((P, Np), I32)
+    ops = [l.np_, l.na, l.va, l.dec, l.act, l.propv, l.ms]
+    in_specs = [cell] * 7
+    if masked:
+        ops.append(packed_mask)
+        in_specs.append(edge_spec)
+    outs = pl.pallas_call(
+        functools.partial(_round_kernel, P, masked),
+        grid=(Np // C,),
+        in_specs=in_specs,
+        out_specs=[cell] * 6,
+        out_shape=[out_shape] * 6,
+        interpret=interpret,
+    )(*ops)
+    np_post2, na_new, va_new, dec_new, ms_new, msgs_l = outs
+    act_new = ((l.act != 0) & (dec_new < 0)).astype(I32)
+    l2 = LaneState(np_=np_post2, na=na_new, va=va_new, dec=dec_new,
+                   act=act_new, propv=l.propv, ms=ms_new)
+    return l2, msgs_l
+
+
+def _pack_masks(key, G, I, P, link, drop_req, drop_rep, Np):
+    """Generate the five delivery masks with the XLA path's exact splits
+    (kernel.py:123) and pack them into one (P, P, Np) int32 bitplane array.
+    Returns (packed, M1, heartbeat_key) — the caller reduces M1 against the
+    active cells to derive the Done-piggyback's anymsg1."""
+    N = G * I
+    eye = jnp.eye(P, dtype=bool)
+    shape4 = (G, I, P, P)
+    k1, k2, k3, k1r, k2r, _k3r, khb = jax.random.split(key, 7)
+    L = (link | eye)[:, None, :, :]
+    M1 = _edge_masks(k1, shape4, L, drop_req, eye)
+    M2 = _edge_masks(k2, shape4, L, drop_req, eye)
+    M3 = _edge_masks(k3, shape4, L, drop_req, eye)
+    R1 = _edge_masks(k1r, shape4, L, drop_rep, eye)
+    R2 = _edge_masks(k2r, shape4, L, drop_rep, eye)
+    packed4 = (M1.astype(I32) << _BIT_M1 | M2.astype(I32) << _BIT_M2
+               | M3.astype(I32) << _BIT_M3 | R1.astype(I32) << _BIT_R1
+               | R2.astype(I32) << _BIT_R2)
+    packed = _mask_to_lanes(packed4, P, N, Np)
+    return packed, M1, khb
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("G", "I", "masked", "interpret"))
+def paxos_step_lanes(
+    l: LaneState,
+    done_view: jnp.ndarray,  # (G, P, P) i32
+    link: jnp.ndarray,       # (G, P, P) bool
+    done: jnp.ndarray,       # (G, P) i32
+    key: jnp.ndarray,
+    drop_req: jnp.ndarray,   # (G, P, P) f32
+    drop_rep: jnp.ndarray,   # (G, P, P) f32
+    *,
+    G: int,
+    I: int,
+    masked: bool = True,
+    interpret: bool = False,
+):
+    """One fused round on lane-resident state.
+
+    masked=True: full fault semantics, bit-identical to the XLA path under
+    the same key.  masked=False: reliable fully-connected fast path (link
+    and drops are ignored — caller asserts the network is perfect), zero
+    mask HBM traffic.
+
+    Returns (LaneState, done_view, msgs) — decided values live in the
+    returned state's `.dec`.
+    """
+    P = l.np_.shape[0]
+    N = G * I
+    eye = jnp.eye(P, dtype=bool)
+
+    if masked:
+        packed, M1, khb = _pack_masks(
+            key, G, I, P, link, drop_req, drop_rep, l.np_.shape[1])
+        l2, msgs_l = _lane_round(l, packed, interpret)
+        # Done piggyback (paxos/rpc.go:74-80): rides prepare traffic + the
+        # once-per-step heartbeat (bit-identical to the XLA path at drop=0,
+        # where the heartbeat covers every live edge).
+        act_gip = (_from_lanes(l.act, G, I, P, N) != 0)
+        anymsg1 = (M1 & act_gip[..., :, None]).any(axis=1)  # (G, src, dst)
+        hb = _edge_masks(khb, (G, P, P), (link | eye), drop_req, eye)
+        gotmsg = jnp.swapaxes(anymsg1 | hb, -1, -2)
+        done_view = jnp.maximum(
+            done_view, jnp.where(gotmsg, done[:, None, :], -1))
+    else:
+        l2, msgs_l = _lane_round(l, None, interpret)
+        # Reliable full mesh: every peer hears every peer each step.
+        done_view = jnp.maximum(done_view, done[:, None, :])
+    done_view = jnp.maximum(
+        done_view, jnp.where(eye[None], done[:, None, :], -1))
+    msgs = msgs_l[:, :N].sum().astype(I32)
+    return l2, done_view, msgs
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -209,72 +429,21 @@ def paxos_step_pallas(
     drop_rep: jnp.ndarray,   # (G, P, P) f32
     interpret: bool = False,
 ) -> tuple[PaxosState, StepIO]:
-    """Drop-in replacement for `paxos_step` with the round fused in Pallas."""
+    """Drop-in replacement for `paxos_step` (same (G, I, P) layout and
+    StepIO contract) with the round fused in Pallas.  Pays the lane
+    transposes both ways; loops that step repeatedly should hold a
+    LaneState and call `paxos_step_lanes` instead."""
     G, I, P = state.np_.shape
-    N = G * I
-    eye = jnp.eye(P, dtype=bool)
-    shape4 = (G, I, P, P)
-    # Same splits as paxos_step (kernel.py:123) for bit-exact masks.
-    k1, k2, k3, k1r, k2r, _k3r, khb = jax.random.split(key, 7)
-    L = (link | eye)[:, None, :, :]
-    M1 = _edge_masks(k1, shape4, L, drop_req, eye)
-    M2 = _edge_masks(k2, shape4, L, drop_req, eye)
-    M3 = _edge_masks(k3, shape4, L, drop_req, eye)
-    R1 = _edge_masks(k1r, shape4, L, drop_rep, eye)
-    R2 = _edge_masks(k2r, shape4, L, drop_rep, eye)
-
-    C = min(8 * LANES, max(LANES, ((N + LANES - 1) // LANES) * LANES))
-    Np = ((N + C - 1) // C) * C
-
-    st = [
-        _to_lanes(state.np_, P, N, Np, 0),
-        _to_lanes(state.na, P, N, Np, 0),
-        _to_lanes(state.va, P, N, Np, NO_VAL),
-        _to_lanes(state.decided, P, N, Np, NO_VAL),
-        _to_lanes(state.active, P, N, Np, 0),
-        _to_lanes(state.propv, P, N, Np, NO_VAL),
-        _to_lanes(state.maxseen, P, N, Np, 0),
-    ]
-    masks = [_mask_to_lanes(m, P, N, Np) for m in (M1, M2, M3, R1, R2)]
-
-    cell = pl.BlockSpec((P, C), lambda i: (0, i))
-    edge_spec = pl.BlockSpec((P, P, C), lambda i: (0, 0, i))
-    out_shape = jax.ShapeDtypeStruct((P, Np), I32)
-    outs = pl.pallas_call(
-        functools.partial(_round_kernel, P),
-        grid=(Np // C,),
-        in_specs=[cell] * 7 + [edge_spec] * 5,
-        out_specs=[cell] * 6,
-        out_shape=[out_shape] * 6,
-        interpret=interpret,
-    )(*st, *masks)
-    np_post2, na_new, va_new, decided_l, maxseen_l, msgs_l = outs
-
-    msgs = msgs_l[:, :N].sum().astype(I32)
-    np_post2 = _from_lanes(np_post2, G, I, P, N)
-    na_new = _from_lanes(na_new, G, I, P, N)
-    va_new = _from_lanes(va_new, G, I, P, N)
-    decided_new = _from_lanes(decided_l, G, I, P, N)
-    maxseen = _from_lanes(maxseen_l, G, I, P, N)
-    active_new = state.active & (decided_new < 0)
-
-    # Done piggyback (paxos/rpc.go:74-80): rides prepare traffic + the
-    # once-per-step heartbeat (bit-identical to the XLA path at drop=0, where
-    # the heartbeat covers every live edge).
-    anymsg1 = (M1 & state.active[..., :, None]).any(axis=1)  # (G, src, dst)
-    hb = _edge_masks(khb, (G, P, P), (link | eye), drop_req, eye)
-    gotmsg = jnp.swapaxes(anymsg1 | hb, -1, -2)
-    done_view = jnp.maximum(state.done_view, jnp.where(gotmsg, done[:, None, :], -1))
-    done_view = jnp.maximum(done_view, jnp.where(eye[None], done[:, None, :], -1))
-
-    new_state = PaxosState(
-        np_=np_post2, na=na_new, va=va_new, decided=decided_new,
-        active=active_new, propv=state.propv, maxseen=maxseen,
-        done_view=done_view,
-    )
-    touched = (np_post2 > 0) | (na_new > 0) | (decided_new >= 0) | active_new
-    io = StepIO(decided=decided_new, done_view=done_view, touched=touched,
-                msgs=msgs)
+    l = to_lane_state(state)
+    l2, done_view, msgs = paxos_step_lanes(
+        l, state.done_view, link, done, key, drop_req, drop_rep,
+        G=G, I=I, masked=True, interpret=interpret)
+    new_state = from_lane_state(l2, done_view, G, I)
+    new_state = new_state._replace(propv=state.propv)
+    touched = ((new_state.np_ > 0) | (new_state.na > 0)
+               | (new_state.decided >= 0) | new_state.active)
+    io = StepIO(decided=new_state.decided, done_view=done_view,
+                touched=touched, msgs=msgs)
     return new_state, io
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu6824.core.kernel import PaxosState, paxos_step
@@ -81,3 +82,73 @@ def sharded_step(mesh: Mesh):
 def place_state(state: PaxosState, mesh: Mesh) -> PaxosState:
     sh = state_shardings(mesh)
     return jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)
+
+
+def sharded_step_pallas(mesh: Mesh, interpret: bool | None = None):
+    """The fused Pallas round under the mesh, via shard_map around
+    pallas_call — each device runs the single-HBM-round-trip kernel on its
+    local shard of the cell universe.
+
+    Axis policy (and the recorded justification for `sharded_step`'s XLA
+    default on other mesh shapes, VERDICT r2 #7):
+      - 'g' (groups) shards freely — groups never communicate, so the fused
+        kernel runs unmodified per shard;
+      - 'p' (peers) must be LOCAL: the kernel unrolls the quorum loop
+        in-register; spanning 'p' across devices would need remote DMA
+        inside the fused round, abandoning its one-HBM-round-trip design.
+        On p>1 meshes XLA's collective insertion (sharded_step) is the
+        right tool;
+      - 'i' (instances) must be LOCAL here because the Done-piggyback
+        reduces over the whole window per group (done_view would diverge
+        across i-shards); sharded_step handles i>1 meshes.
+
+    Per-shard PRNG: the key is folded with the shard's 'g' coordinate, so
+    shards draw independent delivery masks (distribution-identical to, but
+    not bit-identical with, the unsharded path).
+    """
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+
+    from tpu6824.core.kernel import StepIO
+    from tpu6824.core.pallas_kernel import paxos_step_pallas
+
+    if mesh.shape["p"] != 1 or mesh.shape["i"] != 1:
+        raise ValueError(
+            "pallas sharded step needs quorum + window axes local "
+            f"(mesh 'p' == 'i' == 1, got {dict(mesh.shape)}); "
+            "use sharded_step (XLA) for such meshes")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    s3 = P("g", None, None)
+    st_spec = PaxosState(np_=s3, na=s3, va=s3, decided=s3, active=s3,
+                         propv=s3, maxseen=s3, done_view=s3)
+    io_spec = StepIO(decided=s3, done_view=s3, touched=s3, msgs=P("g"))
+
+    def local(state, link, done, key, drop_req, drop_rep):
+        key = jax.random.fold_in(key, jax.lax.axis_index("g"))
+        st, io = paxos_step_pallas(state, link, done, key, drop_req,
+                                   drop_rep, interpret=interpret)
+        return st, io._replace(msgs=io.msgs[None])
+
+    kw = dict(
+        mesh=mesh,
+        in_specs=(st_spec, P("g", None, None), P("g", None), P(),
+                  P("g", None, None), P("g", None, None)),
+        out_specs=(st_spec, io_spec),
+    )
+    try:
+        # varying-mesh-axes checking can't see through pallas_call's
+        # ShapeDtypeStructs; disable it (kwarg renamed across jax versions).
+        f = shard_map(local, check_vma=False, **kw)
+    except TypeError:  # pragma: no cover — older jax
+        f = shard_map(local, check_rep=False, **kw)
+
+    @jax.jit
+    def step(state, link, done, key, drop_req, drop_rep):
+        st, io = f(state, link, done, key, drop_req, drop_rep)
+        return st, io._replace(msgs=io.msgs.sum().astype(jnp.int32))
+
+    return step
