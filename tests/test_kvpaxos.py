@@ -13,6 +13,8 @@ from tpu6824.services.kvpaxos import Clerk, KVPaxosServer, make_cluster
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils.timing import wait_until
 
+from tests.invariants import check_appends
+
 
 @pytest.fixture
 def cluster():
@@ -71,17 +73,7 @@ def test_concurrent_appends_linearizable(cluster):
     assert not errs
 
     final = Clerk(servers).get("k")
-    for i in range(nclients):
-        last = -1
-        for j in range(nops):
-            marker = f"x {i} {j} y"
-            pos = final.find(marker)
-            assert pos >= 0, f"missing {marker!r}"
-            assert final.find(marker, pos + 1) < 0, f"duplicated {marker!r}"
-            assert pos > last, f"out of order: {marker!r}"
-            last = pos
-    # nothing else crept in
-    assert len(final) == sum(len(f"x {i} {j} y") for i in range(nclients) for j in range(nops))
+    check_appends(final, nclients, nops, exact_length=True)
 
 
 def test_partition_progress_and_block(cluster):
@@ -141,12 +133,7 @@ def test_unreliable_exactly_once(cluster):
 
     fabric.set_unreliable(False)
     final = Clerk(servers).get("k")
-    for i in range(3):
-        for j in range(5):
-            marker = f"x {i} {j} y"
-            pos = final.find(marker)
-            assert pos >= 0, f"missing {marker!r} in {final!r}"
-            assert final.find(marker, pos + 1) < 0, f"dup {marker!r} in {final!r}"
+    check_appends(final, 3, 5)
 
 
 def test_log_gc_sustained_load():
@@ -232,12 +219,4 @@ def test_many_partitions_unreliable_churn(cluster):
     assert not errs, errs
 
     final = Clerk(servers).get("k", timeout=30.0)
-    for i in range(nclients):
-        last = -1
-        for j in range(nops):
-            marker = f"x {i} {j} y"
-            pos = final.find(marker)
-            assert pos >= 0, f"missing {marker!r}"
-            assert final.find(marker, pos + 1) < 0, f"dup {marker!r}"
-            assert pos > last, f"out of order: {marker!r}"
-            last = pos
+    check_appends(final, nclients, nops)

@@ -14,6 +14,8 @@ from tpu6824.services.viewservice import ViewServer
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils.timing import wait_until
 
+from tests.invariants import check_appends
+
 TICK = 0.02
 
 
@@ -137,15 +139,7 @@ def test_concurrent_appends_exactly_once(sys3):
         sys3.net.set_unreliable(s, False)
 
     final = sys3.clerk().get("k", timeout=10.0)
-    for i in range(nclients):
-        last = -1
-        for j in range(nops):
-            marker = f"x {i} {j} y"
-            pos = final.find(marker)
-            assert pos >= 0, f"missing {marker!r}"
-            assert final.find(marker, pos + 1) < 0, f"dup {marker!r}"
-            assert pos > last
-            last = pos
+    check_appends(final, nclients, nops)
 
 
 def test_stale_primary_cannot_serve(sys3):

@@ -11,6 +11,8 @@ from tpu6824.services.shardkv import ShardSystem
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils.timing import wait_until
 
+from tests.invariants import check_appends
+
 
 @pytest.fixture
 def sys2():
@@ -151,15 +153,7 @@ def test_concurrent_ops_during_reconfig(sys3):
     assert not errs
 
     final = sys3.clerk().get("k", timeout=60.0)
-    for i in range(nclients):
-        last = -1
-        for j in range(nops):
-            marker = f"x {i} {j} y"
-            pos = final.find(marker)
-            assert pos >= 0, f"missing {marker!r}"
-            assert final.find(marker, pos + 1) < 0, f"dup {marker!r}"
-            assert pos > last, f"order {marker!r}"
-            last = pos
+    check_appends(final, nclients, nops)
 
 
 def test_wrong_group_rerouting(sys2):

@@ -1,0 +1,227 @@
+"""Heavy fault suites over the REAL wire: partition churn + unreliable nets
+driven against the gob socket consensus path and the native epoll server.
+
+The in-process analogs (tests/test_kvpaxos.py:183 churn; FlakyNet suites)
+exercise the RSM logic but never the codec/framing.  Here the same
+adversarial scenarios run over actual Unix sockets:
+
+  - consensus messages are gob net/rpc frames between HostPaxosPeer
+    endpoints (`core/hostpeer.py`), partitioned live by the reference's
+    link-farm trick (per-(src,dst) alias paths re-wired while running,
+    `paxos/test_test.go:712-751`, via `rpc.transport.LinkFarm`);
+  - the unreliable accept loop drops 10% of connections and discards 20%
+    of replies after execution (`paxos/paxos.go:528-544`);
+  - the native C++ epoll server (`rpc/native_server.py`) faces the same
+    alias churn on the client leg while fabric-side partitions churn the
+    consensus leg.
+
+Invariant: `checkAppends` — every client's appends appear exactly once, in
+per-client order (`kvpaxos/test_test.go:342-362`), after heal.
+"""
+
+import random
+import threading
+
+import pytest
+
+from tpu6824.core.hostpeer import HostPaxosPeer
+from tpu6824.core.peer import Fate
+from tpu6824.rpc.transport import LinkFarm, connect, link_alias, unlink_alias
+from tpu6824.services import kvpaxos
+from tpu6824.services.kvpaxos import (
+    KVOP_NAME, KVOP_WIRE, HostOpPeer, KVPaxosServer,
+)
+from tpu6824.shim.wire import default_registry
+from tpu6824.utils.timing import wait_until
+
+from tests.invariants import check_appends
+
+
+def make_farm_peers(tmp_path, n=3, seed=101, registry=None, backoff=0.01):
+    """n HostPaxosPeers whose every consensus message crosses the link farm."""
+    reals = [str(tmp_path / f"real-{i}") for i in range(n)]
+    farm = LinkFarm(str(tmp_path), reals)
+    peers = [
+        HostPaxosPeer(farm.view(i), i, bind_addr=reals[i],
+                      registry=registry, seed=seed + i, backoff=backoff)
+        for i in range(n)
+    ]
+    return farm, peers
+
+
+def churner(farm: LinkFarm, stop: threading.Event, seed=1, period=0.1):
+    """Random live re-partitioning, the TestManyPartition shape: total
+    isolation, full heal, or a random majority pair + isolated third."""
+    rng = random.Random(seed)
+
+    def run():
+        while not stop.is_set():
+            pick = rng.random()
+            if pick < 0.2:
+                farm.part([0], [1], [2])
+            elif pick < 0.4:
+                farm.heal()
+            else:
+                two = rng.sample(range(farm.n), 2)
+                rest = [p for p in range(farm.n) if p not in two]
+                farm.part(two, rest)
+            stop.wait(period)
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+
+
+def ndecided(peers, seq):
+    count, value = 0, None
+    for p in peers:
+        fate, v = p.status(seq)
+        if fate == Fate.DECIDED:
+            if count > 0:
+                assert v == value, f"divergent decisions at {seq}"
+            count, value = count + 1, v
+    return count, value
+
+
+def test_hostpaxos_agreement_under_partition_churn(tmp_path):
+    """paxos/test_test.go:712-783 (partition/churn suites) over real gob
+    sockets: proposals issued while the farm is being re-partitioned all
+    decide after heal, with agreement everywhere."""
+    farm, peers = make_farm_peers(tmp_path)
+    stop = threading.Event()
+    t = churner(farm, stop, seed=2)
+    N = 12
+    try:
+        for seq in range(N):
+            peers[seq % 3].start(seq, f"v{seq}")
+            stop.wait(0.05)
+    finally:
+        stop.set()
+        t.join()
+        farm.heal()
+    try:
+        for seq in range(N):
+            assert wait_until(lambda s=seq: ndecided(peers, s)[0] == 3,
+                              timeout=60.0), \
+                f"seq {seq}: {ndecided(peers, seq)} after heal"
+    finally:
+        for p in peers:
+            p.kill()
+
+
+def test_kvpaxos_wire_many_partitions_unreliable_churn(tmp_path):
+    """TestManyPartition (the course test the reference fork gave up on,
+    kvpaxos/many_part_test.go-FAILED) over the gob wire: unreliable accept
+    loops AND continuous random re-partitioning under concurrent append
+    load — then heal and require exactly-once, per-client-ordered appends.
+    The socket twin of tests/test_kvpaxos.py:183 [VERDICT r2 #4b]."""
+    registry = default_registry().register(KVOP_NAME, KVOP_WIRE)
+    farm, peers = make_farm_peers(tmp_path, registry=registry, seed=31)
+    servers = [KVPaxosServer(None, 0, i, px=HostOpPeer(p))
+               for i, p in enumerate(peers)]
+    for p in peers:
+        p.set_unreliable(True)
+    stop = threading.Event()
+    t = churner(farm, stop, seed=3, period=0.15)
+
+    nclients, nops = 3, 4
+    errs: list = []
+
+    def client(idx):
+        try:
+            ck = kvpaxos.Clerk(servers)
+            for j in range(nops):
+                ck.append("k", f"x {idx} {j} y", timeout=120.0)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=client, args=(i,)) for i in range(nclients)]
+    try:
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+    finally:
+        stop.set()
+        t.join()
+        farm.heal()
+        for p in peers:
+            p.set_unreliable(False)
+    try:
+        assert not errs, errs
+        final = kvpaxos.Clerk(servers).get("k", timeout=60.0)
+        check_appends(final, nclients, nops)
+    finally:
+        for s in servers:
+            s.kill()
+
+
+def test_native_server_client_churn_linearizable():
+    """The native epoll server under churn: clerks dial kvpaxos replicas
+    through alias sockets that are cut and re-wired live (plus unreliable
+    accept loops), while fabric-side partitions churn the consensus leg.
+    checkAppends must hold after heal."""
+    from tpu6824.harness import Deployment
+
+    with Deployment("wchurn") as dep:
+        fabric, servers = kvpaxos.make_cluster(nservers=3, ninstances=32)
+        try:
+            for i, s in enumerate(servers):
+                dep.serve(f"kv{i}", s)
+                dep.set_unreliable(f"kv{i}", True)
+            aliases = [f"{dep.dir}/alias-kv{i}" for i in range(3)]
+            for i in range(3):
+                link_alias(dep.addr(f"kv{i}"), aliases[i])
+            proxies = [connect(a, timeout=5.0) for a in aliases]
+
+            stop = threading.Event()
+            rng = random.Random(7)
+
+            def churn():
+                while not stop.is_set():
+                    pick = rng.random()
+                    if pick < 0.3:  # cut a random client edge
+                        unlink_alias(aliases[rng.randrange(3)])
+                    elif pick < 0.6:  # heal all client edges
+                        for i in range(3):
+                            link_alias(dep.addr(f"kv{i}"), aliases[i])
+                    else:  # consensus-leg partition: majority + minority
+                        two = rng.sample(range(3), 2)
+                        rest = [p for p in range(3) if p not in two]
+                        fabric.partition(0, two, rest)
+                    stop.wait(0.1)
+
+            th = threading.Thread(target=churn)
+            th.start()
+            nclients, nops = 3, 4
+            errs: list = []
+
+            def client(idx):
+                try:
+                    ck = kvpaxos.Clerk(proxies)
+                    for j in range(nops):
+                        ck.append("k", f"x {idx} {j} y", timeout=120.0)
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(nclients)]
+            try:
+                for c in ts:
+                    c.start()
+                for c in ts:
+                    c.join()
+            finally:
+                stop.set()
+                th.join()
+                fabric.heal(0)
+                for i in range(3):
+                    dep.set_unreliable(f"kv{i}", False)
+                    link_alias(dep.addr(f"kv{i}"), aliases[i])
+            assert not errs, errs
+            final = kvpaxos.Clerk(proxies).get("k", timeout=60.0)
+            check_appends(final, nclients, nops)
+        finally:
+            for s in servers:
+                s.kill()
+            fabric.stop_clock()

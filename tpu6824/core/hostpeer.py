@@ -76,7 +76,8 @@ class HostPaxosPeer:
                  registry: Registry | None = None,
                  seed: int | None = None, backoff: float = 0.02,
                  persist_dir: str | None = None,
-                 max_proposers: int = 64):
+                 max_proposers: int = 64,
+                 bind_addr: str | None = None):
         """With `persist_dir`, acceptor promises/acceptances, decisions,
         and Done state are written to disk BEFORE any RPC reply leaves —
         Paxos's durability requirement — and reloaded on construction, so
@@ -84,10 +85,15 @@ class HostPaxosPeer:
         does NOT (`paxos/paxos.go:3-11`: "not crash+restart"); Lab 5 was
         meant to add it and the fork left it empty (SURVEY §2.4.7) — this
         implements what that lab asked for, with the diskv file discipline
-        (atomic write-via-rename, `diskv/server.go:92-105`)."""
+        (atomic write-via-rename, `diskv/server.go:92-105`).
+
+        `bind_addr` separates where this peer LISTENS from how its peers[]
+        entry is dialed — required by the link-farm partition harness
+        (`rpc.transport.LinkFarm`), where every peer dials through its own
+        per-edge alias paths while servers bind their real sockets."""
         self.peers = list(peers)
         self.me = me
-        self.addr = peers[me]
+        self.addr = bind_addr or peers[me]
         self.P = len(peers)
         self.mu = threading.Lock()
         self.acc: dict[int, _Acc] = {}

@@ -378,6 +378,66 @@ def unlink_alias(alias: str) -> None:
         pass
 
 
+class LinkFarm:
+    """Per-(src, dst) dialing aliases over a set of real server sockets —
+    the reference's partition link farm (`pp(tag, i, j)` alias paths wired
+    by `part()`, `paxos/test_test.go:712-751`): peer src dials dst through
+    its own alias edge, so partitions are per-edge, asymmetric if desired,
+    and re-wireable while the cluster runs.
+
+    Servers bind their real paths; each peer dials through `view(src)`.
+    Self edges are wired like any other, though in-process peers usually
+    bypass them (self-calls are function calls in the reference too).
+
+    Edges are SYMLINKS, not the reference's hard links: a symlink resolves
+    the real path at dial time, so `Server.deafen()` (unlink the real path)
+    still deafens farm traffic, and a peer that crash+restarts on the same
+    path (the persist_dir flow) is reachable through existing edges without
+    re-wiring.  Hard links pin the old inode and get both of those wrong."""
+
+    def __init__(self, sockdir: str, real_addrs: list[str],
+                 connected: bool = True):
+        os.makedirs(sockdir, exist_ok=True)
+        self.dir = sockdir
+        self.real = list(real_addrs)
+        self.n = len(real_addrs)
+        if connected:
+            self.heal()
+
+    def alias(self, src: int, dst: int) -> str:
+        return os.path.join(self.dir, f"edge-{src}-{dst}")
+
+    def view(self, src: int) -> list[str]:
+        """The peers[] list peer `src` should dial through."""
+        return [self.alias(src, d) for d in range(self.n)]
+
+    def connect(self, src: int, dst: int) -> None:
+        alias = self.alias(src, dst)
+        unlink_alias(alias)
+        os.symlink(self.real[dst], alias)
+
+    def disconnect(self, src: int, dst: int) -> None:
+        unlink_alias(self.alias(src, dst))
+
+    def part(self, *groups) -> None:
+        """Re-wire the whole farm: edges within each group live, every
+        other edge cut (the reference's `part()` exactly)."""
+        want = set()
+        for grp in [list(g) for g in groups]:
+            for a in grp:
+                for b in grp:
+                    want.add((a, b))
+        for s in range(self.n):
+            for d in range(self.n):
+                if (s, d) in want:
+                    self.connect(s, d)
+                else:
+                    self.disconnect(s, d)
+
+    def heal(self) -> None:
+        self.part(range(self.n))
+
+
 class Proxy:
     """Make a remote server usable where clerks expect a server object:
     `proxy.method(*args)` → `call(addr, "method", *args)`.  RPCError
