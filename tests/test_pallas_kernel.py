@@ -229,6 +229,50 @@ def test_lane_resident_multistep_equals_wrapper():
     _assert_states_equal(sw, got)
 
 
+def test_lossy_done_view_liveness_distribution():
+    """Under loss the two kernels are bit-identical in consensus state (same
+    mask draws), but the Done piggyback rides different traffic: all three
+    phases + heartbeat in XLA (kernel.py:201-206) vs prepare + heartbeat in
+    Pallas (pallas_kernel.py).  Compare the PROPAGATION LIVENESS
+    distributions: the step at which each (g, p, q) learns q's done value
+    must fully converge on both paths, with closely matching means.
+    [VERDICT r2 weak #4]"""
+    G, I, P = 8, 4, 3
+    link = jnp.ones((G, P, P), bool)
+    done = jnp.asarray(
+        np.arange(G * P).reshape(G, P).astype(np.int32) + 1)
+    drop_req = jnp.full((G, P, P), 0.10, jnp.float32)
+    drop_rep = jnp.full((G, P, P), 0.20, jnp.float32)
+    MAX = 40
+
+    def first_learn_steps(step_fn, seed):
+        state = _armed_state(G, I, P, "all")
+        first = np.full((G, P, P), -1, np.int64)
+        key = jax.random.key(seed)
+        for s in range(MAX):
+            key, sub = jax.random.split(key)
+            state, io = step_fn(state, link, done, sub, drop_req, drop_rep)
+            learned = np.asarray(io.done_view) >= np.asarray(done)[:, None, :]
+            first = np.where((first < 0) & learned, s + 1, first)
+            if (first > 0).all():
+                break
+        return first
+
+    means_x, means_p = [], []
+    for seed in (0, 1, 2):
+        fx = first_learn_steps(paxos_step, seed)
+        fp = first_learn_steps(
+            lambda *a: paxos_step_pallas(*a, interpret=True), seed)
+        assert (fx > 0).all(), "XLA done_view never fully propagated"
+        assert (fp > 0).all(), "Pallas done_view never fully propagated"
+        means_x.append(fx.mean())
+        means_p.append(fp.mean())
+    mx, mp = float(np.mean(means_x)), float(np.mean(means_p))
+    # Same information flow; the pallas piggyback may lag slightly (fewer
+    # carrying edges per step) but must stay in the same regime.
+    assert abs(mx - mp) < 1.5, (mx, mp)
+
+
 def test_get_step_dispatch(monkeypatch):
     from tpu6824.core.kernel import paxos_step as xla_step
 
