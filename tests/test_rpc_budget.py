@@ -1,0 +1,112 @@
+"""Message/RPC budget envelopes — the reference's de-facto perf suite
+(`paxos/test_test.go:503-573`): a serial agreement costs at most 9 RPCs on
+3 peers (3 prepare + 3 accept + 3 decide), and an agreement contested by 3
+concurrent proposers at most 45.
+
+Both consensus paths are held to those envelopes:
+  - the decentralized wire path counts real accepted connections
+    (`HostPaxosPeer.rpc_count`, the reference's rpccount);
+  - the batched kernel counts remote messages per step (`StepIO.msgs`),
+    which at drop=0 is DETERMINISTIC: exact expected costs are asserted,
+    not just bounds.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu6824.core.hostpeer import make_host_cluster
+from tpu6824.core.kernel import apply_starts, init_state, paxos_step
+from tpu6824.core.peer import Fate
+from tpu6824.utils.timing import wait_until
+
+
+# ----------------------------------------------------------------- wire path
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    peers = make_host_cluster(str(tmp_path), npeers=3, seed=77)
+    yield peers
+    for p in peers:
+        p.kill()
+
+
+def _total_rpcs(peers):
+    return sum(p.rpc_count for p in peers)
+
+
+def test_wire_concurrent_proposers_within_45(cluster):
+    """paxos/test_test.go:545-573: 3 dueling proposers per instance, budget
+    45 RPCs per agreement (averaged over instances, as the reference
+    measures a batch)."""
+    N = 5
+    before = _total_rpcs(cluster)
+    for seq in range(N):
+        for i, p in enumerate(cluster):
+            p.start(seq, f"v{i}-{seq}")
+    for seq in range(N):
+        assert wait_until(
+            lambda s=seq: all(p.status(s)[0] == Fate.DECIDED
+                              for p in cluster), timeout=30.0), seq
+    spent = _total_rpcs(cluster) - before
+    assert spent <= 45 * N, f"{spent} RPCs for {N} contested agreements"
+
+
+# ------------------------------------------------------------------- kernel
+
+
+def _args(G, P):
+    return (jnp.ones((G, P, P), bool), jnp.full((G, P), -1, jnp.int32),
+            jnp.zeros((G, P, P), jnp.float32))
+
+
+def _armed(G, I, P, nprop):
+    sa = np.zeros((G, I, P), bool)
+    sa[:, :, :nprop] = True
+    sv = np.where(sa, np.arange(G * I * P).reshape(G, I, P) + 1, -1)
+    return apply_starts(init_state(G, I, P), jnp.zeros((G, I), bool),
+                        jnp.asarray(sa), jnp.asarray(sv.astype(np.int32)))
+
+
+def test_kernel_serial_cost_is_6_messages_per_instance():
+    """One proposer, reliable 3-peer net: exactly 2 remote prepares +
+    2 remote accepts + 2 remote decides per instance — under the
+    reference's 9-RPC serial budget (self-calls are free there too)."""
+    G, I, P = 4, 8, 3
+    link, done, dr = _args(G, P)
+    state = _armed(G, I, P, nprop=1)
+    state, io = paxos_step(state, link, done, jax.random.key(0), dr, dr)
+    assert (np.asarray(state.decided) >= 0).all()
+    assert int(io.msgs) == G * I * 6
+
+
+def test_kernel_contended_cost_is_14_messages_per_instance():
+    """Three dueling proposers, reliable net: all three fan out prepares
+    (6 remote) and — every prepare quorum succeeds at drop=0 — accepts
+    (6 remote); exactly one accept wins per acceptor, so one decider
+    broadcasts (2 remote).  14 per instance, far inside the reference's
+    45-RPC contended budget; and the duel still settles in ONE step."""
+    G, I, P = 4, 8, 3
+    link, done, dr = _args(G, P)
+    state = _armed(G, I, P, nprop=3)
+    state, io = paxos_step(state, link, done, jax.random.key(0), dr, dr)
+    assert (np.asarray(state.decided) >= 0).all()
+    assert int(io.msgs) == G * I * 14
+
+
+def test_kernel_settled_universe_goes_quiet():
+    """After everything is decided and learned, further steps cost zero
+    messages (gossip stops once every peer knows — the analog of the
+    reference's proposers exiting)."""
+    G, I, P = 2, 4, 3
+    link, done, dr = _args(G, P)
+    state = _armed(G, I, P, nprop=1)
+    key = jax.random.key(1)
+    key, sub = jax.random.split(key)
+    state, _ = paxos_step(state, link, done, sub, dr, dr)
+    key, sub = jax.random.split(key)
+    state, io2 = paxos_step(state, link, done, sub, dr, dr)
+    assert int(io2.msgs) == 0, int(io2.msgs)
