@@ -1,0 +1,202 @@
+// Go-side conformance for the Python gob codec (tpu6824/shim/gob.py).
+//
+// Three layers of evidence, strongest first:
+//
+//  1. TestGoDecodesPythonGoldens — Go's own encoding/gob decodes every
+//     byte golden in ../../tests/gob_goldens.json (produced by the
+//     spec-derived Python encoder) into the reference struct shapes.
+//     This is the interop claim that matters: a Go peer understands
+//     every byte the framework puts on the wire.
+//  2. TestGoReencodesByteIdentical — after decoding, re-encoding with
+//     Go yields the exact golden bytes, proving the Python encoder
+//     makes the same choices (varints, zero-field omission, field
+//     deltas, type-definition layout) as Go's, not merely compatible
+//     ones.  Reported per-label; failures here with layer 1 green mean
+//     benign encoder-choice divergence (e.g. type-id assignment order),
+//     which decoders on both sides tolerate.
+//  3. TestLiveKVPaxosEndpoint — dials a running Python gob endpoint
+//     (interop/go/serve_endpoints.py) with Go's net/rpc exactly the way
+//     the reference clerks do, and round-trips Put/Append/Get.
+//     Set TPU6824_KV_SOCK to the endpoint's socket path; skipped when
+//     unset.
+//
+// The build image for this framework has no Go toolchain (why these
+// tests exist as shipped-but-not-yet-run evidence); run them anywhere
+// with Go >= 1.21:
+//
+//	cd interop/go && go test -v ./...
+package interop
+
+import (
+	"bytes"
+	"encoding/gob"
+	"encoding/hex"
+	"encoding/json"
+	"net/rpc"
+	"os"
+	"reflect"
+	"testing"
+)
+
+const goldensPath = "../../tests/gob_goldens.json"
+
+// corpus maps every golden label to the Go struct it must decode into.
+var corpus = map[string]func() interface{}{
+	"paxos.PrepareArgs":         func() interface{} { return new(PrepareArgs) },
+	"paxos.PrepareReply.op":     func() interface{} { return new(PrepareReply) },
+	"paxos.PrepareReply.nil":    func() interface{} { return new(PrepareReply) },
+	"paxos.AcceptArgs":          func() interface{} { return new(AcceptArgs) },
+	"paxos.AcceptReply":         func() interface{} { return new(AcceptReply) },
+	"paxos.DecidedArgs.op":      func() interface{} { return new(DecidedArgs) },
+	"paxos.DecidedArgs.int":     func() interface{} { return new(DecidedArgs) },
+	"paxos.DecidedReply":        func() interface{} { return new(DecidedReply) },
+	"kvpaxos.PutAppendArgs":     func() interface{} { return new(KvPutAppendArgs) },
+	"kvpaxos.PutAppendReply":    func() interface{} { return new(KvPutAppendReply) },
+	"kvpaxos.GetArgs":           func() interface{} { return new(KvGetArgs) },
+	"kvpaxos.GetReply":          func() interface{} { return new(KvGetReply) },
+	"kvpaxos.Op":                func() interface{} { return new(Op) },
+	"viewservice.View":          func() interface{} { return new(View) },
+	"viewservice.PingArgs":      func() interface{} { return new(PingArgs) },
+	"viewservice.PingReply":     func() interface{} { return new(PingReply) },
+	"viewservice.GetArgs":       func() interface{} { return new(VsGetArgs) },
+	"viewservice.GetReply":      func() interface{} { return new(VsGetReply) },
+	"pbservice.PutAppendArgs":   func() interface{} { return new(PbPutAppendArgs) },
+	"pbservice.PutAppendReply":  func() interface{} { return new(PbPutAppendReply) },
+	"pbservice.GetArgs":         func() interface{} { return new(PbGetArgs) },
+	"pbservice.GetReply":        func() interface{} { return new(PbGetReply) },
+	"pbservice.InitStateArgs":   func() interface{} { return new(PbInitStateArgs) },
+	"pbservice.InitStateReply":  func() interface{} { return new(PbInitStateReply) },
+	"lockservice.LockArgs":      func() interface{} { return new(LockArgs) },
+	"lockservice.LockReply":     func() interface{} { return new(LockReply) },
+	"lockservice.UnlockArgs":    func() interface{} { return new(UnlockArgs) },
+	"lockservice.UnlockReply":   func() interface{} { return new(UnlockReply) },
+	"shardmaster.Config":        func() interface{} { return new(Config) },
+	"shardmaster.JoinArgs":      func() interface{} { return new(SmJoinArgs) },
+	"shardmaster.JoinReply":     func() interface{} { return new(SmJoinReply) },
+	"shardmaster.LeaveArgs":     func() interface{} { return new(SmLeaveArgs) },
+	"shardmaster.LeaveReply":    func() interface{} { return new(SmLeaveReply) },
+	"shardmaster.MoveArgs":      func() interface{} { return new(SmMoveArgs) },
+	"shardmaster.MoveReply":     func() interface{} { return new(SmMoveReply) },
+	"shardmaster.QueryArgs":     func() interface{} { return new(SmQueryArgs) },
+	"shardmaster.QueryReply":    func() interface{} { return new(SmQueryReply) },
+	"shardkv.GetArgs":           func() interface{} { return new(SkvGetArgs) },
+	"shardkv.GetReply":          func() interface{} { return new(SkvGetReply) },
+	"shardkv.PutAppendArgs":     func() interface{} { return new(SkvPutAppendArgs) },
+	"shardkv.PutAppendReply":    func() interface{} { return new(SkvPutAppendReply) },
+	"shardkv.Rep":               func() interface{} { return new(Rep) },
+	"shardkv.XState":            func() interface{} { return new(XState) },
+	"shardkv.TransferStateArgs": func() interface{} { return new(SkvTransferArgs) },
+	"shardkv.TransferStateReply": func() interface{} {
+		return new(SkvTransferReply)
+	},
+	"netrpc.Request":        func() interface{} { return new(Request) },
+	"netrpc.Response":       func() interface{} { return new(Response) },
+	"netrpc.InvalidRequest": func() interface{} { return new(InvalidRequest) },
+}
+
+func loadGoldens(t *testing.T) map[string]string {
+	t.Helper()
+	raw, err := os.ReadFile(goldensPath)
+	if err != nil {
+		t.Fatalf("reading %s: %v", goldensPath, err)
+	}
+	var m map[string]string
+	if err := json.Unmarshal(raw, &m); err != nil {
+		t.Fatalf("parsing goldens: %v", err)
+	}
+	return m
+}
+
+func registerConcrete() {
+	// The analog of the reference's gob.Register(Op{}) calls; "string" and
+	// "int" are predefined by encoding/gob itself.
+	gob.RegisterName("kvpaxos.Op", Op{})
+}
+
+func TestGoDecodesPythonGoldens(t *testing.T) {
+	registerConcrete()
+	goldens := loadGoldens(t)
+	if len(goldens) == 0 {
+		t.Fatal("empty goldens file")
+	}
+	for label, hexBytes := range goldens {
+		mk, ok := corpus[label]
+		if !ok {
+			t.Errorf("%s: golden has no Go struct mapping", label)
+			continue
+		}
+		data, err := hex.DecodeString(hexBytes)
+		if err != nil {
+			t.Fatalf("%s: bad hex: %v", label, err)
+		}
+		ptr := mk()
+		if err := gob.NewDecoder(bytes.NewReader(data)).Decode(ptr); err != nil {
+			t.Errorf("%s: Go gob rejected python-encoded bytes: %v",
+				label, err)
+		}
+	}
+	for label := range corpus {
+		if _, ok := goldens[label]; !ok {
+			t.Errorf("%s: mapped in Go but missing from goldens", label)
+		}
+	}
+}
+
+func TestGoReencodesByteIdentical(t *testing.T) {
+	registerConcrete()
+	for label, hexBytes := range loadGoldens(t) {
+		mk, ok := corpus[label]
+		if !ok {
+			continue // reported by TestGoDecodesPythonGoldens
+		}
+		data, _ := hex.DecodeString(hexBytes)
+		ptr := mk()
+		if err := gob.NewDecoder(bytes.NewReader(data)).Decode(ptr); err != nil {
+			continue // ditto
+		}
+		var buf bytes.Buffer
+		v := reflect.ValueOf(ptr).Elem().Interface()
+		if err := gob.NewEncoder(&buf).Encode(v); err != nil {
+			t.Errorf("%s: re-encode failed: %v", label, err)
+			continue
+		}
+		if got := hex.EncodeToString(buf.Bytes()); got != hexBytes {
+			t.Errorf("%s: Go re-encode differs from python encoding\n"+
+				" python: %s\n     go: %s", label, hexBytes, got)
+		}
+	}
+}
+
+func TestLiveKVPaxosEndpoint(t *testing.T) {
+	sock := os.Getenv("TPU6824_KV_SOCK")
+	if sock == "" {
+		t.Skip("TPU6824_KV_SOCK unset; start interop/go/serve_endpoints.py " +
+			"and export the socket path to run the live interop test")
+	}
+	c, err := rpc.Dial("unix", sock)
+	if err != nil {
+		t.Fatalf("dial %s: %v", sock, err)
+	}
+	defer c.Close()
+
+	put := KvPutAppendArgs{Key: "go-k", Value: "v1", Op: "Put", OpID: 71}
+	var preply KvPutAppendReply
+	if err := c.Call("KVPaxos.PutAppend", &put, &preply); err != nil {
+		t.Fatalf("PutAppend: %v", err)
+	}
+	if preply.Err != "OK" {
+		t.Fatalf("PutAppend Err=%q", preply.Err)
+	}
+	app := KvPutAppendArgs{Key: "go-k", Value: "+v2", Op: "Append", OpID: 72}
+	if err := c.Call("KVPaxos.PutAppend", &app, &preply); err != nil {
+		t.Fatalf("Append: %v", err)
+	}
+	var greply KvGetReply
+	if err := c.Call("KVPaxos.Get", &KvGetArgs{Key: "go-k", OpID: 73},
+		&greply); err != nil {
+		t.Fatalf("Get: %v", err)
+	}
+	if greply.Err != "OK" || greply.Value != "v1+v2" {
+		t.Fatalf("Get = (%q, %q), want (OK, v1+v2)", greply.Err, greply.Value)
+	}
+}

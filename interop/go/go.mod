@@ -1,0 +1,3 @@
+module tpu6824/interop
+
+go 1.21

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Boot a 3-replica kvpaxos cluster and expose it at a gob net/rpc socket
+for the Go live-interop test (conformance_test.go::TestLiveKVPaxosEndpoint).
+
+    python interop/go/serve_endpoints.py /var/tmp/kvsock &
+    cd interop/go && TPU6824_KV_SOCK=/var/tmp/kvsock go test -run Live -v
+
+Serves until killed.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    sock = sys.argv[1] if len(sys.argv) > 1 else "/var/tmp/tpu6824-kv"
+    from tpu6824.services import kvpaxos
+    from tpu6824.shim.endpoints import serve_kvpaxos
+
+    fabric, servers = kvpaxos.make_cluster(nservers=3, ninstances=64)
+    srv = serve_kvpaxos(servers[0], sock)
+    print(f"kvpaxos gob endpoint at {sock}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.kill()
+        for s in servers:
+            s.kill()
+        fabric.stop_clock()
+
+
+if __name__ == "__main__":
+    main()

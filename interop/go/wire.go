@@ -1,0 +1,218 @@
+// Package interop holds the Go-side halves of the wire-compatibility
+// harness: the reference's RPC argument/reply struct shapes, declared
+// field-for-field (names, order, and Go types are the protocol — see
+// tpu6824/shim/wire.py for the Python halves and the file:line citations
+// into the reference sources).
+//
+// These are freshly written declarations of the public wire contract
+// (paxos/rpc.go:52-84, kvpaxos/common.go:17-42, viewservice/common.go:36-80,
+// pbservice/common.go:21-47, shardmaster/common.go:35-69,
+// shardkv/common.go:21-56 + server.go:60-80, lockservice/common.go:14-33),
+// not copies of reference code; field types use plain int where the
+// reference uses sized ints, because encoding/gob transmits all signed
+// integers identically.
+package interop
+
+// ---- paxos (rpc.go:52-84)
+
+type PrepareArgs struct {
+	Instance int
+	Proposal int
+}
+
+type PrepareReply struct {
+	Err      string
+	Instance int
+	Proposal int
+	Value    interface{}
+}
+
+type AcceptArgs struct {
+	Instance int
+	Proposal int
+	Value    interface{}
+}
+
+type AcceptReply struct{ Err string }
+
+type DecidedArgs struct {
+	Sender   int
+	DoneIns  int
+	Instance int
+	Value    interface{}
+}
+
+type DecidedReply struct{}
+
+// ---- kvpaxos (common.go:17-42, server.go:25-33)
+
+type KvPutAppendArgs struct {
+	Key   string
+	Value string
+	Op    string
+	OpID  int
+}
+
+type KvPutAppendReply struct{ Err string }
+
+type KvGetArgs struct {
+	Key  string
+	OpID int
+}
+
+type KvGetReply struct {
+	Err   string
+	Value string
+}
+
+// Op is kvpaxos's log entry, gob-registered so it can ride interface{}
+// fields of the Paxos wire (RegisterName("kvpaxos.Op", Op{}) in the tests).
+type Op struct {
+	OpID  int
+	Op    string
+	Key   string
+	Value string
+}
+
+// ---- viewservice (common.go:36-80)
+
+type View struct {
+	Viewnum uint
+	Primary string
+	Backup  string
+}
+
+type PingArgs struct {
+	Me      string
+	Viewnum uint
+}
+
+type PingReply struct{ View View }
+
+type VsGetArgs struct{}
+
+type VsGetReply struct{ View View }
+
+// ---- pbservice (common.go:21-47)
+
+type PbPutAppendArgs struct {
+	Key    string
+	Value  string
+	OpID   int
+	Method string
+}
+
+type PbPutAppendReply struct{ Err string }
+
+type PbGetArgs struct {
+	Key  string
+	OpID int
+}
+
+type PbGetReply struct {
+	Err   string
+	Value string
+}
+
+type PbInitStateArgs struct{ State map[string]string }
+
+type PbInitStateReply struct{ Err string }
+
+// ---- lockservice (common.go:14-33)
+
+type LockArgs struct{ Lockname string }
+
+type LockReply struct{ OK bool }
+
+type UnlockArgs struct{ Lockname string }
+
+type UnlockReply struct{ OK bool }
+
+// ---- shardmaster (common.go:35-69)
+
+type Config struct {
+	Num    int
+	Shards [10]int64
+	Groups map[int64][]string
+}
+
+type SmJoinArgs struct {
+	GID     int64
+	Servers []string
+}
+
+type SmJoinReply struct{}
+
+type SmLeaveArgs struct{ GID int64 }
+
+type SmLeaveReply struct{}
+
+type SmMoveArgs struct {
+	Shard int
+	GID   int64
+}
+
+type SmMoveReply struct{}
+
+type SmQueryArgs struct{ Num int }
+
+type SmQueryReply struct{ Config Config }
+
+// ---- shardkv (common.go:21-56, server.go:60-80)
+
+type SkvGetArgs struct {
+	Key string
+	CID string
+	Seq int
+}
+
+type SkvGetReply struct {
+	Err   string
+	Value string
+}
+
+type SkvPutAppendArgs struct {
+	Key   string
+	Value string
+	Op    string
+	CID   string
+	Seq   int
+}
+
+type SkvPutAppendReply struct{ Err string }
+
+type Rep struct {
+	Err   string
+	Value string
+}
+
+type XState struct {
+	KVStore map[string]string
+	MRRSMap map[string]int
+	Replies map[string]Rep
+}
+
+type SkvTransferArgs struct {
+	ConfigNum int
+	Shard     int
+}
+
+type SkvTransferReply struct {
+	Err    string
+	XState XState
+}
+
+// ---- net/rpc headers (rpc/server.go)
+
+type Request struct {
+	ServiceMethod string
+	Seq           uint64
+}
+
+type Response struct {
+	ServiceMethod string
+	Seq           uint64
+	Error         string
+}
+
+type InvalidRequest struct{}
