@@ -209,8 +209,13 @@ def child_main():
 
 
 def _xla_engine(jax, jnp, np, G, I, P, link, done):
-    """Bench engine over the (G, I, P) layout + XLA kernel."""
-    from tpu6824.core.kernel import apply_starts, init_state, paxos_step
+    """Bench engine over the (G, I, P) layout + XLA kernel.  Reliable
+    configs run paxos_step_reliable (no Bernoulli mask draws at all)."""
+    import functools
+
+    from tpu6824.core.kernel import (
+        apply_starts, init_state, paxos_step, paxos_step_reliable,
+    )
 
     def arm(nprop):
         # peer p proposes value base+p — distinct per proposer, so
@@ -221,14 +226,17 @@ def _xla_engine(jax, jnp, np, G, I, P, link, done):
         sv = np.where(sa, base + np.arange(P, dtype=np.int32), -1)
         return jnp.asarray(sa), jnp.asarray(sv)
 
-    # One compiled scan serves every config: arming pattern and drop rates
+    # One compiled scan per (masked) variant: arming pattern and drop rates
     # are runtime operands, not trace-time constants.
-    @jax.jit
-    def run_j(state, sa, sv, dreq, drep, keys):
+    @functools.partial(jax.jit, static_argnames=("masked",))
+    def run_j(state, sa, sv, dreq, drep, keys, masked):
         def cycle(state, key):
             recycled = (state.decided >= 0).any(-1)          # (G, I)
             state = apply_starts(state, recycled, sa, sv)
-            state, _io = paxos_step(state, link, done, key, dreq, drep)
+            if masked:
+                state, _io = paxos_step(state, link, done, key, dreq, drep)
+            else:
+                state, _io = paxos_step_reliable(state, link, done)
             return state, recycled.sum(dtype=jnp.int32)
         return jax.lax.scan(cycle, state, keys)
 
@@ -255,8 +263,7 @@ def _xla_engine(jax, jnp, np, G, I, P, link, done):
     return {
         "init": lambda: init_state(G, I, P),
         "arm": arm,
-        "run": lambda c, sa, sv, dq, dp, keys, masked: run_j(
-            c, sa, sv, dq, dp, keys),
+        "run": run_j,
         "dist": dist,
     }
 

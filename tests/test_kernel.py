@@ -257,3 +257,36 @@ def test_batched_groups_independent():
     state, _ = run_steps(state, 1, jax.random.key(16), **mk_args(G, P))
     dec = np.asarray(state.decided[:, 0, :])
     assert (dec == np.arange(G)[:, None]).all()
+
+
+def test_reliable_step_bitwise_equals_drop0():
+    """paxos_step_reliable must realize exactly paxos_step at zero drop —
+    including under partitions — with no mask draws at all."""
+    from tpu6824.core.kernel import paxos_step_reliable
+
+    G, I, P = 2, 8, 3
+    link = np.ones((G, P, P), bool)
+    link[1] = False          # group 1: isolate peer 2
+    for a in (0, 1):
+        for b in (0, 1):
+            link[1, a, b] = True
+    link = jnp.asarray(link)
+    done = jnp.asarray(np.arange(G * P).reshape(G, P).astype(np.int32))
+    dr = jnp.zeros((G, P, P), jnp.float32)
+
+    state = init_state(G, I, P)
+    sa = np.ones((G, I, P), bool)
+    sv = (np.arange(G * I * P).reshape(G, I, P) + 1).astype(np.int32)
+    state = apply_starts(state, jnp.zeros((G, I), bool), jnp.asarray(sa),
+                         jnp.asarray(sv))
+    sx = jax.tree.map(jnp.copy, state)
+    sr = jax.tree.map(jnp.copy, state)
+    key = jax.random.key(13)
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        sx, iox = paxos_step(sx, link, done, sub, dr, dr)
+        sr, ior = paxos_step_reliable(sr, link, done)
+        for name, a, b in zip(sx._fields, sx, sr):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=f"field {name}")
+        assert int(iox.msgs) == int(ior.msgs)
