@@ -59,9 +59,14 @@ class PaxosFabric:
         unreliable_req_drop: float = UNRELIABLE_REQ_DROP,
         unreliable_rep_drop: float = UNRELIABLE_REP_DROP,
     ):
-        from tpu6824.core.pallas_kernel import get_step
+        from tpu6824.core.pallas_kernel import get_step, resolve_impl
 
         self._step_fn = get_step(kernel)
+        # On the XLA path, steps with no unreliable server skip Bernoulli
+        # mask generation entirely (paxos_step_reliable — bit-identical at
+        # drop=0, works under partitioned links).  The Pallas path keeps its
+        # own mask handling (packed bitplanes / maskless lane fast path).
+        self._reliable_ok = resolve_impl(kernel) == "xla"
         self._req_drop = unreliable_req_drop
         self._rep_drop = unreliable_rep_drop
         self.G, self.I, self.P = ngroups, ninstances, npeers
@@ -172,7 +177,13 @@ class PaxosFabric:
                 state, jnp.asarray(reset), jnp.asarray(sa), jnp.asarray(sv)
             )
 
-        state, io = self._step_fn(state, link, done, sub, drop_req, drop_rep)
+        if self._reliable_ok and not unrel.any():
+            from tpu6824.core.kernel import paxos_step_reliable
+
+            state, io = paxos_step_reliable(state, link, done)
+        else:
+            state, io = self._step_fn(state, link, done, sub, drop_req,
+                                      drop_rep)
         self._state = state
         decided, done_view, touched, msgs = jax.device_get(
             (io.decided, io.done_view, io.touched, io.msgs)
