@@ -220,3 +220,66 @@ def test_many_partitions_unreliable_churn(cluster):
 
     final = Clerk(servers).get("k", timeout=30.0)
     check_appends(final, nclients, nops)
+
+
+def test_holes_in_sequence():
+    """TestHole (kvpaxos/test_test.go:519-608): clients write continuously
+    through servers 0/1 while a partition cuts {0, 1} away mid-agreement;
+    the {2, 3, 4} majority must keep deciding (tolerating the holes the
+    interrupted minority left in the sequence), and after heal the minority
+    fills its holes — every client's reads stay consistent throughout."""
+    import random
+    import time as _time
+
+    fabric, servers = make_cluster(nservers=5, ninstances=64)
+    try:
+        for _iter in range(2):
+            fabric.heal(0)
+            ck2 = Clerk([servers[2]])
+            ck2.put("q", "q", timeout=30.0)
+
+            stop = threading.Event()
+            errs: list = []
+
+            def client(cli):
+                try:
+                    cka = [Clerk([s]) for s in servers]
+                    key = f"hole{cli}"
+                    last = ""
+                    cka[0].put(key, last, timeout=60.0)
+                    rng = random.Random(100 + cli)
+                    while not stop.is_set():
+                        ci = rng.randrange(2)  # only the to-be-cut servers
+                        if rng.random() < 0.5:
+                            nv = str(rng.randrange(1 << 30))
+                            cka[ci].put(key, nv, timeout=60.0)
+                            last = nv
+                        else:
+                            v = cka[ci].get(key, timeout=60.0)
+                            assert v == last, (cli, key, v, last)
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            ths = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+            for t in ths:
+                t.start()
+            _time.sleep(0.4)
+
+            fabric.partition(0, [2, 3, 4], [0, 1])
+            # Majority progresses even though the minority was interrupted
+            # mid-agreement (the "holes").
+            assert ck2.get("q", timeout=30.0) == "q"
+            ck2.put("q", "qq", timeout=30.0)
+            assert ck2.get("q", timeout=30.0) == "qq"
+
+            fabric.heal(0)
+            stop.set()
+            for t in ths:
+                t.join()
+            assert not errs, errs
+            assert ck2.get("q", timeout=30.0) == "qq"
+    finally:
+        for s in servers:
+            s.dead = True
+        fabric.stop_clock()

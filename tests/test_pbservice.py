@@ -180,3 +180,56 @@ def test_viewservice_rpc_budget(sys3):
     used = sys3.vs.get_rpccount() - base
     budget = 2 * (dt / TICK) + 40
     assert used <= budget, (used, budget)
+
+
+def test_repeated_crash_restart_under_load(sys3):
+    """TestRepeatedCrash (pbservice/test_test.go:671-790): a churn thread
+    kills and restarts random servers (waiting out view formation each
+    time) while clients keep writing and re-reading their own keys; every
+    read must return the client's last write, and the stack must still
+    serve after the churn stops."""
+    import random
+
+    stop = threading.Event()
+    errs: list = []
+
+    def churn():
+        rng = random.Random(5)
+        names = list(sys3.servers)
+        while not stop.is_set():
+            name = names[rng.randrange(len(names))]
+            sys3.restart(name)
+            # let a view form and the backup initialize (2·DeadPings·tick)
+            stop.wait(10 * TICK)
+
+    def client(i):
+        try:
+            ck = sys3.clerk()
+            data = {}
+            rng = random.Random(50 + i)
+            while not stop.is_set():
+                k = f"c{i}-{rng.randrange(10)}"
+                if k in data:
+                    v = ck.get(k, timeout=30.0)
+                    assert v == data[k], (k, v, data[k])
+                nv = str(rng.randrange(1 << 30))
+                ck.put(k, nv, timeout=30.0)
+                data[k] = nv
+                time.sleep(0.01)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    churner = threading.Thread(target=churn)
+    clients = [threading.Thread(target=client, args=(i,)) for i in range(2)]
+    churner.start()
+    for t in clients:
+        t.start()
+    time.sleep(4.0)
+    stop.set()
+    churner.join()
+    for t in clients:
+        t.join()
+    assert not errs, errs
+    ck = sys3.clerk()
+    ck.put("aaa", "bbb", timeout=30.0)
+    assert ck.get("aaa", timeout=30.0) == "bbb"
