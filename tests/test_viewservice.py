@@ -118,3 +118,49 @@ def test_no_advance_until_acked(vs):
 
 def test_uninitialized_fresh_start(vs):
     assert vs.get() == View(0, "", "")
+
+
+def test_restarted_server_becomes_backup(vs):
+    """viewservice/test_test.go:100-120 — a crashed-and-restarted ex-primary
+    (pinging 0) is allowed back as BACKUP of the promoted server."""
+    cks = [Clerk(f"s{i}", vs) for i in (1, 2)]
+    views = {ck.me: View(0, "", "") for ck in cks}
+    drive(vs, cks, views,
+          stop_pred=lambda v: v.primary == "s1" and v.backup == "s2")
+    drive(vs, cks, views, stop_pred=lambda v: vs.acked)
+    # s1 restarts: always pings 0; s2 keeps pinging normally.
+    deadline = time.monotonic() + 5.0
+    v = vs.get()
+    while time.monotonic() < deadline and not (
+            v.primary == "s2" and v.backup == "s1"):
+        cks[0].ping(0)
+        views["s2"] = cks[1].ping(views["s2"].viewnum)
+        v = vs.get()
+        time.sleep(TICK)
+    assert v.primary == "s2" and v.backup == "s1", v
+
+
+def test_idle_third_server_becomes_backup_on_failover(vs):
+    """viewservice/test_test.go:121-140 — with an idle third server pinging,
+    a primary failure promotes the backup AND recruits the idle server."""
+    cks = [Clerk(f"s{i}", vs) for i in (1, 2, 3)]
+    views = {ck.me: View(0, "", "") for ck in cks}
+    drive(vs, cks, views,
+          stop_pred=lambda v: v.primary == "s1" and v.backup == "s2")
+    drive(vs, cks, views, stop_pred=lambda v: vs.acked)
+    v = drive(vs, cks, views, dead={"s1"},
+              stop_pred=lambda v: v.primary == "s2" and v.backup == "s3")
+    assert v.primary == "s2" and v.backup == "s3", v
+
+
+def test_dead_backup_removed_from_view(vs):
+    """viewservice/test_test.go:162-180 — when the backup stops pinging and
+    no idle server exists, the view advances to primary-only."""
+    cks = [Clerk(f"s{i}", vs) for i in (1, 2)]
+    views = {ck.me: View(0, "", "") for ck in cks}
+    drive(vs, cks, views,
+          stop_pred=lambda v: v.primary == "s1" and v.backup == "s2")
+    drive(vs, cks, views, stop_pred=lambda v: vs.acked)
+    v = drive(vs, cks, views, dead={"s2"},
+              stop_pred=lambda v: v.primary == "s1" and v.backup == "")
+    assert v.primary == "s1" and v.backup == "", v
