@@ -84,3 +84,51 @@ def test_both_dead_raises(pair):
     b.kill()
     with pytest.raises(RPCError):
         ck.lock("z")
+
+
+# The reference's seven "primary failure just before reply" sequences
+# (lockservice/test_test.go:108-307): the primary executes one op (and
+# forwards it to the backup), then dies WITHOUT replying, so the clerk's
+# retry lands at the backup — the answer must be the first execution's,
+# never a re-execution.  Each script is (pre-ops, post-ops); the first
+# post-op is the one whose reply the dying primary swallows.
+# Fail7's concurrent-retry timing collapses to Fail6's sequence under our
+# immediate-retry clerk and is covered by it.
+FAIL_SCRIPTS = [
+    ("fail2",
+     [(1, "l", "a", True), (1, "l", "b", True)],
+     [(2, "l", "c", True), (1, "l", "c", False),
+      (2, "u", "c", True), (1, "l", "c", True)]),
+    ("fail3",
+     [(1, "l", "a", True), (1, "l", "b", True)],
+     [(1, "l", "b", False)]),
+    ("fail4",
+     [(1, "l", "a", True), (1, "l", "b", True)],
+     [(2, "l", "b", False)]),
+    ("fail5",
+     [(1, "l", "a", True), (1, "l", "b", True), (1, "u", "b", True)],
+     [(1, "u", "b", False), (2, "l", "b", True)]),
+    ("fail6",
+     [(1, "l", "a", True), (1, "u", "a", True),
+      (2, "u", "a", False), (1, "l", "b", True)],
+     [(2, "u", "b", True), (1, "l", "b", True)]),
+    ("fail8",
+     [(1, "l", "a", True), (1, "u", "a", True)],
+     [(2, "u", "a", False), (1, "l", "a", True), (1, "u", "a", True)]),
+]
+
+
+@pytest.mark.parametrize("name,pre,post", FAIL_SCRIPTS,
+                         ids=[s[0] for s in FAIL_SCRIPTS])
+def test_primary_fail_before_reply_scripts(name, pre, post):
+    p, b = make_pair()
+    clerks = {1: Clerk(p, b), 2: Clerk(p, b)}
+
+    def run(ops):
+        for ci, op, lname, want in ops:
+            got = (clerks[ci].lock if op == "l" else clerks[ci].unlock)(lname)
+            assert got is want, (name, ci, op, lname, got, want)
+
+    run(pre)
+    p.die_after_next_deaf()
+    run(post)
