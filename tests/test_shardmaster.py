@@ -180,3 +180,64 @@ def test_rebalance_jax_matches_host():
             active[g - 1] = True
         got = rebalance_jax(jnp.asarray(shards, jnp.int32), jnp.asarray(active))
         assert list(np.asarray(got)) == want, (shards, gids, want, list(np.asarray(got)))
+
+
+def test_min_advances_after_joins(cluster):
+    """shardmaster/test_test.go:239-247 — the config service must Done()
+    applied log entries so every replica's Min() advances (the log is
+    garbage-collected, not pinned)."""
+    from tpu6824.utils.timing import wait_until
+
+    _, servers = cluster
+    ck = Clerk(servers)
+    for i in range(1, 6):
+        ck.join(i, [f"s{i}a", f"s{i}b"])
+    for i in range(2, 6):
+        ck.leave(i)
+    assert wait_until(lambda: all(s.px.min() > 0 for s in servers),
+                      timeout=15.0), [s.px.min() for s in servers]
+
+
+def test_concurrent_join_leave_with_failure(cluster):
+    """shardmaster/test_test.go:312-345 — concurrent Join/Join/Leave bursts
+    through random replicas while replica 0 goes deaf mid-run; the final
+    config must still be balanced with exactly the expected groups."""
+    import random
+
+    fabric, servers = cluster
+    npara = 8
+    gids = list(range(1, npara + 1))
+    errs: list = []
+
+    def burst(i):
+        try:
+            rng = random.Random(i)
+            gid = gids[i]
+            Clerk([servers[1 + rng.randrange(2)]]).join(
+                gid + 1000, ["a", "b", "c"])
+            Clerk([servers[1 + rng.randrange(2)]]).join(gid, ["a", "b", "c"])
+            Clerk([servers[1 + rng.randrange(2)]]).leave(gid + 1000)
+            fabric.deafen(0, 0)  # replica 0 stops hearing (os.Remove analog)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=burst, args=(i,)) for i in range(npara)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    cfg = Clerk(servers[1:]).query(-1)
+    check(cfg, gids)
+
+
+def test_fresh_query_from_deaf_replica(cluster):
+    """TestFreshQuery (shardmaster/test_test.go:348-381) — a replica that
+    cannot HEAR peer traffic (but can still dial out) must return the
+    LATEST configuration from Query(-1): the query logs an op and catches
+    up through its own proposals, never serving stale local state."""
+    fabric, servers = cluster
+    fabric.deafen(0, 0)
+    Clerk([servers[1]]).join(1001, ["a", "b", "c"])
+    cfg = Clerk([servers[0]]).query(-1)
+    assert 1001 in cfg.groups_dict(), cfg
