@@ -167,3 +167,57 @@ def test_wrong_group_rerouting(sys2):
     assert ck.get("a", timeout=60.0) == "1"
     ck.put("a", "2", timeout=60.0)
     assert ck.get("a", timeout=30.0) == "2"
+
+
+def _concurrent_move_churn(sys3, unreliable):
+    """doConcurrent (shardkv/test_test.go:304-360): each client appends to
+    its own key and immediately re-reads its running value, while issuing
+    random shardmaster Moves between ops — optionally with every server's
+    accept loop unreliable."""
+    import random
+    import time
+
+    for gid in sys3.gids:
+        sys3.join(gid)
+    if unreliable:
+        sys3.fabric.set_unreliable(True)
+    nclients, iters = 4, 3
+    errs: list = []
+
+    def client(me):
+        try:
+            rng = random.Random(40 + me)
+            ck = sys3.clerk()
+            mck = sys3.sm_clerk()
+            key, last = f"c{me}", ""
+            for _ in range(iters):
+                nv = str(rng.randrange(1 << 30))
+                ck.append(key, nv, timeout=120.0)
+                last += nv
+                v = ck.get(key, timeout=120.0)
+                assert v == last, (me, v, last)
+                mck.move(rng.randrange(10),
+                         sys3.gids[rng.randrange(len(sys3.gids))],
+                         timeout=120.0)
+                time.sleep(rng.random() * 0.03)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=client, args=(i,))
+          for i in range(nclients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if unreliable:
+        sys3.fabric.set_unreliable(False)
+    assert not errs, errs
+
+
+def test_concurrent_put_get_move(sys3):
+    _concurrent_move_churn(sys3, unreliable=False)
+
+
+def test_concurrent_put_get_move_unreliable(sys3):
+    """TestConcurrentUnreliable (shardkv/test_test.go:473-478)."""
+    _concurrent_move_churn(sys3, unreliable=True)
