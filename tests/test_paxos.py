@@ -262,3 +262,73 @@ def test_rpc_budget_concurrent(fab3):
         waitn(fab3, 0, seq, 3)
     total = fab3.msgs_total - base
     assert total <= ninst * 45, f"too chatty: {total} msgs for {ninst} agreements"
+
+
+def test_max_after_dones(fab3):
+    """TestDoneMax (paxos/test_test.go:460-500): Done() must not affect
+    Max() — it garbage-collects memory, not the sequence high-water mark."""
+    pxa = make_group(fab3)
+    pxa[0].start(0, "x")
+    waitn(fab3, 0, 0, 3)
+    for i in range(1, 11):
+        pxa[0].start(i, "y")
+        waitn(fab3, 0, i, 3)
+    for px in pxa:
+        px.done(10)
+    # propagate: a proposal after Done carries the piggyback
+    for px in pxa:
+        px.start(10, "z")
+    assert wait_until(lambda: all(px.max() == 10 for px in pxa), 10.0), \
+        [px.max() for px in pxa]
+
+
+def test_minority_proposal_ignored(fab5):
+    """TestOld (paxos/test_test.go:629-662): an instance decided by a bare
+    majority while two peers were down; a late peer proposing a DIFFERENT
+    value must adopt the already-chosen one."""
+    pxa = make_group(fab5)
+    # peers 0 and 4 are cut off while 1..3 decide
+    fab5.partition(0, [1, 2, 3], [0], [4])
+    pxa[1].start(1, 111)
+    waitmajority(fab5, 0, 1)
+    # peer 0 comes back and proposes a different value for the same seq
+    fab5.partition(0, [0, 1, 2, 3], [4])
+    pxa[0].start(1, 222)
+    waitn(fab5, 0, 1, 4)
+    for p in (0, 1, 2, 3):
+        fate, v = pxa[p].status(1)
+        assert (fate, v) == (Fate.DECIDED, 111), (p, fate, v)
+
+
+def test_many_instances_unreliable(fab3):
+    """TestManyUnreliable (paxos/test_test.go:664-710): a burst of
+    agreements with every accept loop unreliable still all decide, with
+    agreement everywhere."""
+    fab3.set_unreliable(True)
+    pxa = make_group(fab3)
+    N = 10
+    for seq in range(N):
+        pxa[seq % 3].start(seq, seq * seq)
+    for seq in range(N):
+        waitn(fab3, 0, seq, 3, timeout=60.0)
+        _, v = pxa[0].status(seq)
+        assert v == seq * seq
+    fab3.set_unreliable(False)
+
+
+def test_partition_switch_unreliable(fab5):
+    """TestPartitionUnreliable 'one peer switches partitions, unreliable'
+    (paxos/test_test.go:820-853): under message loss, a peer moved from the
+    minority into the majority completes the agreement it started."""
+    fab5.set_unreliable(True)
+    pxa = make_group(fab5)
+    fab5.partition(0, [0, 1, 2], [3, 4])
+    pxa[3].start(0, "lost")        # minority: cannot decide
+    pxa[1].start(0, "won")
+    waitn(fab5, 0, 0, 3, timeout=60.0)
+    # peer 3 switches into the majority side: must learn the chosen value
+    fab5.partition(0, [0, 1, 2, 3], [4])
+    waitn(fab5, 0, 0, 4, timeout=60.0)
+    fate, v = pxa[3].status(0)
+    assert (fate, v) == (Fate.DECIDED, "won")
+    fab5.set_unreliable(False)
