@@ -332,3 +332,57 @@ def test_partition_switch_unreliable(fab5):
     fate, v = pxa[3].status(0)
     assert (fate, v) == (Fate.DECIDED, "won")
     fab5.set_unreliable(False)
+
+
+def test_lots_of_forgetting(fab3):
+    """TestManyForget (paxos/test_test.go:313-372): starts in random order
+    racing a Done()-as-soon-as-decided thread, under an unreliable net; at
+    the end every still-remembered instance agrees everywhere."""
+    import random
+    import threading
+    import time
+
+    fab3.set_unreliable(True)
+    pxa = make_group(fab3)
+    maxseq = 12
+    stop = threading.Event()
+
+    def starter():
+        rng = random.Random(3)
+        order = list(range(maxseq))
+        rng.shuffle(order)
+        for seq in order:
+            pxa[rng.randrange(3)].start(seq, rng.randrange(1 << 20))
+            time.sleep(0.01)
+
+    def forgetter():
+        rng = random.Random(4)
+        while not stop.is_set():
+            seq = rng.randrange(maxseq)
+            i = rng.randrange(3)
+            if seq >= pxa[i].min():
+                fate, _ = pxa[i].status(seq)
+                if fate == Fate.DECIDED:
+                    pxa[i].done(seq)
+            time.sleep(0.002)
+
+    ts = [threading.Thread(target=starter), threading.Thread(target=forgetter)]
+    for t in ts:
+        t.start()
+    ts[0].join()
+    time.sleep(1.5)
+    stop.set()
+    ts[1].join()
+    fab3.set_unreliable(False)
+
+    # Convergence: every instance at/above the global Min decides everywhere
+    # and agrees (forgotten ones are exempt — that's the point of Done).
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        gmin = min(px.min() for px in pxa)
+        if all(fab3.ndecided(0, seq) == 3 for seq in range(gmin, maxseq)):
+            break
+        time.sleep(0.1)
+    gmin = min(px.min() for px in pxa)
+    for seq in range(gmin, maxseq):
+        assert fab3.ndecided(0, seq) == 3, (seq, fab3.ndecided(0, seq))
