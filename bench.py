@@ -161,6 +161,7 @@ def child_main():
         # drop (paxos/paxos.go:528-544).
         lossy_rate, _ = measure(P, 0.10, 0.20)
         dist = distribution(P, 0.10, 0.20)
+        wire = _wire_rate()
 
         # Roofline context: bytes moved per step — 7 (G,I,P) i32 state
         # arrays read + 6 written; masks are 5 (G,I,P,P) i32 on the XLA
@@ -192,6 +193,7 @@ def child_main():
                          "10% req / 20% reply drop"),
                 "steps_to_decide": dist,
             },
+            "wire": wire,
             "bench_seconds": round(time.time() - t_start, 1),
         }
 
@@ -337,6 +339,49 @@ def _lane_engine(jax, jnp, np, G, I, P, link, done, on_cpu):
         "run": run_j,
         "dist": dist,
     }
+
+
+def _wire_rate(n_instances=120):
+    """Control-plane price check: decided instances/sec over the
+    DECENTRALIZED path — per-message Prepare/Accept/Decided gob RPCs
+    between real Unix-socket endpoints (core/hostpeer.py), the reference's
+    own runtime model.  Host-only; independent of the accelerator."""
+    import shutil
+    import tempfile
+
+    try:
+        from tpu6824.core.hostpeer import make_host_cluster
+        from tpu6824.core.peer import Fate
+
+        d = tempfile.mkdtemp(prefix="bw", dir="/var/tmp")
+        try:
+            peers = make_host_cluster(d, npeers=3, seed=12)
+            try:
+                t0 = time.perf_counter()
+                for seq in range(n_instances):
+                    peers[seq % 3].start(seq, seq)
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    if all(peers[0].status(s)[0] == Fate.DECIDED
+                           for s in range(n_instances)):
+                        break
+                    time.sleep(0.005)
+                dt = time.perf_counter() - t0
+                decided = sum(
+                    1 for s in range(n_instances)
+                    if peers[0].status(s)[0] == Fate.DECIDED)
+                return {
+                    "value": round(decided / dt, 1),
+                    "note": ("decided/sec over per-message gob socket RPC, "
+                             "3 peers (reference runtime model)"),
+                }
+            finally:
+                for p in peers:
+                    p.kill()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001 — never cost the main line
+        return {"value": 0.0, "error": repr(e)[:200]}
 
 
 # --------------------------------------------------------------------------
