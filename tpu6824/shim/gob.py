@@ -480,6 +480,12 @@ class Encoder:
 
     # -- type ids ----------------------------------------------------------
 
+    # Framed type-definition messages memoized across streams: the body is
+    # a pure function of (type, own id, component ids), and the dial-per-
+    # call transport (one fresh Encoder per connection, paxos/rpc.go:24-42)
+    # otherwise rebuilds identical definitions for every single RPC.
+    _DEF_CACHE: dict[tuple, bytes] = {}
+
     def _type_id(self, t: GobType) -> int:
         if isinstance(t, _Builtin):
             return t.id
@@ -490,13 +496,24 @@ class Encoder:
         # Define component types first (Go emits inner defs before outer).
         if isinstance(t, (Slice, Array)):
             elem_id = self._type_id(t.elem)
+            comp = (elem_id,)
         elif isinstance(t, Map):
             kt_id = self._type_id(t.kt)
             vt_id = self._type_id(t.vt)
+            comp = (kt_id, vt_id)
         elif isinstance(t, Struct):
             field_ids = [self._type_id(ft) for _, ft in t.fields]
+            comp = tuple(field_ids)
         else:
             raise GobError(f"cannot assign id to {t!r}")
+        ckey = (k, self._next, comp)
+        cached = self._DEF_CACHE.get(ckey)
+        if cached is not None:
+            tid = self._next
+            self._next += 1
+            self._ids[k] = tid
+            self._pending.append(cached)
+            return tid
         tid = self._next
         self._next += 1
         self._ids[k] = tid
@@ -538,7 +555,9 @@ class Encoder:
             enc_int(body, vt_id)
             enc_uint(body, 0)
         enc_uint(body, 0)                           # end wireType
-        self._pending.append(self._frame(bytes(body)))
+        framed = self._frame(bytes(body))
+        self._DEF_CACHE[ckey] = framed
+        self._pending.append(framed)
         return tid
 
     @staticmethod
@@ -646,6 +665,11 @@ class Encoder:
 # Decoder
 
 
+# Parsed type-definition cache shared by all Decoder instances (read-only
+# _WireDef values), keyed by the raw definition body bytes.
+_TYPEDEF_CACHE: dict[bytes, "_WireDef"] = {}
+
+
 class Decoder:
     """One gob stream, decoding generically from the sender's type
     definitions (field matching by name happens above, in `complete` /
@@ -682,9 +706,17 @@ class Decoder:
             r = _Reader(self._read(size))
             tid = r.int_()
             if tid < 0:
-                self._wire[-tid] = _dec_typedef(r)
-                if not r.done():
-                    raise GobError("trailing bytes after type definition")
+                # Typedef bodies repeat verbatim on every dial-per-call
+                # connection; parse each distinct body once, process-wide.
+                body = r.data[r.pos:]
+                wd = _TYPEDEF_CACHE.get(body)
+                if wd is None:
+                    wd = _dec_typedef(r)
+                    if not r.done():
+                        raise GobError(
+                            "trailing bytes after type definition")
+                    _TYPEDEF_CACHE[body] = wd
+                self._wire[-tid] = wd
                 continue
             v = self._dec_value(r, tid, top=True)
             if not r.done():
