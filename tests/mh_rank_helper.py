@@ -1,0 +1,64 @@
+"""One rank of the two-OS-process multi-host mesh validation (invoked by
+tests/test_multihost_process.py as a subprocess per rank).
+
+Usage: python tests/mh_rank_helper.py <rank> <nproc> <coordinator_port>
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    rank, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from tpu6824.parallel.multihost import init_multihost
+
+    init_multihost(coordinator_address=f"127.0.0.1:{port}",
+                   num_processes=nproc, process_id=rank)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tpu6824.core.kernel import apply_starts, init_state
+    from tpu6824.parallel.mesh import place_state, sharded_step
+    from tpu6824.parallel.multihost import dcn_safe, make_multihost_mesh
+
+    devs = jax.devices()
+    assert len(devs) == 4 * nproc, len(devs)
+    assert len(jax.local_devices()) == 4
+
+    mesh = make_multihost_mesh(devs)
+    assert dcn_safe(mesh), dict(mesh.shape)
+
+    G, I, P = 16, 4, 4
+    state = init_state(G, I, P)
+    sa = np.zeros((G, I, P), bool)
+    sv = np.full((G, I, P), -1, np.int32)
+    sa[:, :, 0] = True
+    sv[:, :, 0] = np.arange(G * I).reshape(G, I) + 1
+    state = apply_starts(state, jnp.zeros((G, I), bool), jnp.asarray(sa),
+                         jnp.asarray(sv))
+    state = place_state(state, mesh)
+    link = jnp.ones((G, P, P), bool)
+    done = jnp.full((G, P), -1, jnp.int32)
+    dr = jnp.zeros((G, P, P), jnp.float32)
+
+    step = sharded_step(mesh)
+    state, io = step(state, link, done, jax.random.key(0), dr, dr)
+    # The global array spans both processes; verify this rank's shards.
+    for shard in state.decided.addressable_shards:
+        assert (np.asarray(shard.data) >= 0).all(), \
+            "multi-process sharded step failed to decide (local shard)"
+    print(f"RANK-OK {rank} mesh={dict(mesh.shape)} msgs={int(io.msgs)}",
+          flush=True)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
