@@ -68,3 +68,45 @@ def test_at_most_once_across_moves(system):
     for j in range(4, 8):
         ck.append("k", f"[{j}]", timeout=30.0)
     assert ck.get("k", timeout=30.0) == "".join(f"[{j}]" for j in range(8))
+
+
+def test_concurrent_move_churn_over_wire(system):
+    """doConcurrent on the fully-decentralized runtime: clients append to
+    their own keys and immediately re-read while random shardmaster Moves
+    churn the config — every hop (client ops, config ops, consensus,
+    XState transfer) is gob socket RPC (shardkv/test_test.go:304-360)."""
+    import random
+    import threading
+    import time
+
+    for gid in system.gids:
+        system.join(gid)
+    nclients, iters = 3, 3
+    errs: list = []
+
+    def client(me):
+        try:
+            rng = random.Random(60 + me)
+            ck = system.clerk()
+            mck = system.sm_clerk()
+            key, last = f"w{me}", ""
+            for _ in range(iters):
+                nv = str(rng.randrange(1 << 30))
+                ck.append(key, nv, timeout=120.0)
+                last += nv
+                v = ck.get(key, timeout=120.0)
+                assert v == last, (me, v, last)
+                mck.move(rng.randrange(10),
+                         system.gids[rng.randrange(len(system.gids))],
+                         timeout=120.0)
+                time.sleep(rng.random() * 0.05)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=client, args=(i,))
+          for i in range(nclients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
