@@ -7,9 +7,7 @@ implements the real thing: promises/acceptances are fsynced BEFORE the RPC
 reply leaves (the Paxos safety requirement), decisions and the Done window
 persist, and a restarted peer resumes with its word intact."""
 
-import pytest
-
-from tpu6824.core.hostpeer import HostPaxosPeer, make_host_cluster
+from tpu6824.core.hostpeer import HostPaxosPeer
 from tpu6824.core.peer import Fate
 from tpu6824.utils.timing import wait_until
 

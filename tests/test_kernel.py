@@ -9,8 +9,6 @@ and Done/Min propagation — before any host API exists on top.
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
-
 from tpu6824.core.kernel import (
     NO_VAL,
     apply_starts,

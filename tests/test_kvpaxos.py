@@ -7,9 +7,8 @@ import threading
 
 import pytest
 
-from tpu6824.core.fabric import PaxosFabric
 from tpu6824.services.common import FlakyNet
-from tpu6824.services.kvpaxos import Clerk, KVPaxosServer, make_cluster
+from tpu6824.services.kvpaxos import Clerk, make_cluster
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils.timing import wait_until
 

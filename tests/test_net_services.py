@@ -9,7 +9,6 @@ import pytest
 from tpu6824.harness import Deployment
 from tpu6824.services import kvpaxos, pbservice, viewservice
 from tpu6824.services.common import FlakyNet
-from tpu6824.utils.errors import RPCError
 
 FAST = 0.03  # ping interval for quick tests
 

@@ -11,7 +11,6 @@ import pytest
 from tpu6824.services.common import FlakyNet
 from tpu6824.services.pbservice import Clerk, PBServer
 from tpu6824.services.viewservice import ViewServer
-from tpu6824.utils.errors import RPCError
 from tpu6824.utils.timing import wait_until
 
 from tests.invariants import check_appends

@@ -7,7 +7,6 @@ import time
 import pytest
 
 from tpu6824.services.viewservice import DEAD_PINGS, Clerk, View, ViewServer
-from tpu6824.utils.timing import wait_until
 
 TICK = 0.02
 

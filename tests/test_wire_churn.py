@@ -22,8 +22,6 @@ per-client order (`kvpaxos/test_test.go:342-362`), after heal.
 import random
 import threading
 
-import pytest
-
 from tpu6824.core.hostpeer import HostPaxosPeer
 from tpu6824.core.peer import Fate
 from tpu6824.rpc.transport import LinkFarm, connect, link_alias, unlink_alias

@@ -33,7 +33,7 @@ import threading
 
 from tpu6824.core.peer import Fate
 from tpu6824.ops.hashing import NSHARDS, key2shard
-from tpu6824.services.shardkv import Op, ShardKVServer, XState
+from tpu6824.services.shardkv import Op, ShardKVServer
 from tpu6824.utils.errors import RPCError
 
 
