@@ -378,3 +378,19 @@ def test_redelivery_queue_drains_after_heal(tmp_path):
     finally:
         for p in peers:
             p.kill()
+
+
+def test_typedef_cache_bounded():
+    """A peer streaming endless UNIQUE (valid) typedefs must not grow the
+    process-wide parse cache without bound."""
+    from tpu6824.shim.gob import _TYPEDEF_CACHE, _TYPEDEF_CACHE_MAX
+
+    n = _TYPEDEF_CACHE_MAX + 64
+    # unique struct name per typedef → unique cache key; each stream ends
+    # with a value message ({A: 1}) so next() absorbs the definitions.
+    for i in range(0, n, 8):
+        defs = [structdef(65, f"T{i + j}", [("A", gob.INT_ID)])
+                for j in range(8)]
+        dec = decoder_for(*defs, valmsg(65, b"\x01\x02\x00"))
+        dec.next()
+    assert len(_TYPEDEF_CACHE) <= _TYPEDEF_CACHE_MAX, len(_TYPEDEF_CACHE)

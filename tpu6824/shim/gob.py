@@ -666,8 +666,12 @@ class Encoder:
 
 
 # Parsed type-definition cache shared by all Decoder instances (read-only
-# _WireDef values), keyed by the raw definition body bytes.
+# _WireDef values), keyed by the raw definition body bytes.  Bounded: a
+# hostile peer streaming unique (valid) typedefs must not grow memory
+# without limit — on overflow the cache resets (honest peers re-warm it
+# with the handful of wire schemas immediately).
 _TYPEDEF_CACHE: dict[bytes, "_WireDef"] = {}
+_TYPEDEF_CACHE_MAX = 4096
 
 
 class Decoder:
@@ -715,6 +719,8 @@ class Decoder:
                     if not r.done():
                         raise GobError(
                             "trailing bytes after type definition")
+                    if len(_TYPEDEF_CACHE) >= _TYPEDEF_CACHE_MAX:
+                        _TYPEDEF_CACHE.clear()
                     _TYPEDEF_CACHE[body] = wd
                 self._wire[-tid] = wd
                 continue
