@@ -1,0 +1,53 @@
+"""The bench driver contract: `python bench.py` must print EXACTLY ONE
+JSON line with the required keys, quickly, no matter what — including with
+a wedged accelerator (simulated by forcing CPU) and with a killed child
+(simulated by an impossible timeout).  The driver records this line as the
+round's benchmark artifact; a regression here silently costs the round's
+number (it did in r02)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def run_bench(extra_env, timeout=240):
+    env = dict(os.environ, **extra_env)
+    r = subprocess.run([sys.executable, BENCH], env=env, timeout=timeout,
+                       capture_output=True, text=True, cwd=REPO)
+    return r
+
+
+@pytest.mark.slow
+def test_one_json_line_with_required_keys():
+    r = run_bench({"BENCH_FORCE_CPU": "1", "BENCH_GROUPS": "4",
+                   "BENCH_INSTANCES": "16", "BENCH_REPS": "1"})
+    assert r.returncode == 0, r.stderr[-500:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "kernel",
+                "steps_per_sec", "approx_bytes_per_step", "contended",
+                "contended_lossy", "wire"):
+        assert key in d, key
+    assert d["value"] > 0
+    assert d["contended_lossy"]["steps_to_decide"]["p50"] >= 1
+    assert d["wire"]["value"] > 0
+
+
+@pytest.mark.slow
+def test_error_line_when_everything_fails():
+    """Even with no viable child, the contract holds: one parseable JSON
+    line, zero exit."""
+    r = run_bench({"BENCH_FORCE_CPU": "1", "BENCH_CPU_TIMEOUT": "2"},
+                  timeout=120)
+    assert r.returncode == 0
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    assert d["value"] == 0.0 and "error" in d
