@@ -11,6 +11,7 @@ import pytest
 from tpu6824.services.common import FlakyNet
 from tpu6824.services.pbservice import Clerk, PBServer
 from tpu6824.services.viewservice import ViewServer
+from tpu6824.utils.errors import RPCError
 from tpu6824.utils.timing import wait_until
 
 from tests.invariants import check_appends
@@ -147,7 +148,10 @@ def test_stale_primary_cannot_serve(sys3):
     refuses to co-sign reads, so clients can never see stale data."""
     ck = sys3.clerk()
     ck.put("k", "fresh", timeout=10.0)
-    old = sys3.vs.get()
+    # Only an ACKED view can advance once its primary goes silent
+    # (viewservice/server.go:90-95); grabbing the view mid-transition
+    # would select the wrong victim.
+    old = sys3.wait_acked()
     stale = sys3.servers[old.primary]
 
     # Partition `stale` from the viewservice only: stop its ticks.
@@ -196,6 +200,12 @@ def test_repeated_crash_restart_under_load(sys3):
         rng = random.Random(5)
         names = list(sys3.servers)
         while not stop.is_set():
+            # Killing a primary that never acked its view wedges the FSM
+            # forever (by design, viewservice/server.go:90-95); the
+            # reference's churn sleeps 2·DeadPings·PingInterval around each
+            # kill for exactly this reason — gate on the ack instead.
+            if not wait_until(lambda: sys3.vs.acked, 5.0):
+                continue
             name = names[rng.randrange(len(names))]
             sys3.restart(name)
             # let a view form and the backup initialize (2·DeadPings·tick)
@@ -209,10 +219,10 @@ def test_repeated_crash_restart_under_load(sys3):
             while not stop.is_set():
                 k = f"c{i}-{rng.randrange(10)}"
                 if k in data:
-                    v = ck.get(k, timeout=30.0)
+                    v = ck.get(k, timeout=60.0)
                     assert v == data[k], (k, v, data[k])
                 nv = str(rng.randrange(1 << 30))
-                ck.put(k, nv, timeout=30.0)
+                ck.put(k, nv, timeout=60.0)
                 data[k] = nv
                 time.sleep(0.01)
         except Exception as e:  # pragma: no cover
@@ -232,3 +242,63 @@ def test_repeated_crash_restart_under_load(sys3):
     ck = sys3.clerk()
     ck.put("aaa", "bbb", timeout=30.0)
     assert ck.get("aaa", timeout=30.0) == "bbb"
+
+
+def test_kill_last_server_new_one_not_active():
+    """pbservice/test_test.go:156-173 — after every initialized server
+    dies, a brand-new (empty) server must NOT serve: the viewservice never
+    promotes an uninitialized server to primary, so Gets block."""
+    s = PBSystem(names=("p1", "p2"))
+    try:
+        s.wait_view(lambda v: v.primary != "" and v.backup != "")
+        ck = s.clerk()
+        ck.put("1", "one", timeout=10.0)
+        old = s.wait_acked()
+        s.servers[old.primary].kill()
+        del s.servers[old.primary]
+        s.wait_view(lambda v: v.primary == old.backup)
+        assert ck.get("1", timeout=10.0) == "one"
+        cur = s.wait_acked()
+        s.servers[cur.primary].kill()
+        del s.servers[cur.primary]
+        # a fresh, never-initialized server appears
+        s.servers["p3"] = PBServer("p3", s.vs, s.net, s.directory,
+                                   tick_interval=TICK)
+        with pytest.raises(RPCError):
+            s.clerk().get("1", timeout=2.0)
+    finally:
+        s.shutdown()
+
+
+def test_put_immediately_after_backup_failure(sys3):
+    """pbservice/test_test.go:275-295: a Put fired the instant the backup
+    dies must complete (primary rides out the failed forward via the view
+    change), and data survives into the next view with the idle server
+    promoted to backup."""
+    ck = sys3.clerk()
+    ck.put("a", "aa", timeout=10.0)
+    v1 = sys3.wait_acked()
+    sys3.servers[v1.backup].kill()
+    del sys3.servers[v1.backup]
+    ck.put("a", "aaa", timeout=10.0)  # immediately after the kill
+    assert ck.get("a", timeout=10.0) == "aaa"
+    third = ({"p1", "p2", "p3"} - {v1.primary, v1.backup}).pop()
+    v2 = sys3.wait_view(
+        lambda v: v.primary == v1.primary and v.backup == third,
+        timeout=10.0)
+    assert ck.get("a", timeout=10.0) == "aaa"
+
+
+def test_put_immediately_after_primary_failure(sys3):
+    """pbservice/test_test.go:297-315: a Put fired the instant the primary
+    dies must complete via the promoted backup; all data intact."""
+    ck = sys3.clerk()
+    ck.put("a", "aa", timeout=10.0)
+    v1 = sys3.wait_acked()
+    sys3.servers[v1.primary].kill()
+    del sys3.servers[v1.primary]
+    ck.put("b", "bbb", timeout=10.0)  # immediately after the kill
+    assert ck.get("b", timeout=10.0) == "bbb"
+    sys3.wait_view(lambda v: v.primary == v1.backup, timeout=10.0)
+    assert ck.get("a", timeout=10.0) == "aa"
+    assert ck.get("b", timeout=10.0) == "bbb"
