@@ -282,3 +282,18 @@ def test_holes_in_sequence():
         for s in servers:
             s.dead = True
         fabric.stop_clock()
+
+
+def test_sequence_of_puts_unreliable(cluster):
+    """'Sequence of puts, unreliable' (kvpaxos/test_test.go:399-436): every
+    intermediate read observes exactly the last put — a re-executed
+    (duplicated) Put would be visible here as a stale or skipped read."""
+    fabric, servers = cluster
+    fabric.set_unreliable(True)
+    try:
+        ck = Clerk(servers)
+        for j in range(8):
+            ck.put("seq-key", str(j), timeout=60.0)
+            assert ck.get("seq-key", timeout=60.0) == str(j)
+    finally:
+        fabric.set_unreliable(False)

@@ -87,6 +87,16 @@ class HostPaxosPeer:
         implements what that lab asked for, with the diskv file discipline
         (atomic write-via-rename, `diskv/server.go:92-105`).
 
+        Disk-LOSS restart is NOT safe on this path: an acceptor restarted
+        over an empty persist_dir has forgotten its promises and could
+        re-grant against them (the amnesia problem — a node cannot detect
+        its own disk loss, since the marker would be on the lost disk).
+        Operators must treat disk loss as a dead peer and redeploy; the
+        diskv service layer handles disk-lossy REJOIN safely instead
+        (`services/diskv.py::_snapshot_from_peer` + the Test5RejoinMix
+        analogs), because there the RSM state, not the consensus vote
+        ledger, is what the lost disk held.
+
         `bind_addr` separates where this peer LISTENS from how its peers[]
         entry is dialed — required by the link-farm partition harness
         (`rpc.transport.LinkFarm`), where every peer dials through its own
