@@ -386,3 +386,27 @@ def test_lots_of_forgetting(fab3):
     gmin = min(px.min() for px in pxa)
     for seq in range(gmin, maxseq):
         assert fab3.ndecided(0, seq) == 3, (seq, fab3.ndecided(0, seq))
+
+
+def test_fabric_reliable_fast_path_is_transparent():
+    """The fabric's maskless fast-path switch (used when no server is
+    unreliable) must be invisible: two same-seed fabrics, one with the
+    fast path disabled, decide identical values in identical step counts."""
+    outcomes = []
+    for force_off in (False, True):
+        f = PaxosFabric(ngroups=2, npeers=3, ninstances=8, auto_step=False,
+                        seed=99)
+        if force_off:
+            f._reliable_ok = False
+        pxa = make_group(f, 0)
+        pxb = make_group(f, 1)
+        for seq in range(4):
+            pxa[seq % 3].start(seq, 100 + seq)
+            pxb[(seq + 1) % 3].start(seq, 200 + seq)
+        f.step(3)
+        outcomes.append((
+            [pxa[0].status(s) for s in range(4)],
+            [pxb[0].status(s) for s in range(4)],
+            f.msgs_total,
+        ))
+    assert outcomes[0] == outcomes[1], outcomes
