@@ -189,3 +189,52 @@ def test_disk_footprint_bounded_appends(sys1):
         # far below the sum-of-partials blowup.
         assert b < 5 * final_len + 8192, (b, final_len)
         assert b < quadratic / 2, (b, quadratic)
+
+
+def test_reconfig_with_dead_replicas(tmp_path):
+    """Test4Limp (diskv/test_test.go:352-430): with one replica of every
+    group crashed (disk kept), data survives joins — each join followed by
+    a read+overwrite of every key — and then leaves, where each departed
+    group's remaining replicas are killed outright after handing off."""
+    import random
+
+    s = DisKVSystem(str(tmp_path), ngroups=2, nreplicas=3, ninstances=32)
+    try:
+        rng = random.Random(11)
+        g0, g1 = s.gids
+        s.join(g0)
+        ck = s.clerk()
+        ck.put("a", "b", timeout=30.0)
+        assert ck.get("a", timeout=30.0) == "b"
+
+        for gid in s.gids:
+            s.crash(gid, rng.randrange(3), lose_disk=False)
+
+        keys = [str(rng.randrange(1 << 20)) for _ in range(6)]
+        vals = {k: str(rng.randrange(1 << 20)) for k in keys}
+        for k in keys:
+            ck.put(k, vals[k], timeout=30.0)
+
+        s.join(g1)
+        for k in keys:
+            assert ck.get(k, timeout=30.0) == vals[k], k
+            vals[k] = str(rng.randrange(1 << 20))
+            ck.put(k, vals[k], timeout=30.0)
+
+        s.leave(g0)
+        # donors must survive until the receiving group has pulled the
+        # shards (the reference sleeps 2s here, test_test.go:401-405;
+        # waiting on config convergence is the deterministic version)
+        cfgnum = s.sm_clerk().query(-1).num
+        assert wait_until(
+            lambda: all(srv.dead or srv.config.num >= cfgnum
+                        for srv in s.groups[g1]), 30.0)
+        for p in range(3):
+            srv = s.groups[g0][p]
+            if not srv.dead:
+                s.crash(g0, p, lose_disk=False)
+        for k in keys:
+            assert ck.get(k, timeout=30.0) == vals[k], k
+        assert ck.get("a", timeout=30.0) == "b"
+    finally:
+        s.shutdown()
