@@ -1,1 +1,9 @@
-from tpu6824.core.kernel import PaxosState, init_state, paxos_step, apply_starts  # noqa: F401
+from tpu6824.core.kernel import (  # noqa: F401
+    PaxosState, StepIO, apply_starts, init_state, paxos_step,
+    paxos_step_reliable,
+)
+from tpu6824.core.pallas_kernel import (  # noqa: F401
+    LaneState, apply_starts_lane, from_lane_state, get_step,
+    paxos_step_lanes, paxos_step_pallas, resolve_impl, to_lane_state,
+)
+from tpu6824.core.hostpeer import HostPaxosPeer, make_host_cluster  # noqa: F401
