@@ -394,3 +394,19 @@ def test_typedef_cache_bounded():
         dec = decoder_for(*defs, valmsg(65, b"\x01\x02\x00"))
         dec.next()
     assert len(_TYPEDEF_CACHE) <= _TYPEDEF_CACHE_MAX, len(_TYPEDEF_CACHE)
+
+
+def test_invalid_utf8_strings_raise_goberror():
+    """Hostile non-UTF-8 bytes in a string field or interface type name
+    must surface as GobError (the codec's one error type), not leak
+    UnicodeDecodeError through the server's exception contract."""
+    # string value with invalid UTF-8
+    payload = bytearray(b"\x00")
+    payload += bytes([2, 0xFF, 0xFE])  # len 2, invalid bytes
+    with pytest.raises(GobError, match="UTF-8"):
+        decoder_for(valmsg(gob.STRING_ID, bytes(payload))).next()
+    # interface concrete-type name with invalid UTF-8
+    body = bytearray(b"\x00")
+    body += bytes([2, 0xFF, 0xFE])
+    with pytest.raises(GobError, match="UTF-8"):
+        decoder_for(valmsg(gob.INTERFACE_ID, bytes(body))).next()

@@ -313,7 +313,11 @@ class _Reader:
         return _struct.unpack(">d", u.to_bytes(8, "big")[::-1])[0]
 
     def string(self) -> str:
-        return self.take(self.uint()).decode("utf-8")
+        raw = self.take(self.uint())
+        try:
+            return raw.decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise GobError(f"invalid UTF-8 in gob string: {e}") from e
 
     def done(self) -> bool:
         return self.pos >= len(self.data)
@@ -801,7 +805,10 @@ class Decoder:
         nlen = r.uint()
         if nlen == 0:
             return None
-        name = r.take(nlen).decode("utf-8")
+        try:
+            name = r.take(nlen).decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise GobError(f"invalid UTF-8 interface type name: {e}") from e
         tid = r.int_()
         blen = r.uint()
         sub = _Reader(r.take(blen))
