@@ -16,8 +16,12 @@ module fuses the whole round into ONE Pallas kernel:
     `paxos/paxos.go:528-544`, as per-edge Bernoulli keeps) are packed as
     BITPLANES of a single int32 array — one mask operand instead of five,
     an ~5× cut in per-step mask HBM traffic.  They are generated with
-    EXACTLY the same `jax.random` splits as the XLA path, so both paths are
-    bit-identical under the same key at any drop rate;
+    EXACTLY the same `jax.random` splits as the XLA path, so the consensus
+    state (np/na/va/decided/maxseen) is bit-identical under the same key at
+    any drop rate; `done_view` is bit-identical only at drop=0 — under loss
+    its Done-piggyback rides only prepare+heartbeat traffic (see below) and
+    is equivalent distributionally, not bit-for-bit
+    (`test_lossy_done_view_liveness_distribution`);
   - when the caller knows the network is reliable and fully connected
     (`masked=False` — the best-case and contended bench configs), no mask
     is materialized at all: the kernel's edge predicate folds to constant

@@ -488,7 +488,10 @@ class Encoder:
     # a pure function of (type, own id, component ids), and the dial-per-
     # call transport (one fresh Encoder per connection, paxos/rpc.go:24-42)
     # otherwise rebuilds identical definitions for every single RPC.
+    # Bounded like the decoder's _TYPEDEF_CACHE: dynamically generated
+    # Struct schemas must not grow it without limit.
     _DEF_CACHE: dict[tuple, bytes] = {}
+    _DEF_CACHE_MAX = 4096
 
     def _type_id(self, t: GobType) -> int:
         if isinstance(t, _Builtin):
@@ -560,6 +563,8 @@ class Encoder:
             enc_uint(body, 0)
         enc_uint(body, 0)                           # end wireType
         framed = self._frame(bytes(body))
+        if len(self._DEF_CACHE) >= self._DEF_CACHE_MAX:
+            self._DEF_CACHE.clear()
         self._DEF_CACHE[ckey] = framed
         self._pending.append(framed)
         return tid
