@@ -410,3 +410,34 @@ def test_fabric_reliable_fast_path_is_transparent():
             f.msgs_total,
         ))
     assert outcomes[0] == outcomes[1], outcomes
+
+
+def test_window_full_start_does_not_leak_intern(fab3):
+    """A Start rejected with WindowFullError must not retain a ref on the
+    interned value (regression: intern.put used to run before the slot
+    allocation that raises)."""
+    fab3.stop_clock()  # no GC: window fills deterministically
+    pxa = make_group(fab3)
+    for s in range(fab3.I):
+        pxa[0].start(s, f"v{s}")
+    live_before = fab3.intern.nlive
+    for _ in range(10):
+        with pytest.raises(WindowFullError):
+            pxa[0].start(fab3.I, "overflow")
+    assert fab3.intern.nlive == live_before
+
+
+def test_partition_does_not_resurrect_killed_peer(fab5):
+    """kill() then re-partition(): the dead peer's links must stay cut —
+    socket surgery can't revive a crashed server (paxos.Kill,
+    paxos/paxos.go:456-461)."""
+    pxa = make_group(fab5)
+    fab5.kill(0, 0)
+    fab5.partition(0, [0, 1], [2, 3, 4])
+    # Peer 1 is alone with a dead partner: no quorum, no progress.
+    pxa[1].start(0, "minority")
+    fab5.wait_steps(5)
+    assert fab5.ndecided(0, 0) == 0
+    # The majority side still works.
+    pxa[2].start(0, "majority")
+    waitn(fab5, 0, 0, 3)

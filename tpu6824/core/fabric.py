@@ -36,6 +36,9 @@ from tpu6824.utils.trace import EventLog, dprintf
 UNRELIABLE_REQ_DROP = 0.10
 UNRELIABLE_REP_DROP = 0.20
 
+# How many per-step PRNG subkeys to pre-split at once (see _next_key_locked).
+_KEY_BATCH = 256
+
 
 class WindowFullError(RuntimeError):
     """No free instance slot: callers are outrunning Done()/Min() GC.
@@ -73,15 +76,24 @@ class PaxosFabric:
         G, I, P = self.G, self.I, self.P
         self._state = init_state(G, I, P)
         self._key = jax.random.key(seed)
+        self._key_buf: list = []
 
         # Host-owned network condition (device inputs):
         self._link = np.ones((G, P, P), bool)
+        self._link_dev = None  # device copy; None = stale (net changed)
         self._unreliable = np.zeros((G, P), bool)  # per receiving server
         self._done = np.full((G, P), -1, np.int32)
+        self._pmin_i32 = np.empty((G, P), np.int32)  # scratch for min-reduce
 
-        # Host mirrors of device outputs:
-        self.m_decided = np.full((G, I, P), NO_VAL, np.int64)
-        self.m_done_view = np.full((G, P, P), -1, np.int64)
+        # Host mirrors of device outputs (device dtype — int32 — so the
+        # per-step refresh is a straight copy, no astype pass):
+        self.m_decided = np.full((G, I, P), NO_VAL, np.int32)
+        self.m_done_view = np.full((G, P, P), -1, np.int32)
+        # Min() cache: _peer_min[g, p] = 1 + min_q done_view[g, p, q],
+        # refreshed vectorized once per step and on done() — so the hot API
+        # calls (start/status, O(ops/sec) of them) read a scalar instead of
+        # reducing a row each (the O(G) bookkeeping wall, VERDICT r3 weak #2).
+        self._peer_min = np.zeros((G, P), np.int64)
         self._max_seq = np.full((G, P), -1, np.int64)  # Max() running high-water
         # Observability (SURVEY §5 build note): per-step event log + counters.
         # The EventLog counters are the single source of truth for steps/msgs;
@@ -92,6 +104,13 @@ class PaxosFabric:
         # Slot management (host only): which absolute seq lives in each slot.
         self._slot_seq = np.full((G, I), -1, np.int64)
         self._seq2slot: list[dict[int, int]] = [dict() for _ in range(G)]
+        # O(1) allocation: per-group LIFO freelist (invariant: slot is listed
+        # iff _slot_seq[g, slot] == -1).  A freed slot may carry a pending
+        # reset; that is safe to hand out because apply_starts applies resets
+        # before starts within the same step.
+        self._free: list[list[int]] = [
+            list(range(I - 1, -1, -1)) for _ in range(G)
+        ]
         self._slot_vids: list[list[list[int]]] = [
             [[] for _ in range(I)] for _ in range(G)
         ]  # interned ids referenced by each slot (for GC decref)
@@ -142,26 +161,36 @@ class PaxosFabric:
         for _ in range(n):
             self._step_once()
 
+    def _next_key_locked(self):
+        # Amortized PRNG: one split call per _KEY_BATCH steps instead of one
+        # per step (jax.random.split is a host round-trip).
+        if not self._key_buf:
+            keys = jax.random.split(self._key, _KEY_BATCH + 1)
+            self._key = keys[0]
+            self._key_buf = list(keys[1:])
+        return self._key_buf.pop()
+
     def _step_once(self):
         with self._lock:
             starts = self._pending_starts
             resets = self._pending_resets
             self._pending_starts = []
             self._pending_resets = []
-            link = jnp.asarray(self._link)
+            if self._link_dev is None:
+                self._link_dev = jnp.asarray(self._link)
+            link = self._link_dev
             done = jnp.asarray(self._done)
-            # Per-edge drop probabilities from per-server unreliable flags:
-            # the *destination* server's accept loop does the dropping.
-            unrel = self._unreliable.astype(np.float32)  # (G, P)
-            drop_req = jnp.asarray(
-                np.broadcast_to(unrel[:, None, :], (self.G, self.P, self.P))
-                * self._req_drop
-            )
-            drop_rep = jnp.asarray(
-                np.broadcast_to(unrel[:, None, :], (self.G, self.P, self.P))
-                * self._rep_drop
-            )
-            self._key, sub = jax.random.split(self._key)
+            any_unrel = bool(self._unreliable.any())
+            reliable = self._reliable_ok and not any_unrel
+            if not reliable:
+                # Per-edge drop probabilities from per-server unreliable
+                # flags: the *destination* server's accept loop drops.
+                unrel = self._unreliable.astype(np.float32)  # (G, P)
+                e = np.broadcast_to(
+                    unrel[:, None, :], (self.G, self.P, self.P))
+                drop_req = jnp.asarray(e * self._req_drop)
+                drop_rep = jnp.asarray(e * self._rep_drop)
+                sub = self._next_key_locked()
 
         state = self._state
         if starts or resets:
@@ -177,7 +206,7 @@ class PaxosFabric:
                 state, jnp.asarray(reset), jnp.asarray(sa), jnp.asarray(sv)
             )
 
-        if self._reliable_ok and not unrel.any():
+        if reliable:
             from tpu6824.core.kernel import paxos_step_reliable
 
             state, io = paxos_step_reliable(state, link, done)
@@ -190,8 +219,20 @@ class PaxosFabric:
         )
 
         with self._lock:
-            self.m_decided = decided.astype(np.int64)
-            self.m_done_view = done_view.astype(np.int64)
+            # device_get output can be read-only; mirrors must be writable
+            # (GC wipes recycled rows, the done() diagonal stays monotone).
+            decided = np.array(decided)
+            done_view = np.array(done_view)
+            self.m_decided = decided
+            self.m_done_view = done_view
+            # done() calls that landed while the step was in flight are in
+            # self._done but not yet in the device output — keep the own-done
+            # diagonal monotone so Min() never transiently regresses.
+            pidx = np.arange(self.P)
+            done_view[:, pidx, pidx] = np.maximum(
+                done_view[:, pidx, pidx], self._done)
+            np.minimum.reduce(done_view, axis=2, out=self._pmin_i32)
+            self._peer_min = self._pmin_i32.astype(np.int64) + 1
             ndec = int((self.m_decided >= 0).sum())
             # _decided_cells was decremented by GC for wiped cells, so this
             # delta counts decisions landing in recycled slots too.
@@ -234,25 +275,32 @@ class PaxosFabric:
         # min over peers of Min_p, where Min_p = 1 + min_q done_view[p, q]
         # (paxos/paxos.go:420-425).  Conservative: a slot may be recycled only
         # once *every* peer has forgotten it.
-        return int(self.m_done_view[g].min(axis=1).min()) + 1
+        return int(self._peer_min[g].min())
 
     def _gc_locked(self):
-        for g in range(self.G):
-            gmin = self._global_min_locked(g)
-            stale = [s for s in self._seq2slot[g] if s < gmin]
-            for seq in stale:
-                slot = self._seq2slot[g].pop(seq)
-                self._slot_seq[g, slot] = -1
-                for vid in self._slot_vids[g][slot]:
-                    self.intern.decref(vid)
-                self._slot_vids[g][slot] = []
-                self._pending_resets.append((g, slot))
-                # Mirrors must stop reporting the old tenant immediately.
-                # Deduct the wiped cells from the running decided count so the
-                # decided_cells counter keeps crediting decisions that land in
-                # recycled slots (steady-state windowed throughput).
-                self._decided_cells -= int((self.m_decided[g, slot, :] >= 0).sum())
-                self.m_decided[g, slot, :] = NO_VAL
+        # Vectorized staleness scan: one (G, I) compare against the per-group
+        # global min, instead of a Python dict walk per group per step.  The
+        # common case (nothing to collect) costs one reduce + one any().
+        gmin = self._peer_min.min(axis=1)  # (G,)
+        stale = (self._slot_seq >= 0) & (self._slot_seq < gmin[:, None])
+        if not stale.any():
+            return
+        for g, slot in np.argwhere(stale):
+            g, slot = int(g), int(slot)
+            seq = int(self._slot_seq[g, slot])
+            del self._seq2slot[g][seq]
+            self._slot_seq[g, slot] = -1
+            self._free[g].append(slot)
+            for vid in self._slot_vids[g][slot]:
+                self.intern.decref(vid)
+            self._slot_vids[g][slot] = []
+            self._pending_resets.append((g, slot))
+            # Mirrors must stop reporting the old tenant immediately.
+            # Deduct the wiped cells from the running decided count so the
+            # decided_cells counter keeps crediting decisions that land in
+            # recycled slots (steady-state windowed throughput).
+            self._decided_cells -= int((self.m_decided[g, slot, :] >= 0).sum())
+            self.m_decided[g, slot, :] = NO_VAL
 
     # ---------------------------------------------------------------- API
 
@@ -262,20 +310,14 @@ class PaxosFabric:
             return slot
         if not create:
             return None
-        free = np.nonzero(self._slot_seq[g] == -1)[0]
-        pending_resets = {s for gg, s in self._pending_resets if gg == g}
-        for cand in free:
-            if int(cand) not in pending_resets:
-                slot = int(cand)
-                break
-        else:
-            if len(free):
-                slot = int(free[0])  # pending reset is applied before the start
-            else:
-                raise WindowFullError(
-                    f"group {g}: all {self.I} instance slots live; "
-                    f"call Done() to advance Min() (global_min={self._global_min_locked(g)})"
-                )
+        if not self._free[g]:
+            raise WindowFullError(
+                f"group {g}: all {self.I} instance slots live; "
+                f"call Done() to advance Min() (global_min={self._global_min_locked(g)})"
+            )
+        # O(1) LIFO pop; a freed slot's pending reset (if any) is applied
+        # before the start lands (apply_starts order), so reuse is safe.
+        slot = self._free[g].pop()
         self._slot_seq[g, slot] = seq
         self._seq2slot[g][seq] = slot
         return slot
@@ -284,25 +326,32 @@ class PaxosFabric:
         """paxos.Start(seq, v) for peer p of group g (paxos/paxos.go:99-109):
         asynchronous — agreement proceeds on subsequent clock steps."""
         with self._lock:
-            if self._dead[g, p]:
-                return
-            if seq < self.peer_min(g, p):
-                return  # forgotten; reference ignores such Starts
-            slot = self._seq2slot[g].get(seq)
-            if slot is not None and self.m_decided[g, slot, p] >= 0:
-                return  # already decided locally; nothing to do
-            vid = self.intern.put(value)
-            slot = self._slot_for_locked(g, seq, create=True)
-            self._slot_vids[g][slot].append(vid)
-            self._pending_starts.append((g, slot, p, vid))
-            self._max_seq[g, p] = max(self._max_seq[g, p], seq)
+            self._start_locked(g, p, seq, value)
+
+    def _start_locked(self, g: int, p: int, seq: int, value) -> None:
+        if self._dead[g, p]:
+            return
+        if seq < self._peer_min[g, p]:
+            return  # forgotten; reference ignores such Starts
+        slot = self._seq2slot[g].get(seq)
+        if slot is not None and self.m_decided[g, slot, p] >= 0:
+            return  # already decided locally; nothing to do
+        # Allocate the slot BEFORE interning: _slot_for_locked may raise
+        # WindowFullError, and an intern ref taken first would never be
+        # decref'd (leak under start-retry backpressure loops).
+        slot = self._slot_for_locked(g, seq, create=True)
+        vid = self.intern.put(value)
+        self._slot_vids[g][slot].append(vid)
+        self._pending_starts.append((g, slot, p, vid))
+        if seq > self._max_seq[g, p]:
+            self._max_seq[g, p] = seq
 
     def status(self, g: int, p: int, seq: int):
         """paxos.Status (paxos/paxos.go:434-447) → (Fate, value)."""
         from tpu6824.core.peer import Fate
 
         with self._lock:
-            if seq < self.peer_min(g, p):
+            if seq < self._peer_min[g, p]:
                 return Fate.FORGOTTEN, None
             slot = self._seq2slot[g].get(seq)
             if slot is None:
@@ -312,18 +361,61 @@ class PaxosFabric:
                 return Fate.PENDING, None
             return Fate.DECIDED, self.intern.get(vid)
 
+    # ----------------------------------------------------- batched API
+    # The fabric is a batched runtime: a driver pumping hundreds of groups
+    # per clock step should pay one lock acquisition per batch, not per op.
+    # Semantics are exactly N calls of the scalar methods, in order.
+
+    def start_many(self, ops) -> None:
+        """Batched Start: `ops` iterates (g, p, seq, value)."""
+        with self._lock:
+            for g, p, seq, value in ops:
+                self._start_locked(g, p, seq, value)
+
+    def status_many(self, queries) -> list:
+        """Batched Status: `queries` iterates (g, p, seq); returns a
+        (Fate, value) list in query order."""
+        from tpu6824.core.peer import Fate
+
+        out = []
+        with self._lock:
+            pmin = self._peer_min
+            dec = self.m_decided
+            get = self.intern.get
+            for g, p, seq in queries:
+                if seq < pmin[g, p]:
+                    out.append((Fate.FORGOTTEN, None))
+                    continue
+                slot = self._seq2slot[g].get(seq)
+                vid = -1 if slot is None else int(dec[g, slot, p])
+                out.append((Fate.PENDING, None) if vid < 0
+                           else (Fate.DECIDED, get(vid)))
+        return out
+
+    def done_many(self, items) -> None:
+        """Batched Done: `items` iterates (g, p, seq)."""
+        with self._lock:
+            for g, p, seq in items:
+                self._done_locked(g, p, seq)
+
     def done(self, g: int, p: int, seq: int) -> None:
         """paxos.Done (paxos/paxos.go:352-359)."""
         with self._lock:
-            self._done[g, p] = max(self._done[g, p], seq)
+            self._done_locked(g, p, seq)
+
+    def _done_locked(self, g: int, p: int, seq: int) -> None:
+        if seq > self._done[g, p]:
+            self._done[g, p] = seq
             # Own view updates without needing a message to self.
-            self.m_done_view[g, p, p] = max(self.m_done_view[g, p, p], seq)
+            if seq > self.m_done_view[g, p, p]:
+                self.m_done_view[g, p, p] = seq
+                self._peer_min[g, p] = int(self.m_done_view[g, p].min()) + 1
 
     def peer_min(self, g: int, p: int) -> int:
         """paxos.Min (paxos/paxos.go:420-425): 1 + min over peers of done as
         known to p via piggybacked/heartbeat traffic."""
         with self._lock:
-            return int(self.m_done_view[g, p].min()) + 1
+            return int(self._peer_min[g, p])
 
     def peer_max(self, g: int, p: int) -> int:
         """paxos.Max (paxos/paxos.go:385-390)."""
@@ -345,14 +437,19 @@ class PaxosFabric:
         within a partition (the socket hard-link farm,
         paxos/test_test.go:712-751).  Peers not listed are fully isolated."""
         with self._lock:
+            self._link_dev = None
             self._link[g] = False
             for part in parts:
                 for a in part:
                     for b in part:
                         self._link[g, a, b] = True
+            # Socket surgery must not resurrect a crashed peer (heal() has
+            # the same guard): dead lanes stay cut whatever the partition.
+            self._apply_dead_locked(g)
 
     def heal(self, g: int | None = None):
         with self._lock:
+            self._link_dev = None
             if g is None:
                 self._link[:] = True
             else:
@@ -364,10 +461,12 @@ class PaxosFabric:
         """Nothing can be delivered TO peer p (socket file removed,
         paxos/test_test.go:194-195); p can still send."""
         with self._lock:
+            self._link_dev = None
             self._link[g, :, p] = False
 
     def set_link(self, g: int, src: int, dst: int, up: bool):
         with self._lock:
+            self._link_dev = None
             self._link[g, src, dst] = up
 
     def _apply_dead_locked(self, g: int):
@@ -381,6 +480,7 @@ class PaxosFabric:
         more sends or receives; its state is NOT recovered (the reference
         Paxos has no persistence)."""
         with self._lock:
+            self._link_dev = None
             self._dead[g, p] = True
             self._apply_dead_locked(g)
 
@@ -388,6 +488,7 @@ class PaxosFabric:
         """Reboot a crashed peer (diskv's restart path): clears the dead flag
         and restores its links, leaving other peers' crash state intact."""
         with self._lock:
+            self._link_dev = None
             self._dead[g, p] = False
             self._link[g, p, :] = True
             self._link[g, :, p] = True

@@ -21,6 +21,8 @@ from tpu6824.rpc import Proxy, Server, connect
 FABRIC_RPCS = [
     # paxos contract (per peer-lane)
     "start", "status", "done", "peer_min", "peer_max",
+    # batched variants (one RPC for a whole step's worth of ops)
+    "start_many", "status_many", "done_many",
     # harness / fault injection
     "ndecided", "set_unreliable", "partition", "heal", "deafen",
     "set_link", "kill", "revive", "is_dead",
