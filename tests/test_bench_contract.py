@@ -26,18 +26,23 @@ def run_bench(extra_env, timeout=240):
 @pytest.mark.slow
 def test_one_json_line_with_required_keys():
     r = run_bench({"BENCH_FORCE_CPU": "1", "BENCH_GROUPS": "4",
-                   "BENCH_INSTANCES": "16", "BENCH_REPS": "1"})
+                   "BENCH_INSTANCES": "16", "BENCH_REPS": "1",
+                   # keep the API-driven configs quick for the contract run
+                   "BENCH_SERVICE_GROUPS": "16", "BENCH_SERVICE_SECONDS": "1",
+                   "BENCH_CLERK_GROUPS": "4"})
     assert r.returncode == 0, r.stderr[-500:]
     lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, r.stdout
     d = json.loads(lines[0])
     for key in ("metric", "value", "unit", "vs_baseline", "kernel",
                 "steps_per_sec", "approx_bytes_per_step", "contended",
-                "contended_lossy", "wire"):
+                "contended_lossy", "wire", "service"):
         assert key in d, key
     assert d["value"] > 0
     assert d["contended_lossy"]["steps_to_decide"]["p50"] >= 1
     assert d["wire"]["value"] > 0
+    assert d["service"]["value"] > 0, d["service"]
+    assert d["service"]["clerk"]["value"] > 0, d["service"]
 
 
 @pytest.mark.slow

@@ -441,3 +441,70 @@ def test_partition_does_not_resurrect_killed_peer(fab5):
     # The majority side still works.
     pxa[2].start(0, "majority")
     waitn(fab5, 0, 0, 3)
+
+
+def test_immediate_int_values(fab3):
+    """Small non-negative int payloads ride the device arrays as tagged
+    immediate ids (fabric.IMM_BASE) — no intern entry, same agreement
+    semantics; everything else still goes through the intern store."""
+    from tpu6824.core.fabric import IMM_BASE
+
+    pxa = make_group(fab3)
+    live0 = fab3.intern.nlive
+    pxa[0].start(0, 7)                      # immediate
+    pxa[1].start(1, IMM_BASE + 5)           # too big: interned
+    pxa[2].start(2, -3)                     # negative: interned
+    pxa[0].start(3, "text")                 # non-int: interned
+    for s in range(4):
+        waitn(fab3, 0, s, 3)
+    assert pxa[1].status(0) == (Fate.DECIDED, 7)
+    assert pxa[0].status(1) == (Fate.DECIDED, IMM_BASE + 5)
+    assert pxa[0].status(2) == (Fate.DECIDED, -3)
+    assert pxa[1].status(3) == (Fate.DECIDED, "text")
+    assert fab3.intern.nlive == live0 + 3  # the immediate one is free
+
+    # Dueling int/str proposers still agree on one value.
+    pxa[0].start(4, 11)
+    pxa[1].start(4, "rival")
+    waitn(fab3, 0, 4, 3)
+    vals = {pxa[p].status(4)[1] for p in range(3)}
+    assert len(vals) == 1 and vals.pop() in (11, "rival")
+
+
+def test_batched_api_matches_scalar(fab3):
+    """start_many/status_many/done_many are exactly N scalar calls."""
+    fab3.start_many([(0, s % 3, s, s * 10) for s in range(6)])
+    for s in range(6):
+        waitn(fab3, 0, s, 3)
+    res = fab3.status_many([(0, (s + 1) % 3, s) for s in range(6)])
+    assert res == [(Fate.DECIDED, s * 10) for s in range(6)]
+    fab3.done_many([(0, p, 5) for p in range(3)])
+    fab3.wait_steps(3)
+    assert all(fab3.peer_min(0, p) == 6 for p in range(3))
+    assert fab3.status_many([(0, 0, 0)]) == [(Fate.FORGOTTEN, None)]
+
+
+def test_stale_pending_start_is_filtered(fab3):
+    """A Start queued for a slot that the window GC recycles before the
+    next step must NOT arm the freed slot (ghost round with a dangling
+    value id).  White-box: queue the start, then force GC under the lock —
+    the interleaving a clock thread makes possible."""
+    fab3.stop_clock()
+    import numpy as np
+
+    with fab3._lock:
+        fab3._start_locked(0, 0, 1, "ghost")
+        # Simulate the in-flight mirror refresh lifting Min past seq 1:
+        fab3.m_done_view[:] = 5
+        fab3._peer_min[:] = 6
+        fab3._gc_locked()
+        assert 1 not in fab3._seq2slot[0]  # slot freed while start pending
+    fab3.step(3)
+    # No slot may be armed/decided with the ghost value.
+    assert (np.asarray(fab3._state.active) == False).all()  # noqa: E712
+    assert fab3._decided_cells == 0
+
+
+def test_done_many_overflow_is_loud(fab3):
+    with pytest.raises(OverflowError):
+        fab3.done_many([(0, 0, 2 ** 31)])

@@ -1,0 +1,28 @@
+"""API-driven service-path throughput (VERDICT r3 task 1): decided/sec
+through Start/Status/Done with the clock in the loop must scale with the
+group axis — host bookkeeping per step must not grow with G (the r3
+O(G)-Python wall).  The bench artifact records the absolute number; here
+we assert the scaling shape with wide margins (1-core CI variance)."""
+
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_service_throughput_scales_with_groups(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("BENCH_SERVICE_SECONDS", "3")
+    monkeypatch.setenv("BENCH_SERVICE_GROUPS", "8")
+    r8 = bench._service_rate()
+    monkeypatch.setenv("BENCH_SERVICE_GROUPS", "256")
+    r256 = bench._service_rate()
+    # 32x the groups must buy real throughput (not collapse under host
+    # bookkeeping): conservatively >= 2.5x, and a floor well above the
+    # reference's O(10^2-10^3)/s envelope.
+    assert r256["value"] >= 2.5 * r8["value"], (r8, r256)
+    assert r256["value"] >= 30_000, r256

@@ -39,6 +39,15 @@ UNRELIABLE_REP_DROP = 0.20
 # How many per-step PRNG subkeys to pre-split at once (see _next_key_locked).
 _KEY_BATCH = 256
 
+# Immediate-value tagging: small non-negative ints ride the device arrays
+# AS their value id (tagged with bit 30) — no intern store round-trip, no
+# refcount, nothing to GC.  The moral analog of tagged immediates in a
+# runtime: the device only ever agrees on int32 ids either way (values
+# never touch the TPU, kernel.py:33-34); for int payloads the id can BE
+# the payload.  Interned ids grow from 0 and are bounded by the live
+# window (G·I values at most), so the spaces cannot collide.
+IMM_BASE = 1 << 30
+
 
 class WindowFullError(RuntimeError):
     """No free instance slot: callers are outrunning Done()/Min() GC.
@@ -118,7 +127,7 @@ class PaxosFabric:
         self.intern = Intern()
 
         self._lock = threading.RLock()
-        self._pending_starts: list[tuple[int, int, int, int]] = []  # (g, slot, p, vid)
+        self._pending_starts: list[tuple[int, int, int, int, int]] = []  # (g, slot, p, vid, seq)
         self._pending_resets: list[tuple[int, int]] = []  # (g, slot)
         self._dead = np.zeros((G, P), bool)
 
@@ -176,6 +185,18 @@ class PaxosFabric:
             resets = self._pending_resets
             self._pending_starts = []
             self._pending_resets = []
+            s_arr = r_arr = None
+            if starts:
+                s_arr = np.asarray(starts, dtype=np.int64)  # (N, 5) cols: g, slot, p, vid, seq
+                # Drop starts whose slot was GC-recycled while they were
+                # queued (the slot no longer maps to their seq): arming the
+                # freed slot would run a ghost round with a value id whose
+                # intern ref the GC already dropped.
+                keep = (self._slot_seq[s_arr[:, 0], s_arr[:, 1]]
+                        == s_arr[:, 4])
+                s_arr = s_arr[keep] if not keep.all() else s_arr
+            if resets:
+                r_arr = np.asarray(resets, dtype=np.int64)  # (N, 2)
             if self._link_dev is None:
                 self._link_dev = jnp.asarray(self._link)
             link = self._link_dev
@@ -193,15 +214,15 @@ class PaxosFabric:
                 sub = self._next_key_locked()
 
         state = self._state
-        if starts or resets:
+        if s_arr is not None or r_arr is not None:
             reset = np.zeros((self.G, self.I), bool)
             sa = np.zeros((self.G, self.I, self.P), bool)
             sv = np.full((self.G, self.I, self.P), NO_VAL, np.int32)
-            for g, slot in resets:
-                reset[g, slot] = True
-            for g, slot, p, vid in starts:
-                sa[g, slot, p] = True
-                sv[g, slot, p] = vid
+            if r_arr is not None:
+                reset[r_arr[:, 0], r_arr[:, 1]] = True
+            if s_arr is not None and len(s_arr):
+                sa[s_arr[:, 0], s_arr[:, 1], s_arr[:, 2]] = True
+                sv[s_arr[:, 0], s_arr[:, 1], s_arr[:, 2]] = s_arr[:, 3]
             state = apply_starts(
                 state, jnp.asarray(reset), jnp.asarray(sa), jnp.asarray(sv)
             )
@@ -285,22 +306,27 @@ class PaxosFabric:
         stale = (self._slot_seq >= 0) & (self._slot_seq < gmin[:, None])
         if not stale.any():
             return
-        for g, slot in np.argwhere(stale):
-            g, slot = int(g), int(slot)
-            seq = int(self._slot_seq[g, slot])
+        gs, slots = np.nonzero(stale)
+        seqs = self._slot_seq[gs, slots]
+        # Array-side reclamation in bulk; only the dict/freelist/intern
+        # bookkeeping stays a (minimal) Python loop.
+        # Mirrors must stop reporting the old tenant immediately, and the
+        # wiped cells are deducted from the running decided count so
+        # decided_cells keeps crediting decisions that land in recycled
+        # slots (steady-state windowed throughput).
+        self._decided_cells -= int((self.m_decided[gs, slots, :] >= 0).sum())
+        self.m_decided[gs, slots, :] = NO_VAL
+        self._slot_seq[gs, slots] = -1
+        self._pending_resets.extend(zip(gs.tolist(), slots.tolist()))
+        decref = self.intern.decref
+        for g, slot, seq in zip(gs.tolist(), slots.tolist(), seqs.tolist()):
             del self._seq2slot[g][seq]
-            self._slot_seq[g, slot] = -1
             self._free[g].append(slot)
-            for vid in self._slot_vids[g][slot]:
-                self.intern.decref(vid)
-            self._slot_vids[g][slot] = []
-            self._pending_resets.append((g, slot))
-            # Mirrors must stop reporting the old tenant immediately.
-            # Deduct the wiped cells from the running decided count so the
-            # decided_cells counter keeps crediting decisions that land in
-            # recycled slots (steady-state windowed throughput).
-            self._decided_cells -= int((self.m_decided[g, slot, :] >= 0).sum())
-            self.m_decided[g, slot, :] = NO_VAL
+            vids = self._slot_vids[g][slot]
+            if vids:
+                for vid in vids:
+                    decref(vid)
+                self._slot_vids[g][slot] = []
 
     # ---------------------------------------------------------------- API
 
@@ -340,9 +366,12 @@ class PaxosFabric:
         # WindowFullError, and an intern ref taken first would never be
         # decref'd (leak under start-retry backpressure loops).
         slot = self._slot_for_locked(g, seq, create=True)
-        vid = self.intern.put(value)
-        self._slot_vids[g][slot].append(vid)
-        self._pending_starts.append((g, slot, p, vid))
+        if type(value) is int and 0 <= value < IMM_BASE:
+            vid = IMM_BASE | value  # immediate: no store, no refcount
+        else:
+            vid = self.intern.put(value)
+            self._slot_vids[g][slot].append(vid)
+        self._pending_starts.append((g, slot, p, vid, seq))
         if seq > self._max_seq[g, p]:
             self._max_seq[g, p] = seq
 
@@ -359,6 +388,8 @@ class PaxosFabric:
             vid = int(self.m_decided[g, slot, p])
             if vid < 0:
                 return Fate.PENDING, None
+            if vid >= IMM_BASE:
+                return Fate.DECIDED, vid - IMM_BASE
             return Fate.DECIDED, self.intern.get(vid)
 
     # ----------------------------------------------------- batched API
@@ -367,10 +398,47 @@ class PaxosFabric:
     # Semantics are exactly N calls of the scalar methods, in order.
 
     def start_many(self, ops) -> None:
-        """Batched Start: `ops` iterates (g, p, seq, value)."""
+        """Batched Start: `ops` iterates (g, p, seq, value).
+
+        Semantically N scalar start() calls; the body is the same logic with
+        the per-op numpy-scalar reads hoisted to plain-int lists (this is
+        the service driver's hottest call)."""
         with self._lock:
+            dead = self._dead.tolist()
+            pmin = self._peer_min.tolist()
+            s2s = self._seq2slot
+            item = self.m_decided.item
+            free = self._free
+            slot_seq = self._slot_seq
+            vids = self._slot_vids
+            put = self.intern.put
+            pend = self._pending_starts.append
+            mx = self._max_seq
             for g, p, seq, value in ops:
-                self._start_locked(g, p, seq, value)
+                if dead[g][p] or seq < pmin[g][p]:
+                    continue
+                slot = s2s[g].get(seq)
+                if slot is not None:
+                    if item(g, slot, p) >= 0:
+                        continue  # already decided locally
+                else:
+                    fl = free[g]
+                    if not fl:
+                        raise WindowFullError(
+                            f"group {g}: all {self.I} instance slots live; "
+                            f"call Done() to advance Min() "
+                            f"(global_min={self._global_min_locked(g)})")
+                    slot = fl.pop()
+                    slot_seq[g, slot] = seq
+                    s2s[g][seq] = slot
+                if type(value) is int and 0 <= value < IMM_BASE:
+                    vid = IMM_BASE | value  # immediate (see IMM_BASE)
+                else:
+                    vid = put(value)
+                    vids[g][slot].append(vid)
+                pend((g, slot, p, vid, seq))
+                if seq > mx[g, p]:
+                    mx[g, p] = seq
 
     def status_many(self, queries) -> list:
         """Batched Status: `queries` iterates (g, p, seq); returns a
@@ -378,25 +446,52 @@ class PaxosFabric:
         from tpu6824.core.peer import Fate
 
         out = []
+        append = out.append
+        forgotten = (Fate.FORGOTTEN, None)
+        pending = (Fate.PENDING, None)
+        decided = Fate.DECIDED
         with self._lock:
-            pmin = self._peer_min
+            # Hot loop: everything hoisted; pmin as a plain nested list so
+            # the per-query compare is int-vs-int, not a numpy scalar.
+            pmin = self._peer_min.tolist()
             dec = self.m_decided
+            item = dec.item
+            s2s = self._seq2slot
             get = self.intern.get
             for g, p, seq in queries:
-                if seq < pmin[g, p]:
-                    out.append((Fate.FORGOTTEN, None))
+                if seq < pmin[g][p]:
+                    append(forgotten)
                     continue
-                slot = self._seq2slot[g].get(seq)
-                vid = -1 if slot is None else int(dec[g, slot, p])
-                out.append((Fate.PENDING, None) if vid < 0
-                           else (Fate.DECIDED, get(vid)))
+                slot = s2s[g].get(seq)
+                vid = -1 if slot is None else item(g, slot, p)
+                if vid < 0:
+                    append(pending)
+                elif vid >= IMM_BASE:
+                    append((decided, vid - IMM_BASE))
+                else:
+                    append((decided, get(vid)))
         return out
 
     def done_many(self, items) -> None:
-        """Batched Done: `items` iterates (g, p, seq)."""
+        """Batched Done: `items` iterates (g, p, seq) — one vectorized
+        update + one row-min recompute per affected group, instead of a
+        per-call row reduction (the RSM drain calls Done once per applied
+        op per peer; this is the fabric's hottest write path)."""
+        items = items if isinstance(items, list) else list(items)
+        if not items:
+            return
+        arr = np.asarray(items, dtype=np.int64)
+        if (arr[:, 2] >= np.int64(2) ** 31).any():
+            raise OverflowError("done seq exceeds int32 (matches scalar "
+                                "done()'s loud failure)")
+        gs, ps, seqs = arr[:, 0], arr[:, 1], arr[:, 2].astype(np.int32)
         with self._lock:
-            for g, p, seq in items:
-                self._done_locked(g, p, seq)
+            np.maximum.at(self._done, (gs, ps), seqs)
+            # Own view updates without needing a message to self.
+            np.maximum.at(self.m_done_view, (gs, ps, ps), seqs)
+            gu = np.unique(gs)
+            self._peer_min[gu] = (
+                self.m_done_view[gu].min(axis=2).astype(np.int64) + 1)
 
     def done(self, g: int, p: int, seq: int) -> None:
         """paxos.Done (paxos/paxos.go:352-359)."""
