@@ -86,9 +86,12 @@ def child_main():
     # rises with the per-group instance window until HBM-bandwidth saturation
     # — I=64→19.6M/s, 256→68.6M/s, 1024→183.7M/s, 4096→274.7M/s,
     # 8192→592.1M/s, 16384→645.9M/s.  8192 sits near the knee with ample
-    # memory/compile headroom ((G,I,P) int32 state ≈ 100MB/array).  The CPU
-    # fallback exists to still emit the JSON line quickly, not to grind
-    # through the TPU-sized problem — small window there.
+    # memory/compile headroom ((G,I,P) int32 state ≈ 100MB/array).
+    # Re-measured 2026-07-30 at the default shape (BENCH_TPU_20260730.json):
+    # 664.7M/s best-case, 697.7M contended, 310.8M contended+lossy (packed
+    # masks; pre-dates the fused-cycle/prng kernel).  The CPU fallback
+    # exists to still emit the JSON line quickly, not to grind through the
+    # TPU-sized problem — small window there.
     G = int(os.environ.get("BENCH_GROUPS", 256 if on_cpu else 1024))
     I = int(os.environ.get("BENCH_INSTANCES", 32 if on_cpu else 8192))
     P = 3
@@ -110,7 +113,7 @@ def child_main():
             sa, sv = engine["arm"](nprop)
             dreq = jnp.full((G, P, P), drop_req, jnp.float32)
             drep = jnp.full((G, P, P), drop_rep, jnp.float32)
-            masked = bool(drop_req or drop_rep)
+            masked = engine["mode_for"](bool(drop_req or drop_rep))
             carry = engine["init"]()
             # warmup rep: compile + reach steady state
             carry, dec = engine["run"](
@@ -186,14 +189,15 @@ def child_main():
                 carry = alt_engine["init"]()
                 sa, sv = alt_engine["arm"](1)
                 zero = jnp.zeros((G, P, P), jnp.float32)
+                alt_rel = alt_engine["mode_for"](False)
                 carry, dec = alt_engine["run"](
                     carry, sa, sv, zero, zero,
-                    jax.random.split(jax.random.key(0), STEPS), False)
+                    jax.random.split(jax.random.key(0), STEPS), alt_rel)
                 jax.block_until_ready(dec)
                 t0 = time.perf_counter()
                 carry, dec = alt_engine["run"](
                     carry, sa, sv, zero, zero,
-                    jax.random.split(jax.random.key(1), STEPS), False)
+                    jax.random.split(jax.random.key(1), STEPS), alt_rel)
                 jax.block_until_ready(dec)
                 dt = time.perf_counter() - t0
                 decided = int(np.asarray(dec).sum())
@@ -204,7 +208,21 @@ def child_main():
         contended_rate, _ = measure(P, 0.0, 0.0, check_full=True)
         # Reference unreliable rates: 10% request drop, further 20% reply
         # drop (paxos/paxos.go:528-544).
-        lossy_rate, _ = measure(P, 0.10, 0.20)
+        prng_fallback = None
+        try:
+            lossy_rate, _ = measure(P, 0.10, 0.20)
+        except Exception as e:  # noqa: BLE001 — demote prng, keep the line
+            lm = engine.get("lossy_mode")
+            if lm is not None and lm["v"] == "prng":
+                print(f"bench: in-kernel prng lossy failed ({e!r}); "
+                      "retrying with packed masks", file=sys.stderr)
+                lm["v"] = "packed"
+                prng_fallback = f"prng mode failed: {e!r}"[:200]
+                lossy_rate, _ = measure(P, 0.10, 0.20)
+            else:
+                raise
+        lossy_mode = (engine["lossy_mode"]["v"]
+                      if "lossy_mode" in engine else "xla")
         dist = distribution(P, 0.10, 0.20)
         wire = _wire_rate()
         # API-driven configs (never cost the headline line on failure):
@@ -217,12 +235,23 @@ def child_main():
         except Exception as e:  # noqa: BLE001
             service["clerk"] = {"value": 0.0, "error": repr(e)[:200]}
 
-        # Roofline context: bytes moved per step — 7 (G,I,P) i32 state
-        # arrays read + 6 written; masks are 5 (G,I,P,P) i32 on the XLA
-        # path, ONE packed i32 bitplane array on the Pallas lossy path, and
-        # absent on the Pallas reliable fast path.
-        state_bytes = 13 * G * I * P * 4
-        mask_bytes = (G * I * P * P * 4 if impl == "pallas"
+        # Roofline context: bytes moved per BEST-CASE step.
+        #  - pallas: the fused cycle is one kernel — reads 7 state + sa +
+        #    sv, writes 7 state + msgs (all (P, N) i32) + rec (1, N).
+        #  - xla: the reliable cycle is recycle-read (dec) + apply_starts
+        #    (7r+7w + sa/sv/reset) + round (7r+6w+io), ~32 (G,I,P)-array
+        #    passes before XLA fusion (an upper bound; fusion trims it).
+        #  Best-case runs draw NO masks on either engine (reliable fast
+        #  paths); mask traffic exists only in the lossy config — 5
+        #  (G,I,P,P) draws on XLA, ONE packed bitplane array in pallas
+        #  packed mode, ZERO in prng mode (in-kernel draws).
+        N_cells = G * I
+        if impl == "pallas":
+            state_bytes = (17 * P + 1) * N_cells * 4
+        else:
+            state_bytes = 32 * N_cells * P * 4
+        mask_bytes = (0 if lossy_mode == "prng"
+                      else G * I * P * P * 4 if impl == "pallas"
                       else 5 * G * I * P * P * 4)
         out = {
             "metric": (f"decided_paxos_instances_per_sec"
@@ -234,8 +263,7 @@ def child_main():
             "kernel": impl,
             "shape": {"G": G, "I": I, "P": P, "steps": STEPS, "reps": reps},
             "steps_per_sec": round(STEPS / best_dt, 2),
-            "approx_bytes_per_step": state_bytes + (
-                0 if impl == "pallas" else mask_bytes),
+            "approx_bytes_per_step": state_bytes,
             "approx_bytes_per_step_lossy": state_bytes + mask_bytes,
             "contended": {
                 "value": round(contended_rate, 1),
@@ -245,14 +273,19 @@ def child_main():
                 "value": round(lossy_rate, 1),
                 "note": (f"{P} dueling proposers/instance, "
                          "10% req / 20% reply drop"),
+                "mask_impl": lossy_mode,
                 "steps_to_decide": dist,
             },
             "wire": wire,
             "service": service,
+            "roofline": _roofline(
+                jax, jnp, on_cpu, impl, state_bytes, STEPS / best_dt),
             "bench_seconds": round(time.time() - t_start, 1),
         }
         if alt is not None:
             out["alt_kernel_best"] = alt
+        if prng_fallback:
+            out["prng_fallback"] = prng_fallback
         return out
 
     try:
@@ -325,23 +358,30 @@ def _xla_engine(jax, jnp, np, G, I, P, link, done):
         "arm": arm,
         "run": run_j,
         "dist": dist,
+        "mode_for": lambda masked: masked,
     }
 
 
 def _lane_engine(jax, jnp, np, G, I, P, link, done, on_cpu):
-    """Bench engine over lane-resident state + the fused Pallas round.
-    State never leaves the (P, Np) layout between steps; reliable configs
-    run the maskless fast path (masked=False)."""
+    """Bench engine over lane-resident state + the fused Pallas CYCLE
+    (recycle+arm+round in one kernel — a single HBM round trip per step).
+    Lossy configs draw delivery bits from the in-kernel counter PRNG on
+    real hardware (mode='prng': mask HBM traffic = zero); on CPU, where
+    the TPU interpreter stubs the PRNG, they fall back to the packed
+    bitplane masks.  `lossy_mode['v']` is mutable so the caller can demote
+    prng→packed if the hardware path fails (never cost the line)."""
     import functools
 
     from tpu6824.core.kernel import init_state
     from tpu6824.core.pallas_kernel import (
-        _block, apply_starts_lane, paxos_step_lanes, to_lane_state,
+        _block, paxos_cycle_lanes, paxos_step_lanes, to_lane_state,
     )
 
     N = G * I
     _, Np = _block(N)
     interp = on_cpu  # off-TPU the kernel runs in interpret mode
+    lossy_mode = {"v": os.environ.get("BENCH_LOSSY_MODE",
+                                      "packed" if on_cpu else "prng")}
 
     def arm(nprop):
         sa = np.zeros((P, Np), np.int32)
@@ -357,16 +397,16 @@ def _lane_engine(jax, jnp, np, G, I, P, link, done, on_cpu):
         dv = jnp.full((G, P, P), -1, jnp.int32)
         return (l, dv)
 
-    @functools.partial(jax.jit, static_argnames=("masked",))
-    def run_j(carry, sa, sv, dreq, drep, keys, masked):
+    @functools.partial(jax.jit, static_argnames=("mode",))
+    def run_j(carry, sa, sv, dreq, drep, keys, mode):
         def cycle(carry, key):
             l, dv = carry
-            recycled = (l.dec >= 0).any(axis=0)              # (Np,)
-            l = apply_starts_lane(l, recycled, sa, sv)
-            l, dv, _msgs = paxos_step_lanes(
-                l, dv, link, done, key, dreq, drep,
-                G=G, I=I, masked=masked, interpret=interp)
-            return (l, dv), recycled.sum(dtype=jnp.int32)
+            l, dv, rec, _msgs = paxos_cycle_lanes(
+                l, dv, done, key, sa, sv, link=link,
+                drop_req=dreq, drop_rep=drep,
+                req_rate=dreq[0, 0, 1], rep_rate=drep[0, 0, 1],
+                G=G, I=I, mode=mode, interpret=interp)
+            return (l, dv), rec.sum(dtype=jnp.int32)
         return jax.lax.scan(cycle, carry, keys)
 
     @functools.partial(jax.jit, static_argnames=("masked",))
@@ -385,6 +425,8 @@ def _lane_engine(jax, jnp, np, G, I, P, link, done, on_cpu):
         return first
 
     def dist(sa, sv, dreq, drep, max_steps):
+        from tpu6824.core.pallas_kernel import apply_starts_lane
+
         l, dv = init()
         l = apply_starts_lane(l, jnp.zeros((Np,), bool), sa, sv)
         idx = jnp.arange(max_steps, dtype=jnp.int32)
@@ -396,7 +438,60 @@ def _lane_engine(jax, jnp, np, G, I, P, link, done, on_cpu):
         "arm": arm,
         "run": run_j,
         "dist": dist,
+        "mode_for": lambda masked: lossy_mode["v"] if masked else "reliable",
+        "lossy_mode": lossy_mode,
     }
+
+
+def _measure_bandwidth(jax, jnp, on_cpu):
+    """In-situ achievable memory bandwidth: a jitted elementwise pass over a
+    large array (reads N + writes N bytes), timed like the kernel reps.
+    This is the roof the consensus round's HBM traffic is judged against —
+    measured on the same device, same dispatch path, same timer."""
+    import time as _t
+
+    n = (16 << 20) if on_cpu else (128 << 20)  # elements (i32)
+    x = jnp.zeros((n,), jnp.int32)
+
+    @jax.jit
+    def touch(a):
+        return a + 1
+
+    x = touch(x)
+    jax.block_until_ready(x)
+    best = float("inf")
+    for _ in range(3):
+        t0 = _t.perf_counter()
+        x = touch(x)
+        jax.block_until_ready(x)
+        best = min(best, _t.perf_counter() - t0)
+    return 2.0 * 4 * n / best  # read + write
+
+
+def _roofline(jax, jnp, on_cpu, impl, bytes_per_step, steps_per_sec):
+    """VERDICT r3 task 3: state what fraction of the chip the best-case
+    run uses, against an in-situ copy-bandwidth roof.  bytes_per_step is
+    the engine's full cycle traffic (pallas: one fused kernel; xla: the
+    unfused upper bound — see the byte model where it is computed)."""
+    try:
+        bw = _measure_bandwidth(jax, jnp, on_cpu)
+        achieved = bytes_per_step * steps_per_sec
+        frac = achieved / bw if bw else 0.0
+        note = ("full steady-state cycle traffic for the measured "
+                f"'{impl}' engine")
+        if frac < 0.30:
+            note += ("; <30% of copy roof: per-cell op depth (unrolled "
+                     "P^2 edge arithmetic on the VPU) bounds the cycle, "
+                     "not HBM — next lever is shrinking per-edge work, "
+                     "not traffic")
+        return {
+            "device_copy_bw_bytes_per_sec": round(bw, 1),
+            "achieved_bytes_per_sec": round(achieved, 1),
+            "bw_fraction": round(frac, 4),
+            "note": note,
+        }
+    except Exception as e:  # noqa: BLE001 — never cost the line
+        return {"error": repr(e)[:200]}
 
 
 def _service_rate():

@@ -282,3 +282,108 @@ def test_get_step_dispatch(monkeypatch):
     assert fn is not xla_step
     with pytest.raises(ValueError):
         get_step("cuda")
+
+
+# ---------------------------------------------------------------- fused cycle
+
+
+def _lane_setup(G=2, I=32, P=3, nprop=1):
+    from tpu6824.core.pallas_kernel import _block, to_lane_state
+
+    N = G * I
+    _, Np = _block(N)
+    sa = np.zeros((P, Np), np.int32)
+    sv = np.full((P, Np), -1, np.int32)
+    base = np.arange(N, dtype=np.int32) * P + 1
+    for p in range(nprop):
+        sa[p, :N] = 1
+        sv[p, :N] = base + p
+    l = to_lane_state(init_state(G, I, P))
+    dv = jnp.full((G, P, P), -1, jnp.int32)
+    return l, dv, jnp.asarray(sa), jnp.asarray(sv), Np
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_fused_cycle_equals_split_cycle(masked):
+    """paxos_cycle_lanes (recycle+arm+round in ONE kernel) is bit-identical
+    to apply_starts_lane + paxos_step_lanes over multi-step recycling
+    schedules, in both reliable and packed-mask modes."""
+    from tpu6824.core.pallas_kernel import (
+        apply_starts_lane, paxos_cycle_lanes, paxos_step_lanes,
+    )
+
+    G, I, P = 2, 32, 3
+    la, dva, sa, sv, Np = _lane_setup(G, I, P, nprop=P)
+    lb, dvb = jax.tree.map(jnp.copy, la), jnp.copy(dva)
+    link = jnp.ones((G, P, P), bool)
+    drop = jnp.full((G, P, P), 0.15 if masked else 0.0, jnp.float32)
+    mode = "packed" if masked else "reliable"
+    key = jax.random.key(3)
+    for step in range(5):
+        # Non-trivial, advancing Done marks so the done_view comparison is
+        # meaningful (piggyback rides post-arm prepare traffic).
+        done = jnp.full((G, P), step - 1, jnp.int32)
+        key, sub = jax.random.split(key)
+        # Split path (the old bench cycle):
+        recycled = (la.dec >= 0).any(axis=0)
+        la = apply_starts_lane(la, recycled, sa, sv)
+        la, dva, _m = paxos_step_lanes(
+            la, dva, link, done, sub, drop, drop,
+            G=G, I=I, masked=masked, interpret=True)
+        # Fused path:
+        lb, dvb, rec, _m2 = paxos_cycle_lanes(
+            lb, dvb, done, sub, sa, sv, link=link,
+            drop_req=drop, drop_rep=drop,
+            G=G, I=I, mode=mode, interpret=True)
+        for name, x, y in zip(la._fields, la, lb):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y),
+                err_msg=f"step {step} field {name}")
+        np.testing.assert_array_equal(np.asarray(dva), np.asarray(dvb))
+        assert int(rec.sum()) == int(recycled.sum()), step
+
+
+def test_prng_mode_zero_drop_equals_reliable():
+    """mode='prng' at drop 0 keeps every edge regardless of the drawn bits
+    (threshold 0), so it must be bit-identical to the reliable fast path —
+    this exercises the in-kernel PRNG plumbing on CPU, where the TPU
+    interpreter stubs the bits (real draws only exist on hardware)."""
+    from tpu6824.core.pallas_kernel import paxos_cycle_lanes
+
+    G, I, P = 1, 16, 3
+    la, dva, sa, sv, Np = _lane_setup(G, I, P, nprop=P)
+    lb, dvb = jax.tree.map(jnp.copy, la), jnp.copy(dva)
+    done = jnp.full((G, P), -1, jnp.int32)
+    key = jax.random.key(11)
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        la, dva, _r, ma = paxos_cycle_lanes(
+            la, dva, done, sub, sa, sv, G=G, I=I, mode="reliable",
+            interpret=True)
+        lb, dvb, _r2, mb = paxos_cycle_lanes(
+            lb, dvb, done, sub, sa, sv, G=G, I=I, mode="prng",
+            req_rate=0.0, rep_rate=0.0, interpret=True)
+        for name, x, y in zip(la._fields, la, lb):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), err_msg=f"field {name}")
+        assert int(ma) == int(mb)
+    assert (np.asarray(la.dec)[:, : G * I] >= 0).all()
+
+
+def test_prng_mode_total_loss_is_safe():
+    """mode='prng' at drop 1.0 delivers self-edges only: no quorum, no
+    decision, no crash — safety under total loss (and, on CPU, exactly
+    what the interpreter's stubbed all-zero bits would produce for any
+    threshold: the degenerate corner is the portable one)."""
+    from tpu6824.core.pallas_kernel import paxos_cycle_lanes
+
+    G, I, P = 1, 16, 3
+    l, dv, sa, sv, _ = _lane_setup(G, I, P, nprop=P)
+    done = jnp.full((G, P), -1, jnp.int32)
+    key = jax.random.key(5)
+    for _ in range(4):
+        key, sub = jax.random.split(key)
+        l, dv, _r, _m = paxos_cycle_lanes(
+            l, dv, done, sub, sa, sv, G=G, I=I, mode="prng",
+            req_rate=1.0, rep_rate=1.0, interpret=True)
+    assert (np.asarray(l.dec) < 0).all(), "decided without a quorum"

@@ -48,6 +48,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from tpu6824.core.kernel import NO_VAL, PaxosState, StepIO, _edge_masks
 
@@ -58,36 +59,85 @@ LANES = 128  # TPU lane width; cell blocks are multiples of this
 _BIT_M1, _BIT_M2, _BIT_M3, _BIT_R1, _BIT_R2 = range(5)
 
 
-def _round_kernel(P: int, masked: bool, *refs):
+def _round_kernel(P: int, mode: str, cycle: bool, *refs):
     """One consensus round for a (P, C) block of cells.
 
-    refs (masked):   np, na, va, dec, act, propv, ms, mask | 6 outputs
-    refs (maskless): np, na, va, dec, act, propv, ms       | 6 outputs
-    State refs are (P, C) int32; mask is (P, P, C) int32 bitplanes
-    (bit 0..4 = M1, M2, M3, R1, R2).  Every operand below is a (1, C) lane
+    `mode` selects the delivery-mask source:
+      - "reliable": no masks at all — the edge predicate folds to constant
+        True (zero mask HBM traffic);
+      - "packed":   one (P, P, C) int32 bitplane input, bits 0..4 =
+        M1, M2, M3, R1, R2 — generated XLA-side with the exact splits of
+        the XLA oracle (bit-identical consensus state under the same key);
+      - "prng":     NO mask input: delivery bits are drawn IN-KERNEL from
+        the TPU's counter PRNG, seeded per (step, block) from a 3-int32
+        SMEM config [seed, thresh_req, thresh_rep] (thresh = drop
+        probability in 1/2^24 units).  Mask HBM traffic: zero.  Only
+        distributionally equivalent to the oracle (different stream).
+
+    `cycle=True` additionally fuses the bench/steady-state recycle+arm
+    (`apply_starts_lane`) into the same VMEM round trip: cells whose `dec`
+    is set are reset, then `sa/sv` arm proposers, then the round runs —
+    one pass over HBM for what was previously three (recycle read, arm
+    read/write, round read/write).  Outputs grow to include act/propv and
+    a per-cell recycled indicator.
+
+    refs order: [cfg?] np, na, va, dec, act, propv, ms, [sa, sv], [mask],
+    then outputs: np, na, va, dec, ms, [act, propv, rec], msgs.
+    State refs are (P, C) int32.  Every operand below is a (1, C) lane
     vector; loops over the peer axis are unrolled at trace time.
     """
-    if masked:
-        (np_ref, na_ref, va_ref, dec_ref, act_ref, propv_ref, ms_ref,
-         mask_ref,
-         np_out, na_out, va_out, dec_out, ms_out, msgs_out) = refs
+    refs = list(refs)
+    cfg_ref = refs.pop(0) if mode == "prng" else None
+    (np_ref, na_ref, va_ref, dec_ref, act_ref, propv_ref, ms_ref) = refs[:7]
+    refs = refs[7:]
+    if cycle:
+        sa_ref, sv_ref = refs[:2]
+        refs = refs[2:]
+    mask_ref = refs.pop(0) if mode == "packed" else None
+    if cycle:
+        (np_out, na_out, va_out, dec_out, ms_out,
+         act_out, propv_out, rec_out, msgs_out) = refs
     else:
-        (np_ref, na_ref, va_ref, dec_ref, act_ref, propv_ref, ms_ref,
-         np_out, na_out, va_out, dec_out, ms_out, msgs_out) = refs
+        (np_out, na_out, va_out, dec_out, ms_out, msgs_out) = refs
 
     C = np_ref.shape[1]
 
     def row(ref, p):
         return ref[p:p + 1, :]
 
-    if masked:
+    tru = jnp.ones((1, C), dtype=bool)
+    if mode == "packed":
         def edge(bit, p, q):
             return ((mask_ref[p, q:q + 1, :] >> bit) & 1) != 0
-    else:
-        # Reliable, fully-connected fast path: the edge predicate is the
-        # constant True vector, which Mosaic folds out of every AND below.
-        tru = jnp.ones((1, C), dtype=bool)
+    elif mode == "prng":
+        # Seed once per (step, block): same step+block => same stream.
+        pltpu.prng_seed(cfg_ref[0], pl.program_id(0))
+        thresh = [cfg_ref[1], cfg_ref[1], cfg_ref[1],  # M1..M3: req drop
+                  cfg_ref[2], cfg_ref[2]]              # R1, R2: reply drop
+        # Draw every directed edge's keep bit up front, in a fixed trace
+        # order (edge() below must be a pure read — several phases consult
+        # the same plane twice).  Self-edges always deliver.
+        planes = []
+        for b in range(5):
+            t = thresh[b]
+            plane = []
+            for p in range(P):
+                prow = []
+                for q in range(P):
+                    if p == q:
+                        prow.append(tru)
+                    else:
+                        bits = pltpu.prng_random_bits((1, C))
+                        r = jax.lax.shift_right_logical(
+                            bits.astype(I32), 8) & jnp.int32(0xFFFFFF)
+                        prow.append(r >= t)
+                plane.append(prow)
+            planes.append(plane)
 
+        def edge(bit, p, q):
+            return planes[bit][p][q]
+    else:  # reliable, fully-connected fast path: the edge predicate is
+        # the constant True vector, which Mosaic folds out of every AND.
         def edge(bit, p, q):
             return tru
 
@@ -98,6 +148,26 @@ def _round_kernel(P: int, masked: bool, *refs):
     active = [row(act_ref, p) != 0 for p in range(P)]
     propv = [row(propv_ref, p) for p in range(P)]
     maxseen = [row(ms_ref, p) for p in range(P)]
+
+    if cycle:
+        # ---- Fused recycle + arm (apply_starts_lane semantics) ----------
+        rec = dec_pre[0] >= 0
+        for p in range(1, P):
+            rec = rec | (dec_pre[p] >= 0)
+        zero_ = jnp.zeros((1, C), I32)
+        noval = zero_ + NO_VAL
+        np_pre = [jnp.where(rec, zero_, x) for x in np_pre]
+        na_pre = [jnp.where(rec, zero_, x) for x in na_pre]
+        va_pre = [jnp.where(rec, noval, x) for x in va_pre]
+        dec_pre = [jnp.where(rec, noval, x) for x in dec_pre]
+        active = [a & ~rec for a in active]
+        propv = [jnp.where(rec, noval, x) for x in propv]
+        maxseen = [jnp.where(rec, zero_, x) for x in maxseen]
+        for p in range(P):
+            arm = (row(sa_ref, p) != 0) & (dec_pre[p] < 0)
+            active[p] = active[p] | arm
+            propv[p] = jnp.where(arm & (propv[p] < 0), row(sv_ref, p),
+                                 propv[p])
 
     # n = k·P + p + 1: globally unique, > maxseen (kernel.py:137).
     n_prop = [(maxseen[p] // P + 1) * P + (p + 1) for p in range(P)]
@@ -213,6 +283,12 @@ def _round_kernel(P: int, masked: bool, *refs):
     dec_out[...] = jnp.concatenate(dec_new, axis=0)
     ms_out[...] = jnp.concatenate(ms_new, axis=0)
     msgs_out[...] = jnp.concatenate(msgs, axis=0)
+    if cycle:
+        act_out[...] = jnp.concatenate(
+            [(active[p] & (dec_new[p] < 0)).astype(I32) for p in range(P)],
+            axis=0)
+        propv_out[...] = jnp.concatenate(propv, axis=0)
+        rec_out[...] = rec.astype(I32)
 
 
 # --------------------------------------------------------------------------
@@ -318,29 +394,63 @@ def apply_starts_lane(l: LaneState, reset: jnp.ndarray,
                      propv=propv, ms=ms)
 
 
-def _lane_round(l: LaneState, packed_mask, interpret: bool):
-    """Invoke the fused round on lane-resident state.  `packed_mask` is the
-    (P, P, Np) int32 bitplane array, or None for the reliable fast path."""
+def _lane_round(l: LaneState, packed_mask, interpret,
+                *, mode=None, cycle=False, sa=None, sv=None, cfg=None):
+    """Invoke the fused round on lane-resident state.
+
+    Back-compat form: `packed_mask` is the (P, P, Np) int32 bitplane array
+    (mode="packed") or None (mode="reliable").  `mode` overrides when
+    given.  With `cycle=True`, sa/sv (P, Np) i32 arm inputs are fused in
+    and the return gains the per-cell recycled vector (see _round_kernel).
+    mode="prng" requires `cfg` = int32[3] [seed, thresh_req, thresh_rep]
+    and, off-TPU, the TPU interpreter (plain interpret mode has no PRNG
+    rules; InterpretParams emulates them — degenerately, all-zero bits)."""
     P, Np = l.np_.shape
     C, _ = _block(Np)  # Np is already block-aligned
-    masked = packed_mask is not None
+    if mode is None:
+        mode = "packed" if packed_mask is not None else "reliable"
+    if mode == "prng" and interpret is True:
+        interpret = pltpu.InterpretParams()
 
     cell = pl.BlockSpec((P, C), lambda i: (0, i))
     edge_spec = pl.BlockSpec((P, P, C), lambda i: (0, 0, i))
     out_shape = jax.ShapeDtypeStruct((P, Np), I32)
-    ops = [l.np_, l.na, l.va, l.dec, l.act, l.propv, l.ms]
-    in_specs = [cell] * 7
-    if masked:
+    ops = []
+    in_specs = []
+    if mode == "prng":
+        ops.append(cfg)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    ops += [l.np_, l.na, l.va, l.dec, l.act, l.propv, l.ms]
+    in_specs += [cell] * 7
+    if cycle:
+        ops += [sa, sv]
+        in_specs += [cell, cell]
+    if mode == "packed":
         ops.append(packed_mask)
         in_specs.append(edge_spec)
+    rec_spec = pl.BlockSpec((1, C), lambda i: (0, i))
+    if cycle:
+        # np, na, va, dec, ms, act, propv, rec, msgs
+        out_specs = [cell] * 7 + [rec_spec, cell]
+        out_shape_l = ([out_shape] * 7
+                       + [jax.ShapeDtypeStruct((1, Np), I32), out_shape])
+    else:
+        out_specs = [cell] * 6
+        out_shape_l = [out_shape] * 6
     outs = pl.pallas_call(
-        functools.partial(_round_kernel, P, masked),
+        functools.partial(_round_kernel, P, mode, cycle),
         grid=(Np // C,),
         in_specs=in_specs,
-        out_specs=[cell] * 6,
-        out_shape=[out_shape] * 6,
+        out_specs=out_specs,
+        out_shape=out_shape_l,
         interpret=interpret,
     )(*ops)
+    if cycle:
+        (np_post2, na_new, va_new, dec_new, ms_new,
+         act_new, propv_new, rec, msgs_l) = outs
+        l2 = LaneState(np_=np_post2, na=na_new, va=va_new, dec=dec_new,
+                       act=act_new, propv=propv_new, ms=ms_new)
+        return l2, msgs_l, rec
     np_post2, na_new, va_new, dec_new, ms_new, msgs_l = outs
     act_new = ((l.act != 0) & (dec_new < 0)).astype(I32)
     l2 = LaneState(np_=np_post2, na=na_new, va=va_new, dec=dec_new,
@@ -407,12 +517,9 @@ def paxos_step_lanes(
         # Done piggyback (paxos/rpc.go:74-80): rides prepare traffic + the
         # once-per-step heartbeat (bit-identical to the XLA path at drop=0,
         # where the heartbeat covers every live edge).
-        act_gip = (_from_lanes(l.act, G, I, P, N) != 0)
-        anymsg1 = (M1 & act_gip[..., :, None]).any(axis=1)  # (G, src, dst)
-        hb = _edge_masks(khb, (G, P, P), (link | eye), drop_req, eye)
-        gotmsg = jnp.swapaxes(anymsg1 | hb, -1, -2)
-        done_view = jnp.maximum(
-            done_view, jnp.where(gotmsg, done[:, None, :], -1))
+        done_view = _done_gossip_packed(
+            l.act, M1, khb, link, drop_req, done_view, done, G, I, P, N,
+            eye)
     else:
         l2, msgs_l = _lane_round(l, None, interpret)
         # Reliable full mesh: every peer hears every peer each step.
@@ -421,6 +528,106 @@ def paxos_step_lanes(
         done_view, jnp.where(eye[None], done[:, None, :], -1))
     msgs = msgs_l[:, :N].sum().astype(I32)
     return l2, done_view, msgs
+
+
+def _done_gossip_packed(act_lanes, M1, khb, link, drop_req, done_view, done,
+                        G, I, P, N, eye):
+    """Done piggyback (paxos/rpc.go:74-80) for packed-mask rounds: rides
+    the prepare traffic of the given (post-arm) active set plus the
+    once-per-step heartbeat over live links.  Shared by the step and the
+    fused cycle so the two paths cannot drift."""
+    act_gip = _from_lanes(act_lanes, G, I, P, N) != 0
+    anymsg1 = (M1 & act_gip[..., :, None]).any(axis=1)  # (G, src, dst)
+    hb = _edge_masks(khb, (G, P, P), (link | eye), drop_req, eye)
+    gotmsg = jnp.swapaxes(anymsg1 | hb, -1, -2)
+    return jnp.maximum(done_view, jnp.where(gotmsg, done[:, None, :], -1))
+
+
+@functools.partial(jax.jit, static_argnames=("G", "I", "mode", "interpret"))
+def paxos_cycle_lanes(
+    l: LaneState,
+    done_view: jnp.ndarray,  # (G, P, P) i32
+    done: jnp.ndarray,       # (G, P) i32
+    key: jnp.ndarray,        # per-step PRNG key
+    sa: jnp.ndarray,         # (P, Np) i32 — arm pattern for recycled cells
+    sv: jnp.ndarray,         # (P, Np) i32 — arm values
+    link=None,               # (G, P, P) bool — packed mode only
+    drop_req=None,           # (G, P, P) f32 — packed mode only
+    drop_rep=None,           # (G, P, P) f32 — packed mode only
+    *,
+    G: int,
+    I: int,
+    mode: str = "reliable",
+    req_rate=0.0,            # prng mode: uniform request-drop probability
+    rep_rate=0.0,            # prng mode: uniform reply-drop probability
+    interpret=False,
+):
+    """One fused steady-state CYCLE: recycle decided cells → arm via sa/sv
+    → full prepare/accept/decide round — a single HBM round trip for what
+    `apply_starts_lane` + `paxos_step_lanes` do in three (VERDICT r3
+    roofline item: the bench cycle's true traffic was ~2x the round's).
+
+    mode="prng" additionally draws the lossy-network delivery bits from
+    the in-kernel counter PRNG (seeded per step+block from `key`), so the
+    unreliable path's HBM traffic is the state arrays and nothing else —
+    no (G, I, P, P) Bernoulli materialization, no packed bitplanes
+    (VERDICT r3 task 2; the reference behavior being modeled is the
+    accept-loop coin flip, paxos/paxos.go:528-544).  The XLA path stays
+    the bit-exact oracle; prng mode is distributionally equivalent.
+    Assumes a fully-connected link (the bench's unreliable config);
+    partitioned/heterogeneous networks use mode="packed".
+
+    Returns (LaneState, done_view, recycled (1, Np) i32, msgs scalar).
+    """
+    P = l.np_.shape[0]
+    N = G * I
+    eye = jnp.eye(P, dtype=bool)
+    full = jnp.ones((G, P, P), bool)
+
+    if mode == "packed":
+        packed, M1, khb = _pack_masks(
+            key, G, I, P, link, drop_req, drop_rep, l.np_.shape[1])
+        # The round's prepare senders are the POST-recycle/arm actives
+        # (the fused kernel recycles and arms before phase 1); recompute
+        # that view here for the Done piggyback so packed-mode cycle and
+        # split apply_starts_lane+paxos_step_lanes agree on done_view.
+        rec_pre = (l.dec >= 0).any(axis=0)[None, :]      # (1, Np)
+        act_post = (((l.act != 0) & ~rec_pre)
+                    | ((sa != 0) & (rec_pre | (l.dec < 0))))
+        l2, msgs_l, rec = _lane_round(l, packed, interpret, cycle=True,
+                                      sa=sa, sv=sv)
+        done_view = _done_gossip_packed(
+            act_post, M1, khb, link, drop_req, done_view, done,
+            G, I, P, N, eye)
+    elif mode == "prng":
+        # 24-bit drop thresholds; the kernel keeps an edge iff its draw's
+        # bits 8..31 >= thresh.
+        scale = jnp.float32(1 << 24)
+        tq = jnp.clip(jnp.round(jnp.float32(req_rate) * scale),
+                      0, scale).astype(I32)
+        tp = jnp.clip(jnp.round(jnp.float32(rep_rate) * scale),
+                      0, scale).astype(I32)
+        seed = jax.lax.bitcast_convert_type(
+            jax.random.key_data(key).ravel()[-1], jnp.int32)
+        cfg = jnp.stack([seed, tq, tp])
+        l2, msgs_l, rec = _lane_round(l, None, interpret, mode="prng",
+                                      cycle=True, sa=sa, sv=sv, cfg=cfg)
+        # Done piggyback: once-per-step heartbeat over the lossy net (the
+        # kernel's deliveries aren't observable host-side in this mode —
+        # same information flow, one gossip opportunity per step).
+        hbdrop = jnp.full((G, P, P), req_rate, jnp.float32)
+        hb = _edge_masks(key, (G, P, P), full, hbdrop, eye)
+        gotmsg = jnp.swapaxes(hb, -1, -2)
+        done_view = jnp.maximum(
+            done_view, jnp.where(gotmsg, done[:, None, :], -1))
+    else:
+        l2, msgs_l, rec = _lane_round(l, None, interpret, cycle=True,
+                                      sa=sa, sv=sv)
+        done_view = jnp.maximum(done_view, done[:, None, :])
+    done_view = jnp.maximum(
+        done_view, jnp.where(eye[None], done[:, None, :], -1))
+    msgs = msgs_l[:, :N].sum().astype(I32)
+    return l2, done_view, rec[:, :N], msgs
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
