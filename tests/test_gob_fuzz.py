@@ -8,7 +8,7 @@ import random
 import pytest
 
 from tpu6824.shim.gob import (
-    BOOL, BYTES, FLOAT, INT, STRING, UINT,
+    BOOL, BYTES, FLOAT, INT, INTERFACE, STRING, UINT,
     Array, Decoder, Encoder, GobError, Map, Slice, Struct, complete,
 )
 
@@ -114,3 +114,143 @@ def test_truncation_never_hangs_or_passes(seed):
     # A cut can still leave ≥1 whole message (type defs + value) intact;
     # then the decode must be CORRECT, not garbage.
     assert complete(schema, got) == complete(schema, v)
+
+
+# --------------------------------------------------------------------------
+# Differential fuzz: production Encoder vs the independent SpecEncoder
+# (VERDICT r3 task 4 — the strongest in-image substitute for the blocked
+# Go-side run).  Two implementations, one spec: every random schema/value
+# must produce byte-identical streams, including interface values, nested
+# structs, and named-type (shared typedef) collapse; and the production
+# Decoder must correctly decode the SPEC encoder's bytes.
+
+from tests.test_gob_conformance import (  # noqa: E402
+    SPEC_REG, SpecEncoder, decode_one, prod_encode,
+)
+from tpu6824.shim import wire as _wire  # noqa: E402
+
+_IFACE_CHOICES = [None, "string", "int", "kvpaxos.Op"]
+
+
+def rand_type_diff(rng: random.Random, pool: list, depth: int = 0):
+    """Like rand_type, plus INTERFACE leaves and named-type reuse: a
+    previously generated Struct can appear again anywhere in the schema,
+    so both encoders must collapse it to one typedef/id."""
+    choices = list(_PRIMS) + ["iface"]
+    if depth < 3:
+        choices += ["slice", "array", "map", "struct", "struct"]
+        if pool:
+            choices += ["reuse", "reuse"]
+    t = rng.choice(choices)
+    if t == "iface":
+        return INTERFACE
+    if t == "reuse":
+        return rng.choice(pool)
+    if t == "slice":
+        return Slice(rand_type_diff(rng, pool, depth + 1))
+    if t == "array":
+        return Array(rng.randint(1, 4), rand_type_diff(rng, pool, depth + 1))
+    if t == "map":
+        return Map(rng.choice([INT, STRING, UINT]),
+                   rand_type_diff(rng, pool, depth + 1))
+    if t == "struct":
+        nf = rng.randint(0, 5)
+        s = Struct(f"D{len(pool)}_{rng.randint(0, 99)}",
+                   [(f"F{i}", rand_type_diff(rng, pool, depth + 1))
+                    for i in range(nf)])
+        pool.append(s)
+        return s
+    return t
+
+
+def rand_value_diff(rng: random.Random, t):
+    if t is INTERFACE:
+        name = rng.choice(_IFACE_CHOICES)
+        if name is None:
+            return None
+        if name == "string":
+            return ("string", "".join(rng.choice("abc ∂") for _ in
+                                      range(rng.randint(0, 6))))
+        if name == "int":
+            return ("int", rng.randint(-10**9, 10**9))
+        return ("kvpaxos.Op", rand_value_diff(rng, _wire.KV_OP))
+    if isinstance(t, Slice):
+        return [rand_value_diff(rng, t.elem) for _ in range(rng.randint(0, 4))]
+    if isinstance(t, Array):
+        return [rand_value_diff(rng, t.elem) for _ in range(t.length)]
+    if isinstance(t, Map):
+        return {rand_value_diff(rng, t.kt): rand_value_diff(rng, t.vt)
+                for _ in range(rng.randint(0, 4))}
+    if isinstance(t, Struct):
+        return {n: rand_value_diff(rng, ft) for n, ft in t.fields}
+    return rand_value(rng, t)
+
+
+def _complete_diff(t, v):
+    """gob.complete, extended to normalize interface payloads (whose
+    concrete schema comes from the registered name, unknowable to the
+    static completer)."""
+    from tpu6824.shim.gob import zero_of
+
+    if t is INTERFACE:
+        if v is None:
+            return None
+        name, inner = v
+        return (name, _complete_diff(SPEC_REG[name], inner))
+    if isinstance(t, Struct):
+        return {n: _complete_diff(ft, v[n]) if n in v else zero_of(ft)
+                for n, ft in t.fields}
+    if isinstance(t, (Slice, Array)):
+        return [_complete_diff(t.elem, e) for e in v]
+    if isinstance(t, Map):
+        return {k: _complete_diff(t.vt, e) for k, e in v.items()}
+    return v
+
+
+CASES_PER_SEED = 20
+
+
+@pytest.mark.parametrize("seed", range(50))
+def test_differential_spec_vs_production(seed):
+    """>=1000 random cases (50 seeds x 20): byte-identical streams from
+    both encoders, and the production decoder reads the spec encoder's
+    bytes back to the original value."""
+    rng = random.Random(10_000 + seed)
+    for case in range(CASES_PER_SEED):
+        pool: list = []
+        schema = rand_type_diff(rng, pool)
+        v = rand_value_diff(rng, schema)
+        spec = SpecEncoder(SPEC_REG).encode(schema, v)
+        prod = prod_encode(schema, v)
+        assert spec == prod, (
+            f"seed {seed} case {case}: encoder divergence\n"
+            f"schema={schema!r}\nvalue={v!r}\n"
+            f"spec={spec.hex()}\nprod={prod.hex()}")
+        got = decode_one(spec)[1]
+        assert _complete_diff(schema, got) == _complete_diff(schema, v), (
+            f"seed {seed} case {case}: decode(spec bytes) mismatch")
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_mutation_agreement(seed):
+    """Random single-byte mutations of valid streams: the decoder must
+    either reject with GobError/EOF (never a crash, never a hang) or
+    return a decodable value — and identical streams decide identically,
+    so the spec- and production-encoded bytes (byte-equal by the test
+    above) cannot disagree on acceptance."""
+    rng = random.Random(20_000 + seed)
+    pool: list = []
+    schema = rand_type_diff(rng, pool)
+    v = rand_value_diff(rng, schema)
+    data = prod_encode(schema, v)
+    for _ in range(40):
+        i = rng.randrange(len(data))
+        mutated = bytes(data[:i] + bytes([data[i] ^ (1 << rng.randrange(8))])
+                        + data[i + 1:])
+        try:
+            decode_one(mutated)
+        except (GobError, EOFError):
+            continue  # loud, typed rejection — the required behavior
+        # Accepted: the flipped bit must be semantically inert (e.g. inside
+        # an ignored length prefix is NOT inert — it raised above — but a
+        # flipped unused bool-encoding bit can legitimately survive).

@@ -2,5 +2,6 @@ from tpu6824.parallel.mesh import (  # noqa: F401
     make_mesh,
     state_shardings,
     sharded_step,
+    sharded_step_auto,
     step_args_shardings,
 )

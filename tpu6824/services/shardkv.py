@@ -79,6 +79,7 @@ class ShardKVServer:
         directory: dict,
         op_timeout: float = 8.0,
         start_ticker: bool = True,
+        sm_poll_interval: float = 0.05,
         px=None,
     ):
         """`px` overrides the consensus backend (PaxosPeer contract) — the
@@ -99,6 +100,9 @@ class ShardKVServer:
         self.config: Config = Config.initial()
         self.applied = -1
         self.op_timeout = op_timeout
+        self.sm_poll_interval = sm_poll_interval
+        self._cfg_cache: dict[int, Config] = {}  # immutable once created
+        self._cfg_target = 0  # highest config num seen from the sm group
         self.dead = False
         self._ticker = None
         if start_ticker:
@@ -191,37 +195,72 @@ class ShardKVServer:
     # ----------------------------------------------------------- reconfig
 
     def _tick_loop(self):
-        """shardkv/server.go:488-493: periodic catch-up + config walk."""
+        """shardkv/server.go:488-493: periodic catch-up + config walk.
+
+        Log drain (apply decided ops, advance Done so the window GC can
+        recycle) runs every 50ms; the shardmaster poll — a LOGGED Query op
+        on the sm group — only every `sm_poll_interval` (the reference
+        polls at 250ms; large deployments raise it so G groups x R
+        replicas of pollers don't saturate the sm log)."""
+        last_sm = -float("inf")
         while not self.dead:
             time.sleep(0.05)
             try:
-                self.tick()
+                now = time.monotonic()
+                poll = now - last_sm >= self.sm_poll_interval
+                if poll:
+                    last_sm = now
+                # poll=False still WALKS toward the last known target at
+                # drain cadence (donor-not-ready retries stay fast) but
+                # sends no new Query ops to the sm group — G x R pollers
+                # must not saturate the sm log between poll intervals.
+                self.tick(poll=poll)
             except RPCError:
-                continue  # shardmaster unreachable / donor not ready: retry
+                continue  # shardmaster unreachable: retry next loop
 
-    def tick(self):
+    def _query_cfg(self, n: int) -> Config:
+        """Config n, from the immutable-config cache when possible — walk
+        retries (donor gating) must not re-Query the sm group per attempt."""
+        cfg = self._cfg_cache.get(n)
+        if cfg is None:
+            cfg = self.smck.query(n, timeout=2.0)
+            self._cfg_cache[n] = cfg
+        return cfg
+
+    def tick(self, poll: bool = True) -> bool:
+        """One catch-up + config walk (shardkv/server.go:377-392).
+
+        With poll=True, asks the sm group for the latest config number
+        first; with poll=False, only walks toward the last known target
+        (no sm Query traffic beyond uncached config bodies).  True iff
+        the walk reached the target."""
         with self.mu:
             if self.dead:
-                return
+                return True
             self._drain_decided()
             cur = self.config.num
-        try:
-            latest = self.smck.query(-1, timeout=2.0)
-        except RPCError:
-            return
-        for n in range(cur + 1, latest.num + 1):
+        if poll:
+            try:
+                self._cfg_target = max(
+                    self._cfg_target, self.smck.query(-1, timeout=2.0).num)
+            except RPCError:
+                return False
+        for n in range(cur + 1, self._cfg_target + 1):
             with self.mu:
                 if self.dead:
-                    return
+                    return True
                 self._drain_decided()
                 if self.config.num >= n:
+                    self._cfg_cache.pop(n, None)
                     continue
                 try:
-                    cfg = self.smck.query(n, timeout=2.0)
+                    cfg = self._query_cfg(n)
                 except RPCError:
-                    return
+                    return False
                 if not self._reconfigure(cfg):
-                    return  # donor not ready; retry next tick
+                    return False  # donor not ready; retry next tick
+                self._cfg_cache.pop(n, None)
+        return True
 
     def _reconfigure(self, cfg: Config) -> bool:
         """Pull newly-owned shards from their previous owners, then log the
@@ -396,7 +435,8 @@ class ShardSystem(_ShardSystemOps):
     """Test/deployment harness: one fabric hosting the shardmaster group and
     `ngroups` shardkv replica groups as fabric lanes."""
 
-    def __init__(self, ngroups=2, nreplicas=3, ninstances=32, base_gid=100):
+    def __init__(self, ngroups=2, nreplicas=3, ninstances=32, base_gid=100,
+                 **server_kw):
         self.fabric = PaxosFabric(
             ngroups=1 + ngroups, npeers=nreplicas, ninstances=ninstances,
             auto_step=True,
@@ -411,7 +451,8 @@ class ShardSystem(_ShardSystemOps):
             gid = base_gid + i
             fg = 1 + i
             self.groups[gid] = [
-                ShardKVServer(self.fabric, fg, gid, p, self.sm_servers, self.directory)
+                ShardKVServer(self.fabric, fg, gid, p, self.sm_servers,
+                              self.directory, **server_kw)
                 for p in range(nreplicas)
             ]
             self.gids.append(gid)
