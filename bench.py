@@ -676,17 +676,20 @@ def _wire_rate(n_instances=120):
     """Control-plane price check: decided instances/sec over the
     DECENTRALIZED path — per-message Prepare/Accept/Decided gob RPCs
     between real Unix-socket endpoints (core/hostpeer.py), the reference's
-    own runtime model.  Host-only; independent of the accelerator."""
+    own runtime model.  Host-only; independent of the accelerator.
+    Measured twice: dial-per-call (the reference's `call()`,
+    paxos/rpc.go:24-42) and pooled long-lived connections (Go's rpc.Client
+    model — same wire, no redial)."""
     import shutil
     import tempfile
 
-    try:
+    def run(pooled):
         from tpu6824.core.hostpeer import make_host_cluster
         from tpu6824.core.peer import Fate
 
         d = tempfile.mkdtemp(prefix="bw", dir="/var/tmp")
         try:
-            peers = make_host_cluster(d, npeers=3, seed=12)
+            peers = make_host_cluster(d, npeers=3, seed=12, pooled=pooled)
             try:
                 t0 = time.perf_counter()
                 for seq in range(n_instances):
@@ -701,16 +704,24 @@ def _wire_rate(n_instances=120):
                 decided = sum(
                     1 for s in range(n_instances)
                     if peers[0].status(s)[0] == Fate.DECIDED)
-                return {
-                    "value": round(decided / dt, 1),
-                    "note": ("decided/sec over per-message gob socket RPC, "
-                             "3 peers (reference runtime model)"),
-                }
+                return round(decided / dt, 1)
             finally:
                 for p in peers:
                     p.kill()
         finally:
             shutil.rmtree(d, ignore_errors=True)
+
+    try:
+        out = {
+            "value": run(False),
+            "note": ("decided/sec over per-message gob socket RPC, "
+                     "3 peers (reference runtime model, dial-per-call)"),
+        }
+        try:
+            out["pooled"] = run(True)
+        except Exception as e:  # noqa: BLE001
+            out["pooled"] = {"error": repr(e)[:200]}
+        return out
     except Exception as e:  # noqa: BLE001 — never cost the main line
         return {"value": 0.0, "error": repr(e)[:200]}
 

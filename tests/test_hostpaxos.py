@@ -184,3 +184,44 @@ def test_concurrent_start_threads(cluster):
         waitn(cluster, seq, 3, timeout=30.0)
         n, v = ndecided(cluster, seq)
         assert n == 3 and v.startswith("t")
+
+
+def test_pooled_cluster_agreement():
+    """pooled=True (long-lived net/rpc client connections, Go's rpc.Client
+    model) preserves the full contract: agreement, catch-up of a slow
+    learner, Done/Min window GC — same wire, fewer dials."""
+    import shutil
+    import tempfile
+
+    from tpu6824.core.hostpeer import make_host_cluster
+    from tpu6824.core.peer import Fate
+    from tpu6824.utils.timing import wait_until
+
+    d = tempfile.mkdtemp(prefix="plc", dir="/var/tmp")
+    try:
+        peers = make_host_cluster(d, npeers=3, seed=7, pooled=True)
+        try:
+            for seq in range(20):
+                peers[seq % 3].start(seq, f"v{seq}")
+            ok = wait_until(
+                lambda: all(p.status(s)[0] == Fate.DECIDED
+                            for p in peers for s in range(20)), 30.0)
+            assert ok, "pooled cluster did not decide all instances"
+            vals = {s: peers[0].status(s)[1] for s in range(20)}
+            for p in peers[1:]:
+                for s in range(20):
+                    assert p.status(s)[1] == vals[s], (s, "disagreement")
+            for p in peers:
+                p.done(9)
+            # Done piggybacks ride each peer's own Decided broadcasts
+            # (paxos/rpc.go:74-80): every peer drives one.
+            for i, p in enumerate(peers):
+                p.start(20 + i, f"gc-driver-{i}")
+            ok = wait_until(lambda: all(p.min() == 10 for p in peers), 30.0)
+            assert ok, [p.min() for p in peers]
+            assert peers[1].status(3)[0] == Fate.FORGOTTEN
+        finally:
+            for p in peers:
+                p.kill()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)

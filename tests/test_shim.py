@@ -239,3 +239,102 @@ def test_unreliable_gob_endpoint_at_most_once(kv_cluster):
     for ep in kv_cluster:
         ep.set_unreliable(False)
     assert kv_get(kv_cluster[0].addr, "u")["Value"] == "once"
+
+
+def test_client_pool_reuses_connection():
+    """GobClientPool: many calls ride one connection (the server accepts
+    once), app errors keep the connection healthy, and a dead server
+    surfaces RPCError then a redial works after restart."""
+    import os
+
+    from tpu6824.shim import gob
+    from tpu6824.shim.netrpc import GobClientPool, GobRpcServer
+    from tpu6824.utils.errors import RPCError
+
+    addr = os.path.join("/var/tmp", f"pool-{os.getpid()}.sock")
+    ECHO_A = gob.Struct("EchoArgs", [("N", gob.INT)])
+    ECHO_R = gob.Struct("EchoReply", [("N", gob.INT)])
+
+    def boot():
+        srv = GobRpcServer(addr)
+        srv.register_method("T.Echo", lambda a: {"N": a["N"] * 2},
+                            ECHO_A, ECHO_R)
+        srv.register_method("T.Boom", lambda a: 1 // 0, ECHO_A, ECHO_R)
+        return srv.start()
+
+    srv = boot()
+    pool = GobClientPool()
+    try:
+        for i in range(20):
+            r = pool.call(addr, "T.Echo", ECHO_A, {"N": i}, ECHO_R)
+            assert r["N"] == 2 * i
+        # 20 calls, one accept: the connection was reused.
+        assert srv.rpc_count <= 3, srv.rpc_count
+        # App error travels in Response.Error; the SAME connection then
+        # serves the next call.
+        import pytest as _pytest
+        with _pytest.raises(RPCError):
+            pool.call(addr, "T.Boom", ECHO_A, {"N": 1}, ECHO_R)
+        assert pool.call(addr, "T.Echo", ECHO_A, {"N": 5}, ECHO_R)["N"] == 10
+        # Server restart: pooled (now stale) connections fail loudly, a
+        # fresh call after the failure redials and succeeds.
+        srv.kill()
+        try:
+            pool.call(addr, "T.Echo", ECHO_A, {"N": 1}, ECHO_R)
+        except RPCError:
+            pass
+        srv = boot()
+        deadline_ok = False
+        for _ in range(10):
+            try:
+                assert pool.call(addr, "T.Echo", ECHO_A,
+                                 {"N": 3}, ECHO_R)["N"] == 6
+                deadline_ok = True
+                break
+            except RPCError:
+                continue  # draining remaining stale pooled conns
+        assert deadline_ok
+    finally:
+        pool.close()
+        srv.kill()
+
+
+def test_client_pool_close_is_terminal():
+    """close() during an in-flight call: the call completes, its connection
+    is closed (never re-pooled), and later calls raise RPCError."""
+    import os
+    import threading
+    import time
+
+    import pytest as _pytest
+
+    from tpu6824.shim import gob
+    from tpu6824.shim.netrpc import GobClientPool, GobRpcServer
+    from tpu6824.utils.errors import RPCError
+
+    addr = os.path.join("/var/tmp", f"poolterm-{os.getpid()}.sock")
+    A = gob.Struct("EchoArgs", [("N", gob.INT)])
+    R = gob.Struct("EchoReply", [("N", gob.INT)])
+    srv = GobRpcServer(addr)
+    srv.register_method(
+        "T.Slow", lambda a: (time.sleep(0.3), {"N": a["N"]})[1], A, R)
+    srv.start()
+    pool = GobClientPool()
+    try:
+        res = {}
+
+        def slow():
+            res["r"] = pool.call(addr, "T.Slow", A, {"N": 1}, R)
+
+        t = threading.Thread(target=slow)
+        t.start()
+        time.sleep(0.1)
+        pool.close()
+        t.join(10)
+        assert res["r"]["N"] == 1        # in-flight call completed
+        assert not pool._idle            # ... and was not re-pooled
+        with _pytest.raises(RPCError):
+            pool.call(addr, "T.Slow", A, {"N": 2}, R)
+    finally:
+        pool.close()
+        srv.kill()

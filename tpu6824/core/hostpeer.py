@@ -77,7 +77,8 @@ class HostPaxosPeer:
                  seed: int | None = None, backoff: float = 0.02,
                  persist_dir: str | None = None,
                  max_proposers: int = 64,
-                 bind_addr: str | None = None):
+                 bind_addr: str | None = None,
+                 pooled: bool = False):
         """With `persist_dir`, acceptor promises/acceptances, decisions,
         and Done state are written to disk BEFORE any RPC reply leaves —
         Paxos's durability requirement — and reloaded on construction, so
@@ -100,7 +101,14 @@ class HostPaxosPeer:
         `bind_addr` separates where this peer LISTENS from how its peers[]
         entry is dialed — required by the link-farm partition harness
         (`rpc.transport.LinkFarm`), where every peer dials through its own
-        per-edge alias paths while servers bind their real sockets."""
+        per-edge alias paths while servers bind their real sockets.
+
+        `pooled=True` reuses net/rpc client connections (Go's long-lived
+        rpc.Client model; `shim.netrpc.GobClientPool`) instead of the
+        reference's dial-per-call — wire-identical per request and still
+        compatible with unmodified Go servers, but the harness's per-
+        connection fault injection then fires only at dial time, so keep
+        the default for fidelity runs."""
         self.peers = list(peers)
         self.me = me
         self.addr = bind_addr or peers[me]
@@ -134,6 +142,11 @@ class HostPaxosPeer:
             os.makedirs(persist_dir, exist_ok=True)
             self._reload()
         reg = registry or wire.default_registry()
+        self._pool = None
+        if pooled:
+            from tpu6824.shim.netrpc import GobClientPool
+
+            self._pool = GobClientPool(registry=reg, timeout=5.0)
         self.server = GobRpcServer(self.addr, seed=seed, registry=reg)
         self.server.register_method("Paxos.Prepare", self._rpc_prepare,
                                     wire.PREPARE_ARGS, wire.PREPARE_REPLY)
@@ -197,6 +210,8 @@ class HostPaxosPeer:
     def kill(self) -> None:
         with self.mu:
             self.dead = True
+        if self._pool is not None:
+            self._pool.close()
         self.server.kill()
 
     # fault hooks delegate to the endpoint (the reference's accept loop).
@@ -386,6 +401,9 @@ class HostPaxosPeer:
                        "Paxos.Decided": self._rpc_decided}[method]
             return handler(args)
         self.events.bump("rpc_out")
+        if self._pool is not None:
+            return self._pool.call(self.peers[peer], method, args_schema,
+                                   args, reply_schema)
         return gob_call(self.peers[peer], method, args_schema, args,
                         reply_schema, registry=self._registry, timeout=5.0)
 
@@ -523,10 +541,12 @@ def _unwrap(v):
 
 def make_host_cluster(sockdir: str, npeers: int = 3,
                       registry: Registry | None = None,
-                      seed: int | None = None) -> list[HostPaxosPeer]:
+                      seed: int | None = None,
+                      pooled: bool = False) -> list[HostPaxosPeer]:
     """Boot npeers decentralized peers on real gob sockets — the
     reference's `Make(peers, me, nil)` per process (paxos/paxos.go:488)."""
     addrs = [f"{sockdir}/px-{i}" for i in range(npeers)]
     return [HostPaxosPeer(addrs, i, registry=registry,
-                          seed=None if seed is None else seed + i)
+                          seed=None if seed is None else seed + i,
+                          pooled=pooled)
             for i in range(npeers)]
