@@ -91,3 +91,19 @@ def test_config_builds_fabric():
         assert cfg.select_backend() in ("cpu", "tpu")
     finally:
         fab.stop_clock()
+
+
+def test_profile_steps_writes_trace(tmp_path):
+    """utils.profiling captures a JAX profiler trace around fabric steps
+    (SURVEY §5: per-kernel-step observability beyond counters)."""
+    import os
+
+    from tpu6824.core.fabric import PaxosFabric
+    from tpu6824.utils.profiling import profile_steps
+
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=8)
+    fab.start(0, 0, 0, 1)
+    out = profile_steps(fab, 3, str(tmp_path / "trace"))
+    found = [os.path.join(r, f) for r, _d, fs in os.walk(out) for f in fs]
+    assert found, "profiler produced no trace files"
+    assert fab.status(0, 1, 0)[0].name == "DECIDED"

@@ -18,6 +18,7 @@ device chatter"):
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -74,6 +75,7 @@ class PaxosFabric:
         from tpu6824.core.pallas_kernel import get_step, resolve_impl
 
         self._step_fn = get_step(kernel)
+        self._kernel_req = kernel  # as requested (checkpoint/restore)
         # On the XLA path, steps with no unreliable server skip Bernoulli
         # mask generation entirely (paxos_step_reliable — bit-identical at
         # drop=0, works under partitioned links).  The Pallas path keeps its
@@ -592,6 +594,150 @@ class PaxosFabric:
     def is_dead(self, g: int, p: int) -> bool:
         with self._lock:
             return bool(self._dead[g, p])
+
+    # ------------------------------------------------------- checkpoint
+
+    def checkpoint(self, path: str) -> None:
+        """Snapshot the ENTIRE consensus universe — device state, host
+        mirrors, slot/window bookkeeping, network condition, queued ops,
+        and every live value payload — to one file, atomically
+        (write-tmp + fsync + rename, the diskv file discipline,
+        diskv/server.go:92-105).
+
+        The reference's paxos is explicitly not crash-safe
+        (paxos/paxos.go:3-11); its persistence story lives in diskv and in
+        `HostPaxosPeer(persist_dir=...)`.  This is the batched-runtime
+        analog: checkpoint/resume for all G groups at once, the way an ML
+        framework checkpoints a training state pytree.
+
+        Must be called with the clock stopped (deterministic snapshot —
+        a step in flight would leave device state and mirrors torn).
+        """
+        import pickle
+
+        with self._lock:
+            if self._running:
+                raise RuntimeError("stop_clock() before checkpoint()")
+            state_np = {f: np.array(x)
+                        for f, x in zip(self._state._fields, self._state)}
+            # Pending window-GC resets are applied INTO the snapshot (their
+            # effect is deterministic): the device arrays may still carry
+            # value ids whose intern refs the GC already dropped — those
+            # cells must not reach restore()'s vid remap.
+            if self._pending_resets:
+                r = np.asarray(self._pending_resets)
+                gs, ss = r[:, 0], r[:, 1]
+                for f, fill in (("np_", 0), ("na", 0), ("va", NO_VAL),
+                                ("decided", NO_VAL), ("active", False),
+                                ("propv", NO_VAL), ("maxseen", 0)):
+                    state_np[f][gs, ss, :] = fill
+            # Live payloads: every vid referenced by any slot or queued op
+            # (immediate-tagged ids carry their own payload; see IMM_BASE).
+            vids = sorted({v for g in range(self.G)
+                           for slot in self._slot_vids[g]
+                           for v in slot})
+            # Everything below is COPIED under the lock: the blob must not
+            # alias mutable fabric state (serialization happens outside
+            # the lock, and other API threads stay free to run).
+            blob = {
+                "dims": (self.G, self.I, self.P),
+                "kernel": self._kernel_req,
+                "drops": (self._req_drop, self._rep_drop),
+                "state": state_np,
+                "link": self._link.copy(),
+                "unreliable": self._unreliable.copy(),
+                "done": self._done.copy(), "dead": self._dead.copy(),
+                "m_decided": self.m_decided.copy(),
+                "m_done_view": self.m_done_view.copy(),
+                "max_seq": self._max_seq.copy(),
+                "slot_seq": self._slot_seq.copy(),
+                "seq2slot": [dict(d) for d in self._seq2slot],
+                "free": [list(s) for s in self._free],
+                "slot_vids": [[list(v) for v in grp]
+                              for grp in self._slot_vids],
+                "values": {v: self.intern.get(v) for v in vids},
+                "pending_starts": list(self._pending_starts),
+                "pending_resets": [],  # applied into the snapshot above
+                "key_data": np.array(jax.random.key_data(self._key)),
+            }
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @classmethod
+    def restore(cls, path: str, **kw) -> "PaxosFabric":
+        """Resume a checkpointed fabric.  Interned value ids are REMAPPED
+        through a fresh intern store (so either intern backend restores
+        into either), with the device arrays rewritten through the same
+        old→new lookup; immediate-tagged ids pass through unchanged.
+        PRNG subkey batching restarts at the saved base key, so post-
+        restore lossy draws differ from an uninterrupted run (determinism
+        holds per process lifetime, not across the boundary)."""
+        import pickle
+
+        with open(path, "rb") as f:
+            blob = pickle.loads(f.read())
+        G, I, P = blob["dims"]
+        kw.setdefault("kernel", blob["kernel"])
+        kw.setdefault("unreliable_req_drop", blob["drops"][0])
+        kw.setdefault("unreliable_rep_drop", blob["drops"][1])
+        # The clock must not run while state is being swapped in.
+        auto_step = kw.pop("auto_step", False)
+        fab = cls(ngroups=G, npeers=P, ninstances=I, **kw)
+        with fab._lock:
+            # Rebuild the intern with exactly one ref per _slot_vids entry
+            # (the GC decrefs one per entry), building the old->new map —
+            # any device vid absent from it fails LOUDLY in remap (the
+            # checkpoint invariant is that no such vid exists).
+            old2new = {}
+            new_vids = [[[] for _ in range(I)] for _ in range(G)]
+            for g in range(G):
+                for slot in range(I):
+                    for old_vid in blob["slot_vids"][g][slot]:
+                        nv = fab.intern.put(blob["values"][old_vid])
+                        old2new[old_vid] = nv
+                        new_vids[g][slot].append(nv)
+            fab._slot_vids = new_vids
+
+            def remap(a):
+                a = np.array(a)
+                m = (a >= 0) & (a < IMM_BASE)
+                if m.any():
+                    a[m] = np.vectorize(
+                        lambda v: old2new[v], otypes=[np.int64])(a[m])
+                return a
+
+            st = {f: np.array(v) for f, v in blob["state"].items()}
+            for f in ("va", "decided", "propv"):
+                st[f] = remap(st[f]).astype(st[f].dtype)
+            fab._state = type(fab._state)(**{
+                f: jnp.asarray(v) for f, v in st.items()})
+            fab._link = np.array(blob["link"])
+            fab._link_dev = None
+            fab._unreliable = np.array(blob["unreliable"])
+            fab._done = np.array(blob["done"])
+            fab._dead = np.array(blob["dead"])
+            fab.m_decided = remap(blob["m_decided"]).astype(np.int32)
+            fab.m_done_view = np.array(blob["m_done_view"])
+            np.minimum.reduce(fab.m_done_view, axis=2, out=fab._pmin_i32)
+            fab._peer_min = fab._pmin_i32.astype(np.int64) + 1
+            fab._max_seq = np.array(blob["max_seq"])
+            fab._slot_seq = np.array(blob["slot_seq"])
+            fab._seq2slot = [dict(d) for d in blob["seq2slot"]]
+            fab._free = [list(s) for s in blob["free"]]
+            fab._decided_cells = int((fab.m_decided >= 0).sum())
+            fab._pending_starts = [
+                (g, s, p, v if v >= IMM_BASE else old2new[v], seq)
+                for g, s, p, v, seq in blob["pending_starts"]]
+            fab._pending_resets = list(blob["pending_resets"])
+            fab._key = jax.random.wrap_key_data(jnp.asarray(blob["key_data"]))
+            fab._key_buf = []
+        if auto_step:
+            fab.start_clock()
+        return fab
 
     # ------------------------------------------------------------- stats
 

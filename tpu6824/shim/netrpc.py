@@ -129,6 +129,28 @@ class GobRpcServer(transport.Server):
         enc.encode(reply_schema, reply)
 
 
+def _roundtrip(enc, dec, addr, method, seq, args_schema, args):
+    """One Request/args → Response/reply exchange on an established
+    connection — the wire conversation shared by `gob_call` and
+    `GobClientPool.call`.  Transport/codec failures become RPCError; the
+    caller decides connection lifecycle before surfacing Response.Error."""
+    try:
+        enc.encode(REQUEST, {"ServiceMethod": method, "Seq": seq})
+        enc.encode(args_schema, args or {})
+        _, resp = dec.next()
+        resp = gob.complete(RESPONSE, resp)
+        _, reply = dec.next()
+    except (OSError, EOFError, gob.GobError, RecursionError) as e:
+        raise RPCError(f"gob call {method}@{addr}: {e}") from e
+    return resp, reply
+
+
+def _finish(resp, reply, addr, method, reply_schema):
+    if resp["Error"]:
+        raise RPCError(f"{method}@{addr}: {resp['Error']}")
+    return gob.complete(reply_schema, reply) if reply_schema else reply
+
+
 class GobClientPool:
     """Reusable net/rpc client connections — Go's `rpc.Dial` + long-lived
     `rpc.Client` model, as the optimized alternative to the reference's
@@ -200,15 +222,8 @@ class GobClientPool:
         conn[3] = seq = conn[3] + 1
         ok = False
         try:
-            try:
-                enc, dec = conn[1], conn[2]
-                enc.encode(REQUEST, {"ServiceMethod": method, "Seq": seq})
-                enc.encode(args_schema, args or {})
-                _, resp = dec.next()
-                resp = gob.complete(RESPONSE, resp)
-                _, reply = dec.next()
-            except (OSError, EOFError, gob.GobError, RecursionError) as e:
-                raise RPCError(f"gob call {method}@{addr}: {e}") from e
+            resp, reply = _roundtrip(conn[1], conn[2], addr, method, seq,
+                                     args_schema, args)
             if resp["Seq"] != seq:
                 # One-at-a-time per connection: a mismatch means the stream
                 # is desynchronized (e.g. a previous half-read).
@@ -223,9 +238,7 @@ class GobClientPool:
                 self._put(addr, conn)  # app errors leave the conn healthy
             else:
                 sock.close()
-        if resp["Error"]:
-            raise RPCError(f"{method}@{addr}: {resp['Error']}")
-        return gob.complete(reply_schema, reply) if reply_schema else reply
+        return _finish(resp, reply, addr, method, reply_schema)
 
     def close(self) -> None:
         """Terminal: closes idle connections now; connections in flight are
@@ -253,17 +266,13 @@ def gob_call(addr: str, method: str, args_schema: gob.Struct, args: dict,
     try:
         try:
             sock.connect(addr)
-            enc = gob.Encoder(sock.sendall, registry)
-            enc.encode(REQUEST, {"ServiceMethod": method, "Seq": 1})
-            enc.encode(args_schema, args or {})
-            dec = gob.Decoder(_sock_read(sock))
-            _, resp = dec.next()
-            resp = gob.complete(RESPONSE, resp)
-            _, reply = dec.next()
-        except (OSError, EOFError, gob.GobError, RecursionError) as e:
+        except OSError as e:
             raise RPCError(f"gob call {method}@{addr}: {e}") from e
-        if resp["Error"]:
-            raise RPCError(f"{method}@{addr}: {resp['Error']}")
-        return gob.complete(reply_schema, reply) if reply_schema else reply
+        enc = gob.Encoder(sock.sendall, registry)
+        dec = gob.Decoder(_sock_read(sock))
+        # Go's net/rpc client numbers from 1.
+        resp, reply = _roundtrip(enc, dec, addr, method, 1,
+                                 args_schema, args)
+        return _finish(resp, reply, addr, method, reply_schema)
     finally:
         sock.close()

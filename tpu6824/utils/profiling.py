@@ -1,0 +1,36 @@
+"""Kernel-step profiling — the part of SURVEY §5's "do better" note that
+EventLog's counters don't cover: device-level timelines.
+
+The reference's only profiling artifact is a commented-out `runtime.GC()`
+(`paxos/paxos.go-too-many-rpcs:132`).  Here the runtime exposes the JAX
+profiler directly: `trace(outdir)` captures a Perfetto/TensorBoard trace
+(XLA ops, fusion boundaries, HBM transfers on TPU) around any region, and
+`profile_steps` wraps N fabric clock steps — the unit all consensus work
+happens in."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def trace(outdir: str):
+    """Capture a JAX profiler trace (viewable in Perfetto / TensorBoard)
+    for the enclosed region."""
+    import jax
+
+    os.makedirs(outdir, exist_ok=True)
+    jax.profiler.start_trace(outdir)
+    try:
+        yield outdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_steps(fabric, n: int, outdir: str) -> str:
+    """Trace n fabric clock steps.  Call with the clock stopped (the traced
+    region must own the stepping).  Returns outdir."""
+    with trace(outdir):
+        fabric.step(n)
+    return outdir
