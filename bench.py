@@ -213,6 +213,10 @@ def child_main():
 
             try:
                 alt = {"kernel": alt_impl, "value": run_alt(G, I)}
+            except AssertionError as e:
+                # Agreement failure is a CORRECTNESS signal, not a compile
+                # problem — never launder it into a smaller-shape number.
+                alt = {"kernel": alt_impl, "error": repr(e)[:200]}
             except Exception as e:  # noqa: BLE001 — comparison is optional
                 Ia = max(64, I // 8)
                 if Ia >= I:

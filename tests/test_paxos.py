@@ -508,3 +508,75 @@ def test_stale_pending_start_is_filtered(fab3):
 def test_done_many_overflow_is_loud(fab3):
     with pytest.raises(OverflowError):
         fab3.done_many([(0, 0, 2 ** 31)])
+
+
+def test_lots_requests_changing_partitions():
+    """TestLots (paxos/test_test.go): 5 UNRELIABLE peers under continuous
+    random 3-way re-partitioning while instances start and Done GC runs;
+    after the churn heals, everything started must decide with agreement
+    and the window must have recycled."""
+    import random as _random
+    import threading
+    import time as _time
+
+    rng = _random.Random(31)
+    fab = PaxosFabric(ngroups=1, npeers=5, ninstances=48, auto_step=True)
+    try:
+        fab.set_unreliable(True)
+        pxa = make_group(fab)
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                parts = [[], [], []]
+                for p in range(5):
+                    parts[rng.randrange(3)].append(p)
+                fab.partition(0, *[pt for pt in parts if pt])
+                _time.sleep(0.02 + rng.random() * 0.08)
+
+        ch = threading.Thread(target=churn, daemon=True)
+        ch.start()
+
+        started = 0
+        t_end = _time.monotonic() + 6.0
+        while _time.monotonic() < t_end:
+            # Throttle in-flight work the way the reference does (it caps
+            # undecided instances at 10): track via ndecided.
+            nd = sum(1 for s in range(max(0, started - 10), started)
+                     if fab.ndecided(0, s) > 0)
+            if started - nd < 8 and started < 40:
+                pxa[started % 5].start(started, started * 7)
+                started += 1
+            # Rolling Done from every peer once a prefix is fully decided
+            # (scan from the live window's floor — forgotten seqs return
+            # ndecided 0 and would otherwise stall the scan at seq 0).
+            done_upto = -1
+            for s in range(max(0, fab.peer_min(0, 0)), started):
+                if fab.ndecided(0, s) == 5:
+                    done_upto = s
+                else:
+                    break
+            if done_upto > 2:
+                for p in pxa:
+                    p.done(done_upto - 2)
+            _time.sleep(0.01)
+
+        stop.set()
+        ch.join(5)
+        assert not ch.is_alive(), "churn thread still live at heal"
+        fab.heal(0)
+        fab.set_unreliable(False)
+        assert started >= 10, f"churn starved the driver: {started}"
+        # Everything started (and not forgotten) decides after heal, with
+        # agreement (ndecided asserts it) — TestLots's closing waitn loop.
+        deadline = _time.monotonic() + 30
+        for s in range(started):
+            while _time.monotonic() < deadline:
+                if fab.peer_min(0, 0) > s or fab.ndecided(0, s) == 5:
+                    break
+                _time.sleep(0.02)
+            assert fab.peer_min(0, 0) > s or fab.ndecided(0, s) == 5, (
+                f"instance {s} undecided after heal")
+        assert fab.peer_min(0, 0) > 0, "Done/Min GC never advanced"
+    finally:
+        fab.stop_clock()

@@ -302,3 +302,48 @@ def test_put_immediately_after_primary_failure(sys3):
     sys3.wait_view(lambda v: v.primary == v1.backup, timeout=10.0)
     assert ck.get("a", timeout=10.0) == "aa"
     assert ck.get("b", timeout=10.0) == "bbb"
+
+
+def test_concurrent_same_key_puts_unreliable(sys3):
+    """TestConcurrentSame/TestConcurrentSameUnreliable
+    (pbservice/test_test.go): concurrent Put()s to one key over an
+    unreliable clerk leg — afterwards the value must be ONE of the written
+    values (no torn/merged state) and stable across repeated reads and a
+    failover."""
+    for s in sys3.servers.values():
+        sys3.net.set_unreliable(s, True)
+    nclients, nputs = 3, 8
+    written = [[] for _ in range(nclients)]
+    errs = []
+
+    def run(ti):
+        try:
+            ck = sys3.clerk()
+            for i in range(nputs):
+                v = f"c{ti}-{i}"
+                ck.put("same", v, timeout=20.0)
+                written[ti].append(v)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append((ti, repr(e)))
+
+    ths = [threading.Thread(target=run, args=(t,)) for t in range(nclients)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(60)
+    assert not any(t.is_alive() for t in ths)
+    assert not errs, errs
+    for s in sys3.servers.values():
+        sys3.net.set_unreliable(s, False)
+
+    ck = sys3.clerk()
+    v1 = ck.get("same", timeout=10.0)
+    allv = {v for w in written for v in w}
+    assert v1 in allv, f"torn value {v1!r}"
+    assert ck.get("same", timeout=10.0) == v1
+    # Failover: the backup must hold the same final value.
+    old = sys3.wait_acked()
+    sys3.servers[old.primary].kill()
+    del sys3.servers[old.primary]
+    sys3.wait_view(lambda v: v.primary == old.backup)
+    assert ck.get("same", timeout=10.0) == v1
