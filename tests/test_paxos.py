@@ -544,7 +544,8 @@ def test_lots_requests_changing_partitions():
             # undecided instances at 10): track via ndecided.
             nd = sum(1 for s in range(max(0, started - 10), started)
                      if fab.ndecided(0, s) > 0)
-            if started - nd < 8 and started < 40:
+            inflight = min(started, 10) - nd  # undecided among the last 10
+            if inflight < 8 and started < 40:
                 pxa[started % 5].start(started, started * 7)
                 started += 1
             # Rolling Done from every peer once a prefix is fully decided

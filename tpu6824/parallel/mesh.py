@@ -26,6 +26,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu6824.core.kernel import PaxosState, paxos_step
 
 
+def _shard_map(local, **kw):
+    """shard_map with the version-compat fallbacks (import location and
+    the check_vma/check_rep kwarg rename) in ONE place."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(local, check_vma=False, **kw)
+    except TypeError:  # pragma: no cover — older jax
+        return shard_map(local, check_rep=False, **kw)
+
+
 def factor3(n: int) -> tuple[int, int, int]:
     """Split n devices into (g, i, p) mesh dims, preferring the group axis."""
     best = (n, 1, 1)
@@ -106,6 +119,78 @@ def sharded_step_auto(mesh: Mesh, impl: str | None = None,
     return sharded_step(mesh), "xla"
 
 
+def sharded_cycle_pallas(mesh: Mesh, G: int, I: int, P: int,
+                         interpret: bool | None = None):
+    """The FLAGSHIP steady-state kernel — the fused recycle+arm+round cycle
+    (`paxos_cycle_lanes`) — under a g-sharded mesh via shard_map.
+
+    Layout: each of the mesh's n group-shards owns G/n groups as its own
+    block-aligned lane state, so the global arrays are (P, n*Np_local)
+    with per-shard padding (a shard's pallas grid never straddles another
+    shard's cells).  Same axis policy as `sharded_step_pallas` (quorum +
+    window local).  Returns (step, make_lane_shards, Np_local):
+
+      step(l, done_view, done, key, sa, sv) -> (l', done_view', rec, msgs)
+      make_lane_shards(PaxosState) -> LaneState in the sharded layout
+    """
+    from tpu6824.core.pallas_kernel import (
+        LaneState, _block, paxos_cycle_lanes, to_lane_state,
+    )
+
+    if not pallas_mesh_ok(mesh):
+        raise ValueError(
+            "pallas fused cycle needs quorum + window axes local "
+            f"(mesh 'p' == 'i' == 1, got {dict(mesh.shape)})")
+    n = mesh.shape["g"]
+    if G % n:
+        raise ValueError(f"G={G} not divisible by mesh 'g'={n}")
+    Gl = G // n
+    _, Npl = _block(Gl * I)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    from jax.sharding import PartitionSpec as P_
+
+    def make_lane_shards(state) -> LaneState:
+        """(G, I, P) PaxosState -> per-shard-padded sharded LaneState."""
+        shards = [
+            to_lane_state(jax.tree.map(lambda a: a[s * Gl:(s + 1) * Gl],
+                                       state))
+            for s in range(n)
+        ]
+        glob = LaneState(*[jnp.concatenate([getattr(s, f) for s in shards],
+                                           axis=1)
+                           for f in LaneState._fields])
+        return jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P_(None, "g"))),
+            glob)
+
+    lane_spec = LaneState(*([P_(None, "g")] * len(LaneState._fields)))
+    dv_spec = P_("g", None, None)
+
+    def local(l, done_view, done, key, sa, sv):
+        key = jax.random.fold_in(key, jax.lax.axis_index("g"))
+        l2, dv2, rec, msgs = paxos_cycle_lanes(
+            l, done_view, done, key, sa, sv,
+            G=Gl, I=I, mode="reliable", interpret=interpret)
+        return l2, dv2, rec, msgs[None]
+
+    f = _shard_map(local, mesh=mesh,
+                   in_specs=(lane_spec, dv_spec, P_("g", None), P_(),
+                             P_(None, "g"), P_(None, "g")),
+                   out_specs=(lane_spec, dv_spec, P_(None, "g"), P_("g")))
+
+    @jax.jit
+    def step(l, done_view, done, key, sa, sv):
+        if l.np_.shape[0] != P:
+            raise ValueError(
+                f"lane state has {l.np_.shape[0]} peers, expected {P}")
+        l2, dv2, rec, msgs = f(l, done_view, done, key, sa, sv)
+        return l2, dv2, rec, msgs.sum().astype(jnp.int32)
+
+    return step, make_lane_shards, Npl
+
+
 def pallas_mesh_ok(mesh: Mesh) -> bool:
     """The ONE statement of the fused round's axis policy: quorum ('p')
     and window ('i') must be device-local.  `sharded_step_auto` consults
@@ -135,11 +220,6 @@ def sharded_step_pallas(mesh: Mesh, interpret: bool | None = None):
     shards draw independent delivery masks (distribution-identical to, but
     not bit-identical with, the unsharded path).
     """
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map
-
     from tpu6824.core.kernel import StepIO
     from tpu6824.core.pallas_kernel import paxos_step_pallas
 
@@ -162,18 +242,12 @@ def sharded_step_pallas(mesh: Mesh, interpret: bool | None = None):
                                    drop_rep, interpret=interpret)
         return st, io._replace(msgs=io.msgs[None])
 
-    kw = dict(
-        mesh=mesh,
-        in_specs=(st_spec, P("g", None, None), P("g", None), P(),
-                  P("g", None, None), P("g", None, None)),
-        out_specs=(st_spec, io_spec),
-    )
-    try:
-        # varying-mesh-axes checking can't see through pallas_call's
-        # ShapeDtypeStructs; disable it (kwarg renamed across jax versions).
-        f = shard_map(local, check_vma=False, **kw)
-    except TypeError:  # pragma: no cover — older jax
-        f = shard_map(local, check_rep=False, **kw)
+    # varying-mesh-axes checking can't see through pallas_call's
+    # ShapeDtypeStructs; _shard_map disables it across jax versions.
+    f = _shard_map(local, mesh=mesh,
+                   in_specs=(st_spec, P("g", None, None), P("g", None), P(),
+                             P("g", None, None), P("g", None, None)),
+                   out_specs=(st_spec, io_spec))
 
     @jax.jit
     def step(state, link, done, key, drop_req, drop_rep):
