@@ -122,3 +122,96 @@ def test_checkpoint_after_gc_with_unapplied_resets():
         assert fab2.status(0, 2, 1) == (Fate.DECIDED, "fresh")
     finally:
         os.unlink(path)
+
+
+def test_fabricd_checkpoint_restart_cycle():
+    """Daemon-level checkpoint/resume across REAL processes: fabricd runs
+    with --checkpoint, serves ops over its socket, is SIGTERMed (final
+    checkpoint written), and a second fabricd --restore serves the same
+    decided state and keeps deciding."""
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    from tpu6824.core.fabric_service import remote_fabric
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    d = tempfile.mkdtemp(prefix="fdck", dir="/var/tmp")
+    addr, ckpt = f"{d}/fab", f"{d}/ckpt"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+
+    def boot(extra):
+        # --restore takes its dimensions from the checkpoint (passing
+        # --groups/--instances alongside it is an argparse error).
+        return subprocess.Popen(
+            [sys.executable, "-m", "tpu6824.main.fabricd", "--addr", addr,
+             "--ttl", "90", "--checkpoint", ckpt] + extra,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+
+    import shutil
+
+    try:
+        p1 = boot(["--groups", "2", "--instances", "16"])
+        deadline = time.time() + 30
+        rf = None
+        while time.time() < deadline:
+            if os.path.exists(addr):
+                try:
+                    rf = remote_fabric(addr, timeout=5.0)
+                    rf.dims()
+                    break
+                except Exception:
+                    rf = None
+            time.sleep(0.2)
+        assert rf is not None, "fabricd never came up"
+        rf.start(0, 0, 0, "survive-restart")
+        rf.start(1, 1, 3, 777)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            f0 = rf.status(0, 1, 0)
+            f1 = rf.status(1, 0, 3)
+            if f0[0].name == "DECIDED" and f1[0].name == "DECIDED":
+                break
+            time.sleep(0.05)
+        assert rf.status(0, 1, 0)[1] == "survive-restart"
+        assert rf.status(1, 0, 3)[1] == 777  # BOTH groups decided pre-ckpt
+        p1.send_signal(signal.SIGTERM)
+        p1.wait(30)
+        assert os.path.exists(ckpt), "no checkpoint written on SIGTERM"
+        if p1.poll() is None:
+            p1.kill()
+
+        p2 = boot(["--restore", ckpt])
+        deadline = time.time() + 30
+        rf = None
+        while time.time() < deadline:
+            try:
+                rf = remote_fabric(addr, timeout=5.0)
+                if rf.status(0, 2, 0)[0].name == "DECIDED":
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert rf is not None
+        assert rf.status(0, 2, 0)[1] == "survive-restart"
+        assert rf.status(1, 2, 3)[1] == 777
+        rf.start(0, 0, 1, "post")
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if rf.status(0, 0, 1)[0].name == "DECIDED":
+                break
+            time.sleep(0.05)
+        assert rf.status(0, 0, 1)[1] == "post"
+        p2.terminate()
+        try:
+            p2.wait(20)
+        except subprocess.TimeoutExpired:
+            p2.kill()
+    finally:
+        for p in [v for v in (locals().get("p1"), locals().get("p2"))
+                  if v is not None]:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(d, ignore_errors=True)

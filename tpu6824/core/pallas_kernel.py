@@ -312,8 +312,26 @@ class LaneState(NamedTuple):
 
 
 def _block(N: int) -> tuple[int, int]:
-    """(block size C, padded cell count Np) for an N-cell universe."""
-    C = min(8 * LANES, max(LANES, ((N + LANES - 1) // LANES) * LANES))
+    """(block size C, padded cell count Np) for an N-cell universe.
+
+    TPU6824_BLOCK_CELLS overrides the per-grid-step cell count (rounded to
+    lane multiples) — the tuning knob for block-size sweeps on hardware:
+    bigger blocks amortize grid overhead and lengthen DMA bursts at the
+    cost of VMEM residency (~4 bytes x ~17 lane rows per cell).
+
+    Read at TRACE time: jit caches key on shapes, so changing the knob
+    inside one process is ignored whenever the padded Np is unchanged —
+    sweep across fresh processes (as bench.py runs do), not in-process."""
+    import os
+
+    raw = os.environ.get("TPU6824_BLOCK_CELLS") or str(8 * LANES)
+    try:
+        cap = int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"TPU6824_BLOCK_CELLS={raw!r} is not an integer") from e
+    cap = max(LANES, (cap // LANES) * LANES)
+    C = min(cap, max(LANES, ((N + LANES - 1) // LANES) * LANES))
     return C, ((N + C - 1) // C) * C
 
 

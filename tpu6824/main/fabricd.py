@@ -14,6 +14,8 @@ orphaned test processes die on their own (`main/diskvd.go:64-74`).
 from __future__ import annotations
 
 import argparse
+import signal
+import sys
 import time
 
 
@@ -25,23 +27,61 @@ def main(argv=None):
     ap.add_argument("--instances", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ttl", type=float, default=600.0)
+    ap.add_argument("--restore", default=None, metavar="CKPT",
+                    help="resume from a fabric checkpoint file")
+    ap.add_argument("--checkpoint", default=None, metavar="CKPT",
+                    help="write a checkpoint here on shutdown (and every "
+                         "--checkpoint-interval seconds)")
+    ap.add_argument("--checkpoint-interval", type=float, default=0.0)
     args = ap.parse_args(argv)
+    if args.checkpoint_interval and not args.checkpoint:
+        ap.error("--checkpoint-interval requires --checkpoint")
+    if args.restore:
+        defaults = {"groups": 1, "peers": 3, "instances": 64, "seed": 0}
+        clash = [k for k, v in defaults.items() if getattr(args, k) != v]
+        if clash:
+            ap.error(f"--restore takes its dimensions from the checkpoint; "
+                     f"conflicting flags: {', '.join('--' + c for c in clash)}")
 
     from tpu6824.core.fabric import PaxosFabric
     from tpu6824.core.fabric_service import serve_fabric
 
-    fabric = PaxosFabric(
-        ngroups=args.groups, npeers=args.peers, ninstances=args.instances,
-        seed=args.seed, auto_step=True,
-    )
+    if args.restore:
+        fabric = PaxosFabric.restore(args.restore, auto_step=True)
+    else:
+        fabric = PaxosFabric(
+            ngroups=args.groups, npeers=args.peers,
+            ninstances=args.instances, seed=args.seed, auto_step=True,
+        )
     srv = serve_fabric(fabric, args.addr)
-    print(f"fabricd: serving (G={args.groups}, I={args.instances}, "
-          f"P={args.peers}) at {args.addr}", flush=True)
+    print(f"fabricd: serving (G={fabric.G}, I={fabric.I}, "
+          f"P={fabric.P}) at {args.addr}", flush=True)
+
+    def _ckpt():
+        # checkpoint() requires a stopped clock (torn-state guard).
+        fabric.stop_clock()
+        try:
+            fabric.checkpoint(args.checkpoint)
+        finally:
+            fabric.start_clock()
+
+    # SIGTERM → SystemExit so the finally block runs (final checkpoint);
+    # the reference daemons just die, but a checkpointing daemon must not.
+    signal.signal(signal.SIGTERM, lambda s, f: sys.exit(0))
+
     try:
-        time.sleep(args.ttl)
+        deadline = time.monotonic() + args.ttl
+        while time.monotonic() < deadline:
+            nap = min(args.checkpoint_interval or args.ttl,
+                      deadline - time.monotonic())
+            time.sleep(max(0.0, nap))
+            if args.checkpoint and args.checkpoint_interval:
+                _ckpt()
     finally:
         srv.kill()
         fabric.stop_clock()
+        if args.checkpoint:
+            fabric.checkpoint(args.checkpoint)
 
 
 if __name__ == "__main__":
