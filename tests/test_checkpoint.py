@@ -178,10 +178,12 @@ def test_fabricd_checkpoint_restart_cycle():
         assert rf.status(0, 1, 0)[1] == "survive-restart"
         assert rf.status(1, 0, 3)[1] == 777  # BOTH groups decided pre-ckpt
         p1.send_signal(signal.SIGTERM)
-        p1.wait(30)
-        assert os.path.exists(ckpt), "no checkpoint written on SIGTERM"
-        if p1.poll() is None:
+        try:
+            p1.wait(30)
+        except subprocess.TimeoutExpired:
             p1.kill()
+            raise AssertionError("fabricd hung on SIGTERM shutdown")
+        assert os.path.exists(ckpt), "no checkpoint written on SIGTERM"
 
         p2 = boot(["--restore", ckpt])
         deadline = time.time() + 30

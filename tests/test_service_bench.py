@@ -29,3 +29,77 @@ def test_service_throughput_scales_with_groups(monkeypatch):
     # (measured here: G=8 ~104k/s, G=256 ~204k/s).
     assert r256["value"] >= 1.3 * r8["value"], (r8, r256)
     assert r256["value"] >= 30_000, r256
+
+
+@pytest.mark.slow
+def test_service_soak_no_leaks(monkeypatch):
+    """Sustained API-driven load must hold the runtime's footprint flat:
+    the intern store tracks only the live window (TestForgetMem's
+    discipline, paxos/test_test.go:371-454, at service scale) and the
+    pending queues drain every step.  ~30s of steady traffic with
+    interned (string) payloads."""
+    import time
+
+    from tpu6824.core.fabric import PaxosFabric, WindowFullError
+    from tpu6824.core.peer import Fate
+
+    G, W, P = 64, 16, 3
+    I = 4 * W
+    fab = PaxosFabric(ngroups=G, npeers=P, ninstances=I)
+    applied = [0] * G
+    started = [0] * G
+    decided = 0
+    DECIDED = Fate.DECIDED
+    peak_live = 0
+    t_end = time.monotonic() + 30.0
+    while time.monotonic() < t_end:
+        queries = []
+        spans = []
+        for g in range(G):
+            lo, hi = applied[g], started[g]
+            if lo < hi:
+                spans.append((g, lo, hi))
+                queries.extend((g, s % P, s) for s in range(lo, hi))
+        res = fab.status_many(queries)
+        dones = []
+        i = 0
+        for g, lo, hi in spans:
+            s = lo
+            while s < hi and res[i][0] is DECIDED:
+                s += 1
+                i += 1
+            i += hi - s
+            if s > lo:
+                applied[g] = s
+                decided += s - lo
+                dones.extend((g, q, s - 1) for q in range(P))
+        if dones:
+            fab.done_many(dones)
+        starts = []
+        for g in range(G):
+            want = applied[g] + W
+            if started[g] < want:
+                # Interned payloads: distinct strings, so every op takes
+                # and must release one intern ref through the GC.
+                starts.extend((g, s % P, s, f"v-{g}-{s}")
+                              for s in range(started[g], want))
+                started[g] = want
+        if starts:
+            try:
+                fab.start_many(starts)
+            except WindowFullError:
+                for g in range(G):
+                    started[g] = applied[g]
+        fab.step()
+        peak_live = max(peak_live, fab.intern.nlive)
+
+    assert decided > 10_000, f"soak starved: {decided}"
+    # Live payloads never exceed the universe of live slots, and drain to
+    # (nearly) nothing once the load stops and GC catches up.
+    assert peak_live <= G * I, (peak_live, G * I)
+    for g in range(G):
+        fab.done_many([(g, q, applied[g] - 1) for q in range(P)])
+    fab.step(4)
+    live_after = fab.intern.nlive
+    assert live_after <= G * W, (live_after, "intern not draining")
+    assert not fab._pending_starts and not fab._pending_resets

@@ -37,8 +37,8 @@ def main(argv=None):
     if args.checkpoint_interval and not args.checkpoint:
         ap.error("--checkpoint-interval requires --checkpoint")
     if args.restore:
-        defaults = {"groups": 1, "peers": 3, "instances": 64, "seed": 0}
-        clash = [k for k, v in defaults.items() if getattr(args, k) != v]
+        clash = [k for k in ("groups", "peers", "instances", "seed")
+                 if getattr(args, k) != ap.get_default(k)]
         if clash:
             ap.error(f"--restore takes its dimensions from the checkpoint; "
                      f"conflicting flags: {', '.join('--' + c for c in clash)}")
@@ -62,6 +62,10 @@ def main(argv=None):
         fabric.stop_clock()
         try:
             fabric.checkpoint(args.checkpoint)
+        except OSError as e:
+            # Transient (disk full, perms): keep serving, retry next
+            # interval rather than taking down every dialed-in daemon.
+            print(f"fabricd: checkpoint failed: {e}", flush=True)
         finally:
             fabric.start_clock()
 
@@ -78,6 +82,8 @@ def main(argv=None):
             if args.checkpoint and args.checkpoint_interval:
                 _ckpt()
     finally:
+        # A second SIGTERM must not abort the final checkpoint mid-write.
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
         srv.kill()
         fabric.stop_clock()
         if args.checkpoint:
