@@ -225,3 +225,34 @@ def test_pooled_cluster_agreement():
                 p.kill()
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+def test_parallel_fanout_agreement():
+    """parallel_fanout=True (concurrent phase fan-out — one RTT per phase)
+    preserves agreement and the Done/Min protocol."""
+    import shutil
+    import tempfile
+
+    from tpu6824.core.hostpeer import make_host_cluster
+    from tpu6824.core.peer import Fate
+    from tpu6824.utils.timing import wait_until
+
+    d = tempfile.mkdtemp(prefix="pfan", dir="/var/tmp")
+    try:
+        peers = make_host_cluster(d, npeers=3, seed=5, pooled=True,
+                                  parallel_fanout=True)
+        try:
+            for seq in range(12):
+                peers[seq % 3].start(seq, seq * 3)
+            ok = wait_until(
+                lambda: all(p.status(s)[0] == Fate.DECIDED
+                            for p in peers for s in range(12)), 30.0)
+            assert ok
+            for s in range(12):
+                vals = {p.status(s)[1] for p in peers}
+                assert vals == {s * 3}, (s, vals)
+        finally:
+            for p in peers:
+                p.kill()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
