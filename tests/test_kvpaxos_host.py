@@ -94,3 +94,25 @@ def test_log_gc_advances_min(cluster):
             break
     assert wait_until(lambda: all(s.px.min() > 0 for s in cluster),
                       timeout=15.0), [s.px.min() for s in cluster]
+
+
+def test_host_cluster_pooled_basic(tmp_path):
+    """The full kvpaxos service stack on the optimized wire profile
+    (pooled net/rpc connections): linearizable ops, at-most-once."""
+    from tpu6824.services.kvpaxos import Clerk, make_host_cluster
+
+    peers, servers = make_host_cluster(str(tmp_path), nservers=3, seed=3,
+                                       pooled=True)
+    try:
+        ck = Clerk(servers)
+        ck.put("k", "v1", timeout=30.0)
+        ck.append("k", "+v2", timeout=30.0)
+        assert ck.get("k", timeout=30.0) == "v1+v2"
+        ck2 = Clerk(servers)
+        ck2.append("k", "+v3", timeout=30.0)
+        assert ck.get("k", timeout=30.0) == "v1+v2+v3"
+    finally:
+        for s in servers:
+            s.kill()
+        for p in peers:
+            p.kill()

@@ -161,12 +161,13 @@ class HostPaxosPeer:
             # hence opt-in rather than tied to pooling.
             from concurrent.futures import ThreadPoolExecutor
 
-            # Sized for the real contention: up to max_proposers
-            # concurrent proposer threads each fan P-1 blocking calls —
-            # a tiny pool would serialize every phase behind a single
-            # slow/deaf peer's 5s timeouts.
+            # Sized for worst-case contention — EVERY proposer slot
+            # simultaneously fanning P-1 blocking calls (e.g. a deaf peer
+            # holding 5s timeouts).  A smaller shared pool would queue
+            # healthy-peer calls behind deaf-peer timeouts, degrading
+            # liveness below the sequential mode this exists to beat.
             self._fanout = ThreadPoolExecutor(
-                max_workers=max(2, (self.P - 1) * min(max_proposers, 16)),
+                max_workers=max(2, (self.P - 1) * max_proposers),
                 thread_name_prefix=f"px{me}-fan")
         self.server = GobRpcServer(self.addr, seed=seed, registry=reg)
         self.server.register_method("Paxos.Prepare", self._rpc_prepare,

@@ -61,11 +61,14 @@ class StructOpPeer:
 def make_host_replica(sockdir: str, prefix: str, name: str, schema: Struct,
                       make_server, nservers: int, me: int,
                       seed: int | None = None,
-                      persist_dir: str | None = None):
+                      persist_dir: str | None = None,
+                      **peer_kw):
     """One decentralized replica: a gob Paxos peer endpoint at
     `{sockdir}/{prefix}-{me}` plus the service RSM built by
     `make_server(host_op_peer)`.  With `persist_dir` the peer's consensus
-    state is crash-durable (see HostPaxosPeer).  Returns (host_peer,
+    state is crash-durable (see HostPaxosPeer).  Extra keywords (pooled=,
+    parallel_fanout=, ...) pass through to HostPaxosPeer, so services can
+    run on the optimized connection profiles.  Returns (host_peer,
     server)."""
     from tpu6824.core.hostpeer import HostPaxosPeer
     from tpu6824.shim.wire import default_registry
@@ -73,18 +76,19 @@ def make_host_replica(sockdir: str, prefix: str, name: str, schema: Struct,
     registry = default_registry().register(name, schema)
     addrs = [f"{sockdir}/{prefix}-{i}" for i in range(nservers)]
     peer = HostPaxosPeer(addrs, me, registry=registry, seed=seed,
-                         persist_dir=persist_dir)
+                         persist_dir=persist_dir, **peer_kw)
     return peer, make_server(peer)
 
 
 def make_host_cluster(sockdir: str, prefix: str, name: str, schema: Struct,
-                      make_server, nservers: int, seed: int | None = None):
+                      make_server, nservers: int, seed: int | None = None,
+                      **peer_kw):
     """All replicas in one process (tests); one-per-process deployments call
     make_host_replica directly."""
     pairs = [
         make_host_replica(sockdir, prefix, name, schema, make_server,
                           nservers, i,
-                          seed=None if seed is None else seed + i)
+                          seed=None if seed is None else seed + i, **peer_kw)
         for i in range(nservers)
     ]
     return [p for p, _ in pairs], [s for _, s in pairs]

@@ -253,26 +253,36 @@ def HostOpPeer(host_peer) -> StructOpPeer:
 
 def make_host_replica(sockdir: str, nservers: int, me: int,
                       seed: int | None = None,
-                      persist_dir: str | None = None, **kw):
+                      persist_dir: str | None = None,
+                      peer_kw: dict | None = None, **kw):
     """One decentralized replica — peer endpoint + RSM server — suitable
     for one-replica-per-OS-process deployment (the reference's model:
     every server process embeds its own Paxos peer,
     kvpaxos/server.go StartServer).  With `persist_dir`, the peer survives
-    crash+restart.  Returns (host_peer, server)."""
+    crash+restart.  `peer_kw` goes to HostPaxosPeer (pooled=,
+    parallel_fanout=, ...); other keywords go to the server.  Returns
+    (host_peer, server)."""
     from tpu6824.services.host_backend import make_host_replica as _mk
 
     return _mk(sockdir, "px", KVOP_NAME, KVOP_WIRE,
                lambda p: KVPaxosServer(None, 0, p.me, px=HostOpPeer(p), **kw),
-               nservers, me, seed=seed, persist_dir=persist_dir)
+               nservers, me, seed=seed, persist_dir=persist_dir,
+               **(peer_kw or {}))
 
 
 def make_host_cluster(sockdir: str, nservers: int = 3, seed: int | None = None,
+                      pooled: bool = False, peer_kw: dict | None = None,
                       **kw):
     """kvpaxos on the decentralized wire path: one gob Paxos endpoint per
     replica, consensus by per-message Prepare/Accept/Decided RPC — the
-    reference's deployment model end to end."""
+    reference's deployment model end to end.  pooled=True runs the peers
+    on long-lived net/rpc client connections (the optimized profile);
+    `peer_kw` passes any further HostPaxosPeer options."""
     from tpu6824.services.host_backend import make_host_cluster as _mk
 
+    pk = dict(peer_kw or {})
+    if pooled:
+        pk["pooled"] = True
     return _mk(sockdir, "px", KVOP_NAME, KVOP_WIRE,
                lambda p: KVPaxosServer(None, 0, p.me, px=HostOpPeer(p), **kw),
-               nservers, seed=seed)
+               nservers, seed=seed, **pk)

@@ -535,8 +535,10 @@ def HostOpPeer(host_peer) -> StructOpPeer:
 
 
 def make_host_group(sockdir: str, gid: int, nreplicas: int, sm_servers,
-                    directory: dict, seed: int | None = None, **kw):
-    """One shardkv replica group on decentralized wire consensus."""
+                    directory: dict, seed: int | None = None,
+                    peer_kw: dict | None = None, **kw):
+    """One shardkv replica group on decentralized wire consensus;
+    `peer_kw` goes to HostPaxosPeer (pooled=, parallel_fanout=, ...)."""
     from tpu6824.services.host_backend import make_host_cluster as _mk
 
     def mk_server(p):
@@ -544,7 +546,7 @@ def make_host_group(sockdir: str, gid: int, nreplicas: int, sm_servers,
                              px=HostOpPeer(p), **kw)
 
     return _mk(sockdir, f"skv{gid}", SKVOP_NAME, SKVOP_WIRE, mk_server,
-               nreplicas, seed=seed)
+               nreplicas, seed=seed, **(peer_kw or {}))
 
 
 class HostShardSystem(_ShardSystemOps):

@@ -295,21 +295,24 @@ def HostOpPeer(host_peer) -> StructOpPeer:
 
 
 def make_host_replica(sockdir: str, nservers: int, me: int,
-                      seed: int | None = None, **kw):
-    """One decentralized shardmaster replica (peer endpoint + RSM)."""
+                      seed: int | None = None,
+                      peer_kw: dict | None = None, **kw):
+    """One decentralized shardmaster replica (peer endpoint + RSM);
+    `peer_kw` goes to HostPaxosPeer (pooled=, parallel_fanout=, ...)."""
     from tpu6824.services.host_backend import make_host_replica as _mk
 
     return _mk(sockdir, "smpx", SMOP_NAME, SMOP_WIRE,
                lambda p: ShardMasterServer(None, 0, p.me, px=HostOpPeer(p),
                                            **kw),
-               nservers, me, seed=seed)
+               nservers, me, seed=seed, **(peer_kw or {}))
 
 
 def make_host_cluster(sockdir: str, nservers: int = 3,
-                      seed: int | None = None, **kw):
+                      seed: int | None = None,
+                      peer_kw: dict | None = None, **kw):
     from tpu6824.services.host_backend import make_host_cluster as _mk
 
     return _mk(sockdir, "smpx", SMOP_NAME, SMOP_WIRE,
                lambda p: ShardMasterServer(None, 0, p.me, px=HostOpPeer(p),
                                            **kw),
-               nservers, seed=seed)
+               nservers, seed=seed, **(peer_kw or {}))
