@@ -1,5 +1,6 @@
-"""One rank of the two-OS-process multi-host mesh validation (invoked by
-tests/test_multihost_process.py as a subprocess per rank).
+"""One rank of the multi-OS-process mesh validation (invoked by
+tests/test_multihost_process.py as a subprocess per rank; 2- and
+4-process meshes).
 
 Usage: python tests/mh_rank_helper.py <rank> <nproc> <coordinator_port>
 """

@@ -1,8 +1,9 @@
-"""REAL multi-host validation: two OS processes, each contributing 4
-virtual CPU devices, glued by `jax.distributed` into one 8-device runtime.
-The ('g','i','p') mesh spans both processes with the host boundary on the
-group axis (dcn_safe), and one sharded consensus step runs with the quorum
-collectives crossing the process boundary (gloo standing in for DCN).
+"""REAL multi-host validation: 2 and 4 OS processes, each contributing 4
+virtual CPU devices, glued by `jax.distributed` into one 8- or 16-device
+runtime.  The ('g','i','p') mesh spans every process with the host
+boundaries on the group axis (dcn_safe), and one sharded consensus step
+runs with the quorum collectives crossing the process boundaries (gloo
+standing in for DCN).
 
 This is the process-mesh path `parallel/multihost.py` promises —
 `tests/test_multihost.py` checks the layout logic single-process; here the
@@ -30,17 +31,21 @@ def _free_port():
 
 
 @pytest.mark.slow
-def test_two_process_mesh_consensus():
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_multi_process_mesh_consensus(nproc):
+    """2- and 4-OS-process meshes: the same helper, the host boundary
+    always on the never-communicating group axis (dcn_safe), quorum
+    collectives crossing every process boundary."""
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # helper sets its own device count
     procs = [
         subprocess.Popen(
-            [sys.executable, HELPER, str(rank), "2", str(port)],
+            [sys.executable, HELPER, str(rank), str(nproc), str(port)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=REPO,
         )
-        for rank in (0, 1)
+        for rank in range(nproc)
     ]
     deadline = time.monotonic() + 180
     outs = []
@@ -51,12 +56,20 @@ def test_two_process_mesh_consensus():
         except subprocess.TimeoutExpired:
             for p2 in procs:
                 p2.kill()
+            for p2 in procs:  # reap: no zombies/open pipes for the session
+                try:
+                    p2.wait(5)
+                except subprocess.TimeoutExpired:
+                    pass
             raise AssertionError("multi-host ranks timed out")
         outs.append(out)
     for rank, (pr, out) in enumerate(zip(procs, outs)):
         assert pr.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
         assert f"RANK-OK {rank}" in out, out[-2000:]
-    # both ranks executed the same global step: identical message counts
-    m0 = [ln for ln in outs[0].splitlines() if ln.startswith("RANK-OK")][0]
-    m1 = [ln for ln in outs[1].splitlines() if ln.startswith("RANK-OK")][0]
-    assert m0.split("msgs=")[1] == m1.split("msgs=")[1]
+    # every rank executed the same global step: identical message counts
+    msgs = [
+        [ln for ln in out.splitlines()
+         if ln.startswith("RANK-OK")][0].split("msgs=")[1]
+        for out in outs
+    ]
+    assert len(set(msgs)) == 1, msgs
