@@ -110,3 +110,21 @@ def test_concurrent_move_churn_over_wire(system):
     for t in ts:
         t.join()
     assert not errs, errs
+
+
+def test_host_shard_system_pooled(tmp_path):
+    """The fully-decentralized capstone on the pooled connection profile:
+    join, write through reconfig, read back — same invariants, fewer
+    dials."""
+    s = HostShardSystem(str(tmp_path), ngroups=2, nreplicas=3, seed=4,
+                        peer_kw={"pooled": True})
+    try:
+        g0, g1 = s.gids
+        s.join(g0)
+        ck = s.clerk()
+        ck.put("a", "1", timeout=60.0)
+        s.join(g1)
+        ck.append("a", "2", timeout=60.0)
+        assert ck.get("a", timeout=60.0) == "12"
+    finally:
+        s.shutdown()

@@ -26,6 +26,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--lifetime", type=float, default=600.0,
                     help="suicide timer, like diskvd's (main/diskvd.go:30-74)")
+    ap.add_argument("--pooled", action="store_true",
+                    help="long-lived net/rpc client connections to peers "
+                         "(optimized profile; per-connection fault "
+                         "injection then fires only at dial time)")
     ap.add_argument("--persist", default=None, metavar="DIR",
                     help="durable consensus state: survive crash+restart")
     args = ap.parse_args(argv)
@@ -35,7 +39,8 @@ def main(argv=None) -> int:
 
     peer, server = make_host_replica(args.dir, args.n, args.me,
                                      seed=args.seed,
-                                     persist_dir=args.persist)
+                                     persist_dir=args.persist,
+                                     peer_kw={"pooled": args.pooled})
     ep = endpoints.serve_kvpaxos(server, f"{args.dir}/clerk-{args.me}")
 
     stop = []

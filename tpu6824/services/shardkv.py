@@ -555,17 +555,18 @@ class HostShardSystem(_ShardSystemOps):
     Paxos — zero shared fabric, the reference's runtime model end to end."""
 
     def __init__(self, sockdir: str, ngroups: int = 2, nreplicas: int = 3,
-                 base_gid: int = 100, seed: int = 0):
+                 base_gid: int = 100, seed: int = 0,
+                 peer_kw: dict | None = None):
         self.directory: dict = {}
         _, self.sm_servers = shardmaster.make_host_cluster(
-            sockdir, nservers=nreplicas, seed=seed)
+            sockdir, nservers=nreplicas, seed=seed, peer_kw=peer_kw)
         self.groups: dict[int, list[ShardKVServer]] = {}
         self.gids = []
         for i in range(ngroups):
             gid = base_gid + i
             _, servers = make_host_group(
                 sockdir, gid, nreplicas, self.sm_servers, self.directory,
-                seed=seed + 100 * (i + 1))
+                seed=seed + 100 * (i + 1), peer_kw=peer_kw)
             self.groups[gid] = servers
             self.gids.append(gid)
 
