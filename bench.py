@@ -264,7 +264,8 @@ def child_main():
 
         # Roofline context: bytes moved per BEST-CASE step.
         #  - pallas: the fused cycle is one kernel — reads 7 state + sa +
-        #    sv, writes 7 state + msgs (all (P, N) i32) + rec (1, N).
+        #    sv, writes 7 state (all (P, N) i32) + rec (1, N); the msgs
+        #    counter output is dropped in the bench loop (count_msgs=False).
         #  - xla: the reliable cycle is recycle-read (dec) + apply_starts
         #    (7r+7w + sa/sv/reset) + round (7r+6w+io), ~32 (G,I,P)-array
         #    passes before XLA fusion (an upper bound; fusion trims it).
@@ -274,7 +275,7 @@ def child_main():
         #  packed mode, ZERO in prng mode (in-kernel draws).
         N_cells = G * I
         if impl == "pallas":
-            state_bytes = (17 * P + 1) * N_cells * 4
+            state_bytes = (16 * P + 1) * N_cells * 4
         else:
             state_bytes = 32 * N_cells * P * 4
         mask_bytes = (0 if lossy_mode == "prng"
@@ -432,7 +433,8 @@ def _lane_engine(jax, jnp, np, G, I, P, link, done, on_cpu):
                 l, dv, done, key, sa, sv, link=link,
                 drop_req=dreq, drop_rep=drep,
                 req_rate=dreq[0, 0, 1], rep_rate=drep[0, 0, 1],
-                G=G, I=I, mode=mode, interpret=interp)
+                G=G, I=I, mode=mode, interpret=interp,
+                count_msgs=False)
             return (l, dv), rec.sum(dtype=jnp.int32)
         return jax.lax.scan(cycle, carry, keys)
 

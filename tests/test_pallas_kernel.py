@@ -387,3 +387,27 @@ def test_prng_mode_total_loss_is_safe():
             l, dv, done, sub, sa, sv, G=G, I=I, mode="prng",
             req_rate=1.0, rep_rate=1.0, interpret=True)
     assert (np.asarray(l.dec) < 0).all(), "decided without a quorum"
+
+
+def test_cycle_count_msgs_off_same_state():
+    """count_msgs=False drops the RPC-budget output without touching the
+    consensus state: bit-identical LaneState/done_view/rec, msgs == -1."""
+    from tpu6824.core.pallas_kernel import paxos_cycle_lanes
+
+    G, I, P = 2, 16, 3
+    la, dva, sa, sv, _ = _lane_setup(G, I, P, nprop=P)
+    lb, dvb = jax.tree.map(jnp.copy, la), jnp.copy(dva)
+    done = jnp.full((G, P), -1, jnp.int32)
+    key = jax.random.key(9)
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        la, dva, ra, ma = paxos_cycle_lanes(
+            la, dva, done, sub, sa, sv, G=G, I=I, interpret=True)
+        lb, dvb, rb, mb = paxos_cycle_lanes(
+            lb, dvb, done, sub, sa, sv, G=G, I=I, interpret=True,
+            count_msgs=False)
+        for name, x, y in zip(la._fields, la, lb):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                          err_msg=name)
+        np.testing.assert_array_equal(np.asarray(ra), np.asarray(rb))
+        assert int(ma) >= 0 and int(mb) == -1

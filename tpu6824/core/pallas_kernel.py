@@ -59,7 +59,8 @@ LANES = 128  # TPU lane width; cell blocks are multiples of this
 _BIT_M1, _BIT_M2, _BIT_M3, _BIT_R1, _BIT_R2 = range(5)
 
 
-def _round_kernel(P: int, mode: str, cycle: bool, *refs):
+def _round_kernel(P: int, mode: str, cycle: bool,
+                  count_msgs: bool, *refs):
     """One consensus round for a (P, C) block of cells.
 
     `mode` selects the delivery-mask source:
@@ -82,7 +83,10 @@ def _round_kernel(P: int, mode: str, cycle: bool, *refs):
     a per-cell recycled indicator.
 
     refs order: [cfg?] np, na, va, dec, act, propv, ms, [sa, sv], [mask],
-    then outputs: np, na, va, dec, ms, [act, propv, rec], msgs.
+    then outputs: np, na, va, dec, ms, [act, propv, rec], [msgs]
+    (`count_msgs=False` drops the msgs output entirely — the RPC-budget
+    counter is one full (P, C) write per block that steady-state
+    throughput loops never read).
     State refs are (P, C) int32.  Every operand below is a (1, C) lane
     vector; loops over the peer axis are unrolled at trace time.
     """
@@ -96,9 +100,12 @@ def _round_kernel(P: int, mode: str, cycle: bool, *refs):
     mask_ref = refs.pop(0) if mode == "packed" else None
     if cycle:
         (np_out, na_out, va_out, dec_out, ms_out,
-         act_out, propv_out, rec_out, msgs_out) = refs
+         act_out, propv_out, rec_out) = refs[:8]
+        refs = refs[8:]
     else:
-        (np_out, na_out, va_out, dec_out, ms_out, msgs_out) = refs
+        (np_out, na_out, va_out, dec_out, ms_out) = refs[:5]
+        refs = refs[5:]
+    msgs_out = refs[0] if count_msgs else None
 
     C = np_ref.shape[1]
 
@@ -267,22 +274,24 @@ def _round_kernel(P: int, mode: str, cycle: bool, *refs):
 
     # Remote-message count per sender (self edges excluded) — RPC budget
     # analog (paxos/test_test.go:503-573).
-    msgs = []
-    for p in range(P):
-        cnt = zero
-        for q in range(P):
-            if q == p:
-                continue
-            cnt = (cnt + D1[p][q].astype(I32) + D2[p][q].astype(I32)
-                   + D3[p][q].astype(I32))
-        msgs.append(cnt)
+    if count_msgs:
+        msgs = []
+        for p in range(P):
+            cnt = zero
+            for q in range(P):
+                if q == p:
+                    continue
+                cnt = (cnt + D1[p][q].astype(I32) + D2[p][q].astype(I32)
+                       + D3[p][q].astype(I32))
+            msgs.append(cnt)
 
     np_out[...] = jnp.concatenate(np_post2, axis=0)
     na_out[...] = jnp.concatenate(na_new, axis=0)
     va_out[...] = jnp.concatenate(va_new, axis=0)
     dec_out[...] = jnp.concatenate(dec_new, axis=0)
     ms_out[...] = jnp.concatenate(ms_new, axis=0)
-    msgs_out[...] = jnp.concatenate(msgs, axis=0)
+    if count_msgs:
+        msgs_out[...] = jnp.concatenate(msgs, axis=0)
     if cycle:
         act_out[...] = jnp.concatenate(
             [(active[p] & (dec_new[p] < 0)).astype(I32) for p in range(P)],
@@ -413,7 +422,8 @@ def apply_starts_lane(l: LaneState, reset: jnp.ndarray,
 
 
 def _lane_round(l: LaneState, packed_mask, interpret,
-                *, mode=None, cycle=False, sa=None, sv=None, cfg=None):
+                *, mode=None, cycle=False, sa=None, sv=None, cfg=None,
+                count_msgs=True):
     """Invoke the fused round on lane-resident state.
 
     Back-compat form: `packed_mask` is the (P, P, Np) int32 bitplane array
@@ -448,15 +458,17 @@ def _lane_round(l: LaneState, packed_mask, interpret,
         in_specs.append(edge_spec)
     rec_spec = pl.BlockSpec((1, C), lambda i: (0, i))
     if cycle:
-        # np, na, va, dec, ms, act, propv, rec, msgs
-        out_specs = [cell] * 7 + [rec_spec, cell]
-        out_shape_l = ([out_shape] * 7
-                       + [jax.ShapeDtypeStruct((1, Np), I32), out_shape])
+        # np, na, va, dec, ms, act, propv, rec, [msgs]
+        out_specs = [cell] * 7 + [rec_spec]
+        out_shape_l = [out_shape] * 7 + [jax.ShapeDtypeStruct((1, Np), I32)]
     else:
-        out_specs = [cell] * 6
-        out_shape_l = [out_shape] * 6
+        out_specs = [cell] * 5
+        out_shape_l = [out_shape] * 5
+    if count_msgs:
+        out_specs.append(cell)
+        out_shape_l.append(out_shape)
     outs = pl.pallas_call(
-        functools.partial(_round_kernel, P, mode, cycle),
+        functools.partial(_round_kernel, P, mode, cycle, count_msgs),
         grid=(Np // C,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -465,11 +477,13 @@ def _lane_round(l: LaneState, packed_mask, interpret,
     )(*ops)
     if cycle:
         (np_post2, na_new, va_new, dec_new, ms_new,
-         act_new, propv_new, rec, msgs_l) = outs
+         act_new, propv_new, rec) = outs[:8]
+        msgs_l = outs[8] if count_msgs else None
         l2 = LaneState(np_=np_post2, na=na_new, va=va_new, dec=dec_new,
                        act=act_new, propv=propv_new, ms=ms_new)
         return l2, msgs_l, rec
-    np_post2, na_new, va_new, dec_new, ms_new, msgs_l = outs
+    (np_post2, na_new, va_new, dec_new, ms_new) = outs[:5]
+    msgs_l = outs[5] if count_msgs else None
     act_new = ((l.act != 0) & (dec_new < 0)).astype(I32)
     l2 = LaneState(np_=np_post2, na=na_new, va=va_new, dec=dec_new,
                    act=act_new, propv=l.propv, ms=ms_new)
@@ -561,7 +575,8 @@ def _done_gossip_packed(act_lanes, M1, khb, link, drop_req, done_view, done,
     return jnp.maximum(done_view, jnp.where(gotmsg, done[:, None, :], -1))
 
 
-@functools.partial(jax.jit, static_argnames=("G", "I", "mode", "interpret"))
+@functools.partial(jax.jit, static_argnames=("G", "I", "mode", "interpret",
+                                             "count_msgs"))
 def paxos_cycle_lanes(
     l: LaneState,
     done_view: jnp.ndarray,  # (G, P, P) i32
@@ -579,6 +594,7 @@ def paxos_cycle_lanes(
     req_rate=0.0,            # prng mode: uniform request-drop probability
     rep_rate=0.0,            # prng mode: uniform reply-drop probability
     interpret=False,
+    count_msgs: bool = True,
 ):
     """One fused steady-state CYCLE: recycle decided cells → arm via sa/sv
     → full prepare/accept/decide round — a single HBM round trip for what
@@ -595,7 +611,9 @@ def paxos_cycle_lanes(
     Assumes a fully-connected link (the bench's unreliable config);
     partitioned/heterogeneous networks use mode="packed".
 
-    Returns (LaneState, done_view, recycled (1, Np) i32, msgs scalar).
+    Returns (LaneState, done_view, recycled (1, Np) i32, msgs scalar —
+    or -1 with `count_msgs=False`, which drops the RPC-budget counter's
+    (P, Np) write + reduce from the kernel for pure-throughput loops).
     """
     P = l.np_.shape[0]
     N = G * I
@@ -613,7 +631,7 @@ def paxos_cycle_lanes(
         act_post = (((l.act != 0) & ~rec_pre)
                     | ((sa != 0) & (rec_pre | (l.dec < 0))))
         l2, msgs_l, rec = _lane_round(l, packed, interpret, cycle=True,
-                                      sa=sa, sv=sv)
+                                      sa=sa, sv=sv, count_msgs=count_msgs)
         done_view = _done_gossip_packed(
             act_post, M1, khb, link, drop_req, done_view, done,
             G, I, P, N, eye)
@@ -629,7 +647,8 @@ def paxos_cycle_lanes(
             jax.random.key_data(key).ravel()[-1], jnp.int32)
         cfg = jnp.stack([seed, tq, tp])
         l2, msgs_l, rec = _lane_round(l, None, interpret, mode="prng",
-                                      cycle=True, sa=sa, sv=sv, cfg=cfg)
+                                      cycle=True, sa=sa, sv=sv, cfg=cfg,
+                                      count_msgs=count_msgs)
         # Done piggyback: once-per-step heartbeat over the lossy net (the
         # kernel's deliveries aren't observable host-side in this mode —
         # same information flow, one gossip opportunity per step).
@@ -640,11 +659,12 @@ def paxos_cycle_lanes(
             done_view, jnp.where(gotmsg, done[:, None, :], -1))
     else:
         l2, msgs_l, rec = _lane_round(l, None, interpret, cycle=True,
-                                      sa=sa, sv=sv)
+                                      sa=sa, sv=sv, count_msgs=count_msgs)
         done_view = jnp.maximum(done_view, done[:, None, :])
     done_view = jnp.maximum(
         done_view, jnp.where(eye[None], done[:, None, :], -1))
-    msgs = msgs_l[:, :N].sum().astype(I32)
+    msgs = (msgs_l[:, :N].sum().astype(I32) if count_msgs
+            else jnp.int32(-1))
     return l2, done_view, rec[:, :N], msgs
 
 
