@@ -223,3 +223,48 @@ def test_native_server_client_churn_linearizable():
             for s in servers:
                 s.kill()
             fabric.stop_clock()
+
+
+def test_pooled_connections_outlive_link_surgery():
+    """Documented semantic difference of the pooled profile: an ESTABLISHED
+    net/rpc connection outlives link-farm surgery (alias removal only
+    affects new dials — exactly as the reference's hard-link partitions
+    only affect new `rpc.Dial`s).  Consensus must stay safe either way:
+    ops decided before the surgery remain decided and agreed."""
+    import shutil
+    import tempfile
+
+    from tpu6824.core.hostpeer import make_host_cluster
+    from tpu6824.core.peer import Fate
+    from tpu6824.utils.timing import wait_until
+
+    d = tempfile.mkdtemp(prefix="pls", dir="/var/tmp")
+    try:
+        peers = make_host_cluster(d, npeers=3, seed=8, pooled=True)
+        try:
+            # Warm peer 1's client pool: IT proposes, establishing pooled
+            # connections to both other peers (a pool only holds edges the
+            # peer has used as a client).
+            peers[1].start(0, "pre-surgery")
+            ok = wait_until(
+                lambda: all(p.status(0)[0] == Fate.DECIDED for p in peers),
+                20.0)
+            assert ok
+            # Surgery: delete peer 2's socket path (deafness for NEW
+            # dials).  Peer 1's established connections still work, so a
+            # subsequent agreement INCLUDING peer 2 can still land.
+            import os
+
+            os.unlink(f"{d}/px-2")
+            peers[1].start(1, "post-surgery")
+            ok = wait_until(
+                lambda: all(p.status(1)[0] == Fate.DECIDED for p in peers),
+                20.0)
+            assert ok, "pooled conns should ride out socket-path removal"
+            vals = {p.status(1)[1] for p in peers}
+            assert vals == {"post-surgery"}
+        finally:
+            for p in peers:
+                p.kill()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
