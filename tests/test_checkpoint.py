@@ -217,3 +217,63 @@ def test_fabricd_checkpoint_restart_cycle():
             if p.poll() is None:
                 p.kill()
         shutil.rmtree(d, ignore_errors=True)
+
+
+@pytest.mark.parametrize("trial", [0, 3, 6, 9])
+def test_checkpoint_restore_random_schedule(trial):
+    """Fuzz: random op/fault/step schedules with checkpoints+restores at
+    random points; after healing, every started instance is decided (or
+    forgotten) with ONE of its proposed values, agreed across peers.
+    Deterministic seeds — failures reproduce."""
+    import random
+    import tempfile
+
+    from tpu6824.core.fabric import PaxosFabric
+
+    rng = random.Random(9000 + trial)
+    G, P, I = rng.choice([(2, 3, 16), (3, 5, 12), (1, 3, 8)])
+    fab = PaxosFabric(ngroups=G, npeers=P, ninstances=I, seed=trial)
+    expected = {}
+    nseq = [0] * G
+    path = tempfile.mktemp(prefix="ckfz", dir="/var/tmp")
+    try:
+        for _phase in range(rng.randint(2, 4)):
+            for _ in range(rng.randint(3, 10)):
+                op = rng.random()
+                g = rng.randrange(G)
+                if op < 0.55 and nseq[g] < I - 2:
+                    seq = nseq[g]
+                    nseq[g] += 1
+                    vals = set()
+                    for p in rng.sample(range(P), rng.randint(1, P)):
+                        v = f"t{trial}-g{g}-s{seq}-p{p}"
+                        if rng.random() < 0.5:
+                            v = rng.randrange(1000)  # immediate-id path
+                        fab.start(g, p, seq, v)
+                        vals.add(v)
+                    expected[(g, seq)] = vals
+                elif op < 0.7:
+                    fab.set_unreliable(rng.random() < 0.5)
+                else:
+                    fab.step(1)
+            fab.step(rng.randint(2, 6))
+            if rng.random() < 0.7:
+                fab.set_unreliable(False)
+                fab.step(3)
+                fab.checkpoint(path)
+                fab = PaxosFabric.restore(path)
+        fab.set_unreliable(False)
+        fab.heal()
+        fab.step(12)
+        for (g, seq), vals in expected.items():
+            f0, v0 = fab.status(g, 0, seq)
+            assert f0 in (Fate.DECIDED, Fate.FORGOTTEN), (g, seq, f0)
+            if f0 == Fate.DECIDED:
+                assert v0 in vals, (g, seq, v0, vals)
+                for p in range(1, P):
+                    fp, vp = fab.status(g, p, seq)
+                    if fp == Fate.DECIDED:
+                        assert vp == v0, (g, seq, p, vp, v0)
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
