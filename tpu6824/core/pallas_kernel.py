@@ -28,7 +28,13 @@ module fuses the whole round into ONE Pallas kernel:
     True and per-step HBM traffic is just the 13 state arrays;
   - state can stay RESIDENT in the `(P, N)` lane layout across steps
     (`LaneState` + `paxos_step_lanes` + `apply_starts_lane`), eliminating
-    the two full-state transposes per step the conversion wrappers pay.
+    the two full-state transposes per step the conversion wrappers pay;
+  - the steady-state CYCLE (`paxos_cycle_lanes`) additionally fuses the
+    recycle+arm pass into the same kernel (one HBM round trip for what
+    was three), can draw lossy delivery bits from the in-kernel counter
+    PRNG (mode="prng": zero mask HBM traffic, distributionally — not
+    bit — equivalent to the XLA oracle), and can drop the RPC-budget
+    counter output (`count_msgs=False`) for pure-throughput loops.
 
 Semantics are those of `paxos_step` (see kernel.py's docstring for the
 mapping to `paxos/paxos.go`); the only realization difference is that the
