@@ -175,6 +175,11 @@ def child_main():
             "kernel": impl,
             "provisional": "contended/lossy/wire configs not yet run",
         })
+        if os.environ.get("BENCH_TEST_WEDGE_AFTER_PROVISIONAL"):
+            # Test hook: simulate the accelerator wedging mid-run so the
+            # parent's stdout-salvage contract stays regression-tested
+            # (it is what recovered the r02-class failure mode).
+            time.sleep(10 ** 6)
         # On a real accelerator, also time the OTHER kernel's best case so
         # every recorded run carries the pallas-vs-xla comparison.  If the
         # full shape won't compile (the XLA graph at G=1024 x I=8192 has

@@ -56,3 +56,26 @@ def test_error_line_when_everything_fails():
     assert len(lines) == 1, r.stdout
     d = json.loads(lines[0])
     assert d["value"] == 0.0 and "error" in d
+
+
+@pytest.mark.slow
+def test_provisional_line_salvaged_when_child_wedges():
+    """The child emits a provisional line right after the headline; if the
+    accelerator then wedges mid-run, the parent's timeout salvage must
+    still deliver that line (this recovered the r02-class failure mode)."""
+    # XLA engine: compiles in seconds at this shape, so the provisional
+    # line reliably lands inside the salvage window even on a loaded host
+    # (the pallas interpret-mode compile could outrun it).  The run costs
+    # the full BENCH_CPU_TIMEOUT by construction — the child never exits.
+    r = run_bench({"BENCH_FORCE_CPU": "1", "BENCH_KERNEL": "xla",
+                   "BENCH_GROUPS": "4",
+                   "BENCH_INSTANCES": "16", "BENCH_REPS": "1",
+                   "BENCH_TEST_WEDGE_AFTER_PROVISIONAL": "1",
+                   "BENCH_CPU_TIMEOUT": "40", "BENCH_DEADLINE": "90"},
+                  timeout=150)
+    assert r.returncode == 0, r.stderr[-500:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    assert d["value"] > 0
+    assert "provisional" in d, d
