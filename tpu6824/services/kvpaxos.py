@@ -68,10 +68,17 @@ class KVPaxosServer:
     def _tick_loop(self):
         while not self.dead:
             time.sleep(0.02)
-            with self.mu:
-                if self.dead:
-                    return
-                self._drain_decided()
+            try:
+                with self.mu:
+                    if self.dead:
+                        return
+                    self._drain_decided()
+            except RPCError:
+                # Transient backend outage (e.g. a fabricd restarting from
+                # a checkpoint behind a remote_fabric handle): keep the
+                # drain ticker alive and retry — shardkv's ticker has the
+                # same tolerance.
+                continue
 
     def _drain_decided(self):
         """Apply every already-decided instance in order; never proposes."""

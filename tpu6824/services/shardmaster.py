@@ -131,10 +131,16 @@ class ShardMasterServer:
     def _tick_loop(self):
         while not self.dead:
             time.sleep(0.02)
-            with self.mu:
-                if self.dead:
-                    return
-                self._drain_decided()
+            try:
+                with self.mu:
+                    if self.dead:
+                        return
+                    self._drain_decided()
+            except RPCError:
+                # Transient backend outage (e.g. a fabricd restarting from
+                # a checkpoint behind a remote_fabric handle): keep the
+                # drain ticker alive and retry.
+                continue
 
     def _drain_decided(self):
         while True:
