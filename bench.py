@@ -513,7 +513,11 @@ def _roofline(jax, jnp, on_cpu, impl, bytes_per_step, steps_per_sec):
         frac = achieved / bw if bw else 0.0
         note = ("full steady-state cycle traffic for the measured "
                 f"'{impl}' engine")
-        if frac < 0.30:
+        if frac > 1.0:
+            note += ("; >1.0 because the engine's byte model is an "
+                     "UN-FUSED upper bound (XLA fusion eliminates part "
+                     "of the modeled traffic)")
+        elif frac < 0.30:
             note += ("; <30% of copy roof: per-cell op depth (unrolled "
                      "P^2 edge arithmetic on the VPU) bounds the cycle, "
                      "not HBM — next lever is shrinking per-edge work, "
