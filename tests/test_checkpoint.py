@@ -124,6 +124,65 @@ def test_checkpoint_after_gc_with_unapplied_resets():
         os.unlink(path)
 
 
+def test_checkpoint_orphaned_pending_start_restores():
+    """Regression (ADVICE r4): a Start that lands MID-step — after the
+    drain, while gossip advances gmin past its seq in the same step — is
+    left queued pointing at a slot the end-of-step GC recycled (its vid
+    already decref'd).  checkpoint() must filter it with the same keep
+    predicate the live drain uses, or the file is unrestorable (restore's
+    vid remap raised KeyError pre-fix)."""
+    path = os.path.join("/var/tmp", f"ckpt-orph-{os.getpid()}")
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=8)
+    for p in range(3):
+        fab.done(0, p, 5)  # everyone is done with <=5; gossip pending
+    # Hook the kernel call to inject the racing Start mid-step (the fabric
+    # lock is released during device compute, so this is the real
+    # interleaving, just made deterministic).
+    fab._reliable_ok = False  # route through _step_fn so the hook fires
+    orig = fab._step_fn
+    fired = []
+
+    def hooked(*a):
+        out = orig(*a)
+        if not fired:
+            fired.append(1)
+            fab.start(0, 1, 5, "orphan-value")  # stale peer_min: passes
+        return out
+
+    fab._step_fn = hooked
+    fab.step(1)  # heartbeat -> gmin = 6; end-of-step GC recycles the slot
+    assert fired and fab._pending_starts, "race window not reproduced"
+    g, slot, _p, _vid, seq = fab._pending_starts[0]
+    assert fab._slot_seq[g, slot] != seq, "expected an orphaned start"
+    fab.checkpoint(path)
+    fab2 = PaxosFabric.restore(path)  # pre-fix: KeyError in vid remap
+    try:
+        assert fab2.status(0, 1, 5)[0] == Fate.FORGOTTEN
+        fab2.start(0, 0, 6, "fresh")
+        fab2.step(3)
+        assert fab2.status(0, 2, 6) == (Fate.DECIDED, "fresh")
+    finally:
+        os.unlink(path)
+
+
+def test_start_many_window_full_reports_resume_index():
+    """start_many's WindowFullError carries the failing index: ops[:index]
+    applied, ops[index:] dropped — callers resume precisely (ADVICE r4)."""
+    from tpu6824.core.fabric import WindowFullError
+
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=4)
+    ops = [(0, 0, s, f"v{s}") for s in range(6)]  # 6 seqs, 4 slots
+    with pytest.raises(WindowFullError) as ei:
+        fab.start_many(ops)
+    assert ei.value.index == 4
+    # The prefix really was applied: all four slots are armed.
+    fab.step(3)
+    for s in range(4):
+        assert fab.status(0, 1, s) == (Fate.DECIDED, f"v{s}")
+    for s in (4, 5):
+        assert fab.status(0, 1, s)[0] == Fate.PENDING
+
+
 def test_fabricd_checkpoint_restart_cycle():
     """Daemon-level checkpoint/resume across REAL processes: fabricd runs
     with --checkpoint, serves ops over its socket, is SIGTERMed (final

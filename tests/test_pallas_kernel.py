@@ -370,22 +370,40 @@ def test_prng_mode_zero_drop_equals_reliable():
     assert (np.asarray(la.dec)[:, : G * I] >= 0).all()
 
 
-def test_prng_mode_total_loss_is_safe():
-    """mode='prng' at drop 1.0 delivers self-edges only: no quorum, no
-    decision, no crash — safety under total loss (and, on CPU, exactly
-    what the interpreter's stubbed all-zero bits would produce for any
-    threshold: the degenerate corner is the portable one)."""
+def test_prng_lossy_interpret_raises():
+    """mode='prng' + interpret + nonzero drop is the silent-livelock corner
+    (InterpretParams PRNG emulation draws all-zero bits, so nothing would
+    ever deliver): the entry must fail loudly and point at mode='packed'
+    (ADVICE r4)."""
+    import pytest
+
     from tpu6824.core.pallas_kernel import paxos_cycle_lanes
 
     G, I, P = 1, 16, 3
     l, dv, sa, sv, _ = _lane_setup(G, I, P, nprop=P)
     done = jnp.full((G, P), -1, jnp.int32)
+    with pytest.raises(ValueError, match="packed"):
+        paxos_cycle_lanes(l, dv, done, jax.random.key(5), sa, sv,
+                          G=G, I=I, mode="prng", req_rate=1.0,
+                          rep_rate=1.0, interpret=True)
+
+
+def test_packed_mode_total_loss_is_safe():
+    """Drop 1.0 delivers self-edges only: no quorum, no decision, no crash
+    — safety under total loss, on the off-TPU lossy path (mode='packed')."""
+    from tpu6824.core.pallas_kernel import paxos_cycle_lanes
+
+    G, I, P = 1, 16, 3
+    l, dv, sa, sv, _ = _lane_setup(G, I, P, nprop=P)
+    done = jnp.full((G, P), -1, jnp.int32)
+    link = jnp.ones((G, P, P), bool)
+    ones = jnp.ones((G, P, P), jnp.float32)
     key = jax.random.key(5)
     for _ in range(4):
         key, sub = jax.random.split(key)
         l, dv, _r, _m = paxos_cycle_lanes(
-            l, dv, done, sub, sa, sv, G=G, I=I, mode="prng",
-            req_rate=1.0, rep_rate=1.0, interpret=True)
+            l, dv, done, sub, sa, sv, link, ones, ones,
+            G=G, I=I, mode="packed", interpret=True)
     assert (np.asarray(l.dec) < 0).all(), "decided without a quorum"
 
 

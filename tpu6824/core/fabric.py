@@ -56,7 +56,17 @@ class WindowFullError(RuntimeError):
     The reference has no such limit because it leaks memory instead
     (`paxos/paxos.go` keeps every un-GC'd instance in a map); the fixed
     window is what makes the device arrays bounded (SURVEY §5 long-context
-    note)."""
+    note).
+
+    `index` is set when raised from `start_many`: ops[:index] were fully
+    applied, ops[index:] were not.  Resuming from `index` once GC frees a
+    slot is the precise retry; re-submitting from 0 is also SAFE (Start is
+    idempotent for an undecided seq) but re-queues the prefix — duplicate
+    pending entries and intern refs that live until GC."""
+
+    def __init__(self, msg: str, index: int | None = None):
+        super().__init__(msg)
+        self.index = index
 
 
 class PaxosFabric:
@@ -404,7 +414,13 @@ class PaxosFabric:
 
         Semantically N scalar start() calls; the body is the same logic with
         the per-op numpy-scalar reads hoisted to plain-int lists (this is
-        the service driver's hottest call)."""
+        the service driver's hottest call).
+
+        NOT atomic: on WindowFullError the prefix ops[:e.index] has been
+        applied and the rest dropped — resume the batch from `e.index`
+        after GC frees slots (retrying from 0 is safe but re-queues the
+        prefix).  The same contract holds for the `fabric_service`
+        start_many RPC."""
         with self._lock:
             dead = self._dead.tolist()
             pmin = self._peer_min.tolist()
@@ -416,7 +432,7 @@ class PaxosFabric:
             put = self.intern.put
             pend = self._pending_starts.append
             mx = self._max_seq
-            for g, p, seq, value in ops:
+            for n, (g, p, seq, value) in enumerate(ops):
                 if dead[g][p] or seq < pmin[g][p]:
                     continue
                 slot = s2s[g].get(seq)
@@ -429,7 +445,9 @@ class PaxosFabric:
                         raise WindowFullError(
                             f"group {g}: all {self.I} instance slots live; "
                             f"call Done() to advance Min() "
-                            f"(global_min={self._global_min_locked(g)})")
+                            f"(global_min={self._global_min_locked(g)}); "
+                            f"batch applied up to index {n}",
+                            index=n)
                     slot = fl.pop()
                     slot_seq[g, slot] = seq
                     s2s[g][seq] = slot
@@ -597,6 +615,18 @@ class PaxosFabric:
 
     # ------------------------------------------------------- checkpoint
 
+    @staticmethod
+    def _start_is_live(slot_seq, t, known_vids=None) -> bool:
+        """Keep predicate for a queued (g, slot, p, vid, seq) start: its
+        slot still maps to its seq (the vectorized form of this same test
+        gates the live drain in _step_once).  With `known_vids`, also
+        require the vid to have a payload (restore-side defense against
+        pre-fix blobs).  One definition, three users — do not fork it."""
+        g, s, _p, v, seq = t
+        if slot_seq[g, s] != seq:
+            return False
+        return known_vids is None or v >= IMM_BASE or v in known_vids
+
     def checkpoint(self, path: str) -> None:
         """Snapshot the ENTIRE consensus universe — device state, host
         mirrors, slot/window bookkeeping, network condition, queued ops,
@@ -656,7 +686,13 @@ class PaxosFabric:
                 "slot_vids": [[list(v) for v in grp]
                               for grp in self._slot_vids],
                 "values": {v: self.intern.get(v) for v in vids},
-                "pending_starts": list(self._pending_starts),
+                # _start_is_live: a start queued mid-step whose slot the
+                # end-of-step GC recycled still sits in the queue with a
+                # decref'd vid — snapshotting it verbatim would make the
+                # file unrestorable (restore()'s vid remap lacks it).
+                "pending_starts": [
+                    t for t in self._pending_starts
+                    if self._start_is_live(self._slot_seq, t)],
                 "pending_resets": [],  # applied into the snapshot above
                 "key_data": np.array(jax.random.key_data(self._key)),
             }
@@ -729,9 +765,14 @@ class PaxosFabric:
             fab._seq2slot = [dict(d) for d in blob["seq2slot"]]
             fab._free = [list(s) for s in blob["free"]]
             fab._decided_cells = int((fab.m_decided >= 0).sum())
+            # Defensive twin of checkpoint()'s keep-filter (pre-fix blobs
+            # may carry GC-orphaned entries): same _start_is_live test,
+            # plus the vid-has-a-payload check.
             fab._pending_starts = [
                 (g, s, p, v if v >= IMM_BASE else old2new[v], seq)
-                for g, s, p, v, seq in blob["pending_starts"]]
+                for g, s, p, v, seq in blob["pending_starts"]
+                if cls._start_is_live(fab._slot_seq, (g, s, p, v, seq),
+                                      old2new)]
             fab._pending_resets = list(blob["pending_resets"])
             fab._key = jax.random.wrap_key_data(jnp.asarray(blob["key_data"]))
             fab._key_buf = []

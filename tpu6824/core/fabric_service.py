@@ -21,7 +21,11 @@ from tpu6824.rpc import Proxy, Server, connect
 FABRIC_RPCS = [
     # paxos contract (per peer-lane)
     "start", "status", "done", "peer_min", "peer_max",
-    # batched variants (one RPC for a whole step's worth of ops)
+    # batched variants (one RPC for a whole step's worth of ops).
+    # start_many is NOT atomic: a WindowFullError reply means the prefix
+    # ops[:e.index] was applied and the rest dropped — resume the batch
+    # from e.index (retry-from-0 is safe but re-queues the prefix; see
+    # PaxosFabric.start_many).
     "start_many", "status_many", "done_many",
     # harness / fault injection
     "ndecided", "set_unreliable", "partition", "heal", "deafen",

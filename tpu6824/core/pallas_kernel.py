@@ -581,9 +581,41 @@ def _done_gossip_packed(act_lanes, M1, khb, link, drop_req, done_view, done,
     return jnp.maximum(done_view, jnp.where(gotmsg, done[:, None, :], -1))
 
 
+def paxos_cycle_lanes(l, done_view, done, key, sa, sv, link=None,
+                      drop_req=None, drop_rep=None, *, G, I,
+                      mode="reliable", req_rate=0.0, rep_rate=0.0,
+                      interpret=False, count_msgs=True):
+    """Guarded entry for the fused cycle (`_paxos_cycle_lanes` holds the
+    real docstring).  mode='prng' under interpret uses InterpretParams,
+    whose PRNG emulation yields all-zero bits: any nonzero drop threshold
+    then fails every non-self `r >= thresh` check and consensus silently
+    livelocks — fail loudly instead and point at mode='packed', which is
+    the off-TPU lossy path (ADVICE r4)."""
+    if mode == "prng" and interpret:
+        try:
+            lossy = float(req_rate) > 0.0 or float(rep_rate) > 0.0
+        except (TypeError, jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            # Traced rates (e.g. bench's jitted run_j): cannot prove zero
+            # at trace time — fail loudly rather than risk the silent
+            # corner; bench's prng→packed demotion handler catches this.
+            lossy = True
+        if lossy:
+            raise ValueError(
+                "paxos_cycle_lanes(mode='prng') under interpret draws "
+                "all-zero PRNG bits (pltpu.InterpretParams emulation): a "
+                "nonzero (or traced, unprovably-zero) drop rate would "
+                "deliver no messages and livelock silently.  Use "
+                "mode='packed' off-TPU for lossy networks.")
+    return _paxos_cycle_lanes(l, done_view, done, key, sa, sv, link,
+                              drop_req, drop_rep, G=G, I=I, mode=mode,
+                              req_rate=req_rate, rep_rate=rep_rate,
+                              interpret=interpret, count_msgs=count_msgs)
+
+
 @functools.partial(jax.jit, static_argnames=("G", "I", "mode", "interpret",
                                              "count_msgs"))
-def paxos_cycle_lanes(
+def _paxos_cycle_lanes(
     l: LaneState,
     done_view: jnp.ndarray,  # (G, P, P) i32
     done: jnp.ndarray,       # (G, P) i32
