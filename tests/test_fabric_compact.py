@@ -1,0 +1,230 @@
+"""Compact-IO fabric path (VERDICT r4 weak #2 — the full-mirror wall).
+
+io_mode="compact" replaces the per-step device_get of the whole
+(G, I, P) decided/touched mirrors with a device-side summary: a
+newly-decided compaction (K-entry index/value buffers + count, full-fetch
+fallback on overflow) and a (G, P) Max() reduction over a device-resident
+slot→seq map; op injection goes scatter-based (O(ops), not O(G·I·P)
+dense tensors).  The host mirrors stay EXACT — decided is sticky per slot
+tenancy, so the incremental scatter equals the full refresh — which these
+tests assert by driving identical schedules through both modes and
+comparing every observable after every step.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import tpu6824.core.fabric as fabric_mod
+from tpu6824.core.fabric import PaxosFabric, WindowFullError
+from tpu6824.core.peer import Fate
+
+
+def _assert_same(fa: PaxosFabric, fb: PaxosFabric, tag=""):
+    np.testing.assert_array_equal(fa.m_decided, fb.m_decided,
+                                  err_msg=f"{tag}: decided mirrors differ")
+    np.testing.assert_array_equal(fa.m_done_view, fb.m_done_view,
+                                  err_msg=f"{tag}: done views differ")
+    np.testing.assert_array_equal(fa._peer_min, fb._peer_min,
+                                  err_msg=f"{tag}: Min() differs")
+    np.testing.assert_array_equal(fa._max_seq, fb._max_seq,
+                                  err_msg=f"{tag}: Max() differs")
+    assert fa._decided_cells == fb._decided_cells, tag
+
+
+def _pair(**kw):
+    fa = PaxosFabric(io_mode="full", **kw)
+    fb = PaxosFabric(io_mode="compact", **kw)
+    return fa, fb
+
+
+def _both(fa, fb, meth, *args):
+    getattr(fa, meth)(*args)
+    getattr(fb, meth)(*args)
+
+
+def test_compact_bit_parity_with_full_mode():
+    """One schedule — contention, faults, partitions, GC, slot recycling,
+    immediates and interned payloads — through both io modes with the same
+    seed: every observable must match after every step (the two modes run
+    the SAME kernel math; only the readback differs)."""
+    fa, fb = _pair(ngroups=3, npeers=3, ninstances=8, seed=7)
+    # Contended proposers, mixed payload kinds, a duplicate start.
+    for f in (fa, fb):
+        f.start(0, 0, 0, 11)           # immediate int
+        f.start(0, 1, 0, "rival")      # interned str, same instance
+        f.start(0, 1, 0, "rival")      # duplicate queue entry
+        f.start(1, 2, 5, ("t", 1))     # interned tuple, sparse seq
+        f.start(2, 0, 0, 3)
+    _both(fa, fb, "set_unreliable", True, 1)
+    _both(fa, fb, "partition", 2, [0, 1], [2])
+    for s in range(6):
+        fa.step()
+        fb.step()
+        _assert_same(fa, fb, f"step {s}")
+    # Group 0 must have decided; check agreement through the public API.
+    assert fa.ndecided(0, 0) == fb.ndecided(0, 0) >= 2
+    sa = [fa.status(0, p, 0) for p in range(3)]
+    sb = [fb.status(0, p, 0) for p in range(3)]
+    assert sa == sb
+    # Partitioned minority of group 2 learned nothing.
+    assert fb.status(2, 2, 0)[0] == Fate.PENDING
+
+    # Heal + GC: done everywhere, window recycles, re-use slots.
+    _both(fa, fb, "heal")
+    _both(fa, fb, "set_unreliable", False)
+    for s in range(4):
+        fa.step()
+        fb.step()
+        _assert_same(fa, fb, f"heal step {s}")
+    for f in (fa, fb):
+        for p in range(3):
+            f.done(0, p, 0)
+    for s in range(4):
+        fa.step()
+        fb.step()
+        _assert_same(fa, fb, f"gc step {s}")
+    assert fb.peer_min(0, 0) == 1
+    # Recycled slot serves a fresh seq identically in both modes.
+    for f in (fa, fb):
+        for seq in range(1, 9):
+            f.start(0, seq % 3, seq, f"v{seq}")
+    for s in range(8):
+        fa.step()
+        fb.step()
+        _assert_same(fa, fb, f"refill step {s}")
+    assert fa.status(0, 2, 8) == fb.status(0, 2, 8)
+
+
+def test_compact_lossy_parity():
+    """Unreliable everywhere (the 10%/20% accept-loop coin flips): same
+    seed -> same Bernoulli draws -> identical outcomes across io modes."""
+    fa, fb = _pair(ngroups=2, npeers=3, ninstances=8, seed=3)
+    _both(fa, fb, "set_unreliable", True)
+    for f in (fa, fb):
+        for i in range(4):
+            for p in range(3):
+                f.start(0, p, i, i * 3 + p)
+            f.start(1, 0, i, f"s{i}")
+    for s in range(25):
+        fa.step()
+        fb.step()
+        _assert_same(fa, fb, f"lossy step {s}")
+        if (fa.m_decided >= 0).all():
+            break
+
+
+def test_compact_summary_overflow_full_fetch():
+    """A burst that decides more cells than the K-entry summary buffer
+    triggers the full-fetch fallback for that step — mirrors stay exact."""
+    kw = dict(ngroups=2, npeers=3, ninstances=16, seed=1)
+    fa = PaxosFabric(io_mode="full", **kw)
+    fb = PaxosFabric(io_mode="compact", summary_k=4, **kw)
+    assert fb._summary_k == 4
+    for f in (fa, fb):
+        for g in range(2):
+            for i in range(16):
+                f.start(g, 0, i, g * 100 + i)
+    for s in range(4):
+        fa.step()
+        fb.step()
+        _assert_same(fa, fb, f"burst step {s}")
+    assert fb._decided_cells == fa._decided_cells > 4
+
+
+def test_compact_injection_bucket_chunking(monkeypatch):
+    """Batches larger than the injection bucket split across standalone
+    injection calls + the fused step, preserving order (resets before
+    starts) and semantics."""
+    monkeypatch.setattr(fabric_mod, "_INJECT_BUCKET", 8)
+    monkeypatch.setattr(fabric_mod, "_SMALL_BUCKET", 4)
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=64,
+                      io_mode="compact", seed=2)
+    # 3 proposers x 30 instances = 90 queued starts >> bucket of 8.
+    for i in range(30):
+        for p in range(3):
+            fab.start(0, p, i, i)
+    fab.step(4)
+    for i in range(30):
+        assert fab.status(0, i % 3, i) == (Fate.DECIDED, i), i
+    # GC a prefix, refill past the bucket again (resets ride the chunks).
+    for p in range(3):
+        fab.done(0, p, 19)
+    fab.step(2)
+    assert fab.peer_min(0, 0) == 20
+    for i in range(30, 50):
+        fab.start(0, i % 3, i, i)
+    fab.step(4)
+    for i in range(30, 50):
+        assert fab.status(0, (i + 1) % 3, i) == (Fate.DECIDED, i), i
+
+
+def test_compact_window_full_and_recycle():
+    """WindowFullError + GC-driven recycling behave identically under
+    compact io (slot bookkeeping is host-side and mode-independent)."""
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=4, io_mode="compact")
+    for s in range(4):
+        fab.start(0, 0, s, s)
+    with pytest.raises(WindowFullError):
+        fab.start(0, 0, 4, 4)
+    fab.step(3)
+    for p in range(3):
+        fab.done(0, p, 1)
+    fab.step(2)
+    fab.start(0, 0, 4, 4)
+    fab.step(3)
+    assert fab.status(0, 1, 4) == (Fate.DECIDED, 4)
+    assert fab.status(0, 0, 0)[0] == Fate.FORGOTTEN
+
+
+def test_compact_checkpoint_roundtrip():
+    """Checkpoint/restore preserves io_mode and rebuilds the device-side
+    slot→seq map; the restored fabric keeps deciding."""
+    path = os.path.join("/var/tmp", f"ckpt-compact-{os.getpid()}")
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=8, io_mode="compact")
+    fab.start(0, 0, 0, "persist-me")
+    fab.start(0, 1, 3, 42)
+    fab.step(3)
+    fab.checkpoint(path)
+    fab2 = PaxosFabric.restore(path)
+    try:
+        assert fab2._io_mode == "compact"
+        assert fab2.status(0, 2, 0) == (Fate.DECIDED, "persist-me")
+        assert fab2.status(0, 2, 3) == (Fate.DECIDED, 42)
+        np.testing.assert_array_equal(
+            np.asarray(fab2._slot_seq_dev), fab2._slot_seq.astype(np.int32))
+        fab2.start(0, 2, 1, "after-restore")
+        fab2.step(3)
+        assert fab2.status(0, 0, 1) == (Fate.DECIDED, "after-restore")
+    finally:
+        os.unlink(path)
+
+
+def test_compact_auto_threshold():
+    """io_mode='auto' resolves by universe size."""
+    small = PaxosFabric(ngroups=1, npeers=3, ninstances=4)
+    assert small._io_mode == "full"
+    big = PaxosFabric(ngroups=64, npeers=3,
+                      ninstances=fabric_mod._COMPACT_CELLS // (64 * 3) + 1)
+    assert big._io_mode == "compact"
+
+
+def test_compact_kvpaxos_service_smoke():
+    """The service stack runs unchanged on a compact-io fabric: clerk
+    appends through kvpaxos replicas, exact-once, correct value."""
+    from tpu6824.services.kvpaxos import Clerk, make_cluster
+
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=32,
+                      io_mode="compact", auto_step=True)
+    fab2, servers = make_cluster(nservers=3, fabric=fab)
+    try:
+        ck = Clerk(servers)
+        ck.put("k", "x")
+        for i in range(5):
+            ck.append("k", f"-{i}")
+        assert ck.get("k") == "x-0-1-2-3-4"
+    finally:
+        for s in servers:
+            s.kill()
+        fab.stop_clock()

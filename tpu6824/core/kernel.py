@@ -255,6 +255,68 @@ def _paxos_round(state, done, eye, Mreq1, Mreq2, Mreq3, Mrep1, Mrep2, hb):
     return new_state, io
 
 
+def apply_starts_compact(
+    state: PaxosState,
+    slot_seq: jnp.ndarray,    # (G, I) i32 — device mirror of the host slot map
+    reset_rows: jnp.ndarray,  # (R,) i32 — flat g*I+slot rows to recycle; pad = G*I
+    cells: jnp.ndarray,       # (N,) i32 — flat (g*I+slot)*P+p cells to arm; pad = G*I*P
+    vids: jnp.ndarray,        # (N,) i32 — proposed value ids, aligned with cells
+    seqs: jnp.ndarray,        # (N,) i32 — absolute seq per start, aligned with cells
+) -> tuple[PaxosState, jnp.ndarray]:
+    """Scatter-based `apply_starts`: O(ops) injection instead of dense
+    (G, I) reset + (G, I, P) arm tensors — the host→device half of keeping
+    the per-step cost O(active cells), not O(G·I·P) (the compact-IO fix
+    for the full-mirror wall; `Status` stays a host-mirror read the way
+    the reference's is a local map read, paxos/paxos.go:434-447).
+
+    Padding uses positive out-of-bounds indices with scatter mode='drop'.
+    Semantics match `apply_starts` exactly: resets first, then arms, with
+    duplicate cells pre-deduplicated by the host (last write wins, the
+    dense scatter's behavior).  Also maintains the device-resident
+    slot→seq map that the step summary uses for Max() bookkeeping.
+
+    Not jitted here: callers fuse it into their step jit so the
+    pre-round `decided` is visible to the newly-decided diff without an
+    extra device round trip.
+    """
+    G, I, P = state.np_.shape
+    nrows = G * I
+
+    def wipe(a, fill):
+        flat = a.reshape(nrows, P)
+        return flat.at[reset_rows].set(fill, mode="drop").reshape(G, I, P)
+
+    np_ = wipe(state.np_, 0)
+    na = wipe(state.na, 0)
+    va = wipe(state.va, NO_VAL)
+    decided = wipe(state.decided, NO_VAL)
+    active = wipe(state.active, False)
+    propv = wipe(state.propv, NO_VAL)
+    maxseen = wipe(state.maxseen, 0)
+    slot_flat = slot_seq.reshape(nrows)
+    slot_flat = slot_flat.at[reset_rows].set(-1, mode="drop")
+    slot_flat = slot_flat.at[cells // P].set(seqs, mode="drop")
+
+    ncells = nrows * P
+    safe = jnp.minimum(cells, ncells - 1)  # clamp pads for the gathers
+    dec_flat = decided.reshape(ncells)
+    act_flat = active.reshape(ncells)
+    prop_flat = propv.reshape(ncells)
+    # active |= start & undecided; propv first-set (see apply_starts).
+    new_act = act_flat[safe] | (dec_flat[safe] < 0)
+    new_prop = jnp.where(prop_flat[safe] < 0, vids, prop_flat[safe])
+    act_flat = act_flat.at[cells].set(new_act, mode="drop")
+    prop_flat = prop_flat.at[cells].set(new_prop, mode="drop")
+    return (
+        PaxosState(
+            np_=np_, na=na, va=va, decided=decided,
+            active=act_flat.reshape(G, I, P), propv=prop_flat.reshape(G, I, P),
+            maxseen=maxseen, done_view=state.done_view,
+        ),
+        slot_flat.reshape(G, I),
+    )
+
+
 @jax.jit
 def apply_starts(
     state: PaxosState,

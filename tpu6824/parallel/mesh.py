@@ -92,6 +92,37 @@ def sharded_step(mesh: Mesh):
     )
 
 
+def sharded_step_reliable(mesh: Mesh):
+    """jit paxos_step_reliable (the no-Bernoulli fast path) over the mesh —
+    the reliable-network twin of `sharded_step`, so a mesh-hosted fabric
+    keeps the zero-drop specialization (fabric.py's `_reliable_ok`)."""
+    from tpu6824.core.kernel import paxos_step_reliable
+
+    st = state_shardings(mesh)
+    link, done = step_args_shardings(mesh)[:2]
+    return jax.jit(
+        paxos_step_reliable.__wrapped__,
+        in_shardings=(st, link, done),
+        out_shardings=None,
+        donate_argnums=(0,),
+    )
+
+
+def sharded_apply_starts(mesh: Mesh):
+    """jit apply_starts (dense host→device op injection) with the state
+    kept in its mesh placement (reset/arm tensors replicate from host)."""
+    from tpu6824.core.kernel import apply_starts
+
+    st = state_shardings(mesh)
+    gi = NamedSharding(mesh, P("g", "i"))
+    gip = NamedSharding(mesh, P("g", "i", "p"))
+    return jax.jit(
+        apply_starts.__wrapped__,
+        in_shardings=(st, gi, gip, gip),
+        out_shardings=st,
+    )
+
+
 def place_state(state: PaxosState, mesh: Mesh) -> PaxosState:
     sh = state_shardings(mesh)
     return jax.tree.map(lambda x, s: jax.device_put(x, s), state, sh)

@@ -436,10 +436,12 @@ class ShardSystem(_ShardSystemOps):
     `ngroups` shardkv replica groups as fabric lanes."""
 
     def __init__(self, ngroups=2, nreplicas=3, ninstances=32, base_gid=100,
-                 **server_kw):
+                 fabric_kw=None, **server_kw):
+        """`fabric_kw` reaches the PaxosFabric constructor (mesh=...,
+        io_mode=..., kernel=... — the sharded-fixture seam)."""
         self.fabric = PaxosFabric(
             ngroups=1 + ngroups, npeers=nreplicas, ninstances=ninstances,
-            auto_step=True,
+            auto_step=True, **(fabric_kw or {}),
         )
         self.sm_servers = [
             shardmaster.ShardMasterServer(self.fabric, 0, p) for p in range(nreplicas)
