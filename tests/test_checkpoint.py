@@ -401,7 +401,7 @@ def test_kvpaxos_survives_fabricd_restore_cycle():
         ck.put("fresh", "new", timeout=30.0)
         assert ck.get("fresh", timeout=30.0) == "new"
         # The drain tickers survived the outage (no dead threads).
-        assert all(s._ticker.is_alive() for s in servers)
+        assert all(s._driver.is_alive() for s in servers)
     finally:
         for s in servers:
             s.dead = True

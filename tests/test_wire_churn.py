@@ -70,6 +70,26 @@ def churner(farm: LinkFarm, stop: threading.Event, seed=1, period=0.1):
     return t
 
 
+def churner_ref(farm: LinkFarm, stop: threading.Event, seed=1, period=0.1):
+    """The reference's EXACT repartition shape
+    (kvpaxos/many_part_test.go-FAILED:113-131): every server assigned to
+    one of three random partition classes, re-wired every 0..2*period
+    seconds."""
+    rng = random.Random(seed)
+
+    def run():
+        while not stop.is_set():
+            classes = [[], [], []]
+            for i in range(farm.n):
+                classes[rng.randrange(3)].append(i)
+            farm.part(*[c for c in classes if c])
+            stop.wait(rng.random() * 2 * period)
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+
+
 def ndecided(peers, seq):
     count, value = 0, None
     for p in peers:
@@ -148,6 +168,55 @@ def test_kvpaxos_wire_many_partitions_unreliable_churn(tmp_path):
     try:
         assert not errs, errs
         final = kvpaxos.Clerk(servers).get("k", timeout=60.0)
+        check_appends(final, nclients, nops)
+    finally:
+        for s in servers:
+            s.kill()
+
+
+def test_kvpaxos_wire_many_partitions_reference_scale(tmp_path):
+    """TestManyPartition at the REFERENCE'S OWN SHAPE over the gob wire
+    (kvpaxos/many_part_test.go-FAILED:84-185): 5 unreliable servers whose
+    every consensus message is a real net/rpc gob frame across the link
+    farm, 10 concurrent clients, random three-way repartitioning at the
+    0-200ms cadence.  Op-bounded (4 appends per client) so the CI budget
+    holds on a single core; exactly-once + per-client order after heal."""
+    registry = default_registry().register(KVOP_NAME, KVOP_WIRE)
+    farm, peers = make_farm_peers(tmp_path, n=5, registry=registry, seed=67)
+    servers = [KVPaxosServer(None, 0, i, px=HostOpPeer(p), op_timeout=2.0)
+               for i, p in enumerate(peers)]
+    for p in peers:
+        p.set_unreliable(True)
+    stop = threading.Event()
+    t = churner_ref(farm, stop, seed=11, period=0.1)
+
+    nclients, nops = 10, 4
+    errs: list = []
+
+    def client(idx):
+        try:
+            ck = kvpaxos.Clerk(servers)
+            for j in range(nops):
+                ck.append("k", f"x {idx} {j} y", timeout=240.0)
+        except Exception as e:  # pragma: no cover
+            errs.append((idx, e))
+
+    ts = [threading.Thread(target=client, args=(i,)) for i in range(nclients)]
+    try:
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=300)
+        assert not any(th.is_alive() for th in ts), "client stuck past 300s"
+    finally:
+        stop.set()
+        t.join()
+        farm.heal()
+        for p in peers:
+            p.set_unreliable(False)
+    try:
+        assert not errs, errs
+        final = kvpaxos.Clerk(servers).get("k", timeout=120.0)
         check_appends(final, nclients, nops)
     finally:
         for s in servers:

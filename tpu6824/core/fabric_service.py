@@ -27,6 +27,9 @@ FABRIC_RPCS = [
     # from e.index (retry-from-0 is safe but re-queues the prefix; see
     # PaxosFabric.start_many).
     "start_many", "status_many", "done_many",
+    # clock pacing for group-commit drivers (blocks server-side until the
+    # next step or timeout; positional args — the Proxy takes no kwargs)
+    "wait_steps",
     # harness / fault injection
     "ndecided", "set_unreliable", "partition", "heal", "deafen",
     "set_link", "kill", "revive", "is_dead",

@@ -36,6 +36,26 @@ class PaxosPeer:
         """Local-only read (paxos/paxos.go:434-447)."""
         return self.fabric.status(self.g, self.me, seq)
 
+    # Batched extensions (used by group-commit RSM drivers when present;
+    # every consumer falls back to the scalar contract otherwise):
+
+    def start_many(self, pairs) -> None:
+        """One lock acquisition for a block of (seq, value) proposals;
+        WindowFullError carries the resume index (fabric.start_many)."""
+        g, me = self.g, self.me
+        self.fabric.start_many([(g, me, s, v) for s, v in pairs])
+
+    def status_many(self, seqs) -> list:
+        g, me = self.g, self.me
+        return self.fabric.status_many([(g, me, s) for s in seqs])
+
+    def wait_progress(self, timeout: float = 0.05) -> None:
+        """Block until the fabric clock advances (or timeout) — the batched
+        analog of the reference's poll-with-backoff sleep
+        (kvpaxos/server.go:73-77).  Positional args only: the fabric may
+        be a remote_fabric Proxy, whose RPC surface takes no kwargs."""
+        self.fabric.wait_steps(1, timeout)
+
     def done(self, seq: int) -> None:
         self.fabric.done(self.g, self.me, seq)
 

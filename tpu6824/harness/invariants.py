@@ -1,0 +1,27 @@
+"""Shared invariant checkers (the reference's test helpers), packaged so
+the pytest suites, bench, and the driver entry points use ONE definition.
+
+`check_appends` — every concurrent client's appends appear in the final
+value exactly once and in per-client order; the linearizability yardstick
+every KV suite shares (`kvpaxos/test_test.go:342-362`,
+`pbservice/test_test.go:424-444`, reused by the diskv suite).  Markers are
+`"x {client} {op} y"` — the spaces make multi-digit indices unambiguous
+under substring search.
+"""
+
+
+def check_appends(final: str, nclients: int, nops: int,
+                  exact_length: bool = False) -> None:
+    for i in range(nclients):
+        last = -1
+        for j in range(nops):
+            marker = f"x {i} {j} y"
+            pos = final.find(marker)
+            assert pos >= 0, f"missing {marker!r} in {final!r}"
+            assert final.find(marker, pos + 1) < 0, f"dup {marker!r}"
+            assert pos > last, f"out of order: {marker!r}"
+            last = pos
+    if exact_length:
+        want = sum(len(f"x {i} {j} y")
+                   for i in range(nclients) for j in range(nops))
+        assert len(final) == want, (len(final), want)
