@@ -700,13 +700,19 @@ def _clerk_rate():
         go = _th.Event()
 
         def run_pipe(g):
+            from tpu6824.utils.errors import RPCError
+
             ck = PipelinedClerk(clusters[g], width=W)
             wave = 0
-            while not stop.is_set():
-                ck.append_wave(f"k{g}", [f"x {c} {wave} y" for c in range(W)])
-                if go.is_set():
-                    counts[g] += W
-                wave += 1
+            try:
+                while not stop.is_set():
+                    ck.append_wave(f"k{g}",
+                                   [f"x {c} {wave} y" for c in range(W)])
+                    if go.is_set():
+                        counts[g] += W
+                    wave += 1
+            except RPCError:
+                pass  # teardown: servers died under us
 
         threads = [_th.Thread(target=run_pipe, args=(g,), daemon=True)
                    for g in range(G)]

@@ -256,3 +256,34 @@ def test_parallel_fanout_agreement():
                 p.kill()
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+def test_participation_floor_blocks_grants_not_progress(cluster):
+    """Amnesiac-rejoin guard (set_participation_floor): a floored peer
+    refuses prepare/accept GRANTS at/below the floor — its forgotten
+    promises can never fork an in-flight instance — while the healthy
+    majority still decides there, the floored peer still learns the
+    outcomes and can still PROPOSE (quorum forms from the others), and
+    everything above the floor is business as usual."""
+    peers = cluster
+    peers[0].set_participation_floor(5)
+    # Healthy majority decides below the floor without peer 0's vote.
+    peers[1].start(3, "below")
+    waitn(peers, 3, 2)
+    _, v = ndecided(peers, 3)
+    assert v == "below"
+    # The floored peer learns the decision (Decided broadcasts land).
+    assert wait_until(lambda: peers[0].status(3)[0] == Fate.DECIDED,
+                      timeout=15.0)
+    # ...but granted nothing: its acceptor never promised/accepted seq 3.
+    st = peers[0].acc.get(3)
+    assert st is None or (st.prep_n == 0 and st.acc_n == 0)
+    # The floored peer can still drive proposals below the floor.
+    peers[0].start(4, "proposed-by-floored")
+    waitn(peers, 4, 2)
+    assert ndecided(peers, 4)[1] == "proposed-by-floored"
+    # Above the floor it participates fully: a decide needing its vote
+    # (one healthy peer deafened) still lands.
+    peers[2].start(9, "above")
+    waitn(peers, 9, 3)
+    assert peers[0].acc.get(9) is not None  # it granted up there

@@ -52,9 +52,17 @@ def wait_socket(addr, timeout=60.0):
 
 class ProcCluster:
     """fabricd + 3 shardmasterd + one 3-replica diskv group, every replica
-    its own OS process with its own data directory."""
+    its own OS process with its own data directory.
 
-    def __init__(self, tmp_path, ninstances=32):
+    consensus="fabric": the KV group's acceptor state lives in fabricd
+    (survives replica SIGKILL).  consensus="hostpx": each diskvd embeds an
+    in-process durable `HostPaxosPeer` persisted under `<dir>/paxos` —
+    SIGKILL destroys BOTH the RSM and acceptor state, the Lab 5 crash
+    model exactly (`diskv/test_test.go:103-117`); the shardmaster group
+    stays on fabricd (not under test)."""
+
+    def __init__(self, tmp_path, ninstances=32, consensus="fabric"):
+        self.consensus = consensus
         self.sockdir = make_sockdir("proc")
         self.fab = os.path.join(self.sockdir, "fabric")
         self.sm_addrs = [os.path.join(self.sockdir, f"sm{i}")
@@ -89,10 +97,14 @@ class ProcCluster:
 
     def boot(self, p, restart):
         a = [
-            "--addr", self.kv_addrs[self.kv_names[p]], "--fabric", self.fab,
+            "--addr", self.kv_addrs[self.kv_names[p]],
             "--fg", "1", "--gid", str(GID), "--me", str(p),
             "--dir", self.data[self.kv_names[p]], "--ttl", "300",
         ]
+        if self.consensus == "hostpx":
+            a += ["--px-sockdir", self.sockdir, "--px-n", "3"]
+        else:
+            a += ["--fabric", self.fab]
         for s in self.sm_addrs:
             a += ["--sm", s]
         for n in self.kv_names:
@@ -111,6 +123,11 @@ class ProcCluster:
             os.unlink(self.kv_addrs[self.kv_names[p]])  # stale socket
         except FileNotFoundError:
             pass
+        if self.consensus == "hostpx":
+            try:
+                os.unlink(os.path.join(self.sockdir, f"px-{p}"))
+            except FileNotFoundError:
+                pass
         if lose_disk:
             shutil.rmtree(self.data[self.kv_names[p]], ignore_errors=True)
 
@@ -128,15 +145,19 @@ class ProcCluster:
         it serves `key` == `want`."""
         addr = self.kv_addrs[self.kv_names[p]]
         deadline = time.monotonic() + timeout
-        opid = 900000 + p
+        n = 0
         while time.monotonic() < deadline:
             try:
-                err, val = call(addr, "get", key, opid, 1, timeout=10)
+                # cid is a STRING (the shardkv Op contract, matching the
+                # reference's string client ids — the gob wire schema
+                # types it that way, so int probes would not encode).
+                err, val = call(addr, "get", key, f"probe-{p}-{n}", 1,
+                                timeout=10)
                 if err == "OK" and val == want:
                     return
             except RPCError:
                 pass
-            opid += 1
+            n += 1
             time.sleep(0.25)
         raise AssertionError(
             f"replica {p} never served {key!r}=={want!r}")
@@ -225,6 +246,49 @@ def test_diskv_process_mixed_rejoin(cluster):
     assert ck.get("a", timeout=60) == "1234"
     for p in (0, 1, 2):
         cluster.wait_replica_serves(p, "a", "1234")
+
+
+@pytest.fixture
+def pxcluster(tmp_path):
+    c = ProcCluster(tmp_path, consensus="hostpx")
+    yield c
+    c.shutdown()
+
+
+@pytest.mark.slow
+def test_diskv_process_durable_consensus_sigkill(pxcluster):
+    """The Lab 5 crash model END TO END (diskv/test_test.go:103-117):
+    every replica embeds its own durable consensus peer (in-process
+    HostPaxosPeer persisted under <dir>/paxos — no fabricd for the KV
+    group), so SIGKILL destroys BOTH the RSM and the acceptor state and
+    --restart restores both from disk.  Proven by a MAJORITY crash: with
+    2 of 3 replicas SIGKILLed, the pre-crash data survives only if their
+    acceptor + KV state really come back from disk — in the fabric-
+    backed deployment this scenario never exercises recovery because the
+    acceptor state outlives the replica process."""
+    c = pxcluster
+    ck = c.clerk()
+    ck.put("k", "v1", timeout=120)
+    ck.append("k", "+v2", timeout=120)
+    assert ck.get("k", timeout=120) == "v1+v2"
+
+    # Majority SIGKILL: consensus state for replicas 1 and 2 is destroyed
+    # with their processes and survives only in <dir>/paxos.
+    c.crash(1)
+    c.crash(2)
+    c.reboot(1)
+    c.reboot(2)
+    for p in range(3):
+        c.wait_replica_serves(p, "k", "v1+v2", timeout=120)
+    ck.append("k", "+v3", timeout=120)
+    assert ck.get("k", timeout=120) == "v1+v2+v3"
+
+    # Total loss on one replica (KV files AND paxos dir wiped): it rejoins
+    # via re-run rounds / peer snapshot and repopulates its disk.
+    c.crash(0, lose_disk=True)
+    ck.append("k", "+v4", timeout=120)
+    c.reboot(0)
+    c.wait_replica_serves(0, "k", "v1+v2+v3+v4", timeout=120)
 
 
 @pytest.mark.slow

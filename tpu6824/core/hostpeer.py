@@ -119,6 +119,9 @@ class HostPaxosPeer:
         self.values: dict[int, tuple | None] = {}  # decided (wrapped)
         self.done_seqs = [-1] * self.P             # paxos.go doneSeqs
         self.max_seq = -1
+        # Acceptor amnesia floor (see set_participation_floor): grants are
+        # refused at/below it.  -1 = normal participation everywhere.
+        self._floor = -1
         self.dead = False
         self.backoff = backoff
         self._rng = random.Random(seed)
@@ -315,12 +318,30 @@ class HostPaxosPeer:
 
     # ------------------------------------------------- acceptor (RPCs)
 
+    def set_participation_floor(self, seq: int) -> None:
+        """Amnesiac-rejoin guard: refuse ACCEPTOR participation (prepare/
+        accept grants) for instances at or below `seq`.
+
+        An acceptor restarted over an empty persist_dir has forgotten its
+        promises; re-granting against them can fork an in-flight instance
+        (two decided values).  A rejoining replica that lost its disk sets
+        the floor to the highest instance any live peer has seen, so the
+        healthy majority alone finishes everything that might have been in
+        flight — this node still PROPOSES (quorum forms from the others),
+        still LEARNS decided values, and participates normally above the
+        floor, where it can never have promised anything."""
+        with self.mu:
+            self._floor = max(self._floor, seq)
+
     def _rpc_prepare(self, a: dict) -> dict:
         """paxos.go:230-257 — grant iff n > prep_n; reply carries the
         highest accepted (n, v) on grant, highest seen n on reject."""
         seq, n = a["Instance"], a["Proposal"]
         with self.mu:
             self.max_seq = max(self.max_seq, seq)
+            if seq <= self._floor:
+                return {"Err": _REJECTED, "Instance": seq,
+                        "Proposal": 0, "Value": None}
             st = self.acc.setdefault(seq, _Acc())
             if n > st.prep_n:
                 st.prep_n = n
@@ -335,6 +356,8 @@ class HostPaxosPeer:
         seq, n, v = a["Instance"], a["Proposal"], a["Value"]
         with self.mu:
             self.max_seq = max(self.max_seq, seq)
+            if seq <= self._floor:
+                return {"Err": _REJECTED}
             st = self.acc.setdefault(seq, _Acc())
             if n >= st.prep_n:
                 st.prep_n = st.acc_n = n

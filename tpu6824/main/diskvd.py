@@ -5,6 +5,20 @@ harness compiles and `os.StartProcess`es a real daemon per replica so a kill
 is a REAL crash and a removed directory is REAL disk loss
 (`diskv/test_test.go:62-233`, `main/diskvd.go:30-74`).
 
+Two consensus modes:
+
+  - `--fabric ADDR`: the replica dials a fabricd process that owns the
+    device arrays (the batched-runtime deployment).  A SIGKILL destroys
+    the RSM + disk but the acceptor state lives on in fabricd.
+  - `--px-sockdir DIR --px-n N`: the replica embeds its OWN durable
+    consensus peer — an in-process `HostPaxosPeer` with
+    `persist_dir=<dir>/paxos` — speaking per-message gob RPC to its peer
+    replicas' endpoints (`DIR/px-<i>`).  This is the reference's Lab 5
+    crash model EXACTLY (`diskv/test_test.go:103-117`): process death
+    destroys BOTH the KV state and the acceptor state; the disk restores
+    both on `--restart`, and directory removal is a total loss the
+    replica must recover from via re-run rounds / peer snapshot.
+
     python -m tpu6824.main.diskvd --addr .../g500-0 --fabric .../fabric \
         --fg 1 --gid 500 --me 0 --sm .../sm0 --sm .../sm1 \
         --peer g500-1=.../g500-1 --peer g500-2=.../g500-2 \
@@ -14,13 +28,20 @@ is a REAL crash and a removed directory is REAL disk loss
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="diskvd")
     ap.add_argument("--addr", required=True)
-    ap.add_argument("--fabric", required=True)
+    ap.add_argument("--fabric", help="fabricd socket (fabric mode)")
+    ap.add_argument("--px-sockdir",
+                    help="host-paxos mode: dir of per-replica consensus "
+                         "endpoints px-<i>; the peer persists under "
+                         "<dir>/paxos")
+    ap.add_argument("--px-n", type=int, default=3,
+                    help="host-paxos mode: replica-group size")
     ap.add_argument("--fg", type=int, required=True, help="fabric group lane")
     ap.add_argument("--gid", type=int, required=True)
     ap.add_argument("--me", type=int, required=True)
@@ -32,8 +53,9 @@ def main(argv=None):
     ap.add_argument("--restart", action="store_true")
     ap.add_argument("--ttl", type=float, default=600.0)
     args = ap.parse_args(argv)
+    if bool(args.fabric) == bool(args.px_sockdir):
+        ap.error("exactly one of --fabric / --px-sockdir is required")
 
-    from tpu6824.core.fabric_service import remote_fabric
     from tpu6824.rpc import connect
     from tpu6824.rpc.native_server import make_server
     from tpu6824.services.diskv import DisKVServer
@@ -44,17 +66,39 @@ def main(argv=None):
         directory[name] = connect(addr)
     sm_proxies = [connect(a) for a in args.sm]
 
-    kv = DisKVServer(
-        remote_fabric(args.fabric), args.fg, args.gid, args.me,
-        sm_proxies, directory, dir=args.dir, restart=args.restart,
-    )
+    peer = None
+    if args.px_sockdir:
+        from tpu6824.services.host_backend import make_host_replica
+        from tpu6824.services.shardkv import (
+            SKVOP_NAME, SKVOP_WIRE, HostOpPeer,
+        )
+
+        peer, kv = make_host_replica(
+            args.px_sockdir, "px", SKVOP_NAME, SKVOP_WIRE,
+            lambda p: DisKVServer(
+                None, args.fg, args.gid, p.me, sm_proxies, directory,
+                dir=args.dir, restart=args.restart, px=HostOpPeer(p)),
+            args.px_n, args.me,
+            persist_dir=os.path.join(args.dir, "paxos"),
+        )
+    else:
+        from tpu6824.core.fabric_service import remote_fabric
+
+        kv = DisKVServer(
+            remote_fabric(args.fabric), args.fg, args.gid, args.me,
+            sm_proxies, directory, dir=args.dir, restart=args.restart,
+        )
     srv = make_server(args.addr).register_obj(kv).start()
     print(f"diskvd: g{args.gid}-{args.me} at {args.addr} "
-          f"(dir={args.dir}, restart={args.restart})", flush=True)
+          f"(dir={args.dir}, restart={args.restart}, "
+          f"consensus={'host-px' if peer is not None else 'fabric'})",
+          flush=True)
     try:
         time.sleep(args.ttl)
     finally:
         kv.dead = True
+        if peer is not None:
+            peer.kill()
         srv.kill()
 
 

@@ -66,10 +66,30 @@ class DisKVServer(ShardKVServer):
         os.makedirs(dir, exist_ok=True)
         super().__init__(fabric, fg, gid, me, sm_clerk_servers, directory,
                          start_ticker=False, **kw)
+        self._blank_boot = False
         if restart:
             with self.mu:
                 self._load_from_disk()
+            # Restarted over a BLANK directory = total disk loss: both the
+            # KV image and (in host-px mode) the acceptor ledger are gone.
+            self._blank_boot = self.applied < 0 and not self.kv
+            self._boot_recover()
         self._start_ticker()
+
+    def _boot_recover(self):
+        """Rejoin protocol for a restarted replica (Test5RejoinMix shape,
+        diskv/test_test.go:1139-1280): before serving or proposing, adopt
+        a full snapshot from any live peer that is AHEAD of our disk
+        image.  This matters most after total disk loss: an amnesiac
+        replica whose applied counter restarts at -1 would otherwise
+        propose at seqs the cluster already applied and GC'd — and since
+        acceptor state below Min is forgotten everywhere, those rounds
+        would decide fresh values, forking the replica onto a divergent
+        log.  If no peer answers (we are the freshest survivor, or the
+        whole group is rebooting), proceed with the disk image — the
+        drain's FORGOTTEN handler retries the pull later."""
+        with self.mu:
+            self._snapshot_from_peer()
 
     # ------------------------------------------------------------ file layout
 
@@ -161,7 +181,20 @@ class DisKVServer(ShardKVServer):
                 continue
             if snap is None:
                 continue
-            kv, dup, config, applied = snap
+            kv, dup, config, applied, donor_max = snap
+            if self._blank_boot:
+                # Amnesiac acceptor guard: our (host-px) consensus peer
+                # lost its promise/accept ledger with the disk.  Refuse
+                # acceptor participation for every instance any live peer
+                # has seen — the healthy majority finishes anything that
+                # was in flight; re-granting against forgotten promises
+                # could decide a second value for the same instance.
+                # No-op on the fabric backend (acceptor state lives in
+                # the fabric process and survived our crash).
+                setf = getattr(self.px, "set_participation_floor", None)
+                if setf is not None:
+                    setf(donor_max)
+                self._blank_boot = False
             self.kv = dict(kv)
             self.dup = dict(dup)
             self.config = config
@@ -183,7 +216,10 @@ class DisKVServer(ShardKVServer):
         try:
             if self.applied < min_applied:
                 return None
-            return (dict(self.kv), dict(self.dup), self.config, self.applied)
+            # The trailing max() is the donor's consensus horizon — the
+            # amnesia floor a disk-lost replica must not accept below.
+            return (dict(self.kv), dict(self.dup), self.config,
+                    self.applied, self.px.max())
         finally:
             self.mu.release()
 

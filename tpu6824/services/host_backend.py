@@ -54,6 +54,10 @@ class StructOpPeer:
     def max(self) -> int:
         return self.hp.max()
 
+    def set_participation_floor(self, seq: int) -> None:
+        """Amnesiac-rejoin guard passthrough (HostPaxosPeer docstring)."""
+        self.hp.set_participation_floor(seq)
+
     def kill(self) -> None:
         self.hp.kill()
 

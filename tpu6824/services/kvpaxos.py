@@ -146,11 +146,16 @@ class KVPaxosServer:
             n = 0
             for fate, v in res:
                 if fate == Fate.DECIDED:
-                    self._apply(v)
+                    # isinstance guard: this log may carry foreign entries
+                    # (shardkv's drain has the same guard, shardkv.py).
+                    is_op = isinstance(v, Op)
+                    if is_op:
+                        self._apply(v)
                     self.applied += 1
                     mine = self._inflight.pop(self.applied, None)
                     if (mine is not None
-                            and (mine.cid, mine.cseq) != (v.cid, v.cseq)
+                            and (not is_op
+                                 or (mine.cid, mine.cseq) != (v.cid, v.cseq))
                             and (mine.cid, mine.cseq) in self._waiters):
                         self._subq.append(mine)  # lost the slot: re-propose
                 elif fate == Fate.FORGOTTEN:
@@ -257,6 +262,16 @@ class KVPaxosServer:
                 # a checkpoint behind a remote_fabric handle): keep the
                 # driver alive and retry at the old ticker's cadence —
                 # shardkv's ticker has the same tolerance.
+                time.sleep(0.02)
+                continue
+            except Exception:  # noqa: BLE001 — singleton thread
+                # The driver is the server's only engine: if it dies, no
+                # future resolves, this replica stops Done()ing, and the
+                # whole group's window eventually jams.  Surface the bug
+                # loudly but keep driving.
+                import traceback
+
+                traceback.print_exc()
                 time.sleep(0.02)
                 continue
 
@@ -425,8 +440,11 @@ class PipelinedClerk:
                 self._retry_blocking(op)
 
     def _retry_blocking(self, op: Op) -> None:
-        """The reference clerk's forever loop, for ops whose fast path
-        failed (dup filtering makes the retry at-most-once)."""
+        """The reference clerk's retry loop, for ops whose fast path
+        failed (dup filtering makes the retry at-most-once) — bounded by
+        op_timeout so a torn-down cluster (every server dead) raises
+        instead of spinning forever."""
+        deadline = time.monotonic() + self.op_timeout
         i = self._leader + 1
         while True:
             srv = self.servers[i % len(self.servers)]
@@ -436,6 +454,10 @@ class PipelinedClerk:
                 self._leader = (i - 1) % len(self.servers)
                 return
             except RPCError:
+                if time.monotonic() >= deadline:
+                    raise RPCError(
+                        f"pipelined clerk: op ({op.cid},{op.cseq}) found "
+                        f"no live majority within {self.op_timeout}s")
                 time.sleep(0.01)
 
     def get(self, key: str) -> str:
