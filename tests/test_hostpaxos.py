@@ -282,8 +282,10 @@ def test_participation_floor_blocks_grants_not_progress(cluster):
     peers[0].start(4, "proposed-by-floored")
     waitn(peers, 4, 2)
     assert ndecided(peers, 4)[1] == "proposed-by-floored"
-    # Above the floor it participates fully: a decide needing its vote
-    # (one healthy peer deafened) still lands.
+    # Above the floor it participates fully: deafen peer 1 so a decide
+    # NEEDS the floored peer's vote (quorum must be {0, 2}).
+    peers[1].deafen()
     peers[2].start(9, "above")
-    waitn(peers, 9, 3)
+    waitn(peers, 9, 2)
+    assert peers[0].status(9) == (Fate.DECIDED, "above")
     assert peers[0].acc.get(9) is not None  # it granted up there

@@ -109,3 +109,36 @@ def test_no_persist_dir_means_reference_semantics(tmp_path):
         assert p2._rpc_prepare({"Instance": 0, "Proposal": 5})["Err"] == "OK"
     finally:
         p2.kill()
+
+
+def test_participation_floor_survives_restart(tmp_path):
+    """Double-crash hole (round-5 review): an amnesiac replica rejoins
+    and lowers its quarantine floor to the group horizon H; if it then
+    crashes WITH an intact disk, the restart must still refuse grants at
+    or below H — the pre-disk-loss promises it guards against are still
+    forgotten.  The floor therefore rides the persisted meta record."""
+    import os
+
+    from tpu6824.core.hostpeer import FLOOR_ALL
+
+    d = str(tmp_path / "disk-0")
+    os.makedirs(d, exist_ok=True)
+    addrs = [str(tmp_path / f"px-{i}") for i in range(3)]
+    p = HostPaxosPeer(addrs, 0, seed=1, persist_dir=d,
+                      participation_floor=FLOOR_ALL)
+    assert p.participation_floor() == FLOOR_ALL
+    p.set_participation_floor(7, force=True)  # the rejoin protocol's lowering
+    p.kill()
+    # Restart over the intact disk, WITHOUT a ctor floor (the daemon only
+    # passes FLOOR_ALL when the ledger is missing).
+    p2 = HostPaxosPeer(addrs, 0, seed=1, persist_dir=d)
+    try:
+        assert p2.participation_floor() >= 7
+        # Grants at/below the floor stay refused...
+        r = p2._rpc_prepare({"Instance": 5, "Proposal": 4})
+        assert r["Err"] != "OK"
+        # ...and are normal above it.
+        r = p2._rpc_prepare({"Instance": 8, "Proposal": 4})
+        assert r["Err"] == "OK"
+    finally:
+        p2.kill()

@@ -45,6 +45,10 @@ from tpu6824.utils.trace import EventLog, dprintf
 
 _REJECTED = "ErrRejected"  # paxos/rpc.go:47
 
+# Participation floor covering every possible instance: an amnesiac boot
+# grants nothing until the rejoin protocol lowers the floor (force=True).
+FLOOR_ALL = 1 << 62
+
 
 def _wrap(value):
     if value is None or isinstance(value, tuple):
@@ -79,7 +83,8 @@ class HostPaxosPeer:
                  max_proposers: int = 64,
                  bind_addr: str | None = None,
                  pooled: bool = False,
-                 parallel_fanout: bool = False):
+                 parallel_fanout: bool = False,
+                 participation_floor: int | None = None):
         """With `persist_dir`, acceptor promises/acceptances, decisions,
         and Done state are written to disk BEFORE any RPC reply leaves —
         Paxos's durability requirement — and reloaded on construction, so
@@ -120,8 +125,12 @@ class HostPaxosPeer:
         self.done_seqs = [-1] * self.P             # paxos.go doneSeqs
         self.max_seq = -1
         # Acceptor amnesia floor (see set_participation_floor): grants are
-        # refused at/below it.  -1 = normal participation everywhere.
-        self._floor = -1
+        # refused at/below it.  -1 = normal participation everywhere.  An
+        # amnesiac restart passes `participation_floor=FLOOR_ALL` so the
+        # endpoint comes up refusing every grant — there is no window
+        # between the accept loop starting and the rejoin protocol
+        # computing the real horizon.
+        self._floor = -1 if participation_floor is None else participation_floor
         self.dead = False
         self.backoff = backoff
         self._rng = random.Random(seed)
@@ -145,6 +154,13 @@ class HostPaxosPeer:
         if persist_dir:
             os.makedirs(persist_dir, exist_ok=True)
             self._reload()
+            if participation_floor is not None:
+                # The quarantine must be durable from the very first
+                # instant: a crash after a peer's Decided lands a dec-*
+                # file but before any meta write would otherwise make the
+                # next restart look non-amnesiac and boot unguarded.
+                with self.mu:
+                    self._persist_meta_locked()
         reg = registry or wire.default_registry()
         self._pool = None
         self._fanout = None
@@ -281,7 +297,10 @@ class HostPaxosPeer:
     def _persist_meta_locked(self) -> None:
         if not self.persist_dir:
             return
-        self._persist("meta", (self.done_seqs, self.max_seq))
+        # The floor rides the meta record so a post-rejoin crash with an
+        # intact disk cannot resurrect grants below it (the pre-disk-loss
+        # promises it guards against are STILL forgotten).
+        self._persist("meta", (self.done_seqs, self.max_seq, self._floor))
 
     def _reload(self) -> None:
         """Crash recovery: restore promises, acceptances, decisions, and the
@@ -300,7 +319,12 @@ class HostPaxosPeer:
                     self.values[seq] = pickle.load(open(path, "rb"))
                     self.max_seq = max(self.max_seq, seq)
                 elif fn == "meta":
-                    self.done_seqs, saved_max = pickle.load(open(path, "rb"))
+                    rec = pickle.load(open(path, "rb"))
+                    if len(rec) >= 3:  # floor-carrying format
+                        self.done_seqs, saved_max, floor = rec[:3]
+                        self._floor = max(self._floor, floor)
+                    else:  # pre-floor meta files
+                        self.done_seqs, saved_max = rec
                     self.max_seq = max(self.max_seq, saved_max)
             except (OSError, pickle.PickleError, ValueError, EOFError):
                 continue  # torn scratch file: the .tmp never replaced it
@@ -318,20 +342,31 @@ class HostPaxosPeer:
 
     # ------------------------------------------------- acceptor (RPCs)
 
-    def set_participation_floor(self, seq: int) -> None:
+    def set_participation_floor(self, seq: int, force: bool = False) -> None:
         """Amnesiac-rejoin guard: refuse ACCEPTOR participation (prepare/
         accept grants) for instances at or below `seq`.
 
         An acceptor restarted over an empty persist_dir has forgotten its
         promises; re-granting against them can fork an in-flight instance
-        (two decided values).  A rejoining replica that lost its disk sets
-        the floor to the highest instance any live peer has seen, so the
-        healthy majority alone finishes everything that might have been in
-        flight — this node still PROPOSES (quorum forms from the others),
-        still LEARNS decided values, and participates normally above the
-        floor, where it can never have promised anything."""
+        (two decided values).  A rejoining replica that lost its disk
+        boots with the floor at FLOOR_ALL (ctor kwarg — no grants at all,
+        closing the window before the rejoin protocol runs), then lowers
+        it with `force=True` to the highest instance ANY live peer has
+        seen, so the healthy majority alone finishes everything that
+        might have been in flight — this node still PROPOSES (quorum
+        forms from the others), still LEARNS decided values, and
+        participates normally above the floor, where it can never have
+        promised anything."""
         with self.mu:
-            self._floor = max(self._floor, seq)
+            self._floor = seq if force else max(self._floor, seq)
+            self._persist_meta_locked()
+
+    def participation_floor(self) -> int:
+        """Current amnesia floor (-1 = full participation).  The rejoin
+        protocol reads this to learn whether the peer booted quarantined
+        (FLOOR_ALL) and still needs the group-horizon lowering."""
+        with self.mu:
+            return self._floor
 
     def _rpc_prepare(self, a: dict) -> dict:
         """paxos.go:230-257 — grant iff n > prep_n; reply carries the

@@ -68,18 +68,28 @@ def main(argv=None):
 
     peer = None
     if args.px_sockdir:
+        from tpu6824.core.hostpeer import FLOOR_ALL
         from tpu6824.services.host_backend import make_host_replica
         from tpu6824.services.shardkv import (
             SKVOP_NAME, SKVOP_WIRE, HostOpPeer,
         )
 
+        paxos_dir = os.path.join(args.dir, "paxos")
+        # Amnesiac restart (--restart over a missing/empty paxos ledger):
+        # the consensus endpoint must come up granting NOTHING — there
+        # must be no window between its accept loop starting and the
+        # rejoin protocol installing the real participation floor
+        # (DisKVServer._lower_amnesia_floor lowers it).
+        amnesiac = args.restart and not (
+            os.path.isdir(paxos_dir) and os.listdir(paxos_dir))
+        peer_kw = {"participation_floor": FLOOR_ALL} if amnesiac else {}
         peer, kv = make_host_replica(
             args.px_sockdir, "px", SKVOP_NAME, SKVOP_WIRE,
             lambda p: DisKVServer(
                 None, args.fg, args.gid, p.me, sm_proxies, directory,
                 dir=args.dir, restart=args.restart, px=HostOpPeer(p)),
             args.px_n, args.me,
-            persist_dir=os.path.join(args.dir, "paxos"),
+            persist_dir=paxos_dir, **peer_kw,
         )
     else:
         from tpu6824.core.fabric_service import remote_fabric

@@ -30,7 +30,9 @@ import base64
 import os
 import pickle
 import threading
+import time
 
+from tpu6824.core.hostpeer import FLOOR_ALL as _FLOOR_ALL
 from tpu6824.core.peer import Fate
 from tpu6824.ops.hashing import NSHARDS, key2shard
 from tpu6824.services.shardkv import Op, ShardKVServer
@@ -57,7 +59,7 @@ def _atomic_write(path: str, data: bytes):
 
 class DisKVServer(ShardKVServer):
     RPC_METHODS = ["get", "put_append", "transfer_state", "full_snapshot",
-                   "disk_bytes"]  # wire surface (rpc.Server)
+                   "consensus_horizon", "disk_bytes"]  # wire surface
 
     def __init__(self, fabric, fg, gid, me, sm_clerk_servers, directory,
                  dir: str, restart: bool = False, **kw):
@@ -66,13 +68,9 @@ class DisKVServer(ShardKVServer):
         os.makedirs(dir, exist_ok=True)
         super().__init__(fabric, fg, gid, me, sm_clerk_servers, directory,
                          start_ticker=False, **kw)
-        self._blank_boot = False
         if restart:
             with self.mu:
                 self._load_from_disk()
-            # Restarted over a BLANK directory = total disk loss: both the
-            # KV image and (in host-px mode) the acceptor ledger are gone.
-            self._blank_boot = self.applied < 0 and not self.kv
             self._boot_recover()
         self._start_ticker()
 
@@ -88,8 +86,66 @@ class DisKVServer(ShardKVServer):
         log.  If no peer answers (we are the freshest survivor, or the
         whole group is rebooting), proceed with the disk image — the
         drain's FORGOTTEN handler retries the pull later."""
+        getf = getattr(self.px, "participation_floor", None)
+        if getf is not None and getf() >= _FLOOR_ALL:
+            # The consensus peer booted quarantined (diskvd passes
+            # FLOOR_ALL when --restart finds no paxos ledger; the peer
+            # persists it immediately, so a double-crash re-quarantines).
+            # One quick poll, then a background retry — the ctor must not
+            # block on peers that may themselves be mid-rejoin behind
+            # unbound service sockets; staying quarantined meanwhile is
+            # always safe (grants refused, serving/learning unaffected).
+            if not self._try_lower_amnesia_floor(deadline_s=0.0):
+                threading.Thread(target=self._floor_retry_loop,
+                                 daemon=True).start()
         with self.mu:
             self._snapshot_from_peer()
+
+    def _group_peers(self):
+        """Live directory entries of this group's OTHER replicas —
+        in-process servers or socket proxies alike (selected by name,
+        the g<gid>-<p> convention)."""
+        prefix = f"g{self.gid}-"
+        for name, srv in list(self.directory.items()):
+            if name != self.name and name.startswith(prefix):
+                yield name, srv
+
+    def _try_lower_amnesia_floor(self, deadline_s: float) -> bool:
+        """Blank-disk rejoin, floor half: lower the boot quarantine
+        (FLOOR_ALL) to the group's consensus horizon.  The horizon must
+        cover every instance that could carry one of OUR forgotten
+        promises, and a prepare-majority that included us need not
+        include any single responder — so horizons are required from
+        enough peers that every possible majority-minus-us is
+        intersected (P - floor(P/2) of the others).  Until that many
+        answer, the quarantine stands: granting nothing is always safe;
+        a whole-group blank restart is unrecoverable data anyway and
+        fresh deployments never pass --restart."""
+        setf = self.px.set_participation_floor
+        nothers = sum(1 for _ in self._group_peers())
+        P = nothers + 1
+        needed = min(nothers, P - P // 2)
+        deadline = time.monotonic() + deadline_s
+        while not self.dead:
+            horizons = []
+            for _name, srv in self._group_peers():
+                try:
+                    horizons.append(srv.consensus_horizon())
+                except RPCError:
+                    continue
+            if len(horizons) >= needed and horizons:
+                setf(max(horizons), force=True)
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.25)
+        return False
+
+    def _floor_retry_loop(self):
+        while not self.dead:
+            if self._try_lower_amnesia_floor(deadline_s=5.0):
+                return
+            time.sleep(1.0)
 
     # ------------------------------------------------------------ file layout
 
@@ -171,30 +227,14 @@ class DisKVServer(ShardKVServer):
         path the reference's Test5RejoinMix scenarios demand).  Peers are
         selected by directory NAME (g<gid>-<p>), not object attributes, so
         entries may be in-process servers or socket proxies alike."""
-        prefix = f"g{self.gid}-"
-        for name, srv in list(self.directory.items()):
-            if name == self.name or not name.startswith(prefix):
-                continue
+        for name, srv in self._group_peers():
             try:
                 snap = srv.full_snapshot(self.applied + 1)
             except RPCError:
                 continue
             if snap is None:
                 continue
-            kv, dup, config, applied, donor_max = snap
-            if self._blank_boot:
-                # Amnesiac acceptor guard: our (host-px) consensus peer
-                # lost its promise/accept ledger with the disk.  Refuse
-                # acceptor participation for every instance any live peer
-                # has seen — the healthy majority finishes anything that
-                # was in flight; re-granting against forgotten promises
-                # could decide a second value for the same instance.
-                # No-op on the fabric backend (acceptor state lives in
-                # the fabric process and survived our crash).
-                setf = getattr(self.px, "set_participation_floor", None)
-                if setf is not None:
-                    setf(donor_max)
-                self._blank_boot = False
+            kv, dup, config, applied = snap
             self.kv = dict(kv)
             self.dup = dict(dup)
             self.config = config
@@ -216,12 +256,16 @@ class DisKVServer(ShardKVServer):
         try:
             if self.applied < min_applied:
                 return None
-            # The trailing max() is the donor's consensus horizon — the
-            # amnesia floor a disk-lost replica must not accept below.
-            return (dict(self.kv), dict(self.dup), self.config,
-                    self.applied, self.px.max())
+            return (dict(self.kv), dict(self.dup), self.config, self.applied)
         finally:
             self.mu.release()
+
+    def consensus_horizon(self) -> int:
+        """Donor half of the amnesia floor (`_lower_amnesia_floor`): the
+        highest instance this replica's consensus peer has seen."""
+        if self.dead:
+            raise RPCError("dead")
+        return self.px.max()
 
     def disk_bytes(self) -> int:
         """Total persistent footprint (the tc.space() probe,

@@ -54,9 +54,12 @@ class StructOpPeer:
     def max(self) -> int:
         return self.hp.max()
 
-    def set_participation_floor(self, seq: int) -> None:
+    def set_participation_floor(self, seq: int, force: bool = False) -> None:
         """Amnesiac-rejoin guard passthrough (HostPaxosPeer docstring)."""
-        self.hp.set_participation_floor(seq)
+        self.hp.set_participation_floor(seq, force=force)
+
+    def participation_floor(self) -> int:
+        return self.hp.participation_floor()
 
     def kill(self) -> None:
         self.hp.kill()

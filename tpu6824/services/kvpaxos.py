@@ -410,7 +410,15 @@ class PipelinedClerk:
 
     def append_wave(self, key: str, values: list[str]) -> None:
         """Append values[c] as logical client c (len(values) <= width),
-        all concurrently in flight; returns when every one is applied."""
+        all concurrently in flight; returns when every one is applied.
+
+        Raises RPCError if an op finds no live majority within
+        op_timeout.  The raise means that op's fate is UNKNOWN (it may
+        have applied); its logical client's cseq is already consumed, so
+        re-appending the same payload would NOT be dup-filtered — treat
+        the raise as fatal for this clerk instance.  (The reference
+        clerk never surfaces this state: it blocks forever instead,
+        kvpaxos/client.go:69-104.)"""
         assert len(values) <= self.width
         srv = self.servers[self._leader % len(self.servers)]
         ops = []
