@@ -789,6 +789,40 @@ class PaxosFabric:
                     append((decided, get(vid)))
         return out
 
+    def drain_decided(self, g: int, p: int, lo: int, max_n: int = 256):
+        """Bulk RSM drain: the values of the contiguous DECIDED prefix
+        starting at seq `lo` for peer p of group g — one lock acquisition
+        and one numpy pass instead of up to `max_n` status() dict walks
+        (the hot half of the reference's sync loop,
+        kvpaxos/server.go:69-113, vectorized).
+
+        Returns (values, next_seq, forgotten): `values` are the decided
+        payloads for seqs [lo, next_seq); `forgotten=True` means `lo` is
+        already below Min() for this peer (caller must recover via its
+        FORGOTTEN path).  Stops at the first gap (undecided or
+        unallocated seq), exactly like a status() walk would."""
+        with self._lock:
+            if lo < self._peer_min[g, p]:
+                return [], lo, True
+            ss = self._slot_seq[g]
+            mask = (ss >= lo) & (ss < lo + max_n)
+            if not mask.any():
+                return [], lo, False
+            slots = np.nonzero(mask)[0]
+            seqs = ss[slots]
+            order = np.argsort(seqs)
+            slots = slots[order]
+            seqs = seqs[order]
+            vids = self.m_decided[g, slots, p]
+            good = (seqs == np.arange(lo, lo + len(seqs))) & (vids >= 0)
+            k = len(good) if good.all() else int(np.argmin(good))
+            if k == 0:
+                return [], lo, False
+            get = self.intern.get
+            out = [vid - IMM_BASE if vid >= IMM_BASE else get(vid)
+                   for vid in vids[:k].tolist()]
+            return out, lo + k, False
+
     def done_many(self, items) -> None:
         """Batched Done: `items` iterates (g, p, seq) — one vectorized
         update + one row-min recompute per affected group, instead of a

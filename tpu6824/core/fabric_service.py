@@ -27,6 +27,11 @@ FABRIC_RPCS = [
     # from e.index (retry-from-0 is safe but re-queues the prefix; see
     # PaxosFabric.start_many).
     "start_many", "status_many", "done_many",
+    # vectorized RSM drain (PaxosFabric.drain_decided — MUST stay in this
+    # list: PaxosPeer exposes it unconditionally and the RPC Proxy
+    # synthesizes any method name, so omitting it here would turn the
+    # group-commit drive loop into an RPCError retry livelock)
+    "drain_decided",
     # clock pacing for group-commit drivers (blocks server-side until the
     # next step or timeout; positional args — the Proxy takes no kwargs)
     "wait_steps",

@@ -49,6 +49,11 @@ class PaxosPeer:
         g, me = self.g, self.me
         return self.fabric.status_many([(g, me, s) for s in seqs])
 
+    def drain_decided(self, lo: int, max_n: int = 256):
+        """(values, next_seq, forgotten) for the decided prefix at `lo` —
+        one vectorized fabric pass (see PaxosFabric.drain_decided)."""
+        return self.fabric.drain_decided(self.g, self.me, lo, max_n)
+
     def wait_progress(self, timeout: float = 0.05) -> None:
         """Block until the fabric clock advances (or timeout) — the batched
         analog of the reference's poll-with-backoff sleep

@@ -129,11 +129,53 @@ class KVPaxosServer:
             fut.set(reply)
         return reply
 
+    def _pop_lost_inflight_locked(self, v):
+        """Post-apply bookkeeping at self.applied: if my proposal for this
+        slot lost to `v`, re-queue it (its waiter is still parked)."""
+        mine = self._inflight.pop(self.applied, None)
+        if (mine is not None
+                and (not isinstance(v, Op)
+                     or (mine.cid, mine.cseq) != (v.cid, v.cseq))
+                and (mine.cid, mine.cseq) in self._waiters):
+            self._subq.append(mine)
+
     def _drain_bulk_locked(self, status_many):
-        """Apply every already-decided instance in order, in bulk: one
-        status_many per probe window instead of one status per op, one
-        Done() high-water call per drain.  Re-queues my in-flight
-        proposals whose slot another server's op won."""
+        """Apply every already-decided instance in order, in bulk.  On the
+        fabric backend the decided prefix comes from ONE vectorized pass
+        per window (`PaxosFabric.drain_decided` — numpy over the slot map
+        and mirrors, no per-seq dict walk); other backends fall back to
+        status_many probes.  One Done() high-water call per drain; my
+        in-flight proposals whose slot another server's op won are
+        re-queued."""
+        drain = getattr(self.px, "drain_decided", None)
+        if drain is None:
+            return self._drain_bulk_scalar_locked(status_many)
+        base0 = self.applied + 1
+        while True:
+            vals, nxt, forgotten = drain(self.applied + 1, 256)
+            if forgotten:
+                # Everything below Min() is gone everywhere; our dup
+                # filter refreshes from the ops we can still see.
+                mn = self.px.min()
+                if mn <= self.applied + 1:
+                    break  # transient view; retry next pass
+                while self.applied + 1 < mn:
+                    self.applied += 1
+                    self._inflight.pop(self.applied, None)
+                continue
+            if not vals:
+                break
+            for v in vals:
+                if isinstance(v, Op):
+                    self._apply(v)
+                self.applied += 1
+                self._pop_lost_inflight_locked(v)
+        self._last_drain = self.applied + 1 - base0
+        if self.applied >= base0:
+            self.px.done(self.applied)
+
+    def _drain_bulk_scalar_locked(self, status_many):
+        """status_many-probe drain for backends without drain_decided."""
         base0 = self.applied + 1
         # Probe sizing: start from the last pass's drain count (steady
         # state hits the right window in one call), floor 1 so an idle
@@ -148,16 +190,10 @@ class KVPaxosServer:
                 if fate == Fate.DECIDED:
                     # isinstance guard: this log may carry foreign entries
                     # (shardkv's drain has the same guard, shardkv.py).
-                    is_op = isinstance(v, Op)
-                    if is_op:
+                    if isinstance(v, Op):
                         self._apply(v)
                     self.applied += 1
-                    mine = self._inflight.pop(self.applied, None)
-                    if (mine is not None
-                            and (not is_op
-                                 or (mine.cid, mine.cseq) != (v.cid, v.cseq))
-                            and (mine.cid, mine.cseq) in self._waiters):
-                        self._subq.append(mine)  # lost the slot: re-propose
+                    self._pop_lost_inflight_locked(v)
                 elif fate == Fate.FORGOTTEN:
                     # Another replica applied + GC'd past us; our dup filter
                     # will be refreshed by the ops we *can* still see.
