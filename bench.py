@@ -747,6 +747,7 @@ def _clerk_rate():
     clusters = [[KVPaxosServer(fab, g, p) for p in range(P)] for g in range(G)]
     try:
         counts = [0] * G
+        waves_done = [0] * G  # completed waves since thread start
         stop = _th.Event()
         go = _th.Event()
 
@@ -762,6 +763,7 @@ def _clerk_rate():
                     if go.is_set():
                         counts[g] += W
                     wave += 1
+                    waves_done[g] = wave
             except RPCError:
                 pass  # teardown: servers died under us
 
@@ -782,7 +784,11 @@ def _clerk_rate():
         total = sum(counts)
         assert total > 0, "no pipelined clerk op completed"
         for g in range(min(G, 2)):
-            _check_markers(Clerk(clusters[g]).get(f"k{g}"), W, 2)
+            # Verify only waves that COMPLETED (a short measurement window
+            # may have finished just one on the slowest groups).
+            nops = min(2, waves_done[g])
+            assert nops > 0, f"group {g} completed no wave"
+            _check_markers(Clerk(clusters[g]).get(f"k{g}"), W, nops)
     finally:
         for cl in clusters:
             for s in cl:

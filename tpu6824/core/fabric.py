@@ -60,6 +60,9 @@ _SEQ_LIMIT = 2 ** 31
 _SUMMARY_K = int(os.environ.get("TPU6824_SUMMARY_K", 16384))
 _INJECT_BUCKET = int(os.environ.get("TPU6824_INJECT_BUCKET", 8192))
 _SMALL_BUCKET = 256  # second, tiny pad size so idle steps ship ~3KB not ~100KB
+# Idle-adaptive clock: sleep this long after a step that injected nothing,
+# delivered no messages, and decided nothing (0 disables; see _clock_loop).
+_IDLE_SLEEP = float(os.environ.get("TPU6824_IDLE_SLEEP", 0.002))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -232,6 +235,7 @@ class PaxosFabric:
         self._free: list[list[int]] = [
             list(range(I - 1, -1, -1)) for _ in range(G)
         ]
+        self._live_slots = 0  # allocated - GC'd (idle-clock predicate)
         self._slot_vids: list[list[list[int]]] = [
             [[] for _ in range(I)] for _ in range(G)
         ]  # interned ids referenced by each slot (for GC decref)
@@ -244,6 +248,8 @@ class PaxosFabric:
         self._dead = np.zeros((G, P), bool)
 
         self._running = False
+        self._last_step_active = True  # idle-adaptive clock (see _clock_loop)
+        self._clock_wake = threading.Event()
         self._thread: threading.Thread | None = None
         self._step_sleep = step_sleep
         self._stepped = threading.Condition(self._lock)
@@ -268,6 +274,12 @@ class PaxosFabric:
             self._thread = None
 
     def _clock_loop(self):
+        # Idle-adaptive pacing: a step that injected nothing, delivered no
+        # remote messages, and decided nothing is pure bookkeeping — on a
+        # busy host the free-running clock would spend a whole core
+        # re-running it.  Sleep briefly after such steps (still ~500
+        # steps/s, plenty for done-gossip convergence) and snap back to
+        # full speed the moment anything happens.
         while True:
             with self._lock:
                 if not self._running:
@@ -275,6 +287,12 @@ class PaxosFabric:
             self.step()
             if self._step_sleep:
                 time.sleep(self._step_sleep)
+            elif _IDLE_SLEEP and not self._last_step_active:
+                # Interruptible: any queued op wakes the clock instantly
+                # (and a step always follows the wait, so clearing cannot
+                # strand a queued op), so idling never adds op latency.
+                self._clock_wake.wait(_IDLE_SLEEP)
+                self._clock_wake.clear()
 
     def step(self, n: int = 1):
         """Advance the whole fabric by n kernel steps (callable from the clock
@@ -407,6 +425,10 @@ class PaxosFabric:
             # Max() bookkeeping: highest seq this peer has participated in.
             seqs = np.where(touched, self._slot_seq[:, :, None], -1)  # (G,I,P)
             self._max_seq = np.maximum(self._max_seq, seqs.max(axis=1))
+            self._last_step_active = (
+                s_arr is not None or r_arr is not None or int(msgs) > 0
+                or newly > 0
+                or self._live_slots * self.P > self._decided_cells)
             self._gc_locked()
             self._stepped.notify_all()
 
@@ -571,6 +593,9 @@ class PaxosFabric:
                         self.steps_total, newly, int(msgs))
             self._max_seq = np.maximum(self._max_seq,
                                        maxseq.astype(np.int64))
+            self._last_step_active = (
+                nr > 0 or ns > 0 or int(msgs) > 0 or newly > 0
+                or self._live_slots * P > self._decided_cells)
             self._gc_locked()
             self._stepped.notify_all()
 
@@ -622,6 +647,7 @@ class PaxosFabric:
         self._slot_seq[gs, slots] = -1
         self._pending_resets.extend(zip(gs.tolist(), slots.tolist()))
         decref = self.intern.decref
+        self._live_slots -= len(gs)
         for g, slot, seq in zip(gs.tolist(), slots.tolist(), seqs.tolist()):
             del self._seq2slot[g][seq]
             self._free[g].append(slot)
@@ -647,6 +673,7 @@ class PaxosFabric:
         # O(1) LIFO pop; a freed slot's pending reset (if any) is applied
         # before the start lands (apply_starts order), so reuse is safe.
         slot = self._free[g].pop()
+        self._live_slots += 1
         self._slot_seq[g, slot] = seq
         self._seq2slot[g][seq] = slot
         return slot
@@ -677,6 +704,7 @@ class PaxosFabric:
             vid = self.intern.put(value)
             self._slot_vids[g][slot].append(vid)
         self._pending_starts.append((g, slot, p, vid, seq))
+        self._clock_wake.set()
         if seq > self._max_seq[g, p]:
             self._max_seq[g, p] = seq
 
@@ -714,6 +742,15 @@ class PaxosFabric:
         after GC frees slots (retrying from 0 is safe but re-queues the
         prefix).  The same contract holds for the `fabric_service`
         start_many RPC."""
+        try:
+            self._start_many_locked(ops)
+        finally:
+            # Even a WindowFullError mid-batch pended a prefix: wake the
+            # idle clock so backpressure-retry loops never pay the idle
+            # sleep.
+            self._clock_wake.set()
+
+    def _start_many_locked(self, ops) -> None:
         with self._lock:
             dead = self._dead.tolist()
             pmin = self._peer_min.tolist()
@@ -746,6 +783,7 @@ class PaxosFabric:
                             f"batch applied up to index {n}",
                             index=n)
                     slot = fl.pop()
+                    self._live_slots += 1
                     slot_seq[g, slot] = seq
                     s2s[g][seq] = slot
                 if type(value) is int and 0 <= value < IMM_BASE:
@@ -1111,6 +1149,7 @@ class PaxosFabric:
                 fab._slot_seq_dev = ss
             fab._seq2slot = [dict(d) for d in blob["seq2slot"]]
             fab._free = [list(s) for s in blob["free"]]
+            fab._live_slots = G * I - sum(len(s) for s in fab._free)
             fab._decided_cells = int((fab.m_decided >= 0).sum())
             # Defensive twin of checkpoint()'s keep-filter (pre-fix blobs
             # may carry GC-orphaned entries): same _start_is_live test,
