@@ -34,6 +34,7 @@ kvpaxos/server.go:73-77; see BASELINE.md) — vs_baseline = value / 1000.
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -604,17 +605,26 @@ def _service_rate():
     P = 3
     seconds = float(os.environ.get("BENCH_SERVICE_SECONDS", 4.0))
 
-    # The driver paces the clock (pump ops, then advance one step) — the
-    # deterministic-clock mode every harness test uses.  A free-running
+    # The driver paces the clock (pump ops, then advance one dispatch) —
+    # the deterministic-clock mode every harness test uses.  A free-running
     # clock thread only duels the driver for the GIL/core and burns kernel
-    # steps on a starved pipeline; pacing keeps every step's window full.
-    # Compact io keeps the per-step device→host readback O(active cells),
-    # which is what lets the service path run at north-star G (VERDICT r4
-    # weak #2: the full (G, I, P) mirror copy would be ~125MB/step at
-    # kernel bench shape).
+    # steps on a starved pipeline; pacing keeps every dispatch's window
+    # full.  Compact io keeps the per-step device→host readback O(active
+    # cells), which is what lets the service path run at north-star G
+    # (VERDICT r4 weak #2: the full (G, I, P) mirror copy would be
+    # ~125MB/step at kernel bench shape).  The pipelined clock (ISSUE 1)
+    # stacks K micro-steps per dispatch (one lax.scan dispatch + ONE
+    # readback) and `step_async` keeps a dispatch in flight while the
+    # driver pumps — the host work for pass N+1 overlaps device compute
+    # for dispatch N.
     io_mode = os.environ.get("BENCH_SERVICE_IO", "compact")
+    spd = int(os.environ.get("BENCH_SERVICE_SPD",
+                             os.environ.get(
+                                 "TPU6824_CLOCK_STEPS_PER_DISPATCH", 4)))
+    depth = int(os.environ.get("BENCH_SERVICE_DEPTH", 2))
     fab = PaxosFabric(ngroups=G, npeers=P, ninstances=I, auto_step=False,
-                      io_mode=io_mode)
+                      io_mode=io_mode, steps_per_dispatch=spd,
+                      pipeline_depth=depth)
     try:
         applied = [0] * G   # next seq to harvest
         started = [0] * G   # next seq to start
@@ -678,14 +688,18 @@ def _service_rate():
         t_end = _t.monotonic() + 1.0
         while _t.monotonic() < t_end:
             pump()
-            fab.step()
+            fab.step_async()
+        fab.flush()
+        pump()
         steps0 = fab.steps_total
         base = decided_total
         t0 = _t.perf_counter()
         t_end = _t.monotonic() + seconds
         while _t.monotonic() < t_end:
             pump()
-            fab.step()
+            fab.step_async()
+        fab.flush()  # retire in-flight dispatches inside the timed window
+        pump()       # ...and harvest what they decided
         dt = _t.perf_counter() - t0
         n = decided_total - base
         assert n > 0, "service path decided nothing"
@@ -700,6 +714,8 @@ def _service_rate():
                      f"fabric clock in the loop, G={G} W={W}"),
             "shape": {"G": G, "I": I, "P": P, "window": W},
             "io_mode": fab._io_mode,
+            "steps_per_dispatch": fab.steps_per_dispatch,
+            "pipeline_depth": fab.pipeline_depth,
             "steps_per_sec": round((fab.steps_total - steps0) / dt, 1),
         }
     finally:
@@ -742,12 +758,28 @@ def _clerk_rate():
     P = 3
     seconds = float(os.environ.get("BENCH_SERVICE_SECONDS", 4.0))
 
-    # ---- phase 1: pipelined (one thread per group, W-wide waves) ----
-    fab = PaxosFabric(ngroups=G, npeers=P, ninstances=4 * W, auto_step=True)
+    # ---- phase 1: pipelined (one thread per group, W logical clients
+    # streamed barrier-free) ----
+    # Compact io + K-step dispatches + the double-buffered clock: the
+    # clock thread spends its time inside device dispatches (GIL
+    # released), which is exactly what a host full of clerk/driver
+    # threads needs; append_stream keeps every logical client's next op
+    # flowing without a per-wave straggler barrier.
+    # spd=1: clerk throughput is wave-latency-bound and a wave can only
+    # ride the NEXT dispatch, so longer dispatches (K>1) delay retires
+    # without committing more — measured 11.0k ops/s at spd=1 vs 5.4k at
+    # spd=2 on the dev box.  The pipeline depth (launch N+1 while N's
+    # summary is folded in) is what pays here, not step fusion.
+    spd = int(os.environ.get("BENCH_CLERK_SPD", 1))
+    burst = int(os.environ.get("BENCH_CLERK_BURST", 32))  # waves/stream call
+    fab = PaxosFabric(ngroups=G, npeers=P, ninstances=4 * W, auto_step=True,
+                      io_mode="compact", steps_per_dispatch=spd,
+                      pipeline_depth=2)
     clusters = [[KVPaxosServer(fab, g, p) for p in range(P)] for g in range(G)]
     try:
         counts = [0] * G
         waves_done = [0] * G  # completed waves since thread start
+        primed = [False] * G  # group completed its first op (warmup gate)
         stop = _th.Event()
         go = _th.Event()
 
@@ -756,13 +788,23 @@ def _clerk_rate():
 
             ck = PipelinedClerk(clusters[g], width=W)
             wave = 0
+
+            def on_done(n):
+                # Op-granular accounting: only completions inside the
+                # timed window count (a burst straddling the go/stop
+                # boundary must not land as one lump).
+                primed[g] = True
+                if go.is_set() and not stop.is_set():
+                    counts[g] += n
+
             try:
                 while not stop.is_set():
-                    ck.append_wave(f"k{g}",
-                                   [f"x {c} {wave} y" for c in range(W)])
-                    if go.is_set():
-                        counts[g] += W
-                    wave += 1
+                    ck.append_stream(
+                        f"k{g}",
+                        [[f"x {c} {wave + b} y" for b in range(burst)]
+                         for c in range(W)],
+                        on_done=on_done)
+                    wave += burst
                     waves_done[g] = wave
             except RPCError:
                 pass  # teardown: servers died under us
@@ -771,7 +813,15 @@ def _clerk_rate():
                    for g in range(G)]
         for t in threads:
             t.start()
-        _t.sleep(1.5)  # warmup
+        # Warmup until EVERY group's pipeline actually flows (the
+        # fused-scan compile can eat several seconds on a fresh backend,
+        # and a fixed sleep — or an aggregate count two fast groups could
+        # satisfy alone — would start the timed window while most groups
+        # are still ramping), then settle briefly.
+        t_hard = _t.monotonic() + 60.0
+        while not all(primed) and _t.monotonic() < t_hard:
+            _t.sleep(0.1)
+        _t.sleep(1.0)
         go.set()
         s0 = fab.steps_total
         t0 = _t.perf_counter()
@@ -841,9 +891,12 @@ def _clerk_rate():
         "value": round(total / dt, 1),
         "note": f"kvpaxos Clerk Append ops/sec, {G} replica groups x {P} "
                 f"servers on one fabric, PipelinedClerk width={W} "
-                f"(group-commit driver); checkAppends green",
+                f"append_stream burst={burst} (group-commit driver); "
+                f"checkAppends green",
         "groups": G,
         "width": W,
+        "steps_per_dispatch": spd,
+        "pipeline_depth": 2,
         "steps_per_sec": round(steps / dt, 1),
         "thread_per_clerk": {
             "value": round(total2 / dt2, 1),
@@ -922,29 +975,51 @@ def _parse_json_line(text):
     return None
 
 
+def _killpg_run(cmd, timeout, env=None):
+    """Run `cmd` in its OWN process group with a HARD kill on timeout:
+    SIGKILL the whole group, so a wedged accelerator runtime (or a helper
+    it forked — the r02/r05 failure mode: a grandchild holding the device
+    lock and the stdout pipe keeps a plain subprocess kill from ever
+    reaping) cannot outlive its deadline or block the parent's read.
+    Returns (rc, stdout, stderr, timed_out); stdout is salvaged on
+    timeout."""
+    p = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO, start_new_session=True,
+    )
+    try:
+        out, err = p.communicate(timeout=timeout)
+        return p.returncode, out, err, False
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            out, err = p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:  # pipe held open post-SIGKILL
+            out, err = "", ""
+        return -9, out, err, True
+
+
 def _run_child(env_extra, timeout):
     if timeout <= 0:
         return None, "no budget left"
     env = dict(os.environ, **env_extra)
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
-            timeout=timeout, capture_output=True, text=True, env=env,
-            cwd=REPO,
-        )
-    except subprocess.TimeoutExpired as e:
-        # The child may have printed its result and then wedged in backend
-        # teardown — salvage the line rather than discarding a good number.
-        out = e.stdout
-        if isinstance(out, bytes):
-            out = out.decode(errors="replace")
+    rc, out, err, timed_out = _killpg_run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        timeout=timeout, env=env)
+    if timed_out:
+        # The child may have printed its result (or the provisional line)
+        # and then wedged in backend teardown — salvage the line rather
+        # than discarding a good number.
         parsed = _parse_json_line(out)
         if parsed is not None:
             return parsed, None
         return None, "timeout"
-    if r.returncode != 0:
-        return None, (r.stderr or "")[-400:] or f"rc={r.returncode}"
-    parsed = _parse_json_line(r.stdout)
+    if rc != 0:
+        return None, (err or "")[-400:] or f"rc={rc}"
+    parsed = _parse_json_line(out)
     if parsed is not None:
         return parsed, None
     return None, "no JSON line in child output"
@@ -959,21 +1034,31 @@ def parent_main():
     errors = []
     force_cpu = bool(os.environ.get("BENCH_FORCE_CPU"))
 
-    accel_ok = False
+    # Accelerator probe, hard-killed (process GROUP SIGKILL) on timeout so a
+    # wedged device runtime cannot pin the driver lock into the next stage.
+    # A probe that HANGS is inconclusive, not a verdict: slow first-touch
+    # TPU init has repeatedly outlived the probe window (the recurring
+    # `fallback_reason: "accel probe hung >25s"` since r02) while the
+    # hardware was perfectly reachable — so a hung probe still attempts the
+    # accel bench child (itself hard-killable, with the CPU reserve
+    # protected), and only an explicit probe FAILURE (nonzero exit: no
+    # device) skips straight to CPU.
+    accel_try = False
     if not force_cpu:
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=min(PROBE_TIMEOUT, left(CPU_RESERVE)),
-                capture_output=True)
-            accel_ok = r.returncode == 0
-            if not accel_ok:
-                errors.append("accel probe failed")
-        except subprocess.TimeoutExpired:
-            errors.append(f"accel probe hung >{PROBE_TIMEOUT:.0f}s")
+        rc, _out, _err, timed_out = _killpg_run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=min(PROBE_TIMEOUT, left(CPU_RESERVE)))
+        if timed_out:
+            errors.append(f"accel probe hung >{PROBE_TIMEOUT:.0f}s "
+                          "(inconclusive; attempting accel bench anyway)")
+            accel_try = left(CPU_RESERVE) > 30
+        elif rc != 0:
+            errors.append("accel probe failed")
+        else:
+            accel_try = True
 
     result = None
-    if accel_ok:
+    if accel_try:
         result, err = _run_child({}, min(TPU_TIMEOUT, left(CPU_RESERVE)))
         if err:
             errors.append(f"accel bench: {err}")

@@ -42,7 +42,13 @@ def test_one_json_line_with_required_keys():
     assert d["contended_lossy"]["steps_to_decide"]["p50"] >= 1
     assert d["wire"]["value"] > 0
     assert d["service"]["value"] > 0, d["service"]
+    # Pipelined-clock provenance (ISSUE 1): every recorded service run
+    # must say how many micro-steps each dispatch fused and how deep the
+    # launch/retire pipeline ran, or sweeps are uninterpretable.
+    assert d["service"]["steps_per_dispatch"] >= 1, d["service"]
+    assert d["service"]["pipeline_depth"] >= 1, d["service"]
     assert d["service"]["clerk"]["value"] > 0, d["service"]
+    assert d["service"]["clerk"]["steps_per_dispatch"] >= 1, d["service"]
 
 
 @pytest.mark.slow

@@ -1,0 +1,287 @@
+"""Pipelined multi-step fabric clock (ISSUE 1).
+
+Two contracts, separately pinned:
+
+  - K-STEP FUSION: a fabric with `steps_per_dispatch=K` advancing one
+    dispatch must be BIT-IDENTICAL to the K=1 clock advancing K steps —
+    same mirrors, Min()/Max(), decided counters, slot maps — under any
+    host-visible schedule, including unreliable nets (the fused scan pops
+    the same K PRNG subkeys the K=1 clock would), partitions, kills and
+    window GC.  The free-slot MIN-HEAP is what makes this exact: the K=1
+    clock may GC a window across several retires where the fused clock
+    GCs it in one, and allocation must not depend on that batching.
+  - PIPELINED (double-buffered) CLOCK: `step_async` with depth > 1 keeps
+    dispatches in flight while ops land; mirrors may LAG but every
+    seq-space observable (Status/Min/Max/ndecided) must match the
+    synchronous clock after a flush, and the incremental mirror must
+    still equal device truth bit-for-bit once quiesced (the tenancy
+    filter on the summary scatter is what keeps recycled slots from
+    resurrecting mid-pipeline).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from tpu6824.core.fabric import PaxosFabric, WindowFullError
+from tpu6824.core.peer import Fate
+
+
+def _assert_bit_same(fa: PaxosFabric, fb: PaxosFabric, tag=""):
+    np.testing.assert_array_equal(fa.m_decided, fb.m_decided,
+                                  err_msg=f"{tag}: decided mirrors differ")
+    np.testing.assert_array_equal(fa.m_done_view, fb.m_done_view,
+                                  err_msg=f"{tag}: done views differ")
+    np.testing.assert_array_equal(fa._peer_min, fb._peer_min,
+                                  err_msg=f"{tag}: Min() differs")
+    np.testing.assert_array_equal(fa._max_seq, fb._max_seq,
+                                  err_msg=f"{tag}: Max() differs")
+    np.testing.assert_array_equal(fa._slot_seq, fb._slot_seq,
+                                  err_msg=f"{tag}: slot maps differ")
+    assert fa._decided_cells == fb._decided_cells, tag
+
+
+def _churn(fab_pair, rng, G, P, I, next_seq, applied, step_pair):
+    """One randomized churn round applied identically to both fabrics:
+    start bursts (immediates + interned), Done() advances, partitions,
+    heals, unreliable toggles, kill/revive — then advance both by the
+    same K micro-steps via `step_pair`."""
+    r = rng.random()
+    if r < 0.5:
+        g = rng.randrange(G)
+        for _ in range(rng.randrange(1, 5)):
+            if next_seq[g] - applied[g] >= I - 4:
+                break
+            seq = next_seq[g]
+            val = rng.choice([seq, f"v{g}.{seq}"])
+            p = rng.randrange(P)
+            outcomes = []
+            for f in fab_pair:
+                try:
+                    f.start(g, p, seq, val)
+                    outcomes.append("ok")
+                except WindowFullError:
+                    outcomes.append("full")
+            assert outcomes[0] == outcomes[1], "backpressure diverged"
+            if outcomes[0] == "ok":
+                next_seq[g] += 1
+    elif r < 0.72:
+        g = rng.randrange(G)
+        while applied[g] < next_seq[g]:
+            if fab_pair[0].status(g, 0, applied[g])[0] != Fate.DECIDED:
+                break
+            applied[g] += 1
+        if applied[g] > 0:
+            for f in fab_pair:
+                f.done_many([(g, p, applied[g] - 1) for p in range(P)])
+    elif r < 0.82:
+        g = rng.randrange(G)
+        two = rng.sample(range(P), 2)
+        rest = [p for p in range(P) if p not in two]
+        for f in fab_pair:
+            f.partition(g, two, rest)
+    elif r < 0.88:
+        for f in fab_pair:
+            f.heal()
+    elif r < 0.94:
+        flag = rng.random() < 0.5
+        for f in fab_pair:
+            f.set_unreliable(flag)
+    else:
+        g, p = rng.randrange(G), rng.randrange(P)
+        if fab_pair[0].is_dead(g, p):
+            for f in fab_pair:
+                f.revive(g, p)
+        else:
+            for f in fab_pair:
+                f.kill(g, p)
+    step_pair()
+
+
+def _quiesce_and_check_device_truth(fab: PaxosFabric):
+    """Heal, drain the injection queues, then assert the incremental host
+    mirror equals the device's decided array bit-for-bit."""
+    import jax
+
+    fab.heal()
+    fab.set_unreliable(False)
+    fab.step(4)
+    for _ in range(8):
+        if not fab._pending_resets and not fab._pending_starts:
+            break
+        fab.step()
+    assert not fab._pending_resets and not fab._pending_starts
+    truth = np.array(jax.device_get(fab._state.decided))
+    np.testing.assert_array_equal(
+        fab.m_decided, truth,
+        err_msg="incremental mirror drifted from device truth")
+    assert fab._decided_cells == int((truth >= 0).sum())
+
+
+def _run_kstep_parity(K, io_mode, kernel=None, rounds=30, seed=23,
+                      G=3, P=3, I=16):
+    kw = dict(ngroups=G, npeers=P, ninstances=I, seed=seed,
+              io_mode=io_mode, kernel=kernel)
+    fa = PaxosFabric(steps_per_dispatch=K, **kw)
+    fb = PaxosFabric(**kw)  # the K=1 synchronous reference clock
+    assert fa.steps_per_dispatch == K and fb.steps_per_dispatch == 1
+    rng = random.Random(seed)
+    next_seq, applied = [0] * G, [0] * G
+
+    def step_pair():
+        fa.step()    # one dispatch = K fused micro-steps
+        fb.step(K)   # K synchronous dispatches
+        assert fa.steps_total == fb.steps_total
+
+    for r in range(rounds):
+        _churn((fa, fb), rng, G, P, I, next_seq, applied, step_pair)
+        _assert_bit_same(fa, fb, f"round {r}")
+    assert fa._decided_cells > 0, "churn decided nothing — vacuous run"
+    _quiesce_and_check_device_truth(fb if K == 1 else fa)
+
+
+def test_kstep_parity_compact_xla():
+    _run_kstep_parity(K=4, io_mode="compact")
+
+
+def test_kstep_parity_full_xla():
+    _run_kstep_parity(K=3, io_mode="full", rounds=20, seed=9)
+
+
+def test_kstep_parity_pallas():
+    """Same contract on the Pallas engine (interpret mode on CPU): the
+    fused scan and the K=1 clock must pop identical per-step keys, so the
+    packed-mask Bernoulli draws line up bit-for-bit."""
+    _run_kstep_parity(K=2, io_mode="compact", kernel="pallas",
+                      rounds=8, seed=5, G=2, I=8)
+
+
+def test_pipelined_depth_safety_and_convergence():
+    """Depth-3 step_async vs the synchronous clock, same churn schedule
+    with partition/unreliable/kill events landing MID-PIPELINE (with
+    depth 3 there are always in-flight dispatches when they hit).
+
+    Step-for-step progress parity is NOT the contract here: GC retire
+    batching shifts slot assignment with depth, and under a lossy net a
+    different slot draws different Bernoulli coins, so an instance may
+    legally decide a step earlier or later.  What must hold is SAFETY and
+    CONVERGENCE: any seq both clocks have decided carries the SAME value
+    at every checkpoint; after heal + reliable quiesce both clocks agree
+    on every seq's fate and value, Min()/Max() converge to the same
+    points, and the pipelined mirror equals device truth bit-for-bit
+    (the tenancy filter's job)."""
+    G, P, I = 3, 3, 24
+    kw = dict(ngroups=G, npeers=P, ninstances=I, seed=31, io_mode="compact")
+    fa = PaxosFabric(pipeline_depth=3, steps_per_dispatch=2, **kw)
+    fb = PaxosFabric(pipeline_depth=1, steps_per_dispatch=2, **kw)
+    rng = random.Random(77)
+    next_seq, applied = [0] * G, [0] * G
+
+    def step_pair():
+        fa.step_async()
+        fb.step()
+
+    for r in range(40):
+        _churn((fa, fb), rng, G, P, I, next_seq, applied, step_pair)
+        if r % 8 == 7:
+            fa.flush()
+            queries = [(g, p, s) for g in range(G) for p in range(P)
+                       for s in range(next_seq[g])]
+            for q, ra, rb in zip(queries, fa.status_many(queries),
+                                 fb.status_many(queries)):
+                if ra[0] == rb[0] == Fate.DECIDED:
+                    assert ra == rb, (r, q)  # same seq → same value, always
+    fa.flush()
+    assert fa.steps_total == fb.steps_total
+    # Converge: heal, reliable, and run both clocks until quiescent.
+    for f in (fa, fb):
+        f.heal()
+        f.set_unreliable(False)
+        f.step(12)
+    queries = [(g, p, s) for g in range(G) for p in range(P)
+               for s in range(next_seq[g])]
+    assert fa.status_many(queries) == fb.status_many(queries)
+    for g in range(G):
+        for p in range(P):
+            assert fa.peer_min(g, p) == fb.peer_min(g, p), (g, p)
+            assert fa.peer_max(g, p) == fb.peer_max(g, p), (g, p)
+        for s in range(applied[g], next_seq[g]):
+            assert fa.ndecided(g, s) == fb.ndecided(g, s)
+    assert fa._decided_cells == fb._decided_cells > 0
+    _quiesce_and_check_device_truth(fa)
+
+
+def test_pipelined_clock_smoke_no_deadlock():
+    """Tier-1 liveness: a few hundred micro-steps of the free-running
+    pipelined clock under client load — ops keep deciding, the clock
+    keeps retiring, and stop_clock() drains the pipeline."""
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=32, io_mode="compact",
+                      steps_per_dispatch=2, pipeline_depth=2,
+                      auto_step=True)
+    try:
+        from tpu6824.utils.timing import wait_until
+
+        for batch in range(4):
+            ops = [(g, (batch + s) % 3, batch * 12 + s, batch * 12 + s)
+                   for g in range(2) for s in range(12)]
+            fab.start_many(ops)
+            assert wait_until(
+                lambda: all(
+                    fab.status(g, 0, batch * 12 + 11)[0] == Fate.DECIDED
+                    for g in range(2)),
+                timeout=30.0), f"batch {batch} never decided"
+            fab.done_many([(g, p, batch * 12 + 11)
+                           for g in range(2) for p in range(3)])
+        fab.wait_steps(max(0, 200 - fab.steps_total), timeout=20.0)
+        assert fab.steps_total >= 200, fab.steps_total
+        assert fab.steps_total % fab.steps_per_dispatch == 0
+    finally:
+        fab.stop_clock()
+    assert not fab._inflight, "stop_clock must drain the pipeline"
+
+
+def test_windowfull_resumable_mid_pipeline():
+    """WindowFullError.index stays an exact resume point while dispatches
+    are in flight: ops[:index] applied, ops[index:] droppable, and
+    resuming from index after Done()/GC completes the batch exactly once."""
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=8, io_mode="compact",
+                      steps_per_dispatch=2, pipeline_depth=2)
+    ops = [(0, s % 3, s, s) for s in range(20)]
+    with pytest.raises(WindowFullError) as ei:
+        fab.start_many(ops)
+    idx = ei.value.index
+    assert idx == 8
+    # Let the accepted prefix decide mid-pipeline (async advance).
+    for _ in range(6):
+        fab.step_async()
+    fab.flush()
+    for s in range(idx):
+        assert fab.status(0, 0, s)[0] == Fate.DECIDED, s
+    fab.done_many([(0, p, idx - 1) for p in range(3)])
+    fab.step(2)  # gossip Done, run GC, recycle slots
+    fab.start_many(ops[idx:16])
+    with pytest.raises(WindowFullError) as ei2:
+        fab.start_many(ops[16:])
+    fab.step_async()
+    fab.step_async()
+    fab.flush()
+    for s in range(idx, 16):
+        assert fab.status(0, 1, s) == (Fate.DECIDED, s), s
+    assert ei2.value.index is not None  # still a resumable batch contract
+
+
+def test_knobs_flow_through_config(monkeypatch):
+    from tpu6824.config import Config
+
+    monkeypatch.setenv("TPU6824_CLOCK_STEPS_PER_DISPATCH", "3")
+    monkeypatch.setenv("TPU6824_PIPELINE_DEPTH", "4")
+    cfg = Config.from_env()
+    assert cfg.fabric.steps_per_dispatch == 3
+    assert cfg.fabric.pipeline_depth == 4
+    fab = cfg.make_fabric()
+    try:
+        assert fab.steps_per_dispatch == 3
+        assert fab.pipeline_depth == 4
+    finally:
+        fab.stop_clock()

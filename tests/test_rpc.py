@@ -1,6 +1,9 @@
 """L0 transport tests — the contract the reference's harness depends on
 (`paxos/rpc.go:24-42` call semantics; `paxos/paxos.go:524-552` accept-loop
-fault injection; `paxos/test_test.go:194-195,712-751` filesystem surgery)."""
+fault injection; `paxos/test_test.go:194-195,712-751` filesystem surgery),
+plus the pooled-persistent-connection default (ISSUE 1 satellite): reuse,
+dial-per-call fallback, and the stat-identity revalidation that keeps the
+filesystem surgery meaningful under pooling."""
 
 import os
 import threading
@@ -9,6 +12,7 @@ import uuid
 import pytest
 
 from tpu6824.rpc import Server, call, connect, link_alias, unlink_alias
+from tpu6824.rpc.transport import reset_pool
 from tpu6824.services.lockservice import Clerk, LockServer
 from tpu6824.utils.errors import RPCError
 
@@ -30,6 +34,75 @@ def sockdir():
 
 def addr(sockdir, name):
     return os.path.join(sockdir, name)
+
+
+def test_pooled_reuse_is_default(sockdir):
+    """Pooled persistent connections are the default: N sequential calls
+    ride ONE accepted connection (rpc_count still counts every request —
+    the reference's rpccount semantics at request granularity)."""
+    reset_pool()
+    a = addr(sockdir, "pool")
+    srv = Server(a).register("inc", lambda x: x + 1).start()
+    try:
+        for i in range(10):
+            assert call(a, "inc", i) == i + 1
+        assert srv.rpc_count == 10
+        assert srv.accept_count == 1, "pooled calls must reuse the connection"
+    finally:
+        srv.kill()
+
+
+def test_dial_per_call_flag(sockdir):
+    """pooled=False restores the reference's literal discipline: one
+    accepted connection per call."""
+    reset_pool()
+    a = addr(sockdir, "dial")
+    srv = Server(a).register("inc", lambda x: x + 1).start()
+    try:
+        for i in range(5):
+            assert call(a, "inc", i, pooled=False) == i + 1
+        assert srv.rpc_count == 5
+        assert srv.accept_count == 5
+    finally:
+        srv.kill()
+
+
+def test_pooled_survives_server_restart(sockdir):
+    """A cached connection to a dead server must not poison later calls:
+    the socket path's stat identity changes across restart, so the pool
+    discards the stale connection and redials — no manual reset needed."""
+    reset_pool()
+    a = addr(sockdir, "restart")
+    srv = Server(a).register("who", lambda: "first").start()
+    try:
+        assert call(a, "who") == "first"
+    finally:
+        srv.kill()
+    with pytest.raises(RPCError):
+        call(a, "who")  # killed: path unlinked, cached conn unusable
+    srv2 = Server(a).register("who", lambda: "second").start()
+    try:
+        assert call(a, "who") == "second"
+        assert call(a, "who") == "second"
+        assert srv2.accept_count == 1  # and the new conn pools normally
+    finally:
+        srv2.kill()
+
+
+def test_pooled_deafen_applies_to_cached_connection(sockdir):
+    """deafen() (unlink the socket path) must fail pooled calls too, even
+    though a cached established connection could physically still talk —
+    the stat revalidation is what preserves the harness semantics."""
+    reset_pool()
+    a = addr(sockdir, "pdeaf")
+    srv = Server(a).register("f", lambda: 1).start()
+    try:
+        assert call(a, "f") == 1  # connection now cached
+        srv.deafen()
+        with pytest.raises(RPCError):
+            call(a, "f")
+    finally:
+        srv.kill()
 
 
 def test_basic_call_and_app_error(sockdir):

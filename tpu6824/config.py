@@ -32,6 +32,13 @@ class FabricConfig:
     # reference accept-loop fault rates (paxos/paxos.go:528-544)
     unreliable_req_drop: float = 0.10
     unreliable_rep_drop: float = 0.20
+    # pipelined clock (ISSUE 1): kernel micro-steps fused per device
+    # dispatch (lax.scan in the step jit) and how many dispatches the
+    # free-running clock keeps in flight (2 = double buffering).  None →
+    # $TPU6824_CLOCK_STEPS_PER_DISPATCH / $TPU6824_PIPELINE_DEPTH →
+    # fabric defaults (1 / 2).
+    steps_per_dispatch: int | None = None
+    pipeline_depth: int | None = None
 
 
 @dataclasses.dataclass
@@ -82,6 +89,11 @@ class Config:
             key = prefix + name.upper()
             if key in os.environ:
                 setattr(cfg.fabric, name, int(os.environ[key]))
+        for name, key in (("steps_per_dispatch",
+                           prefix + "CLOCK_STEPS_PER_DISPATCH"),
+                          ("pipeline_depth", prefix + "PIPELINE_DEPTH")):
+            if key in os.environ:
+                setattr(cfg.fabric, name, int(os.environ[key]))
         if prefix + "MESH" in os.environ:
             g, i, p = (int(x) for x in os.environ[prefix + "MESH"].split(","))
             cfg.mesh = MeshConfig(g, i, p)
@@ -112,4 +124,6 @@ class Config:
             seed=f.seed, auto_step=f.auto_step, step_sleep=f.step_sleep,
             kernel=f.kernel, unreliable_req_drop=f.unreliable_req_drop,
             unreliable_rep_drop=f.unreliable_rep_drop,
+            steps_per_dispatch=f.steps_per_dispatch,
+            pipeline_depth=f.pipeline_depth,
         )

@@ -11,17 +11,24 @@ Host↔device contract (designed to avoid per-op round-trips — SURVEY §7 "Hos
 device chatter"):
   - API calls (`start/status/done/...`) only touch host mirrors and pending-op
     queues under a lock; they never talk to the device.
-  - A single clock thread drains queues into `apply_starts`, runs
-    `paxos_step`, and refreshes the mirrors — one device round-trip per step
-    for the whole universe of cells, regardless of op rate.
+  - A single clock thread drains queues into `apply_starts`, runs the step
+    kernel, and refreshes the mirrors — one device round-trip per DISPATCH
+    for the whole universe of cells, regardless of op rate.  A dispatch is
+    `steps_per_dispatch` fused kernel micro-steps (lax.scan on the compact
+    path), and the free-running clock double-buffers dispatches
+    (`pipeline_depth`): queued ops are staged for dispatch N+1 and dispatch
+    N-1's compact summary is folded into the mirrors while dispatch N runs
+    on-device, with the heavy stage/apply work outside the fabric lock.
 """
 
 from __future__ import annotations
 
 import functools
+import heapq
 import os
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
@@ -63,6 +70,20 @@ _SMALL_BUCKET = 256  # second, tiny pad size so idle steps ship ~3KB not ~100KB
 # Idle-adaptive clock: sleep this long after a step that injected nothing,
 # delivered no messages, and decided nothing (0 disables; see _clock_loop).
 _IDLE_SLEEP = float(os.environ.get("TPU6824_IDLE_SLEEP", 0.002))
+# Pipelined multi-step clock (the host↔device amortization knobs; both
+# also plumb through tpu6824.config.FabricConfig):
+#   - steps per dispatch: K kernel micro-steps fused per device dispatch
+#     (lax.scan around the round), so the summary readback fires once per
+#     K steps instead of once per step;
+#   - pipeline depth: how many dispatches the clock thread keeps in
+#     flight before retiring the oldest (2 = classic double buffering:
+#     stage/launch N+1 while N computes, apply N-1's mirror delta after).
+#     Depth only shapes the free-running clock / step_async(); direct
+#     step() calls stay synchronous (launch + retire) for deterministic
+#     tests.
+_STEPS_PER_DISPATCH = int(
+    os.environ.get("TPU6824_CLOCK_STEPS_PER_DISPATCH", 1))
+_PIPELINE_DEPTH = int(os.environ.get("TPU6824_PIPELINE_DEPTH", 2))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -118,6 +139,8 @@ class PaxosFabric:
         io_mode: str | None = None,
         summary_k: int | None = None,
         mesh=None,
+        steps_per_dispatch: int | None = None,
+        pipeline_depth: int | None = None,
     ):
         from tpu6824.core.kernel import paxos_step_reliable
         from tpu6824.core.pallas_kernel import get_step, resolve_impl
@@ -139,6 +162,18 @@ class PaxosFabric:
             self._reliable_ok = resolve_impl(kernel) == "xla"
             self._step_reliable = paxos_step_reliable
             self._apply_starts = apply_starts
+            if self._reliable_ok:
+                # Fused K-round scan for the full-io path (one dispatch +
+                # one readback per K micro-steps); the pallas/mesh engines
+                # chain async dispatches instead (see _step_once_full).
+                from tpu6824.core.kernel import (
+                    paxos_multi_step, paxos_multi_step_reliable,
+                )
+
+                self._multi_step = paxos_multi_step
+                self._multi_reliable = paxos_multi_step_reliable
+            else:
+                self._multi_step = self._multi_reliable = None
         else:
             # Mesh-hosted fabric (SURVEY §0's architecture sentence): the
             # (G, I, P) consensus universe lives sharded over the device
@@ -161,6 +196,7 @@ class PaxosFabric:
                         f"axis {ax}={mesh.shape[ax]}")
             self._state = place_state(self._state, mesh)
             self._step_fn, impl = sharded_step_auto(mesh, impl=kernel)
+            self._multi_step = self._multi_reliable = None
             self._reliable_ok = impl == "xla"
             self._step_reliable = (sharded_step_reliable(mesh)
                                    if self._reliable_ok else None)
@@ -200,7 +236,21 @@ class PaxosFabric:
                     NamedSharding(mesh, PartitionSpec("g", "i")))
         self._compact_fns: dict = {}
         self._zero_drop = None  # lazily-built (G, P, P) f32 zeros
-        self._dummy_key = None
+        self._dummy_keys = None  # stacked (K,) dummies for the fused scan
+
+        # Pipelined multi-step clock state (see the knob comment above):
+        self._spd = max(1, int(steps_per_dispatch
+                               if steps_per_dispatch is not None
+                               else _STEPS_PER_DISPATCH))
+        self._pipeline_depth = max(1, int(pipeline_depth
+                                          if pipeline_depth is not None
+                                          else _PIPELINE_DEPTH))
+        self._inflight: deque = deque()  # launched, unretired dispatches
+        # Summary-overflow resync epoch: a full-mirror resync at retire
+        # reads the NEWEST device state, which includes dispatches still
+        # in flight — those must recount absolutely at their own retire
+        # instead of adding their (already-mirrored) increments again.
+        self._resync_epoch = 0
 
         # Host-owned network condition (device inputs):
         self._link = np.ones((G, P, P), bool)
@@ -228,13 +278,16 @@ class PaxosFabric:
         # Slot management (host only): which absolute seq lives in each slot.
         self._slot_seq = np.full((G, I), -1, np.int64)
         self._seq2slot: list[dict[int, int]] = [dict() for _ in range(G)]
-        # O(1) allocation: per-group LIFO freelist (invariant: slot is listed
-        # iff _slot_seq[g, slot] == -1).  A freed slot may carry a pending
-        # reset; that is safe to hand out because apply_starts applies resets
-        # before starts within the same step.
-        self._free: list[list[int]] = [
-            list(range(I - 1, -1, -1)) for _ in range(G)
-        ]
+        # Per-group free-slot MIN-HEAP (invariant: slot is listed iff
+        # _slot_seq[g, slot] == -1).  Smallest-slot-first makes allocation
+        # a pure function of the free SET, not of GC batch boundaries —
+        # required for the K-step parity contract: the K=1 clock may GC a
+        # window across several retires where the fused K-step clock GCs
+        # it in one, and a LIFO freelist would then hand out different
+        # slots.  A freed slot may carry a pending reset; that is safe to
+        # hand out because apply_starts applies resets before starts
+        # within the same step.
+        self._free: list[list[int]] = [list(range(I)) for _ in range(G)]
         self._live_slots = 0  # allocated - GC'd (idle-clock predicate)
         self._slot_vids: list[list[list[int]]] = [
             [[] for _ in range(I)] for _ in range(G)
@@ -283,8 +336,10 @@ class PaxosFabric:
         while True:
             with self._lock:
                 if not self._running:
-                    return
-            self.step()
+                    # Retire whatever the pipelined loop left in flight so
+                    # stop_clock() hands back fully-applied mirrors.
+                    break
+            self.step_async()
             if self._step_sleep:
                 time.sleep(self._step_sleep)
             elif _IDLE_SLEEP and not self._last_step_active:
@@ -293,12 +348,35 @@ class PaxosFabric:
                 # strand a queued op), so idling never adds op latency.
                 self._clock_wake.wait(_IDLE_SLEEP)
                 self._clock_wake.clear()
+        self.flush()
 
     def step(self, n: int = 1):
-        """Advance the whole fabric by n kernel steps (callable from the clock
-        thread or directly in deterministic tests)."""
+        """Advance the whole fabric by n dispatches of `steps_per_dispatch`
+        kernel micro-steps each, synchronously (callable from the clock
+        thread or directly in deterministic tests).  Any dispatches left in
+        flight by step_async() are retired first."""
+        self.flush()
         for _ in range(n):
             self._step_once()
+
+    def step_async(self):
+        """Pipelined advance: launch one dispatch, then retire the oldest
+        in-flight dispatches down to `pipeline_depth - 1` — so with depth 2
+        the host stages/applies mirrors for dispatch N±1 while dispatch N
+        computes on-device.  API calls remain safe concurrently (they only
+        touch host mirrors under the lock).  Falls back to a synchronous
+        step on the full-io path, which has no launch/retire split."""
+        if self._io_mode != "compact" or self._pipeline_depth <= 1:
+            self._step_once()
+            return
+        self._inflight.append(self._launch_compact())
+        while len(self._inflight) >= self._pipeline_depth:
+            self._retire_compact(self._inflight.popleft())
+
+    def flush(self):
+        """Retire every in-flight dispatch (no-op when none are)."""
+        while self._inflight:
+            self._retire_compact(self._inflight.popleft())
 
     def _next_key_locked(self):
         # Amortized PRNG: one split call per _KEY_BATCH steps instead of one
@@ -335,9 +413,14 @@ class PaxosFabric:
         freed slot would run a ghost round with a value id whose intern
         ref the GC already dropped; the vectorized form of
         `_start_is_live`) — and stage the network condition for the
-        kernel.  Returns (s_arr, r_arr, link, done, reliable, sub,
-        drop_req, drop_rep); the drop/key slots are None on the reliable
-        fast path."""
+        kernel.  Returns (s_arr, r_arr, link, done, reliable, keys,
+        drop_req, drop_rep); `keys` is a list of `steps_per_dispatch`
+        per-micro-step PRNG subkeys, popped in the same order a K=1 clock
+        would pop them (the multi-step parity contract); the drop/key
+        slots are None on the reliable fast path.  Only the queue swap
+        and network snapshot need the lock — callers do the heavy pad/
+        dedup work outside it so API threads keep running while a
+        dispatch is being staged."""
         starts = self._pending_starts
         resets = self._pending_resets
         self._pending_starts = []
@@ -355,7 +438,7 @@ class PaxosFabric:
         link = self._link_dev
         done = self._put("done", self._done)
         reliable = self._reliable_ok and not bool(self._unreliable.any())
-        sub = drop_req = drop_rep = None
+        keys = drop_req = drop_rep = None
         if not reliable:
             # Per-edge drop probabilities from per-server unreliable
             # flags: the *destination* server's accept loop drops.
@@ -364,12 +447,12 @@ class PaxosFabric:
                 unrel[:, None, :], (self.G, self.P, self.P))
             drop_req = self._put("drop", e * self._req_drop)
             drop_rep = self._put("drop", e * self._rep_drop)
-            sub = self._next_key_locked()
-        return s_arr, r_arr, link, done, reliable, sub, drop_req, drop_rep
+            keys = [self._next_key_locked() for _ in range(self._spd)]
+        return s_arr, r_arr, link, done, reliable, keys, drop_req, drop_rep
 
     def _step_once_full(self):
         with self._lock:
-            (s_arr, r_arr, link, done, reliable, sub, drop_req,
+            (s_arr, r_arr, link, done, reliable, keys, drop_req,
              drop_rep) = self._drain_and_stage_locked()
 
         state = self._state
@@ -386,14 +469,35 @@ class PaxosFabric:
                 state, jnp.asarray(reset), jnp.asarray(sa), jnp.asarray(sv)
             )
 
-        if reliable:
-            state, io = self._step_reliable(state, link, done)
+        # K micro-steps, ONE device_get.  The XLA engine fuses the rounds
+        # into a single scan dispatch (kernel.paxos_multi_step*); the
+        # mesh/pallas engines chain K async dispatches instead, with
+        # touched/msgs merged on-device — either way the host round-trip
+        # cost is paid once per dispatch, not once per micro-step.
+        if self._spd > 1 and self._multi_step is not None:
+            if reliable:
+                state, io = self._multi_reliable(state, link, done,
+                                                 self._spd)
+            else:
+                state, io = self._multi_step(state, link, done,
+                                             self._stacked_keys(keys),
+                                             drop_req, drop_rep)
+            touched_acc, msgs_acc = io.touched, io.msgs
         else:
-            state, io = self._step_fn(state, link, done, sub, drop_req,
-                                      drop_rep)
+            touched_acc = msgs_acc = None
+            for k in range(self._spd):
+                if reliable:
+                    state, io = self._step_reliable(state, link, done)
+                else:
+                    state, io = self._step_fn(state, link, done, keys[k],
+                                              drop_req, drop_rep)
+                touched_acc = (io.touched if touched_acc is None
+                               else touched_acc | io.touched)
+                msgs_acc = (io.msgs if msgs_acc is None
+                            else msgs_acc + io.msgs)
         self._state = state
         decided, done_view, touched, msgs = jax.device_get(
-            (io.decided, io.done_view, io.touched, io.msgs)
+            (io.decided, io.done_view, touched_acc, msgs_acc)
         )
 
         with self._lock:
@@ -416,7 +520,7 @@ class PaxosFabric:
             # delta counts decisions landing in recycled slots too.
             newly = ndec - self._decided_cells
             self._decided_cells = ndec
-            self.events.bump("steps")
+            self.events.bump("steps", self._spd)
             self.events.bump("msgs", int(msgs))
             if newly > 0:
                 self.events.bump("decided_cells", newly)
@@ -435,13 +539,23 @@ class PaxosFabric:
     # ------------------------------------------------- compact step path
 
     def _compact_fn(self, reliable: bool):
-        """The fused injection+round+summary jit.  Injection is fused so
-        the pre-round `decided` (= the newly-decided diff's baseline) is
-        an internal value, not an extra host round trip; the summary is
-        fused so the readback is (cnt, K idx/vals, (G,P) maxseq, done_view,
-        msgs) — O(active cells) — instead of the (G, I, P) mirrors.  This
-        is what lets the service path ride the kernel at north-star shape
-        (Status stays a local host-mirror read, paxos/paxos.go:434-447)."""
+        """The fused injection+multi-round+summary jit.  Injection is fused
+        so the pre-dispatch `decided` (= the newly-decided diff's baseline)
+        is an internal value, not an extra host round trip; the
+        `steps_per_dispatch` micro-rounds run inside ONE lax.scan, so the
+        whole dispatch is a single device program; and the summary is fused
+        so the readback is (cnt, K idx/vals/seqs, (G,P) maxseq, done_view,
+        msgs) — O(active cells), ONCE per dispatch — instead of one
+        (G, I, P) mirror copy per step.  `decided` is sticky within a
+        dispatch (resets only inject at dispatch start), so diffing the
+        final state against the baseline is exactly the union of the
+        per-step diffs.  The per-entry `seqs` readback is the tenancy tag
+        the pipelined retire needs: a summary entry whose slot the host
+        GC'd/reassigned after launch is recognizable (host slot→seq no
+        longer matches) and dropped instead of resurrecting a recycled
+        row.  This is what lets the service path ride the kernel at
+        north-star shape (Status stays a local host-mirror read,
+        paxos/paxos.go:434-447)."""
         fn = self._compact_fns.get(reliable)
         if fn is not None:
             return fn
@@ -449,31 +563,59 @@ class PaxosFabric:
         step_reliable = self._step_reliable
         K = self._summary_k
         G, I, P = self.G, self.I, self.P
-        ncells = G * I * P
+        nrows, ncells = G * I, G * I * P
 
         def fused(state, slot_seq, reset_rows, cells, vids, seqs,
-                  link, done, key, drop_req, drop_rep):
+                  link, done, keys, drop_req, drop_rep):
             state, slot_seq = apply_starts_compact(
                 state, slot_seq, reset_rows, cells, vids, seqs)
             prev = state.decided
-            if reliable:
-                st2, io = step_reliable(state, link, done)
-            else:
-                st2, io = step(state, link, done, key, drop_req, drop_rep)
-            newly = (io.decided >= 0) & (prev < 0)
+
+            def body(st, key):
+                if reliable:
+                    st2, io = step_reliable(st, link, done)
+                else:
+                    st2, io = step(st, link, done, key, drop_req, drop_rep)
+                return st2, (io.touched, io.msgs)
+
+            st2, (touched_k, msgs_k) = jax.lax.scan(body, state, keys)
+            touched = touched_k.any(axis=0)
+            msgs = msgs_k.sum().astype(jnp.int32)
+            newly = (st2.decided >= 0) & (prev < 0)
             flat = newly.reshape(-1)
             cnt = flat.sum().astype(jnp.int32)
             idx = jnp.nonzero(flat, size=K, fill_value=ncells)[0]
             idx = idx.astype(jnp.int32)
-            vals = io.decided.reshape(-1)[jnp.minimum(idx, ncells - 1)]
+            vals = st2.decided.reshape(-1)[jnp.minimum(idx, ncells - 1)]
+            iseqs = slot_seq.reshape(-1)[
+                jnp.minimum(idx // P, nrows - 1)]
             maxseq = jnp.max(
-                jnp.where(io.touched, slot_seq[:, :, None], jnp.int32(-1)),
+                jnp.where(touched, slot_seq[:, :, None], jnp.int32(-1)),
                 axis=1)  # (G, P)
-            return st2, slot_seq, cnt, idx, vals, maxseq, io.done_view, io.msgs
+            return (st2, slot_seq, cnt, idx, vals, iseqs, maxseq,
+                    st2.done_view, msgs)
 
         fn = jax.jit(fused, donate_argnums=(0, 1))
         self._compact_fns[reliable] = fn
         return fn
+
+    def _stacked_keys(self, keys):
+        """One (K,) key array for the fused scan; reliable dispatches reuse
+        a cached dummy stack (the scan ignores it at zero drop).  On a
+        mesh-hosted fabric the stack gets the replicated key sharding —
+        a committed unsharded array would conflict with the sharded
+        step's in_shardings (same reason _put exists)."""
+        if keys is not None:
+            ks = jnp.stack(keys)
+            if self._mesh is not None:
+                ks = jax.device_put(ks, self._sh_key)
+            return ks
+        if self._dummy_keys is None:
+            ks = jax.random.split(jax.random.key(0), self._spd)
+            if self._mesh is not None:
+                ks = jax.device_put(ks, self._sh_key)
+            self._dummy_keys = ks
+        return self._dummy_keys
 
     @staticmethod
     def _pad_i32(arr, fill: int, bucket: int):
@@ -483,11 +625,18 @@ class PaxosFabric:
             out[:n] = arr
         return jnp.asarray(out)
 
-    def _step_once_compact(self):
+    def _launch_compact(self):
+        """Stage the queued ops and launch ONE fused dispatch
+        (`steps_per_dispatch` micro-steps); returns the pending handle for
+        `_retire_compact`.  Only the queue swap + network snapshot hold
+        the lock — the pad/dedup/device-put work and the dispatch itself
+        run outside it, so `start_many`/`status_many` callers proceed
+        concurrently with an in-flight dispatch (the double-buffering half
+        of the pipelined clock)."""
         G, I, P = self.G, self.I, self.P
         nrows, ncells = G * I, G * I * P
         with self._lock:
-            (s_arr, r_arr, link, done, reliable, sub, drop_req,
+            (s_arr, r_arr, link, done, reliable, keys, drop_req,
              drop_rep) = self._drain_and_stage_locked()
             if reliable:
                 # The fused jit takes one signature; the reliable variant
@@ -495,13 +644,9 @@ class PaxosFabric:
                 if self._zero_drop is None:
                     self._zero_drop = self._put(
                         "drop", np.zeros((G, P, P), np.float32))
-                if self._dummy_key is None:
-                    k0 = jax.random.key(0)
-                    self._dummy_key = (
-                        jax.device_put(k0, self._sh_key)
-                        if self._mesh is not None else k0)
                 drop_req = drop_rep = self._zero_drop
-                sub = self._dummy_key
+            epoch = self._resync_epoch
+        sub = self._stacked_keys(keys)
         rrows = np.empty(0, np.int64)
         if r_arr is not None:
             rrows = r_arr[:, 0] * I + r_arr[:, 1]
@@ -554,30 +699,73 @@ class PaxosFabric:
         out = self._compact_fn(reliable)(
             state, slot_dev, *pads(chunks[-1]), link, done, sub,
             drop_req, drop_rep)
-        st2, slot_dev, cnt, idx, vals, maxseq, done_view, msgs = out
+        st2, slot_dev = out[0], out[1]
         self._state = st2
         self._slot_seq_dev = slot_dev
-        cnt, idx, vals, maxseq, done_view, msgs = jax.device_get(
-            (cnt, idx, vals, maxseq, done_view, msgs))
+        # out[2:]: cnt, idx, vals, iseqs, maxseq, done_view, msgs — all
+        # still device futures; device_get happens at retire.
+        return (out[2:], nr + ns, epoch)
+
+    def _retire_compact(self, pending):
+        """Fetch one dispatch's summary and fold it into the host mirrors
+        (the mirror-apply half of the pipeline; the blocking device_get
+        runs outside the lock)."""
+        handles, n_inject, epoch = pending
+        cnt, idx, vals, iseqs, maxseq, done_view, msgs = jax.device_get(
+            handles)
+        G, I, P = self.G, self.I, self.P
+        ncells = G * I * P
 
         with self._lock:
             cnt = int(cnt)
             if cnt > self._summary_k:
                 # Compaction overflow (a burst decided more cells than K):
-                # one full fetch for this step, mirrors resync absolutely.
+                # one full fetch, mirrors resync absolutely.  The fetch
+                # reads the NEWEST device state — with dispatches in
+                # flight that runs ahead of this retire, so later retires
+                # of already-launched dispatches must recount instead of
+                # re-adding increments the resync already mirrored
+                # (the epoch check below).
                 decided = np.array(jax.device_get(self._state.decided))
+                if self._pending_resets:
+                    # Queued GC wipes not yet injected into any launched
+                    # dispatch: the fetched state still carries the old
+                    # tenants; the mirror must not resurrect them.
+                    r = np.asarray(self._pending_resets, dtype=np.int64)
+                    decided[r[:, 0], r[:, 1], :] = NO_VAL
                 self.m_decided = decided
                 ndec = int((decided >= 0).sum())
                 newly = ndec - self._decided_cells
                 self._decided_cells = ndec
+                self._resync_epoch += 1
             else:
+                applied = 0
                 if cnt:
                     valid = idx < ncells
+                    pidx_v = idx[valid]
+                    # Tenancy filter: with dispatches pipelined, the host
+                    # may have GC'd/reassigned a slot after this dispatch
+                    # launched; its summary entries then carry a seq the
+                    # host slot map no longer holds — drop them (the
+                    # recycled row was already wiped, and the device wipe
+                    # rides the queued reset).  Synchronous clocks never
+                    # trip this (the filter keeps everything).
+                    live = (self._slot_seq.reshape(-1)[pidx_v // P]
+                            == iseqs[valid])
+                    pidx_v = pidx_v[live] if not live.all() else pidx_v
                     # np.put: flat scatter that cannot silently land in a
                     # reshape copy if the mirror ever goes non-contiguous.
-                    np.put(self.m_decided, idx[valid], vals[valid])
-                newly = cnt
-                self._decided_cells += cnt
+                    np.put(self.m_decided, pidx_v, vals[valid][live])
+                    applied = len(pidx_v)
+                if epoch < self._resync_epoch:
+                    # Launched before an overflow resync: the absolute
+                    # fetch already mirrored this dispatch's decisions.
+                    ndec = int((self.m_decided >= 0).sum())
+                    newly = ndec - self._decided_cells
+                    self._decided_cells = ndec
+                else:
+                    newly = applied
+                    self._decided_cells += applied
             done_view = np.array(done_view)
             self.m_done_view = done_view
             pidx = np.arange(P)
@@ -585,7 +773,7 @@ class PaxosFabric:
                 done_view[:, pidx, pidx], self._done)
             np.minimum.reduce(done_view, axis=2, out=self._pmin_i32)
             self._peer_min = self._pmin_i32.astype(np.int64) + 1
-            self.events.bump("steps")
+            self.events.bump("steps", self._spd)
             self.events.bump("msgs", int(msgs))
             if newly > 0:
                 self.events.bump("decided_cells", newly)
@@ -594,10 +782,21 @@ class PaxosFabric:
             self._max_seq = np.maximum(self._max_seq,
                                        maxseq.astype(np.int64))
             self._last_step_active = (
-                nr > 0 or ns > 0 or int(msgs) > 0 or newly > 0
+                n_inject > 0 or int(msgs) > 0 or newly > 0
                 or self._live_slots * P > self._decided_cells)
             self._gc_locked()
             self._stepped.notify_all()
+
+    def _step_once_compact(self):
+        self._retire_compact(self._launch_compact())
+
+    @property
+    def steps_per_dispatch(self) -> int:
+        return self._spd
+
+    @property
+    def pipeline_depth(self) -> int:
+        return self._pipeline_depth
 
     @property
     def steps_total(self) -> int:
@@ -650,7 +849,7 @@ class PaxosFabric:
         self._live_slots -= len(gs)
         for g, slot, seq in zip(gs.tolist(), slots.tolist(), seqs.tolist()):
             del self._seq2slot[g][seq]
-            self._free[g].append(slot)
+            heapq.heappush(self._free[g], slot)
             vids = self._slot_vids[g][slot]
             if vids:
                 for vid in vids:
@@ -670,9 +869,10 @@ class PaxosFabric:
                 f"group {g}: all {self.I} instance slots live; "
                 f"call Done() to advance Min() (global_min={self._global_min_locked(g)})"
             )
-        # O(1) LIFO pop; a freed slot's pending reset (if any) is applied
-        # before the start lands (apply_starts order), so reuse is safe.
-        slot = self._free[g].pop()
+        # Smallest free slot (heap pop); a freed slot's pending reset (if
+        # any) is applied before the start lands (apply_starts order), so
+        # reuse is safe.
+        slot = heapq.heappop(self._free[g])
         self._live_slots += 1
         self._slot_seq[g, slot] = seq
         self._seq2slot[g][seq] = slot
@@ -782,7 +982,7 @@ class PaxosFabric:
                             f"(global_min={self._global_min_locked(g)}); "
                             f"batch applied up to index {n}",
                             index=n)
-                    slot = fl.pop()
+                    slot = heapq.heappop(fl)
                     self._live_slots += 1
                     slot_seq[g, slot] = seq
                     s2s[g][seq] = slot
@@ -1015,6 +1215,13 @@ class PaxosFabric:
         import pickle
 
         with self._lock:
+            # Guard BEFORE flushing: flush races a live clock thread's
+            # step_async on the in-flight deque — the misuse must raise
+            # without touching anything.
+            if self._running:
+                raise RuntimeError("stop_clock() before checkpoint()")
+        self.flush()  # retire any step_async() dispatches still in flight
+        with self._lock:
             if self._running:
                 raise RuntimeError("stop_clock() before checkpoint()")
             state_np = {f: np.array(x)
@@ -1148,7 +1355,11 @@ class PaxosFabric:
                                           PartitionSpec("g", "i")))
                 fab._slot_seq_dev = ss
             fab._seq2slot = [dict(d) for d in blob["seq2slot"]]
+            # Pre-heap blobs stored LIFO lists; heapify restores the
+            # smallest-first allocation invariant either way.
             fab._free = [list(s) for s in blob["free"]]
+            for fl in fab._free:
+                heapq.heapify(fl)
             fab._live_slots = G * I - sum(len(s) for s in fab._free)
             fab._decided_cells = int((fab.m_decided >= 0).sum())
             # Defensive twin of checkpoint()'s keep-filter (pre-fix blobs

@@ -151,6 +151,58 @@ def paxos_step_reliable(
     return _paxos_round(state, done, eye, L, L, L, L, L, link | eye)
 
 
+def _merge_scan_io(state: PaxosState, touched_k, msgs_k) -> StepIO:
+    """Fold a scan's per-round (touched, msgs) stacks into the one merged
+    StepIO a multi-step dispatch reports: decided/done_view are the final
+    round's (both monotone within a dispatch — decided is sticky per
+    tenancy, done_view max-accumulates), touched is the union (Max() needs
+    every slot any round touched), msgs is the dispatch total."""
+    return StepIO(decided=state.decided, done_view=state.done_view,
+                  touched=touched_k.any(axis=0),
+                  msgs=msgs_k.sum().astype(I32))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def paxos_multi_step(
+    state: PaxosState,
+    link: jnp.ndarray,       # (G, P, P) bool
+    done: jnp.ndarray,       # (G, P) i32
+    keys: jnp.ndarray,       # (K,) PRNG keys, one per fused micro-step
+    drop_req: jnp.ndarray,   # (G, P, P) f32
+    drop_rep: jnp.ndarray,   # (G, P, P) f32
+) -> tuple[PaxosState, StepIO]:
+    """K fused `paxos_step` rounds in ONE device dispatch (lax.scan over
+    the per-step keys): bit-identical to K sequential calls under the same
+    key sequence, but the host pays one dispatch + one readback per K
+    steps — the pipelined-clock amortization (ISSUE 1) on the full-io
+    path."""
+
+    def body(st, key):
+        st2, io = paxos_step(st, link, done, key, drop_req, drop_rep)
+        return st2, (io.touched, io.msgs)
+
+    st, (touched_k, msgs_k) = jax.lax.scan(body, state, keys)
+    return st, _merge_scan_io(st, touched_k, msgs_k)
+
+
+@functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+def paxos_multi_step_reliable(
+    state: PaxosState,
+    link: jnp.ndarray,       # (G, P, P) bool
+    done: jnp.ndarray,       # (G, P) i32
+    nsteps: int,
+) -> tuple[PaxosState, StepIO]:
+    """`paxos_multi_step` on the lossless fast path: no keys, no Bernoulli
+    draws, `nsteps` fused rounds per dispatch."""
+
+    def body(st, _):
+        st2, io = paxos_step_reliable(st, link, done)
+        return st2, (io.touched, io.msgs)
+
+    st, (touched_k, msgs_k) = jax.lax.scan(body, state, None, length=nsteps)
+    return st, _merge_scan_io(st, touched_k, msgs_k)
+
+
 def _paxos_round(state, done, eye, Mreq1, Mreq2, Mreq3, Mrep1, Mrep2, hb):
     """One prepare→accept→decide round given materialized delivery masks
     (Mreq*/Mrep* are (G, I, P, P); hb is the (G, P, P) heartbeat mask)."""

@@ -444,7 +444,12 @@ def _lane_round(l: LaneState, packed_mask, interpret,
     if mode is None:
         mode = "packed" if packed_mask is not None else "reliable"
     if mode == "prng" and interpret is True:
-        interpret = pltpu.InterpretParams()
+        ip = getattr(pltpu, "InterpretParams", None)
+        if ip is None:  # jax < 0.5: no TPU-interpreter PRNG emulation
+            raise NotImplementedError(
+                "mode='prng' off-TPU needs pallas TPU InterpretParams "
+                "(newer jax); use mode='packed' on CPU")
+        interpret = ip()
 
     cell = pl.BlockSpec((P, C), lambda i: (0, i))
     edge_spec = pl.BlockSpec((P, P, C), lambda i: (0, 0, i))

@@ -17,7 +17,6 @@ import shutil
 import uuid
 
 from tpu6824.rpc import DelayProxy, Proxy, Server, connect
-from tpu6824.rpc.transport import link_alias, unlink_alias
 
 
 def make_sockdir(tag: str = "") -> str:
@@ -88,29 +87,32 @@ class Deployment:
 
     def interpose_delay(self, name: str, delay: float = 0.0) -> DelayProxy:
         """Swap a DelayProxy in front of a live service, transparently to
-        dialers: the public path now reaches the proxy, which forwards to
-        the real socket via a hidden alias (the socket-rename trick,
-        `pbservice/test_test.go:897-954`)."""
+        dialers: the real socket is RENAMED aside (a bound Unix socket
+        stays connectable through its renamed path — the socket-rename
+        trick, `pbservice/test_test.go:897-954`) and the proxy binds the
+        public path itself.  rename, unlike the alias approach this
+        replaced, works on filesystems that refuse hard links to sockets —
+        where `link_alias`'s symlink fallback would have re-resolved the
+        proxy's backend path to the re-pointed public path, i.e. the proxy
+        dialing itself in an infinite accept→dial loop."""
         if name in self._proxies:
             raise RuntimeError(f"{name} already has a delay proxy")
         public = self.addr(name)
         hidden = public + ".real"
-        link_alias(public, hidden)  # keep the server dialable for the proxy
-        proxy = DelayProxy(public + ".proxy", hidden, delay).start()
-        link_alias(proxy.addr, public)  # dialers now reach the proxy
+        os.rename(public, hidden)  # server now dialable at hidden only
+        proxy = DelayProxy(public, hidden, delay).start()
         self._proxies[name] = proxy
         return proxy
 
     def remove_delay(self, name: str) -> None:
-        """Undo interpose_delay: point the public path back at the server."""
+        """Undo interpose_delay: the public path is the server's again."""
         proxy = self._proxies.pop(name, None)
         if proxy is None:
             raise RuntimeError(f"{name} has no delay proxy")
         public = self.addr(name)
         hidden = public + ".real"
-        link_alias(hidden, public)
-        unlink_alias(hidden)
-        proxy.kill()
+        proxy.kill()  # unlinks the public path it bound
+        os.rename(hidden, public)
 
     def shutdown(self) -> None:
         for proxy in self._proxies.values():

@@ -12,11 +12,14 @@
 //
 // Framing matches tpu6824/rpc/transport.py: 4-byte big-endian length prefix,
 // opaque payload (the codec lives above).  Semantics mirrored from the
-// Python Server: rpc_count increments per accepted connection (including
-// dropped ones), one request served per connection (dial-per-call), the
-// reply-discard path executes the handler then SHUT_WR so the client sees
-// a dead connection after the op ran — the executed-but-unacked case the
-// at-most-once machinery upstairs is tested against.
+// Python Server: connections are PERSISTENT (the pooled client default —
+// many requests per connection; a dial-per-call client simply sends one),
+// rpc_count increments per served request, and the fault coins are drawn
+// per REQUEST with every injected fault tearing the connection down: the
+// request-drop path discards the frame unprocessed, the reply-discard path
+// executes the handler then SHUT_WR so the client sees a dead connection
+// after the op ran — the executed-but-unacked case the at-most-once
+// machinery upstairs is tested against.
 //
 // C ABI only; loaded via ctypes (no pybind11 in this image).
 
@@ -58,10 +61,10 @@ using Callback = void (*)(uint64_t conn_id, const uint8_t* data,
 
 struct Conn {
   int fd = -1;
-  bool discard_reply = false;
-  bool handed_off = false;   // one request per connection
+  bool discard_reply = false;  // fault drawn for the CURRENT request
+  bool handed_off = false;     // one request in flight per connection
   bool want_write = false;
-  int64_t deadline_ms = 0;   // absolute steady-clock ms; 30s per conn
+  int64_t deadline_ms = 0;   // absolute steady-clock ms; 30s per I/O phase
   std::vector<uint8_t> rbuf;
   std::vector<uint8_t> wbuf;
   size_t woff = 0;
@@ -119,23 +122,48 @@ void handle_accept(Server* s) {
   for (;;) {
     int fd = accept4(s->lfd, nullptr, nullptr, SOCK_NONBLOCK);
     if (fd < 0) return;
-    s->rpc_count.fetch_add(1, std::memory_order_relaxed);
-    bool unrel = s->unreliable.load(std::memory_order_relaxed);
-    double r1 = next_unit(s->rng), r2 = next_unit(s->rng);
-    if (unrel && r1 < kReqDrop) {  // discard unprocessed: op NOT executed
-      close(fd);
-      continue;
-    }
     uint64_t id = s->next_id++;
     Conn& c = s->conns[id];
     c.fd = fd;
-    c.discard_reply = unrel && r2 < kRepDrop;
     c.deadline_ms = now_ms() + kConnTimeoutMs;
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.u64 = id;
     epoll_ctl(s->epfd, EPOLL_CTL_ADD, fd, &ev);
   }
+}
+
+// Hand the next buffered complete frame (if any) to the callback.  Called
+// from handle_read and after a reply flush (the client may have sent its
+// next pooled request while the previous one was being served).  Per-REQUEST
+// fault injection and rpc counting live here: a request-drop closes the
+// connection with the frame unprocessed — for a pooled client that is a
+// torn connection + redial, the reference's per-connection economics.
+// Returns false when the connection was closed.
+bool try_dispatch(Server* s, uint64_t id, Conn& c) {
+  if (c.handed_off || c.rbuf.size() < 4) return true;
+  size_t len = (size_t(c.rbuf[0]) << 24) | (size_t(c.rbuf[1]) << 16) |
+               (size_t(c.rbuf[2]) << 8) | size_t(c.rbuf[3]);
+  if (len > kMaxFrame) {
+    close_conn(s, id);
+    return false;
+  }
+  if (c.rbuf.size() < 4 + len) return true;
+  s->rpc_count.fetch_add(1, std::memory_order_relaxed);
+  bool unrel = s->unreliable.load(std::memory_order_relaxed);
+  double r1 = next_unit(s->rng), r2 = next_unit(s->rng);
+  if (unrel && r1 < kReqDrop) {  // discard unprocessed: op NOT executed
+    close_conn(s, id);
+    return false;
+  }
+  c.discard_reply = unrel && r2 < kRepDrop;
+  c.handed_off = true;  // one request in flight per connection
+  c.deadline_ms = now_ms() + kConnTimeoutMs;
+  epoll_mod(s, id, c);
+  s->cb(id, c.rbuf.data() + 4, int64_t(len));
+  // The callback copies the payload synchronously; drop the consumed frame.
+  c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + 4 + len);
+  return true;
 }
 
 void handle_read(Server* s, uint64_t id) {
@@ -158,21 +186,9 @@ void handle_read(Server* s, uint64_t id) {
     eof = true;  // a buffered complete frame is still served (the client
     break;       // may legally send-then-SHUT_WR and wait for the reply)
   }
-  if (!c.handed_off && c.rbuf.size() >= 4) {
-    size_t len = (size_t(c.rbuf[0]) << 24) | (size_t(c.rbuf[1]) << 16) |
-                 (size_t(c.rbuf[2]) << 8) | size_t(c.rbuf[3]);
-    if (len > kMaxFrame) {
-      close_conn(s, id);
-      return;
-    }
-    if (c.rbuf.size() >= 4 + len) {
-      c.handed_off = true;  // one request per connection (dial-per-call)
-      epoll_mod(s, id, c);
-      s->cb(id, c.rbuf.data() + 4, int64_t(len));
-      return;
-    }
-  }
-  if (eof) close_conn(s, id);  // hung up before a full frame
+  if (!try_dispatch(s, id, c)) return;
+  if (eof && !c.handed_off && !c.want_write)
+    close_conn(s, id);  // hung up with nothing in flight
 }
 
 void handle_write(Server* s, uint64_t id) {
@@ -189,7 +205,17 @@ void handle_write(Server* s, uint64_t id) {
     close_conn(s, id);
     return;
   }
-  close_conn(s, id);  // reply fully written → connection done
+  // Reply fully written → reset for the next pooled request on this
+  // connection (a dial-per-call client just hangs up instead; the read
+  // side then sees EOF and closes).
+  c.wbuf.clear();
+  c.woff = 0;
+  c.want_write = false;
+  c.handed_off = false;
+  c.discard_reply = false;
+  c.deadline_ms = now_ms() + kConnTimeoutMs;
+  epoll_mod(s, id, c);
+  try_dispatch(s, id, c);  // next request may already be buffered
 }
 
 void drain_replies(Server* s) {

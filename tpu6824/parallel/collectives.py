@@ -19,7 +19,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # the version-compat fallback mesh.py also carries
+    from jax.experimental.shard_map import shard_map
 
 
 def quorum_counts(votes: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:

@@ -152,7 +152,12 @@ class KVPaxosServer:
             return self._drain_bulk_scalar_locked(status_many)
         base0 = self.applied + 1
         while True:
-            vals, nxt, forgotten = drain(self.applied + 1, 256)
+            # 1024-wide drain: with the pipelined clock a single dispatch
+            # can decide several waves' worth of seqs (K micro-steps per
+            # retire), and the vectorized fabric pass costs the same lock
+            # acquisition either way — don't make the driver loop to keep
+            # up with it.
+            vals, nxt, forgotten = drain(self.applied + 1, 1024)
             if forgotten:
                 # Everything below Min() is gone everywhere; our dup
                 # filter refreshes from the ops we can still see.
@@ -245,10 +250,21 @@ class KVPaxosServer:
             lambda seqs: [px.status(s) for s in seqs])
         wait_progress = getattr(px, "wait_progress", None)
         busy = False
+        # Idle-adaptive catch-up tick: 20ms while anything is moving, then
+        # backed off geometrically to 120ms on a quiet replica.  A passive
+        # replica's tick exists only to apply already-decided entries and
+        # advance Done(); at clerk-bench shape (hundreds of replicas on one
+        # host) a fixed 20ms tick costs thousands of wakeups/sec of pure
+        # GIL+fabric-lock churn that starves the clock thread the pipeline
+        # is trying to keep busy.  Any submitted op (_wake) snaps the tick
+        # back instantly, so op latency never pays the backoff.
+        idle_wait = 0.02
         while True:
             if not busy:
-                # Idle: 20ms catch-up tick (the passive-replica drain).
-                self._wake.wait(0.02)
+                if self._wake.wait(idle_wait):
+                    idle_wait = 0.02
+                else:
+                    idle_wait = min(idle_wait * 2, 0.12)
             try:
                 with self.mu:
                     if self.dead:
@@ -257,6 +273,8 @@ class KVPaxosServer:
                     self._drain_bulk_locked(status_many)
                     props = self._collect_proposals_locked()
                     busy = bool(props or self._inflight or self._subq)
+                    if busy or getattr(self, "_last_drain", 0):
+                        idle_wait = 0.02
                 if props:
                     try:
                         if start_many is not None:
@@ -283,14 +301,19 @@ class KVPaxosServer:
                         raise
                 if busy:
                     # Ops outstanding: pace on consensus progress (one
-                    # fabric clock step), then drain again immediately —
-                    # no idle tick in the decide→resolve path.  A paused
-                    # or stopped clock makes wait_progress return
-                    # instantly; floor the pace so that can't become a
-                    # GIL-starving spin loop.
+                    # fabric clock retire), then drain again immediately —
+                    # no idle tick in the decide→resolve path.  The wait
+                    # returns at the FIRST retire notify, so the long
+                    # timeout adds no latency when the clock is moving —
+                    # it only stops N busy drivers from re-taking the
+                    # fabric lock at 20Hz each to harvest nothing while a
+                    # loaded clock (hundreds of replicas, one core) is
+                    # still mid-dispatch.  A paused or stopped clock makes
+                    # wait_progress return instantly; floor the pace so
+                    # that can't become a GIL-starving spin loop.
                     t0 = time.monotonic()
                     if wait_progress is not None:
-                        wait_progress(0.05)
+                        wait_progress(0.25)
                     if time.monotonic() - t0 < 0.001:
                         time.sleep(0.002)
             except RPCError:
@@ -474,14 +497,84 @@ class PipelinedClerk:
                 ok = fut.wait(max(0.0, deadline - time.monotonic()))
                 ok = ok and fut.value is not _DEAD
             if not ok:
-                # Give up on this server's fast path for the op (stops
-                # its driver re-proposing on our behalf), then fall back
-                # to the reference clerk's blocking loop.
+                self._fail_over(srv, op)
+
+    def append_stream(self, key: str, values_per_client,
+                      on_done=None) -> None:
+        """Barrier-free form of append_wave, built to ride the pipelined
+        fabric clock: logical client c appends `values_per_client[c]` in
+        order, and each client's NEXT op is submitted the moment its
+        previous one resolves — no cross-client wave barrier, so one
+        straggler (an op that just missed a dispatch and waits a whole
+        pipeline turn) no longer stalls the other width-1 clients'
+        submissions.  Resolved clients are re-submitted in one
+        `submit_batch`, which the group-commit driver proposes as one
+        consecutive seq block.  The per-client sequential invariant
+        (checkAppends' per-client order) holds exactly as in append_wave;
+        failure semantics per op match append_wave's (abandon + blocking
+        retry on the other replicas).  `on_done(n)` is called as ops
+        complete (throughput accounting at op granularity — a long stream
+        resolves incrementally, not as one lump at return)."""
+        assert len(values_per_client) <= self.width
+        srv = self.servers[self._leader % len(self.servers)]
+        queues = [list(vs) for vs in values_per_client]
+        heads = [0] * len(queues)
+        pend: dict[int, tuple[Op, _Fut | None, float]] = {}
+        while True:
+            ops, cs = [], []
+            for c, q in enumerate(queues):
+                if heads[c] < len(q) and c not in pend:
+                    cid, cseq = self.clients[c]
+                    cseq += 1
+                    self.clients[c][1] = cseq
+                    ops.append(Op("append", key, q[heads[c]], cid, cseq))
+                    heads[c] += 1
+                    cs.append(c)
+            if ops:
                 try:
-                    srv.abandon(op.cid, op.cseq)
+                    futs = srv.submit_batch(ops)
                 except RPCError:
-                    pass
-                self._retry_blocking(op)
+                    futs = [None] * len(ops)
+                dl = time.monotonic() + self.op_timeout
+                for c, op, fut in zip(cs, ops, futs):
+                    pend[c] = (op, fut, dl)
+            if not pend:
+                return
+            # Park on the oldest outstanding future, then sweep them all:
+            # group commit resolves whole blocks per clock retire, so one
+            # wait usually frees a batch of clients at once (set() wakes
+            # this immediately — the 0.2s cap only bounds the timeout
+            # housekeeping pass, it is not added latency).
+            _, fut0, dl0 = next(iter(pend.values()))
+            if fut0 is not None:
+                fut0.wait(min(0.2, max(0.0, dl0 - time.monotonic())))
+            else:
+                time.sleep(0.001)
+            now = time.monotonic()
+            resolved = 0
+            for c in list(pend):
+                op, fut, dl = pend[c]
+                if fut is not None and fut.ev.is_set():
+                    del pend[c]
+                    if fut.value is _DEAD:
+                        self._fail_over(srv, op)
+                    else:
+                        resolved += 1  # fast-path completion only
+                elif fut is None or now >= dl:
+                    del pend[c]
+                    self._fail_over(srv, op)
+            if resolved and on_done is not None:
+                on_done(resolved)
+
+    def _fail_over(self, srv, op: Op) -> None:
+        """Give up on this server's fast path for the op (stops its driver
+        re-proposing on our behalf), then fall back to the reference
+        clerk's blocking loop."""
+        try:
+            srv.abandon(op.cid, op.cseq)
+        except RPCError:
+            pass
+        self._retry_blocking(op)
 
     def _retry_blocking(self, op: Op) -> None:
         """The reference clerk's retry loop, for ops whose fast path

@@ -77,31 +77,46 @@ class GobRpcServer(transport.Server):
         self._methods[name] = (fn, args_schema, reply_schema)
         return self
 
-    # transport.Server's accept loop calls this per connection.
-    def _serve_conn(self, conn: socket.socket, discard_reply: bool) -> None:
+    # transport.Server's accept loop calls this per connection; the fault
+    # coins are drawn per REQUEST (the accept-loop semantics at request
+    # granularity, matching transport.Server since pooled connections
+    # became the default), and every injected fault tears the connection
+    # down so pooled and dial-per-call clients pay the same redial.
+    def _serve_conn(self, conn: socket.socket) -> None:
         try:
             conn.settimeout(30.0)
             dec = gob.Decoder(_sock_read(conn))
             enc = gob.Encoder(conn.sendall, self.registry)
-            while True:
+            while not self._dead.is_set():
                 try:
                     _, req = dec.next()
                 except (EOFError, OSError):
                     return
                 req = gob.complete(REQUEST, req)
+                with self._lock:
+                    self.rpc_count += 1
+                    unrel = self._unreliable
+                    r1 = self._rng.random()
+                    r2 = self._rng.random()
+                drop_req = unrel and r1 < transport.REQ_DROP
+                discard_reply = unrel and r2 < transport.REP_DROP
                 method = req["ServiceMethod"]
                 entry = self._methods.get(method)
                 if entry is None:
                     dec.next()  # consume and discard the args body
+                    if drop_req:
+                        return  # discarded unprocessed (op NOT executed)
                     self._respond(enc, method, req["Seq"],
                                   f"rpc: can't find method {method}",
                                   INVALID, {}, conn, discard_reply)
                     if discard_reply:
-                        return  # one deaf reply per unreliable connection
+                        return  # deaf reply tears the connection down
                     continue
                 fn, args_schema, reply_schema = entry
                 _, args = dec.next()
                 args = gob.complete(args_schema, args)
+                if drop_req:
+                    return  # discarded unprocessed (op NOT executed)
                 try:
                     reply = fn(args)
                     err = ""
@@ -110,10 +125,12 @@ class GobRpcServer(transport.Server):
                 self._respond(enc, method, req["Seq"], err,
                               reply_schema, reply, conn, discard_reply)
                 if discard_reply:
-                    return  # one deaf reply per unreliable connection
+                    return  # deaf reply tears the connection down
         except (gob.GobError, RPCError, OSError, EOFError, RecursionError):
             pass
         finally:
+            with self._lock:
+                self._live.discard(conn)
             conn.close()
 
     @staticmethod
