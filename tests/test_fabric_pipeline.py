@@ -271,6 +271,216 @@ def test_windowfull_resumable_mid_pipeline():
     assert ei2.value.index is not None  # still a resumable batch contract
 
 
+# ------------------------------------------------- decided-delta feed
+# (ISSUE 2 tentpole): the fabric computes each retire's newly-decided
+# (seq, value) delta once per group and fans it out to per-(g, p)
+# subscriber queues.  Contracts pinned here:
+#   - EXACTLY-ONCE: a (g, p, seq) tenancy is delivered at most once, under
+#     GC slot recycling, partition/unreliable churn, kill/revive
+#     mid-batch, pipelined dispatches, and summary-overflow resyncs.
+#   - BIT-EQUIVALENCE with drain_decided: the feed's reassembled
+#     contiguous prefix per peer equals what the drain scan returns, and
+#     every delivery agrees with Status() for live cells.
+#   - DECODE-ONCE: interned payloads hit the intern store once per
+#     (group, seq), not once per replica (intern.gets counters).
+
+
+def _run_feed_equivalence(io_mode, kernel=None, rounds=40, seed=17,
+                          G=3, P=3, I=16, spd=1, depth=1, summary_k=None):
+    fab = PaxosFabric(ngroups=G, npeers=P, ninstances=I, seed=seed,
+                      io_mode=io_mode, kernel=kernel,
+                      steps_per_dispatch=spd, pipeline_depth=depth,
+                      summary_k=summary_k)
+    subs = {(g, p): fab.subscribe_decided(g, p)
+            for g in range(G) for p in range(P)}
+    seen = {k: {} for k in subs}   # (g, p) -> {seq: value}, via feed only
+    mark = {k: 0 for k in subs}    # drain_decided comparison watermark
+    rng = random.Random(seed)
+    next_seq = [0] * G
+    applied = [0] * G
+
+    def harvest():
+        for key, sub in subs.items():
+            for seq, val in sub.pop():
+                assert seq not in seen[key], (key, seq, "duplicate delivery")
+                seen[key][seq] = val
+
+    def check():
+        harvest()
+        # Contiguous-prefix bit-equivalence: the run an RSM would apply
+        # from the feed equals drain_decided's, value for value.
+        for (g, p), got in seen.items():
+            vals, nxt, forgotten = fab.drain_decided(g, p, mark[g, p], I + 8)
+            if forgotten:
+                mark[g, p] = fab.peer_min(g, p)
+                continue
+            for off, v in enumerate(vals):
+                seq = mark[g, p] + off
+                assert got.get(seq, "<missing>") == v, (g, p, seq, v)
+            mark[g, p] = nxt
+        # Completeness + agreement on every live decided mirror cell
+        # (deliveries happen under the same lock as the mirror update, so
+        # a decided cell without a delivery is a dropped delta).
+        with fab._lock:
+            ss = fab._slot_seq.copy()
+            dec = fab.m_decided.copy()
+        for g in range(G):
+            for slot in range(I):
+                seq = int(ss[g, slot])
+                if seq < 0:
+                    continue
+                for p in range(P):
+                    if dec[g, slot, p] >= 0:
+                        assert seq in seen[g, p], (g, p, seq, "undelivered")
+
+    for r in range(rounds):
+        action = rng.random()
+        if action < 0.55:
+            g = rng.randrange(G)
+            for _ in range(rng.randrange(1, 5)):
+                if next_seq[g] - applied[g] >= I - 4:
+                    break
+                seq = next_seq[g]
+                val = rng.choice([seq, f"v{g}.{seq}"])
+                try:
+                    fab.start(g, rng.randrange(P), seq, val)
+                except WindowFullError:
+                    break
+                next_seq[g] += 1
+        elif action < 0.72:
+            # Done() advance → window GC → slot recycling under the feed.
+            g = rng.randrange(G)
+            while applied[g] < next_seq[g]:
+                if fab.status(g, 0, applied[g])[0] != Fate.DECIDED:
+                    break
+                applied[g] += 1
+            if applied[g] > 0:
+                fab.done_many([(g, p, applied[g] - 1) for p in range(P)])
+        elif action < 0.80:
+            g = rng.randrange(G)
+            two = rng.sample(range(P), 2)
+            fab.partition(g, two, [p for p in range(P) if p not in two])
+        elif action < 0.86:
+            fab.heal()
+        elif action < 0.92:
+            fab.set_unreliable(rng.random() < 0.5)
+        else:
+            g, p = rng.randrange(G), rng.randrange(P)
+            (fab.revive if fab.is_dead(g, p) else fab.kill)(g, p)
+        if depth > 1:
+            fab.step_async()  # faults land while dispatches are in flight
+        else:
+            fab.step()
+        check()
+    fab.flush()
+    fab.heal()
+    fab.set_unreliable(False)
+    fab.step(6)
+    check()
+    assert sum(len(v) for v in seen.values()) > 0, "nothing decided — vacuous"
+
+
+def test_feed_equivalence_churn_compact():
+    _run_feed_equivalence("compact")
+
+
+def test_feed_equivalence_churn_full():
+    _run_feed_equivalence("full", rounds=30, seed=9)
+
+
+def test_feed_equivalence_pipelined_overflow_resync():
+    """summary_k=4 forces compaction-overflow resyncs while depth-2
+    dispatches are in flight: the resync's mirror diff and the stale-epoch
+    fresh-transition filter must keep the feed exactly-once."""
+    _run_feed_equivalence("compact", summary_k=4, spd=2, depth=2,
+                          rounds=40, seed=3)
+
+
+def test_feed_equivalence_churn_pallas():
+    """Same contract on the Pallas engine (interpret mode on CPU)."""
+    _run_feed_equivalence("compact", kernel="pallas", rounds=8, seed=5,
+                          G=2, I=8)
+
+
+def test_feed_decodes_once_per_group_not_per_replica():
+    """The acceptance counter: N interned values decided in a group with P
+    subscribed replicas cost exactly N intern decodes — the feed decodes
+    at fan-out, not per consumer (and a late subscriber's seed reuses the
+    cache, costing zero more)."""
+    for io in ("full", "compact"):
+        fab = PaxosFabric(ngroups=2, npeers=3, ninstances=32, io_mode=io)
+        subs = {(g, p): fab.subscribe_decided(g, p)
+                for g in range(2) for p in range(3)}
+        N = 10
+        g0 = fab.intern.gets
+        for g in range(2):
+            fab.start_many([(g, s % 3, s, f"payload-{g}-{s}")
+                            for s in range(N)])
+        fab.step(5)
+        for g in range(2):
+            assert fab.ndecided(g, N - 1) == 3  # reads vids, no decode
+        for (g, p), sub in subs.items():
+            got = sorted(sub.pop())
+            assert [s for s, _ in got] == list(range(N)), (io, g, p)
+            assert [v for _, v in got] == [f"payload-{g}-{s}"
+                                           for s in range(N)], (io, g, p)
+        assert fab.intern.gets - g0 == 2 * N, (
+            io, "decoded once per (group, seq), not per replica")
+        late = fab.subscribe_decided(0, 0)
+        assert sorted(s for s, _ in late.pop()) == list(range(N))
+        assert fab.intern.gets - g0 == 2 * N, (io, "seed must reuse cache")
+
+
+def test_feed_kill_revive_mid_batch():
+    """Kill a peer while a dispatch is in flight, keep deciding, revive:
+    deliveries stay exactly-once and agree with Status everywhere, and the
+    live peers' contiguous prefix matches drain_decided."""
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=32, io_mode="compact",
+                      steps_per_dispatch=2, pipeline_depth=2)
+    subs = {p: fab.subscribe_decided(0, p) for p in range(3)}
+    seen = {p: {} for p in range(3)}
+
+    def harvest():
+        for p, s in subs.items():
+            for seq, val in s.pop():
+                assert seq not in seen[p], (p, seq)
+                seen[p][seq] = val
+
+    fab.start_many([(0, 0, s, s) for s in range(10)])
+    fab.step_async()          # mid-batch: a dispatch is in flight
+    fab.kill(0, 2)
+    fab.step_async()
+    fab.flush()
+    harvest()
+    fab.start_many([(0, 0, s, s) for s in range(10, 20)])
+    fab.step(3)
+    harvest()
+    fab.revive(0, 2)
+    fab.step(6)
+    fab.flush()
+    harvest()
+    for p in range(3):
+        for seq, val in seen[p].items():
+            assert fab.status(0, p, seq) == (Fate.DECIDED, val), (p, seq)
+    vals, nxt, _ = fab.drain_decided(0, 0, 0, 64)
+    assert nxt == 20 and [seen[0][s] for s in range(nxt)] == vals
+    assert len(seen[0]) == 20 and len(seen[1]) == 20
+
+
+def test_feed_unsubscribe_stops_fanout():
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=16, io_mode="compact")
+    sub = fab.subscribe_decided(0, 0)
+    fab.start_many([(0, 0, s, s) for s in range(3)])
+    fab.step(2)
+    assert len(sub.pop()) == 3
+    sub.close()
+    sub.close()  # idempotent
+    fab.start_many([(0, 0, s, s) for s in range(3, 6)])
+    fab.step(2)
+    assert sub.pop() == []
+    assert fab.stats()["feed"]["subscribers"] == 0
+
+
 def test_knobs_flow_through_config(monkeypatch):
     from tpu6824.config import Config
 

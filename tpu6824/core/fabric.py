@@ -39,6 +39,7 @@ from tpu6824.core.intern import Intern
 from tpu6824.core.kernel import (
     NO_VAL, apply_starts, apply_starts_compact, init_state,
 )
+from tpu6824.utils.profiling import PhaseProfiler
 from tpu6824.utils.trace import EventLog, dprintf
 
 # Reference unreliable-network rates: 10% of requests dropped before
@@ -122,6 +123,48 @@ class WindowFullError(RuntimeError):
     def __init__(self, msg: str, index: int | None = None):
         super().__init__(msg)
         self.index = index
+
+
+class DecidedSub:
+    """One (group, peer) subscription to the fabric's decided-delta feed.
+
+    The fabric pushes `(seq, value)` pairs — value already DECODED, once
+    per (group, seq) across all of the group's subscribers — as cells
+    transition undecided → decided in the host mirror.  Replaces the
+    per-replica `drain_decided` re-scan: P replicas of a group used to
+    each run the vectorized mirror pass per driver tick (3× duplicate
+    scan per group); with the feed the fabric computes the delta once at
+    retire and fans it out.
+
+    Deliveries are unordered across seqs (Paxos instances decide
+    independently); consumers reassemble the contiguous run they apply
+    (`services/common.py::DecidedTap`).  `pop()` is lock-free on the
+    consumer side (deque append/popleft are atomic); `wake` (if given) is
+    called after each delivery batch — hook it to the consumer's wakeup
+    event so the apply loop never polls."""
+
+    __slots__ = ("g", "p", "wake", "_q", "_fabric", "delivered")
+
+    def __init__(self, fabric, g: int, p: int, wake=None):
+        self.g, self.p, self.wake = g, p, wake
+        self._q: deque = deque()
+        self._fabric = fabric
+        self.delivered = 0  # lifetime count (tests/stats)
+
+    def pop(self) -> list:
+        """Drain everything delivered so far: list of (seq, value).
+        Deliveries arrive as per-retire BATCHES (one queue entry per
+        retire, columnar (seqs, values) lists) — flattened here, so the
+        fabric's fan-out never builds per-cell tuples under its lock."""
+        q = self._q
+        out = []
+        while q:  # single consumer per sub; producers only append
+            seqs, vals = q.popleft()
+            out.extend(zip(seqs, vals))
+        return out
+
+    def close(self) -> None:
+        self._fabric.unsubscribe_decided(self)
 
 
 class PaxosFabric:
@@ -295,6 +338,22 @@ class PaxosFabric:
 
         self.intern = Intern()
 
+        # Decided-delta feed (the service-stack half of the pipelined
+        # clock): per-(g, p) subscriber lists, the set of groups with any
+        # subscriber (fan-out skip predicate — zero overhead for
+        # bench/kernel fabrics with no services attached), and the
+        # per-group decode-once cache: seq → decoded payload, filled on
+        # the FIRST newly-decided cell of a (g, seq) and evicted by the
+        # window GC — so P replicas consuming the feed cost one intern
+        # decode per decided instance, not one per replica.
+        self._subs: dict[tuple[int, int], list[DecidedSub]] = {}
+        self._sub_groups: set[int] = set()
+        self._feed_vals: list[dict[int, object]] = [dict() for _ in range(G)]
+        # Host-side phase profiler (stage → dispatch → retire → feed;
+        # services add apply/notify through the same object via
+        # PaxosPeer.profiler) — surfaced in stats()["phases"].
+        self.profiler = PhaseProfiler()
+
         self._lock = threading.RLock()
         self._pending_starts: list[tuple[int, int, int, int, int]] = []  # (g, slot, p, vid, seq)
         self._pending_resets: list[tuple[int, int]] = []  # (g, slot)
@@ -451,6 +510,7 @@ class PaxosFabric:
         return s_arr, r_arr, link, done, reliable, keys, drop_req, drop_rep
 
     def _step_once_full(self):
+        t0 = time.perf_counter_ns()
         with self._lock:
             (s_arr, r_arr, link, done, reliable, keys, drop_req,
              drop_rep) = self._drain_and_stage_locked()
@@ -468,6 +528,8 @@ class PaxosFabric:
             state = self._apply_starts(
                 state, jnp.asarray(reset), jnp.asarray(sa), jnp.asarray(sv)
             )
+        self.profiler.add("stage", time.perf_counter_ns() - t0)
+        t0 = time.perf_counter_ns()
 
         # K micro-steps, ONE device_get.  The XLA engine fuses the rounds
         # into a single scan dispatch (kernel.paxos_multi_step*); the
@@ -496,6 +558,8 @@ class PaxosFabric:
                 msgs_acc = (io.msgs if msgs_acc is None
                             else msgs_acc + io.msgs)
         self._state = state
+        self.profiler.add("dispatch", time.perf_counter_ns() - t0)
+        t_r = time.perf_counter_ns()
         decided, done_view, touched, msgs = jax.device_get(
             (io.decided, io.done_view, touched_acc, msgs_acc)
         )
@@ -505,6 +569,20 @@ class PaxosFabric:
             # (GC wipes recycled rows, the done() diagonal stays monotone).
             decided = np.array(decided)
             done_view = np.array(done_view)
+            if self._sub_groups:
+                # Decided-delta feed on the full-refresh path: the delta
+                # is the fresh mirror transitions, by diff against the
+                # outgoing mirror (GC wipes and their device-side resets
+                # complete within one synchronous step, so the diff can
+                # never resurrect a recycled tenant).  Before _gc_locked,
+                # while the slot map still names the fed seqs.
+                trans = (decided >= 0) & (self.m_decided < 0)
+                flat = np.nonzero(trans.reshape(-1))[0]
+                if len(flat):
+                    self.profiler.add("retire",
+                                      time.perf_counter_ns() - t_r, count=0)
+                    self._feed_cells_locked(flat, decided.reshape(-1)[flat])
+                    t_r = time.perf_counter_ns()
             self.m_decided = decided
             self.m_done_view = done_view
             # done() calls that landed while the step was in flight are in
@@ -535,6 +613,7 @@ class PaxosFabric:
                 or self._live_slots * self.P > self._decided_cells)
             self._gc_locked()
             self._stepped.notify_all()
+            self.profiler.add("retire", time.perf_counter_ns() - t_r)
 
     # ------------------------------------------------- compact step path
 
@@ -635,6 +714,7 @@ class PaxosFabric:
         of the pipelined clock)."""
         G, I, P = self.G, self.I, self.P
         nrows, ncells = G * I, G * I * P
+        t0 = time.perf_counter_ns()
         with self._lock:
             (s_arr, r_arr, link, done, reliable, keys, drop_req,
              drop_rep) = self._drain_and_stage_locked()
@@ -693,12 +773,16 @@ class PaxosFabric:
                     self._pad_i32(None if sseqs is None else sseqs[cc:d],
                                   0, bucket))
 
+        last_pads = pads(chunks[-1])
+        self.profiler.add("stage", time.perf_counter_ns() - t0)
+        t0 = time.perf_counter_ns()
         for c in chunks[:-1]:
             state, slot_dev = _apply_compact_jit(state, slot_dev,
                                                  *pads(c, bucket=B))
         out = self._compact_fn(reliable)(
-            state, slot_dev, *pads(chunks[-1]), link, done, sub,
+            state, slot_dev, *last_pads, link, done, sub,
             drop_req, drop_rep)
+        self.profiler.add("dispatch", time.perf_counter_ns() - t0)
         st2, slot_dev = out[0], out[1]
         self._state = st2
         self._slot_seq_dev = slot_dev
@@ -709,8 +793,11 @@ class PaxosFabric:
     def _retire_compact(self, pending):
         """Fetch one dispatch's summary and fold it into the host mirrors
         (the mirror-apply half of the pipeline; the blocking device_get
-        runs outside the lock)."""
+        runs outside the lock).  Newly-decided cells — fresh <0 → >=0
+        mirror transitions only — are fanned out to the decided-delta
+        feed before GC runs, while the slot map still names their seqs."""
         handles, n_inject, epoch = pending
+        t_r = time.perf_counter_ns()
         cnt, idx, vals, iseqs, maxseq, done_view, msgs = jax.device_get(
             handles)
         G, I, P = self.G, self.I, self.P
@@ -718,6 +805,7 @@ class PaxosFabric:
 
         with self._lock:
             cnt = int(cnt)
+            feed_flat = feed_vids = None
             if cnt > self._summary_k:
                 # Compaction overflow (a burst decided more cells than K):
                 # one full fetch, mirrors resync absolutely.  The fetch
@@ -733,6 +821,13 @@ class PaxosFabric:
                     # tenants; the mirror must not resurrect them.
                     r = np.asarray(self._pending_resets, dtype=np.int64)
                     decided[r[:, 0], r[:, 1], :] = NO_VAL
+                if self._sub_groups:
+                    # Feed delta = the mirror transitions this resync
+                    # makes (same rule as the scatter path, computed by
+                    # diff because the summary overflowed).
+                    trans = (decided >= 0) & (self.m_decided < 0)
+                    feed_flat = np.nonzero(trans.reshape(-1))[0]
+                    feed_vids = decided.reshape(-1)[feed_flat]
                 self.m_decided = decided
                 ndec = int((decided >= 0).sum())
                 newly = ndec - self._decided_cells
@@ -753,9 +848,19 @@ class PaxosFabric:
                     live = (self._slot_seq.reshape(-1)[pidx_v // P]
                             == iseqs[valid])
                     pidx_v = pidx_v[live] if not live.all() else pidx_v
+                    vals_v = vals[valid][live]
+                    if self._sub_groups:
+                        # A retire launched before an overflow resync may
+                        # re-report cells the resync already mirrored (and
+                        # fed) — the fresh-transition filter keeps the
+                        # feed exactly-once per tenancy.
+                        prev = self.m_decided.reshape(-1)[pidx_v]
+                        fresh = prev < 0
+                        feed_flat = pidx_v[fresh]
+                        feed_vids = vals_v[fresh]
                     # np.put: flat scatter that cannot silently land in a
                     # reshape copy if the mirror ever goes non-contiguous.
-                    np.put(self.m_decided, pidx_v, vals[valid][live])
+                    np.put(self.m_decided, pidx_v, vals_v)
                     applied = len(pidx_v)
                 if epoch < self._resync_epoch:
                     # Launched before an overflow resync: the absolute
@@ -766,6 +871,14 @@ class PaxosFabric:
                 else:
                     newly = applied
                     self._decided_cells += applied
+            if feed_flat is not None and len(feed_flat):
+                # Before _gc_locked: the fed seqs must still be in the
+                # slot map.  The feed self-times; split the retire timer
+                # around it so phases don't double-count.
+                self.profiler.add("retire", time.perf_counter_ns() - t_r,
+                                  count=0)
+                self._feed_cells_locked(feed_flat, feed_vids)
+                t_r = time.perf_counter_ns()
             done_view = np.array(done_view)
             self.m_done_view = done_view
             pidx = np.arange(P)
@@ -786,6 +899,7 @@ class PaxosFabric:
                 or self._live_slots * P > self._decided_cells)
             self._gc_locked()
             self._stepped.notify_all()
+            self.profiler.add("retire", time.perf_counter_ns() - t_r)
 
     def _step_once_compact(self):
         self._retire_compact(self._launch_compact())
@@ -850,6 +964,9 @@ class PaxosFabric:
         for g, slot, seq in zip(gs.tolist(), slots.tolist(), seqs.tolist()):
             del self._seq2slot[g][seq]
             heapq.heappush(self._free[g], slot)
+            fv = self._feed_vals[g]
+            if fv:
+                fv.pop(seq, None)  # decode cache lives per tenancy
             vids = self._slot_vids[g][slot]
             if vids:
                 for vid in vids:
@@ -1060,6 +1177,132 @@ class PaxosFabric:
             out = [vid - IMM_BASE if vid >= IMM_BASE else get(vid)
                    for vid in vids[:k].tolist()]
             return out, lo + k, False
+
+    # ------------------------------------------------- decided-delta feed
+
+    def subscribe_decided(self, g: int, p: int, wake=None) -> DecidedSub:
+        """Subscribe to peer p of group g's decided deltas.
+
+        The returned sub's queue is SEEDED with everything this peer has
+        already decided (mirror state at subscription time), so feed
+        consumption is complete from any subscription point — a server
+        booted onto a warm or checkpoint-restored fabric catches up from
+        the seed, then rides the deltas.  Values are decoded through the
+        group's decode-once cache either way."""
+        sub = DecidedSub(self, g, p, wake=wake)
+        with self._lock:
+            self._subs.setdefault((g, p), []).append(sub)
+            self._sub_groups.add(g)
+            ss = self._slot_seq[g]
+            live = (ss >= 0) & (self.m_decided[g, :, p] >= 0)
+            if live.any():
+                slots = np.nonzero(live)[0]
+                seqs = ss[slots]
+                order = np.argsort(seqs)
+                vids = self.m_decided[g, slots[order], p]
+                sq = seqs[order].tolist()
+                decode = self._feed_decode_locked
+                sub._q.append(
+                    (sq, [decode(g, s, int(v))
+                          for s, v in zip(sq, vids.tolist())]))
+                sub.delivered += len(slots)
+        return sub
+
+    def unsubscribe_decided(self, sub: DecidedSub) -> None:
+        with self._lock:
+            lst = self._subs.get((sub.g, sub.p))
+            if lst is not None:
+                try:
+                    lst.remove(sub)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self._subs[sub.g, sub.p]
+            if not any(g == sub.g for g, _ in self._subs):
+                self._sub_groups.discard(sub.g)
+
+    def _feed_decode_locked(self, g: int, seq: int, vid: int):
+        """vid → payload through the per-group decode-once cache.
+        Immediate-tagged ids carry their own payload (no store, nothing to
+        cache); interned ids hit `intern.get` exactly once per (g, seq)
+        tenancy — the cache entry lives until the window GC forgets the
+        seq, so stragglers (a deafened peer deciding retires later) reuse
+        the decode instead of repeating it."""
+        if vid >= IMM_BASE:
+            return vid - IMM_BASE
+        cache = self._feed_vals[g]
+        val = cache.get(seq, cache)  # sentinel: cached None is a value
+        if val is cache:
+            val = self.intern.get(vid)
+            cache[seq] = val
+        return val
+
+    def _feed_cells_locked(self, flat_cells, vids) -> None:
+        """Fan newly-decided cells (flat (G·I·P) indices + their value
+        ids) out to subscriber queues.  Caller guarantees every cell is a
+        FRESH mirror transition (<0 → >=0), so a (g, p, seq) is delivered
+        at most once per tenancy; seqs come from the host slot map, which
+        the tenancy filter has already validated.
+
+        COLUMNAR on purpose: cells are grouped per (g, p) with one stable
+        sort, values decoded per run (cache makes it once per (g, seq)),
+        and each subscriber receives ONE (seqs, values) batch per retire.
+        The first cut did a per-cell Python loop with per-cell queue
+        appends and spent ~160ms per retire under the fabric lock at
+        clerk-bench shape (48 groups × 64-wide waves ≈ 9k cells/retire),
+        stalling every start_many/status_many caller behind it."""
+        if not self._sub_groups or not len(flat_cells):
+            return
+        t0 = time.perf_counter_ns()
+        G, I, P = self.G, self.I, self.P
+        gs = flat_cells // (I * P)
+        if len(self._sub_groups) < G:
+            keep = np.isin(gs, np.fromiter(self._sub_groups, np.int64,
+                                           len(self._sub_groups)))
+            if not keep.all():
+                flat_cells = flat_cells[keep]
+                vids = vids[keep]
+                gs = gs[keep]
+        rem = flat_cells - gs * (I * P)
+        slots = rem // P
+        ps = rem - slots * P
+        seqs = self._slot_seq[gs, slots]
+        ok = seqs >= 0
+        if not ok.all():
+            gs, ps, seqs, vids = gs[ok], ps[ok], seqs[ok], vids[ok]
+        if not len(gs):
+            self.profiler.add("feed", time.perf_counter_ns() - t0)
+            return
+        key = gs * P + ps
+        order = np.argsort(key, kind="stable")
+        key_o = key[order]
+        seqs_o = seqs[order]
+        vids_o = vids[order]
+        bounds = np.flatnonzero(np.diff(key_o)) + 1
+        starts = np.concatenate(([0], bounds)).tolist()
+        ends = np.concatenate((bounds, [len(key_o)])).tolist()
+        subs = self._subs
+        decode = self._feed_decode_locked
+        woken: list[DecidedSub] = []
+        n = 0
+        for a, b in zip(starts, ends):
+            g, p = divmod(int(key_o[a]), P)
+            lst = subs.get((g, p))
+            if not lst:
+                continue  # decode lazily: only cells a subscriber consumes
+            sq = seqs_o[a:b].tolist()
+            vals = [decode(g, s, v) for s, v in zip(sq, vids_o[a:b].tolist())]
+            for sub in lst:
+                sub._q.append((sq, vals))
+                sub.delivered += b - a
+                n += b - a
+                if sub.wake is not None:
+                    woken.append(sub)  # one run per (g, p): no dups
+        if n:
+            self.events.bump("feed_delivered", n)
+        for sub in woken:
+            sub.wake()
+        self.profiler.add("feed", time.perf_counter_ns() - t0)
 
     def done_many(self, items) -> None:
         """Batched Done: `items` iterates (g, p, seq) — one vectorized
@@ -1381,7 +1624,9 @@ class PaxosFabric:
 
     def stats(self) -> dict:
         """Live counters: steps, remote messages, decided cells, and their
-        per-second rates — the decided/sec counter SURVEY §5 asks for."""
+        per-second rates — the decided/sec counter SURVEY §5 asks for —
+        plus the host-side phase breakdown (stage/dispatch/retire/feed and,
+        when services drive this fabric, their apply/notify legs)."""
         counters = self.events.counters()
         with self._lock:
             out = {
@@ -1391,8 +1636,13 @@ class PaxosFabric:
                 "groups": self.G,
                 "instances": self.I,
                 "peers": self.P,
+                "feed": {
+                    "subscribers": sum(len(v) for v in self._subs.values()),
+                    "delivered": counters.get("feed_delivered", 0),
+                },
             }
         out["rates"] = self.events.rates()
+        out["phases"] = PhaseProfiler.breakdown(self.profiler.snapshot())
         return out
 
     def ndecided(self, g: int, seq: int) -> int:

@@ -31,6 +31,11 @@ class PyIntern:
         self._keys: list = []
         self._refs: list[int] = []
         self._free: list[int] = []
+        # Decode counter: how many times get() resolved an id to a payload.
+        # The decided-delta feed's contract is ONE decode per (group, seq)
+        # regardless of replica count — tests assert it through this (a
+        # plain int; += under the GIL is adequate for test accounting).
+        self.gets = 0
 
     def put(self, value) -> int:
         """Intern `value`, increment its refcount, return its id."""
@@ -53,6 +58,7 @@ class PyIntern:
             return vid
 
     def get(self, vid: int):
+        self.gets += 1
         return self._vals[vid]
 
     def incref(self, vid: int):
@@ -118,6 +124,7 @@ class NativeIntern:
         self._h = lib.intern_new()
         self._mu = threading.Lock()
         self._vals: dict[int, object] = {}  # id → live value mirror
+        self.gets = 0  # decode counter (see PyIntern.gets)
 
     def __del__(self):
         h, self._h = getattr(self, "_h", None), None
@@ -139,6 +146,7 @@ class NativeIntern:
 
     def get(self, vid: int):
         with self._mu:
+            self.gets += 1
             return self._vals[vid]
 
     def incref(self, vid: int):

@@ -54,6 +54,25 @@ class PaxosPeer:
         one vectorized fabric pass (see PaxosFabric.drain_decided)."""
         return self.fabric.drain_decided(self.g, self.me, lo, max_n)
 
+    def subscribe_decided(self, wake=None):
+        """Subscribe this peer to the fabric's decided-delta feed
+        (PaxosFabric.subscribe_decided), or None when the backend has no
+        feed — a `remote_fabric` Proxy synthesizes ANY method name, so
+        feature-detect by type, not getattr (callers fall back to
+        drain_decided on None)."""
+        if not isinstance(self.fabric, PaxosFabric):
+            return None
+        return self.fabric.subscribe_decided(self.g, self.me, wake=wake)
+
+    @property
+    def profiler(self):
+        """The fabric's PhaseProfiler (services record their apply/notify
+        legs into it so stats() shows the whole decided pipeline); None on
+        non-fabric backends — same Proxy caveat as subscribe_decided."""
+        if not isinstance(self.fabric, PaxosFabric):
+            return None
+        return self.fabric.profiler
+
     def wait_progress(self, timeout: float = 0.05) -> None:
         """Block until the fabric clock advances (or timeout) — the batched
         analog of the reference's poll-with-backoff sleep

@@ -29,6 +29,86 @@ def fresh_cid() -> int:
     return _sysrand.getrandbits(62)
 
 
+class DecidedTap:
+    """Reassembles a decided-delta feed (`PaxosFabric.subscribe_decided`)
+    into the contiguous run an RSM applies.
+
+    The feed delivers (seq, value) as cells decide — unordered across
+    seqs, since Paxos instances resolve independently.  The tap buffers
+    out-of-order arrivals and `pop_ready(applied)` returns the values for
+    seqs applied+1, applied+2, ... up to the first gap — exactly the
+    prefix `drain_decided(applied + 1)` would return, without any replica
+    re-scanning the fabric mirrors (the fan-out replaces P duplicate
+    vectorized scans per group per driver tick).
+
+    Single-consumer, no locking of its own: called from the one driver
+    thread that owns `applied`."""
+
+    __slots__ = ("sub", "pending", "_booted", "_gap_at", "_gap_passes")
+
+    # How many consecutive empty drains the SAME gap must block before
+    # should_probe_min re-probes the backend's Min() (see below).
+    GAP_PROBE_PASSES = 8
+
+    def __init__(self, sub):
+        self.sub = sub
+        self.pending: dict[int, object] = {}
+        self._booted = False    # one unconditional boot-time probe
+        self._gap_at = -1       # seq the last empty drain blocked on
+        self._gap_passes = 0
+
+    def pop_ready(self, applied: int) -> list:
+        """Values decided at applied+1..applied+k (contiguous); [] if
+        applied+1 hasn't been delivered yet."""
+        pending = self.pending
+        for seq, val in self.sub.pop():
+            if seq > applied:
+                pending[seq] = val
+        out = []
+        nxt = applied + 1
+        while nxt in pending:
+            out.append(pending.pop(nxt))
+            nxt += 1
+        if out:
+            self._gap_at = -1  # progress: any prior gap is gone
+        return out
+
+    def should_probe_min(self, applied: int) -> bool:
+        """Gate the consumer's FORGOTTEN probe (a Min() call on the
+        consensus backend — a fabric-lock acquisition) after an empty
+        `pop_ready`.  While the subscriber lives, the window GC can never
+        pass its own `applied` (Min waits on its Done), so a gap below
+        Min is only possible when the subscription started on an
+        already-GC'd group (warm boot / checkpoint restore): probe once
+        at boot, then only when the SAME gap has blocked
+        `GAP_PROBE_PASSES` consecutive drains — transient out-of-order
+        decide gaps are the common case, and probing each would
+        re-create the per-pass lock traffic the feed removes."""
+        probe = not self._booted
+        self._booted = True
+        if self.pending:
+            if applied + 1 == self._gap_at:
+                self._gap_passes += 1
+                probe = probe or self._gap_passes >= self.GAP_PROBE_PASSES
+            else:
+                self._gap_at = applied + 1
+                self._gap_passes = 0
+        if probe:
+            self._gap_passes = 0
+        return probe
+
+    def discard_through(self, applied: int) -> None:
+        """Drop buffered entries at or below `applied` (after a FORGOTTEN
+        fast-forward, or when the server applied seqs through another
+        path, e.g. shardkv's _sync walk)."""
+        pending = self.pending
+        for seq in [s for s in pending if s <= applied]:
+            del pending[seq]
+
+    def close(self) -> None:
+        self.sub.close()
+
+
 class FlakyNet:
     """Per-server unreliability switch for the clerk↔server leg."""
 

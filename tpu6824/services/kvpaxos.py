@@ -23,8 +23,9 @@ from typing import NamedTuple
 
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
-from tpu6824.services.common import FlakyNet, fresh_cid
+from tpu6824.services.common import DecidedTap, FlakyNet, fresh_cid
 from tpu6824.utils.errors import OK, ErrNoKey, RPCError
+from tpu6824.utils.profiling import PhaseProfiler
 
 
 class Op(NamedTuple):
@@ -41,16 +42,21 @@ _DEAD = object()  # future sentinel: server killed while ops waited
 
 
 class _Fut:
-    """One submitted op's completion slot (value = the RSM reply)."""
+    """One submitted op's completion slot (value = the RSM reply).
+    `t_set` records the resolve instant so latency accounting reads the
+    real completion time, not the time a sweeping waiter got around to
+    noticing it (the pipelined clerk parks up to 0.2s between sweeps)."""
 
-    __slots__ = ("ev", "value")
+    __slots__ = ("ev", "value", "t_set")
 
     def __init__(self):
         self.ev = threading.Event()
         self.value = None
+        self.t_set = None
 
     def set(self, v):
         self.value = v
+        self.t_set = time.monotonic()
         self.ev.set()
 
     def wait(self, timeout):
@@ -94,6 +100,16 @@ class KVPaxosServer:
         self._inflight: dict[int, Op] = {}  # seq -> my undecided proposal
         self._next_seq = 0               # next seq I would propose at
         self._wake = threading.Event()
+        # Decided-delta feed (fabric backends): the fabric computes each
+        # retire's newly-decided (seq, value) delta ONCE per group and
+        # fans it out, waking this driver — so the P replicas stop
+        # re-scanning the decided mirror via drain_decided (3× duplicate
+        # vectorized scan per group per tick) and stop polling
+        # wait_progress.  Other backends keep the drain/status paths.
+        self._prof = getattr(self.px, "profiler", None) or PhaseProfiler()
+        sub_fn = getattr(self.px, "subscribe_decided", None)
+        sub = sub_fn(wake=self._wake.set) if sub_fn is not None else None
+        self._tap = DecidedTap(sub) if sub is not None else None
         # The driver doubles as the background catch-up ticker: it applies
         # already-decided instances and advances Done() even when no client
         # talks to this replica.  The reference only applies inside RPC
@@ -139,14 +155,95 @@ class KVPaxosServer:
                 and (mine.cid, mine.cseq) in self._waiters):
             self._subq.append(mine)
 
+    def _apply_batch_locked(self, vals) -> list:
+        """Apply one contiguous decided run as a tight batch — the batched
+        doGet/doPutAppend (kvpaxos/server.go:115-162) with the dict
+        lookups hoisted and every per-op branch inline.  Futures are
+        COLLECTED, not resolved: the caller sets them in one notify sweep
+        after the batch, so waiter wakeups never interleave with apply
+        work.  Returns [(fut, reply), ...]."""
+        dup = self.dup
+        kv = self.kv
+        kv_get = kv.get
+        dup_get = dup.get
+        waiters_pop = self._waiters.pop
+        notif = []
+        for v in vals:
+            self.applied += 1
+            if isinstance(v, Op):
+                seen, reply = dup_get(v.cid, (-1, None))
+                if v.cseq > seen:
+                    kind = v.kind
+                    if kind == "get":
+                        reply = ((OK, kv[v.key]) if v.key in kv
+                                 else (ErrNoKey, ""))
+                    elif kind == "put":
+                        kv[v.key] = v.value
+                        reply = (OK, "")
+                    elif kind == "append":
+                        kv[v.key] = kv_get(v.key, "") + v.value
+                        reply = (OK, "")
+                    else:
+                        reply = (OK, "")
+                    dup[v.cid] = (v.cseq, reply)
+                fut = waiters_pop((v.cid, v.cseq), None)
+                if fut is not None:
+                    notif.append((fut, reply))
+            self._pop_lost_inflight_locked(v)
+        return notif
+
+    def _drain_feed_locked(self):
+        """Feed-based drain: pop the tap's contiguous decided run, apply
+        it as one batch, resolve the batch's futures in one notify sweep,
+        Done() once — no fabric-mirror scan, no per-op lock round-trips.
+
+        FORGOTTEN handling: `DecidedTap.should_probe_min` gates the Min()
+        probe (once at boot, then only for a gap that has aged several
+        passes — see its docstring for why transient gaps must not
+        probe); on a forgotten span we fast-forward, dropping the skipped
+        seqs' in-flight proposals."""
+        tap = self._tap
+        prof = self._prof
+        base0 = self.applied + 1
+        notif = []
+        apply_ns = 0
+        while True:
+            run = tap.pop_ready(self.applied)
+            if not run:
+                if tap.should_probe_min(self.applied):
+                    mn = self.px.min()
+                    if mn > self.applied + 1:
+                        while self.applied + 1 < mn:
+                            self.applied += 1
+                            self._inflight.pop(self.applied, None)
+                        tap.discard_through(self.applied)
+                        continue
+                break
+            t0 = time.perf_counter_ns()
+            notif.extend(self._apply_batch_locked(run))
+            apply_ns += time.perf_counter_ns() - t0
+        applied_n = self.applied + 1 - base0
+        if applied_n > 0:
+            prof.add("apply", apply_ns)
+            t0 = time.perf_counter_ns()
+            for fut, reply in notif:
+                fut.set(reply)
+            prof.add("notify", time.perf_counter_ns() - t0)
+        self._last_drain = applied_n
+        if self.applied >= base0:
+            self.px.done(self.applied)
+
     def _drain_bulk_locked(self, status_many):
         """Apply every already-decided instance in order, in bulk.  On the
-        fabric backend the decided prefix comes from ONE vectorized pass
-        per window (`PaxosFabric.drain_decided` — numpy over the slot map
-        and mirrors, no per-seq dict walk); other backends fall back to
-        status_many probes.  One Done() high-water call per drain; my
-        in-flight proposals whose slot another server's op won are
-        re-queued."""
+        fabric backend the decided-delta FEED delivers each retire's new
+        (seq, value) pairs — computed once per group, decoded once, fanned
+        out to every replica (`_drain_feed_locked`).  Backends without the
+        feed get the vectorized `drain_decided` prefix scan; backends
+        without that fall back to status_many probes.  One Done()
+        high-water call per drain; my in-flight proposals whose slot
+        another server's op won are re-queued."""
+        if self._tap is not None:
+            return self._drain_feed_locked()
         drain = getattr(self.px, "drain_decided", None)
         if drain is None:
             return self._drain_bulk_scalar_locked(status_many)
@@ -268,6 +365,8 @@ class KVPaxosServer:
             try:
                 with self.mu:
                     if self.dead:
+                        if self._tap is not None:
+                            self._tap.close()  # idempotent; stops fan-out
                         return
                     self._wake.clear()
                     self._drain_bulk_locked(status_many)
@@ -300,22 +399,33 @@ class KVPaxosServer:
                             self._unpropose_locked(props, 0)
                         raise
                 if busy:
-                    # Ops outstanding: pace on consensus progress (one
-                    # fabric clock retire), then drain again immediately —
-                    # no idle tick in the decide→resolve path.  The wait
-                    # returns at the FIRST retire notify, so the long
-                    # timeout adds no latency when the clock is moving —
-                    # it only stops N busy drivers from re-taking the
-                    # fabric lock at 20Hz each to harvest nothing while a
-                    # loaded clock (hundreds of replicas, one core) is
-                    # still mid-dispatch.  A paused or stopped clock makes
-                    # wait_progress return instantly; floor the pace so
-                    # that can't become a GIL-starving spin loop.
-                    t0 = time.monotonic()
-                    if wait_progress is not None:
-                        wait_progress(0.25)
-                    if time.monotonic() - t0 < 0.001:
-                        time.sleep(0.002)
+                    # Ops outstanding: pace on consensus progress, then
+                    # drain again immediately — no idle tick in the
+                    # decide→resolve path.  With the decided-delta feed
+                    # the fabric WAKES us the moment a retire delivers to
+                    # our tap (and submit_batch wakes us for new ops), so
+                    # the driver parks on its own event — zero fabric-lock
+                    # traffic while a dispatch is in flight, and a fast
+                    # return always means there is work (no spin floor
+                    # needed: the next pass consumes what woke us, and an
+                    # empty tap blocks the next wait).  Feedless backends
+                    # keep the retire-notify wait: it returns at the FIRST
+                    # retire, so the long timeout adds no latency when the
+                    # clock is moving — it only stops N busy drivers from
+                    # re-taking the fabric lock at 20Hz each to harvest
+                    # nothing while a loaded clock (hundreds of replicas,
+                    # one core) is still mid-dispatch.  A paused or
+                    # stopped clock makes wait_progress return instantly;
+                    # floor that pace so it can't become a GIL-starving
+                    # spin loop.
+                    if self._tap is not None:
+                        self._wake.wait(0.25)
+                    else:
+                        t0 = time.monotonic()
+                        if wait_progress is not None:
+                            wait_progress(0.25)
+                        if time.monotonic() - t0 < 0.001:
+                            time.sleep(0.002)
             except RPCError:
                 # Transient backend outage (e.g. a fabricd restarting from
                 # a checkpoint behind a remote_fabric handle): keep the
@@ -395,6 +505,8 @@ class KVPaxosServer:
             for fut in self._waiters.values():
                 fut.set(_DEAD)
             self._waiters.clear()
+            if self._tap is not None:
+                self._tap.close()  # stop the fabric fanning into a corpse
         self._wake.set()
         self.px.kill()
 
@@ -500,7 +612,7 @@ class PipelinedClerk:
                 self._fail_over(srv, op)
 
     def append_stream(self, key: str, values_per_client,
-                      on_done=None) -> None:
+                      on_done=None, lat_sink: list | None = None) -> None:
         """Barrier-free form of append_wave, built to ride the pipelined
         fabric clock: logical client c appends `values_per_client[c]` in
         order, and each client's NEXT op is submitted the moment its
@@ -514,7 +626,10 @@ class PipelinedClerk:
         failure semantics per op match append_wave's (abandon + blocking
         retry on the other replicas).  `on_done(n)` is called as ops
         complete (throughput accounting at op granularity — a long stream
-        resolves incrementally, not as one lump at return)."""
+        resolves incrementally, not as one lump at return).  `lat_sink`
+        (a list) collects per-op submit→resolve latencies in seconds for
+        fast-path completions — the clerk-leg p50/p95/p99 the reference
+        bounds with waitn's poll budget (test_test.go:51-66)."""
         assert len(values_per_client) <= self.width
         srv = self.servers[self._leader % len(self.servers)]
         queues = [list(vs) for vs in values_per_client]
@@ -560,6 +675,16 @@ class PipelinedClerk:
                         self._fail_over(srv, op)
                     else:
                         resolved += 1  # fast-path completion only
+                        if lat_sink is not None:
+                            # submit instant = dl - op_timeout (no extra
+                            # clock read on the submit side); resolve
+                            # instant = fut.t_set, stamped by the driver
+                            # at set() time so the sweep's park interval
+                            # never inflates the percentile tail.
+                            done_at = fut.t_set if fut.t_set is not None \
+                                else now
+                            lat_sink.append(
+                                done_at - (dl - self.op_timeout))
                 elif fut is None or now >= dl:
                     del pend[c]
                     self._fail_over(srv, op)

@@ -39,7 +39,7 @@ from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.ops.hashing import NSHARDS, key2shard
 from tpu6824.services import shardmaster
-from tpu6824.services.common import FlakyNet, fresh_cid
+from tpu6824.services.common import DecidedTap, FlakyNet, fresh_cid
 from tpu6824.services.shardmaster import Config
 from tpu6824.utils.errors import (
     OK,
@@ -104,6 +104,12 @@ class ShardKVServer:
         self._cfg_cache: dict[int, Config] = {}  # immutable once created
         self._cfg_target = 0  # highest config num seen from the sm group
         self.dead = False
+        # Decided-delta feed (fabric backends): the tick/catch-up drain
+        # consumes the fabric's once-per-group decided fan-out instead of
+        # walking status() seq by seq; see kvpaxos for the full rationale.
+        sub_fn = getattr(self.px, "subscribe_decided", None)
+        sub = sub_fn() if sub_fn is not None else None
+        self._tap = DecidedTap(sub) if sub is not None else None
         self._ticker = None
         if start_ticker:
             self._start_ticker()
@@ -150,6 +156,32 @@ class ShardKVServer:
         return reply
 
     def _drain_decided(self):
+        tap = self._tap
+        if tap is not None:
+            # Feed path: apply the tap's contiguous run as a batch, one
+            # Done() high-water call per drain.  _sync may have applied
+            # seqs out from under the tap (it walks status() while
+            # proposing) — discard those before reassembling.
+            base0 = self.applied + 1
+            tap.discard_through(self.applied)
+            while True:
+                run = tap.pop_ready(self.applied)
+                if not run:
+                    if tap.should_probe_min(self.applied):
+                        mn = self.px.min()
+                        if mn > self.applied + 1:
+                            # GC'd past us before we subscribed (warm
+                            # boot); skip the forgotten span.
+                            self.applied = mn - 1
+                            tap.discard_through(self.applied)
+                            continue
+                    break
+                for v in run:
+                    self._apply(v)
+                    self.applied += 1
+            if self.applied >= base0:
+                self.px.done(self.applied)
+            return
         while True:
             fate, v = self.px.status(self.applied + 1)
             if fate == Fate.DECIDED:
@@ -354,6 +386,8 @@ class ShardKVServer:
     def kill(self):
         with self.mu:
             self.dead = True
+            if self._tap is not None:
+                self._tap.close()
         self.px.kill()
 
 
