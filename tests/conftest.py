@@ -32,7 +32,44 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu"
 
 
+import pytest  # noqa: E402
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-running integration tests"
     )
+    config.addinivalue_line(
+        "markers",
+        "nemesis: deterministic fault-schedule tests (fixed-seed smokes "
+        "run in tier-1; full soaks carry `slow` too).  On failure the "
+        "nemesis_report fixture prints the seed + fault timeline and "
+        "writes /tmp/nemesis-<test>.json for one-command replay",
+    )
+
+
+@pytest.hookimpl(tryfirst=True, hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Stash each phase's report on the item so fixture teardowns (the
+    nemesis failure artifact below) can see whether the test failed."""
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "rep_" + rep.when, rep)
+
+
+@pytest.fixture
+def nemesis_report(request):
+    """Failure-replay plumbing for nemesis tests: the test attaches its
+    seed/schedule/Nemesis (`rep.attach(nemesis=nem)`); if the test then
+    fails, teardown prints the seed + as-injected fault timeline and
+    writes /tmp/nemesis-<test>.json — `TPU6824_NEMESIS_SEED=<seed>
+    python -m pytest <nodeid>` replays the identical schedule."""
+    from tpu6824.harness.nemesis import ReplayArtifact
+
+    artifact = ReplayArtifact(test=request.node.nodeid)
+    yield artifact
+    rep = getattr(request.node, "rep_call", None)
+    if rep is not None and rep.failed and artifact.attached:
+        path = artifact.write("/tmp")
+        print(f"\n=== nemesis failure artifact: {path} ===")
+        print(artifact.describe())

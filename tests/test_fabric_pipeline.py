@@ -495,3 +495,26 @@ def test_knobs_flow_through_config(monkeypatch):
         assert fab.pipeline_depth == 4
     finally:
         fab.stop_clock()
+
+
+def test_depth_shrink_mid_pipeline_retires_stranded_dispatch():
+    """set_pipeline_depth(1) while a dispatch is in flight (the nemesis's
+    live depth churn) must NOT strand it: later dispatches never
+    re-report an earlier dispatch's newly-decided summary, so the
+    depth<=1 fast path has to flush the in-flight queue before stepping
+    synchronously — otherwise decisions made during the stranded
+    dispatch stay out of the mirrors until the clock stops."""
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=16, seed=5,
+                      io_mode="compact", pipeline_depth=2)
+    # Arm an instance, then launch exactly one dispatch and keep it in
+    # flight (depth 2: step_async launches without retiring the first).
+    fab.start(0, 0, 0, "v0")
+    fab.step_async()
+    assert len(fab._inflight) == 1
+    fab.set_pipeline_depth(1)
+    fab.step_async()  # depth<=1 path: must retire the stranded dispatch
+    assert len(fab._inflight) == 0
+    # Decisions from both dispatches are in the mirrors; the instance
+    # decides everywhere within a few synchronous steps.
+    fab.step(6)
+    assert fab.ndecided(0, 0) == 3

@@ -386,3 +386,38 @@ def test_sequence_of_puts_unreliable(cluster):
             assert ck.get("seq-key", timeout=60.0) == str(j)
     finally:
         fabric.set_unreliable(False)
+
+
+def test_clerk_backoff_modes():
+    """Clerk retry pacing knob (TPU6824_CLERK_BACKOFF): jitter mode is
+    decorrelated-exponential bounded by [base, cap]; fixed mode keeps the
+    reference's flat cadence reachable for fidelity runs."""
+    from tpu6824.services.common import Backoff
+
+    bo = Backoff(base=0.002, cap=0.1, mode="jitter", seed=1)
+    seen = [bo.next_interval() for _ in range(200)]
+    assert all(0.002 <= s <= 0.1 for s in seen)
+    assert max(seen) > 0.05  # grows toward the cap over a long outage
+    bo.reset()
+    assert bo.next_interval() <= 0.006  # first retry after reset is cheap
+    # Same seed → same pattern (seeded clerks have reproducible retries).
+    again = Backoff(base=0.002, cap=0.1, mode="jitter", seed=1)
+    assert [again.next_interval() for _ in range(200)] == seen
+
+    fx = Backoff(mode="fixed")
+    assert [fx.next_interval() for _ in range(3)] == [0.01] * 3
+    fx20 = Backoff(mode="fixed", fixed_sleep=0.02)
+    assert fx20.next_interval() == 0.02
+
+    # Env resolution: explicit mode wins; default comes from the knob.
+    import os
+    old = os.environ.get("TPU6824_CLERK_BACKOFF")
+    try:
+        os.environ["TPU6824_CLERK_BACKOFF"] = "fixed"
+        assert Backoff().mode == "fixed"
+        assert Backoff(mode="jitter").mode == "jitter"
+    finally:
+        if old is None:
+            os.environ.pop("TPU6824_CLERK_BACKOFF", None)
+        else:
+            os.environ["TPU6824_CLERK_BACKOFF"] = old

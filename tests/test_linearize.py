@@ -1,0 +1,225 @@
+"""Unit tests for the Wing–Gong linearizability checker itself — known
+linearizable AND known NON-linearizable histories, asserting the verdict
+both ways so the checker can never rot into always-green.
+
+Histories are hand-timed OpRecords; each bug shape is the classic one:
+stale read, lost update, split-brain append ordering, real-time
+violation, phantom value.
+"""
+
+import pytest
+
+from tpu6824.harness.linearize import (
+    History,
+    HistoryClerk,
+    OpRecord,
+    check_history,
+)
+from tpu6824.utils.errors import RPCError
+
+
+def op(client, kind, key, call, ret, value="", output=None):
+    return OpRecord(client, kind, key, value, output, call, ret)
+
+
+# ------------------------------------------------------- linearizable
+
+
+def test_sequential_history_ok():
+    h = [
+        op(0, "put", "x", 0.0, 1.0, value="a"),
+        op(0, "get", "x", 2.0, 3.0, output="a"),
+        op(0, "append", "x", 4.0, 5.0, value="b"),
+        op(0, "get", "x", 6.0, 7.0, output="ab"),
+    ]
+    res = check_history(h)
+    assert res.ok, res.describe()
+
+
+def test_get_on_missing_key_reads_empty():
+    res = check_history([op(0, "get", "x", 0.0, 1.0, output="")])
+    assert res.ok
+    res = check_history([op(0, "get", "x", 0.0, 1.0, output="ghost")])
+    assert not res.ok  # phantom value: never written
+
+
+def test_concurrent_appends_either_order_ok():
+    for final in ("ab", "ba"):
+        h = [
+            op(0, "append", "k", 0.0, 2.0, value="a"),
+            op(1, "append", "k", 0.0, 2.0, value="b"),
+            op(2, "get", "k", 3.0, 4.0, output=final),
+        ]
+        assert check_history(h).ok, final
+
+
+def test_concurrent_put_get_may_see_either():
+    for out in ("", "v"):
+        h = [
+            op(0, "put", "x", 0.0, 2.0, value="v"),
+            op(1, "get", "x", 1.0, 1.5, output=out),
+        ]
+        assert check_history(h).ok, out
+
+
+def test_per_key_composition_isolates_violation():
+    h = [
+        op(0, "put", "good", 0.0, 1.0, value="g"),
+        op(0, "get", "good", 2.0, 3.0, output="g"),
+        op(1, "put", "bad", 0.0, 1.0, value="b"),
+        op(1, "get", "bad", 2.0, 3.0, output="WRONG"),
+    ]
+    res = check_history(h)
+    assert not res.ok
+    assert [v.key for v in res.violations] == ["bad"]
+    assert all(r.ok for r in res.results if r.key == "good")
+
+
+def test_larger_sequential_history_fast():
+    h = []
+    val = ""
+    for j in range(200):
+        h.append(op(0, "append", "k", 2 * j, 2 * j + 1, value=str(j)))
+        val += str(j)
+    h.append(op(0, "get", "k", 500.0, 501.0, output=val))
+    res = check_history(h)
+    assert res.ok and not res.undecided
+
+
+# --------------------------------------------------- NON-linearizable
+
+
+def test_stale_read_caught():
+    """Read returns the OLD value after a later put completed strictly
+    before the read was invoked."""
+    h = [
+        op(0, "put", "x", 0.0, 1.0, value="a"),
+        op(0, "put", "x", 2.0, 3.0, value="b"),
+        op(1, "get", "x", 4.0, 5.0, output="a"),
+    ]
+    res = check_history(h)
+    assert not res.ok
+    assert res.violations and res.violations[0].key == "x"
+    assert "NOT linearizable" in res.describe()
+
+
+def test_lost_update_caught():
+    """Two completed appends, a later read sees only one."""
+    h = [
+        op(0, "append", "k", 0.0, 1.0, value="a"),
+        op(1, "append", "k", 0.5, 1.5, value="b"),
+        op(2, "get", "k", 2.0, 3.0, output="a"),
+    ]
+    assert not check_history(h).ok
+
+
+def test_split_brain_append_order_caught():
+    """Two sequential reads observe the two concurrent appends in
+    CONFLICTING orders — each read alone is fine, together they cannot
+    be one register."""
+    h = [
+        op(0, "append", "k", 0.0, 1.0, value="a"),
+        op(1, "append", "k", 0.0, 1.0, value="b"),
+        op(2, "get", "k", 2.0, 3.0, output="ab"),
+        op(2, "get", "k", 4.0, 5.0, output="ba"),
+    ]
+    assert not check_history(h).ok
+
+
+def test_realtime_order_enforced():
+    """A get invoked after a put RETURNED must see it (this is what
+    separates linearizability from serializability)."""
+    h = [
+        op(0, "put", "x", 0.0, 0.5, value="v"),
+        op(1, "get", "x", 1.0, 2.0, output=""),
+    ]
+    assert not check_history(h).ok
+
+
+def test_duplicate_append_caught():
+    """The lost-dup-table shape: one append, but the state a read
+    observes contains it twice."""
+    h = [
+        op(0, "append", "k", 0.0, 1.0, value="x1y"),
+        op(1, "get", "k", 2.0, 3.0, output="x1yx1y"),
+    ]
+    assert not check_history(h).ok
+
+
+# ------------------------------------------------------ incomplete ops
+
+
+def test_incomplete_mutation_may_or_may_not_apply():
+    pending = op(0, "append", "k", 0.0, None, value="a")
+    for out in ("", "a"):
+        h = [pending, op(1, "get", "k", 1.0, 2.0, output=out)]
+        assert check_history(h).ok, out
+    # ...but it cannot apply TWICE:
+    h = [pending, op(1, "get", "k", 1.0, 2.0, output="aa")]
+    assert not check_history(h).ok
+
+
+def test_incomplete_get_is_dropped():
+    h = [
+        op(0, "put", "x", 0.0, 1.0, value="v"),
+        op(1, "get", "x", 2.0, None),  # no response observed
+        op(0, "get", "x", 3.0, 4.0, output="v"),
+    ]
+    res = check_history(h)
+    assert res.ok
+    assert sum(r.nops for r in res.results) == 2  # the lost get constrains nothing
+
+
+# ------------------------------------------------------- HistoryClerk
+
+
+class _DictClerk:
+    """In-memory clerk with the services' get/put/append surface."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def get(self, key, **kw):
+        return self.kv.get(key, "")
+
+    def put(self, key, value, **kw):
+        self.kv[key] = value
+
+    def append(self, key, value, **kw):
+        self.kv[key] = self.kv.get(key, "") + value
+
+
+class _DeadClerk:
+    def append(self, key, value, **kw):
+        raise RPCError("no majority")
+
+
+def test_history_clerk_records_and_checks():
+    hist = History()
+    ck = HistoryClerk(_DictClerk(), hist)
+    ck.put("a", "1")
+    ck.append("a", "2")
+    assert ck.get("a") == "12"
+    ck.put("b", "z")
+    assert len(hist) == 4
+    recs = hist.ops()
+    assert all(r.ret is not None and r.ret >= r.call for r in recs)
+    assert recs[2].output == "12"
+    assert check_history(hist).ok
+
+
+def test_history_clerk_records_unknown_fate_on_error():
+    hist = History()
+    ck = HistoryClerk(_DeadClerk(), hist)
+    with pytest.raises(RPCError):
+        ck.append("k", "v")
+    (rec,) = hist.ops()
+    assert rec.ret is None and rec.kind == "append"
+    assert check_history(hist).ok  # unknown fate alone is not a violation
+
+
+def test_history_clerk_distinct_client_ids():
+    hist = History()
+    a = HistoryClerk(_DictClerk(), hist)
+    b = HistoryClerk(_DictClerk(), hist)
+    assert a.client != b.client

@@ -1,0 +1,505 @@
+"""Nemesis soaks: deterministic seeded fault schedules driven against the
+service stack, judged by the Wing–Gong linearizability checker
+(`harness/linearize.py`) instead of the append-interleaving check alone.
+
+Layout:
+  - schedule determinism / replay identity (pure engine tests);
+  - fixed-seed kvpaxos + shardkv smokes (tier-1, `nemesis` marker);
+  - stats()["health"] stalled-group reporting under an induced
+    majority-less partition;
+  - the checker-catches-a-real-bug test: the dup table disabled via the
+    test-only hook, under a fixed-seed schedule + lossy clerk leg —
+    the checker MUST report a violation;
+  - wire-Deployment nemesis over real sockets;
+  - full soaks on both kernel engines (slow).
+
+Every nemesis test takes the `nemesis_report` fixture: on failure the
+seed + as-injected fault timeline are printed and written to
+/tmp/nemesis-<test>.json; TPU6824_NEMESIS_SEED=<seed> replays the
+identical schedule (`harness/nemesis.py::seed_from_env`).
+"""
+
+import threading
+
+import pytest
+
+from tpu6824.core.fabric import PaxosFabric
+from tpu6824.harness.linearize import History, HistoryClerk, check_history
+from tpu6824.harness.nemesis import (
+    FabricTarget,
+    FaultSchedule,
+    Nemesis,
+    seed_from_env,
+)
+from tpu6824.services.common import FlakyNet
+from tpu6824.services.kvpaxos import Clerk, make_cluster
+from tpu6824.utils.timing import wait_until
+
+from tests.invariants import check_appends
+
+pytestmark = pytest.mark.nemesis
+
+
+# ------------------------------------------------------ schedule engine
+
+
+FABRIC_SPEC = {"kind": "fabric", "groups": [0], "npeers": 3,
+               "actions": FabricTarget.ACTIONS}
+
+
+def test_schedule_generation_deterministic():
+    a = FaultSchedule.generate(42, 3.0, FABRIC_SPEC)
+    b = FaultSchedule.generate(42, 3.0, FABRIC_SPEC)
+    assert a == b and a.signature() == b.signature()
+    assert len(a) > 0
+    c = FaultSchedule.generate(43, 3.0, FABRIC_SPEC)
+    assert a.signature() != c.signature()
+
+
+def test_schedule_round_trips_through_json(tmp_path):
+    a = FaultSchedule.generate(7, 2.0, FABRIC_SPEC)
+    p = str(tmp_path / "sched.json")
+    import json
+
+    with open(p, "w") as f:
+        json.dump(a.to_dict(), f)
+    b = FaultSchedule.from_json(p)
+    assert a == b
+
+
+def test_schedule_ends_restored():
+    """Whatever a schedule injects, its restore tail must leave the
+    target healed: no partitioned group, no killed peer, no unreliable
+    peer outstanding after the last event."""
+    sched = FaultSchedule.generate(13, 4.0, FABRIC_SPEC)
+    parted, killed, unrel = set(), set(), set()
+    for ev in sched:
+        a, args = ev.action, ev.args
+        if a.startswith("partition_"):
+            parted.add(args["g"])
+        elif a == "heal":
+            parted.discard(args["g"])
+        elif a == "kill":
+            killed.add((args["g"], args["p"]))
+        elif a == "revive":
+            killed.discard((args["g"], args["p"]))
+        elif a in ("unreliable", "reliable"):
+            (unrel.add if args["flag"] else unrel.discard)(
+                (args["g"], args["p"]))
+    assert not parted and not killed and not unrel
+
+
+def test_schedule_kills_bounded_to_minority():
+    spec = dict(FABRIC_SPEC, npeers=5)
+    sched = FaultSchedule.generate(3, 6.0, spec,
+                                   weights={"kill": 50.0, "revive": 0.1})
+    killed = set()
+    for ev in sched:
+        if ev.action == "kill":
+            killed.add(ev.args["p"])
+            assert len(killed) <= 2  # floor((5-1)/2): majority always alive
+        elif ev.action == "revive":
+            killed.discard(ev.args["p"])
+
+
+def test_fabric_nemesis_replay_identity(nemesis_report):
+    """Same seed → the identical injected fault timeline, on two
+    independent fabrics (the acceptance-criteria replay contract)."""
+    seed = seed_from_env(1009)
+    sigs = []
+    for _ in range(2):
+        fab = PaxosFabric(ngroups=1, npeers=3, ninstances=16,
+                          auto_step=True)
+        try:
+            sched = FaultSchedule.generate(
+                seed, 1.2, FabricTarget(fab).spec())
+            nem = Nemesis(FabricTarget(fab), sched).start()
+            nemesis_report.attach(nemesis=nem, seed=seed)
+            nem.join(30.0)
+            assert nem.done
+            sigs.append(nem.signature())
+            assert nem.signature() == sched.signature()
+        finally:
+            fab.stop_clock()
+    assert sigs[0] == sigs[1]
+
+
+# ------------------------------------------------------------- health
+
+
+def test_health_reports_stalled_group_during_majorityless_partition():
+    """stats()["health"]: a group whose peers are fully isolated (no
+    majority anywhere) must surface in stalled_groups instead of hanging
+    silently; heal clears it and the op completes."""
+    fabric, servers = make_cluster(nservers=3, ninstances=32)
+    try:
+        ck = Clerk(servers)
+        ck.put("warm", "1")  # group has decided: health baseline is fresh
+        assert fabric.stats()["health"]["stalled_groups"] == []
+        fabric.partition(0, [0], [1], [2])
+        done = threading.Event()
+
+        def blocked_put():
+            ck.put("k", "v", timeout=90.0)
+            done.set()
+
+        t = threading.Thread(target=blocked_put, daemon=True)
+        t.start()
+        assert wait_until(
+            lambda: fabric.stats(stall_after=0.4)["health"]
+            ["stalled_groups"] == [0],
+            timeout=20.0), fabric.stats(stall_after=0.4)["health"]
+        h = fabric.stats(stall_after=0.4)["health"]
+        assert h["oldest_undecided_age_s"] > 0.4
+        # Contract fields are always present (TUNING § health):
+        for field in ("last_retire_age_s", "stall_after_s", "feed_depth",
+                      "feed_depth_max"):
+            assert field in h, h
+        fabric.heal(0)
+        assert done.wait(30.0)
+        # Progress resumed: the stall report clears.
+        assert wait_until(
+            lambda: fabric.stats(stall_after=0.4)["health"]
+            ["stalled_groups"] == [],
+            timeout=20.0)
+        assert ck.get("k") == "v"
+    finally:
+        for s in servers:
+            s.dead = True
+        fabric.stop_clock()
+
+
+def test_health_stats_and_depth_round_trip_over_wire():
+    """The fabric-service exports added for nemesis/health must survive
+    the real wire: stats() (with its health block) pickles through a
+    remote_fabric Proxy, and set_pipeline_depth applies remotely."""
+    import shutil
+
+    from tpu6824.core.fabric_service import remote_fabric, serve_fabric
+    from tpu6824.harness import make_sockdir
+
+    d = make_sockdir("fabsvc")
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=16, auto_step=True)
+    srv = serve_fabric(fab, d + "/fab")
+    try:
+        rf = remote_fabric(d + "/fab", timeout=10.0)
+        rf.start(0, 0, 0, "v")
+        st = rf.stats()
+        h = st["health"]
+        for field in ("last_retire_age_s", "stall_after_s",
+                      "stalled_groups", "feed_depth", "feed_depth_max"):
+            assert field in h, h
+        rf.set_pipeline_depth(3)
+        assert fab.pipeline_depth == 3
+        rf.set_pipeline_depth(2)
+        assert fab.pipeline_depth == 2
+    finally:
+        srv.kill()
+        fab.stop_clock()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# ------------------------------------------------------ kvpaxos smokes
+
+
+def _kv_traffic(servers, nclients, nops, history, net=None, timeout=120.0,
+                key="k"):
+    """nclients threads of append(+periodic get) traffic through
+    HistoryClerks; returns (threads, errs)."""
+    errs: list = []
+
+    def client(idx):
+        try:
+            ck = HistoryClerk(Clerk(servers, net=net), history)
+            for j in range(nops):
+                ck.append(key, f"x {idx} {j} y", timeout=timeout)
+                if j % 3 == 2:
+                    ck.get(key, timeout=timeout)
+        except Exception as e:  # pragma: no cover
+            errs.append((idx, e))
+
+    ts = [threading.Thread(target=client, args=(i,), daemon=True)
+          for i in range(nclients)]
+    return ts, errs
+
+
+def run_kvpaxos_nemesis(seed, duration, nclients, nops, nemesis_report,
+                        fabric_kw=None, weights=None, disable_dup=False,
+                        flaky_seed=None):
+    fabric = PaxosFabric(ngroups=1, npeers=3, ninstances=32,
+                         auto_step=True, **(fabric_kw or {}))
+    _, servers = make_cluster(fabric=fabric, nservers=3, ninstances=32)
+    net = None
+    if flaky_seed is not None:
+        net = FlakyNet(seed=flaky_seed)
+        for s in servers:
+            net.set_unreliable(s, True)
+    if disable_dup:
+        for s in servers:
+            s._test_disable_dup = True
+    history = History()
+    try:
+        target = FabricTarget(fabric)
+        sched = FaultSchedule.generate(seed, duration, target.spec(),
+                                       weights=weights)
+        nem = Nemesis(target, sched).start()
+        nemesis_report.attach(nemesis=nem, seed=seed)
+        ts, errs = _kv_traffic(servers, nclients, nops, history, net=net)
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=240.0)
+        assert not any(t.is_alive() for t in ts), "client stuck past 240s"
+        nem.join(60.0)
+        assert nem.done
+        assert nem.signature() == sched.signature()
+        assert not errs, errs
+        if net is not None:
+            for s in servers:
+                net.set_unreliable(s, False)
+        final = HistoryClerk(Clerk(servers), history)
+        value = final.get("k", timeout=60.0)
+        return history, value
+    finally:
+        for s in servers:
+            s.dead = True
+        fabric.stop_clock()
+
+
+def test_kvpaxos_nemesis_smoke(nemesis_report):
+    """Fixed-seed nemesis over kvpaxos on the PIPELINED clock (K=2 fused
+    micro-steps, depth-2 double buffering, compact io): partitions (incl.
+    majority-less), unreliable toggles, kill/revive, clock pauses and
+    live pipeline-depth churn — then the full history must linearize."""
+    history, value = run_kvpaxos_nemesis(
+        seed_from_env(24601), duration=2.0, nclients=3, nops=6,
+        nemesis_report=nemesis_report,
+        fabric_kw=dict(io_mode="compact", steps_per_dispatch=2,
+                       pipeline_depth=2))
+    check_appends(value, 3, 6)
+    res = check_history(history)
+    assert res.ok, res.describe()
+
+
+def test_kvpaxos_nemesis_catches_disabled_dup_table(nemesis_report):
+    """The deliberately-injected linearizability bug: at-most-once
+    duplicate suppression disabled via the test-only hook, clerk leg
+    lossy (replies dropped after execution force retries), fixed-seed
+    nemesis running.  Retried appends now apply twice; the Wing–Gong
+    checker MUST catch it — this is the test that keeps the checker
+    honest (it can never rot into always-green)."""
+    history, _ = run_kvpaxos_nemesis(
+        seed_from_env(31337), duration=1.5, nclients=3, nops=16,
+        nemesis_report=nemesis_report,
+        # keep consensus mostly healthy so the lossy CLERK leg drives
+        # the retries; the checker must catch the dup regardless
+        weights={"kill": 0.0, "clock_pause": 0.0,
+                 "partition_isolate": 0.3},
+        disable_dup=True, flaky_seed=5)
+    res = check_history(history)
+    assert not res.ok, (
+        "checker missed the disabled-dup-table bug: "
+        f"{len(history)} ops judged linearizable")
+    assert res.violations, res.describe()
+    assert res.violations[0].key == "k"
+
+
+# ------------------------------------------------------- shardkv smoke
+
+
+def test_shardkv_nemesis_reconfiguration_smoke(nemesis_report):
+    """Nemesis over shardkv with RECONFIGURATION as a schedule-driven
+    fault dimension (arxiv 1906.01365's point: exercise the commit path
+    under membership change, not around it): the extra action alternately
+    leaves/joins the second group — shard migrations race partitions,
+    kill/revive and unreliable toggles on the kv lanes (the shardmaster
+    lane stays clean).  The mixed-key history must linearize."""
+    from tpu6824.services.shardkv import ShardSystem
+
+    system = ShardSystem(ngroups=2, nreplicas=3, ninstances=32)
+    g0, g1 = system.gids
+    history = History()
+    try:
+        system.join(g0)
+        system.join(g1)
+        state = {"joined": True}
+
+        def reconfigure():
+            if state["joined"]:
+                system.leave(g1)
+            else:
+                system.join(g1)
+            state["joined"] = not state["joined"]
+
+        target = FabricTarget(system.fabric, groups=[1, 2],
+                              extra={"reconfigure": reconfigure})
+        seed = seed_from_env(8086)
+        sched = FaultSchedule.generate(
+            seed, 2.0, target.spec(),
+            weights={"reconfigure": 3.0, "clock_pause": 0.0})
+        nem = Nemesis(target, sched).start()
+        nemesis_report.attach(nemesis=nem, seed=seed)
+
+        errs: list = []
+        keys = ["a", "b", "c", "d", "e", "f"]
+
+        def client(idx):
+            try:
+                ck = HistoryClerk(system.clerk(), history, client=idx)
+                for j in range(6):
+                    k = keys[(idx + j) % len(keys)]
+                    ck.append(k, f"x {idx} {j} y", timeout=120.0)
+                    if j % 2 == 1:
+                        ck.get(k, timeout=120.0)
+            except Exception as e:  # pragma: no cover
+                errs.append((idx, e))
+
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=240.0)
+        assert not any(t.is_alive() for t in ts), "client stuck past 240s"
+        nem.join(60.0)
+        assert nem.done
+        assert not errs, errs
+        # Read every key back post-heal so each key's history ends with
+        # an observation.
+        ck = HistoryClerk(system.clerk(), history, client="final")
+        for k in keys:
+            ck.get(k, timeout=60.0)
+        res = check_history(history)
+        assert res.ok, res.describe()
+    finally:
+        system.shutdown()
+
+
+# ------------------------------------------------------ wire deployment
+
+
+def test_wire_deployment_nemesis(nemesis_report):
+    """The same schedule engine over REAL sockets: kvpaxos replicas
+    behind a Deployment; the nemesis toggles unreliable accept loops,
+    reversible deafness (socket path renamed aside) and delay-proxy
+    interposition while clerks dial the proxies.  History must
+    linearize after restore."""
+    from tpu6824.harness import Deployment
+    from tpu6824.harness.nemesis import DeploymentTarget
+    from tpu6824.rpc import connect
+
+    with Deployment("nemesis") as dep:
+        fabric, servers = make_cluster(nservers=3, ninstances=32)
+        history = History()
+        try:
+            names = [f"kv{i}" for i in range(3)]
+            for name, s in zip(names, servers):
+                dep.serve(name, s)
+            proxies = [connect(dep.addr(n), timeout=5.0) for n in names]
+
+            target = DeploymentTarget(dep, names)
+            seed = seed_from_env(4242)
+            sched = FaultSchedule.generate(seed, 1.5, target.spec())
+            nem = Nemesis(target, sched).start()
+            nemesis_report.attach(nemesis=nem, seed=seed)
+
+            ts, errs = _kv_traffic(proxies, 2, 4, history)
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=240.0)
+            assert not any(t.is_alive() for t in ts)
+            nem.join(60.0)
+            assert nem.done
+            assert not errs, errs
+            final = HistoryClerk(Clerk(proxies), history)
+            value = final.get("k", timeout=60.0)
+            check_appends(value, 2, 4)
+            res = check_history(history)
+            assert res.ok, res.describe()
+        finally:
+            for s in servers:
+                s.kill()
+            fabric.stop_clock()
+
+
+# ------------------------------------------------------------ full soaks
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_kvpaxos_nemesis_soak(kernel, nemesis_report):
+    """Long kvpaxos nemesis on BOTH kernel engines (pallas runs in
+    interpret mode off-TPU, so its op budget is small)."""
+    heavy = kernel == "xla"
+    history, value = run_kvpaxos_nemesis(
+        seed_from_env(5150), duration=4.0 if heavy else 1.5,
+        nclients=4 if heavy else 2, nops=10 if heavy else 3,
+        nemesis_report=nemesis_report,
+        fabric_kw=dict(kernel=kernel, io_mode="compact",
+                       steps_per_dispatch=2, pipeline_depth=2))
+    check_appends(value, 4 if heavy else 2, 10 if heavy else 3)
+    res = check_history(history)
+    assert res.ok, res.describe()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_shardkv_nemesis_soak(kernel, nemesis_report):
+    """shardkv-under-reconfiguration nemesis on both kernel engines."""
+    from tpu6824.services.shardkv import ShardSystem
+
+    heavy = kernel == "xla"
+    system = ShardSystem(ngroups=2, nreplicas=3, ninstances=32,
+                         fabric_kw={"kernel": kernel})
+    g0, g1 = system.gids
+    history = History()
+    try:
+        system.join(g0)
+        system.join(g1)
+        state = {"joined": True}
+
+        def reconfigure():
+            (system.leave if state["joined"] else system.join)(g1)
+            state["joined"] = not state["joined"]
+
+        target = FabricTarget(system.fabric, groups=[1, 2],
+                              extra={"reconfigure": reconfigure})
+        seed = seed_from_env(777)
+        sched = FaultSchedule.generate(
+            seed, 4.0 if heavy else 1.5, target.spec(),
+            weights={"reconfigure": 3.0, "clock_pause": 0.0})
+        nem = Nemesis(target, sched).start()
+        nemesis_report.attach(nemesis=nem, seed=seed)
+        errs: list = []
+        keys = ["a", "b", "c", "d"]
+        nops = 8 if heavy else 3
+
+        def client(idx):
+            try:
+                ck = HistoryClerk(system.clerk(), history, client=idx)
+                for j in range(nops):
+                    k = keys[(idx + j) % len(keys)]
+                    ck.append(k, f"x {idx} {j} y", timeout=180.0)
+                    if j % 2 == 1:
+                        ck.get(k, timeout=180.0)
+            except Exception as e:  # pragma: no cover
+                errs.append((idx, e))
+
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(3 if heavy else 2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=400.0)
+        assert not any(t.is_alive() for t in ts)
+        nem.join(120.0)
+        assert nem.done
+        assert not errs, errs
+        ck = HistoryClerk(system.clerk(), history, client="final")
+        for k in keys:
+            ck.get(k, timeout=120.0)
+        res = check_history(history)
+        assert res.ok, res.describe()
+    finally:
+        system.shutdown()

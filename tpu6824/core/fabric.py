@@ -85,6 +85,12 @@ _IDLE_SLEEP = float(os.environ.get("TPU6824_IDLE_SLEEP", 0.002))
 _STEPS_PER_DISPATCH = int(
     os.environ.get("TPU6824_CLOCK_STEPS_PER_DISPATCH", 1))
 _PIPELINE_DEPTH = int(os.environ.get("TPU6824_PIPELINE_DEPTH", 2))
+# Health reporting (stats()["health"]): a group counts as STALLED when it
+# has live undecided instances older than this AND has decided nothing
+# for this long — the signature of a group with no reachable majority
+# (minority partition, too many peers dead).  Threshold only shapes the
+# report, never behavior.
+_STALL_AFTER = float(os.environ.get("TPU6824_STALL_AFTER", 1.0))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -143,13 +149,14 @@ class DecidedSub:
     called after each delivery batch — hook it to the consumer's wakeup
     event so the apply loop never polls."""
 
-    __slots__ = ("g", "p", "wake", "_q", "_fabric", "delivered")
+    __slots__ = ("g", "p", "wake", "_q", "_fabric", "delivered", "consumed")
 
     def __init__(self, fabric, g: int, p: int, wake=None):
         self.g, self.p, self.wake = g, p, wake
         self._q: deque = deque()
         self._fabric = fabric
         self.delivered = 0  # lifetime count (tests/stats)
+        self.consumed = 0   # consumer-side twin: depth = delivered - consumed
 
     def pop(self) -> list:
         """Drain everything delivered so far: list of (seq, value).
@@ -161,7 +168,15 @@ class DecidedSub:
         while q:  # single consumer per sub; producers only append
             seqs, vals = q.popleft()
             out.extend(zip(seqs, vals))
+        self.consumed += len(out)  # unlocked: health reads tolerate skew
         return out
+
+    def depth(self) -> int:
+        """Undrained item count — a consumer falling behind the fan-out
+        shows up here (stats()["health"]["feed_depth"]).  Racy by design
+        (producer and consumer bump different counters); never negative
+        is all the health report needs."""
+        return max(0, self.delivered - self.consumed)
 
     def close(self) -> None:
         self._fabric.unsubscribe_decided(self)
@@ -317,6 +332,15 @@ class PaxosFabric:
         # steps_total/msgs_total below are read-through views.
         self.events = EventLog()
         self._decided_cells = 0  # running count of decided (g, i, p) cells
+        # Health bookkeeping (stats()["health"]): when the last dispatch
+        # retired into the mirrors, when each group last decided anything,
+        # and when each live slot was allocated — enough to report a
+        # stalled (majority-less) group instead of letting it hang
+        # silently (see _health_locked).
+        now = time.monotonic()
+        self._last_retire_t = now
+        self._g_last_decided = np.full(G, now, np.float64)
+        self._slot_alloc_t = np.zeros((G, I), np.float64)
 
         # Slot management (host only): which absolute seq lives in each slot.
         self._slot_seq = np.full((G, I), -1, np.int64)
@@ -424,8 +448,14 @@ class PaxosFabric:
         the host stages/applies mirrors for dispatch N±1 while dispatch N
         computes on-device.  API calls remain safe concurrently (they only
         touch host mirrors under the lock).  Falls back to a synchronous
-        step on the full-io path, which has no launch/retire split."""
+        step on the full-io path, which has no launch/retire split — but
+        first retires anything a DEEPER previous depth left in flight
+        (set_pipeline_depth(1) mid-pipeline must not strand a launched
+        dispatch: later dispatches never re-report its newly-decided
+        summary, so an unretired entry would hold those decisions out of
+        the mirrors until the clock stopped)."""
         if self._io_mode != "compact" or self._pipeline_depth <= 1:
+            self.flush()
             self._step_once()
             return
         self._inflight.append(self._launch_compact())
@@ -569,14 +599,17 @@ class PaxosFabric:
             # (GC wipes recycled rows, the done() diagonal stays monotone).
             decided = np.array(decided)
             done_view = np.array(done_view)
+            # Fresh mirror transitions (<0 → >=0): the decided-delta feed's
+            # payload and the per-group health timestamp in one diff (GC
+            # wipes and their device-side resets complete within one
+            # synchronous step, so the diff can never resurrect a recycled
+            # tenant).  Before _gc_locked, while the slot map still names
+            # the fed seqs.
+            trans = (decided >= 0) & (self.m_decided < 0)
+            gdec = trans.any(axis=(1, 2))
+            if gdec.any():
+                self._g_last_decided[gdec] = time.monotonic()
             if self._sub_groups:
-                # Decided-delta feed on the full-refresh path: the delta
-                # is the fresh mirror transitions, by diff against the
-                # outgoing mirror (GC wipes and their device-side resets
-                # complete within one synchronous step, so the diff can
-                # never resurrect a recycled tenant).  Before _gc_locked,
-                # while the slot map still names the fed seqs.
-                trans = (decided >= 0) & (self.m_decided < 0)
                 flat = np.nonzero(trans.reshape(-1))[0]
                 if len(flat):
                     self.profiler.add("retire",
@@ -613,6 +646,7 @@ class PaxosFabric:
                 or self._live_slots * self.P > self._decided_cells)
             self._gc_locked()
             self._stepped.notify_all()
+            self._last_retire_t = time.monotonic()
             self.profiler.add("retire", time.perf_counter_ns() - t_r)
 
     # ------------------------------------------------- compact step path
@@ -821,11 +855,15 @@ class PaxosFabric:
                     # tenants; the mirror must not resurrect them.
                     r = np.asarray(self._pending_resets, dtype=np.int64)
                     decided[r[:, 0], r[:, 1], :] = NO_VAL
+                # Mirror transitions this resync makes: the feed delta
+                # (same rule as the scatter path, computed by diff
+                # because the summary overflowed) and the per-group
+                # health timestamp.
+                trans = (decided >= 0) & (self.m_decided < 0)
+                gdec = trans.any(axis=(1, 2))
+                if gdec.any():
+                    self._g_last_decided[gdec] = time.monotonic()
                 if self._sub_groups:
-                    # Feed delta = the mirror transitions this resync
-                    # makes (same rule as the scatter path, computed by
-                    # diff because the summary overflowed).
-                    trans = (decided >= 0) & (self.m_decided < 0)
                     feed_flat = np.nonzero(trans.reshape(-1))[0]
                     feed_vids = decided.reshape(-1)[feed_flat]
                 self.m_decided = decided
@@ -849,19 +887,25 @@ class PaxosFabric:
                             == iseqs[valid])
                     pidx_v = pidx_v[live] if not live.all() else pidx_v
                     vals_v = vals[valid][live]
+                    # A retire launched before an overflow resync may
+                    # re-report cells the resync already mirrored (and
+                    # fed) — the fresh-transition filter keeps the feed
+                    # exactly-once per tenancy, and the health timestamp
+                    # honest: only cells deciding NOW may refresh a
+                    # group's last-decided age (a stale re-report must
+                    # not suppress a stalled-group report).
+                    prev = self.m_decided.reshape(-1)[pidx_v]
+                    fresh_cells = pidx_v[prev < 0]
                     if self._sub_groups:
-                        # A retire launched before an overflow resync may
-                        # re-report cells the resync already mirrored (and
-                        # fed) — the fresh-transition filter keeps the
-                        # feed exactly-once per tenancy.
-                        prev = self.m_decided.reshape(-1)[pidx_v]
-                        fresh = prev < 0
-                        feed_flat = pidx_v[fresh]
-                        feed_vids = vals_v[fresh]
+                        feed_flat = fresh_cells
+                        feed_vids = vals_v[prev < 0]
                     # np.put: flat scatter that cannot silently land in a
                     # reshape copy if the mirror ever goes non-contiguous.
                     np.put(self.m_decided, pidx_v, vals_v)
                     applied = len(pidx_v)
+                    if len(fresh_cells):
+                        self._g_last_decided[np.unique(
+                            fresh_cells // (I * P))] = time.monotonic()
                 if epoch < self._resync_epoch:
                     # Launched before an overflow resync: the absolute
                     # fetch already mirrored this dispatch's decisions.
@@ -899,6 +943,7 @@ class PaxosFabric:
                 or self._live_slots * P > self._decided_cells)
             self._gc_locked()
             self._stepped.notify_all()
+            self._last_retire_t = time.monotonic()
             self.profiler.add("retire", time.perf_counter_ns() - t_r)
 
     def _step_once_compact(self):
@@ -911,6 +956,19 @@ class PaxosFabric:
     @property
     def pipeline_depth(self) -> int:
         return self._pipeline_depth
+
+    def set_pipeline_depth(self, depth: int) -> None:
+        """Live pipeline-depth churn (the nemesis uses this as a fault
+        dimension): the free-running clock adapts on its next step_async —
+        a shallower depth retires the in-flight surplus immediately, a
+        deeper one lets more dispatches accumulate.  Safe concurrently
+        with a running clock; direct step() calls stay synchronous."""
+        self._pipeline_depth = max(1, int(depth))
+
+    @property
+    def clock_running(self) -> bool:
+        with self._lock:
+            return self._running
 
     @property
     def steps_total(self) -> int:
@@ -993,6 +1051,7 @@ class PaxosFabric:
         self._live_slots += 1
         self._slot_seq[g, slot] = seq
         self._seq2slot[g][seq] = slot
+        self._slot_alloc_t[g, slot] = time.monotonic()
         return slot
 
     def start(self, g: int, p: int, seq: int, value) -> None:
@@ -1079,6 +1138,8 @@ class PaxosFabric:
             put = self.intern.put
             pend = self._pending_starts.append
             mx = self._max_seq
+            alloc_t = self._slot_alloc_t
+            now = time.monotonic()  # batch-granular is plenty for health
             for n, (g, p, seq, value) in enumerate(ops):
                 if seq >= _SEQ_LIMIT:
                     raise OverflowError(
@@ -1103,6 +1164,7 @@ class PaxosFabric:
                     self._live_slots += 1
                     slot_seq[g, slot] = seq
                     s2s[g][seq] = slot
+                    alloc_t[g, slot] = now
                 if type(value) is int and 0 <= value < IMM_BASE:
                     vid = IMM_BASE | value  # immediate (see IMM_BASE)
                 else:
@@ -1588,6 +1650,9 @@ class PaxosFabric:
             fab._peer_min = fab._pmin_i32.astype(np.int64) + 1
             fab._max_seq = np.array(blob["max_seq"])
             fab._slot_seq = np.array(blob["slot_seq"])
+            # Health clocks restart at the restore instant: a restored
+            # undecided slot must age from NOW, not from epoch 0.
+            fab._slot_alloc_t[:] = time.monotonic()
             if fab._io_mode == "compact":
                 ss = jnp.asarray(fab._slot_seq.astype(np.int32))
                 if fab._mesh is not None:
@@ -1622,11 +1687,12 @@ class PaxosFabric:
 
     # ------------------------------------------------------------- stats
 
-    def stats(self) -> dict:
+    def stats(self, stall_after: float | None = None) -> dict:
         """Live counters: steps, remote messages, decided cells, and their
         per-second rates — the decided/sec counter SURVEY §5 asks for —
         plus the host-side phase breakdown (stage/dispatch/retire/feed and,
-        when services drive this fabric, their apply/notify legs)."""
+        when services drive this fabric, their apply/notify legs) and the
+        graceful-degradation health block (see _health_locked)."""
         counters = self.events.counters()
         with self._lock:
             out = {
@@ -1640,10 +1706,48 @@ class PaxosFabric:
                     "subscribers": sum(len(v) for v in self._subs.values()),
                     "delivered": counters.get("feed_delivered", 0),
                 },
+                "health": self._health_locked(
+                    _STALL_AFTER if stall_after is None else stall_after),
             }
         out["rates"] = self.events.rates()
         out["phases"] = PhaseProfiler.breakdown(self.profiler.snapshot())
         return out
+
+    def _health_locked(self, stall_after: float) -> dict:
+        """Graceful-degradation report: how stale the host mirrors are
+        (`last_retire_age_s`), how far each feed consumer has fallen
+        behind the fan-out (`feed_depth`, items per (g, p) subscription),
+        and `stalled_groups` — groups holding live UNDECIDED instances
+        older than `stall_after` that have also decided nothing for that
+        long.  That is the signature of a group with no reachable
+        majority (minority partition / too many dead peers): proposals
+        sit armed forever, and without this report the only symptom is
+        clerks timing out.  Groups that are merely busy keep deciding
+        (fresh `_g_last_decided`), and freshly-proposed work is younger
+        than the threshold — neither is reported."""
+        now = time.monotonic()
+        live = self._slot_seq >= 0  # (G, I)
+        undecided = live & ~(self.m_decided >= 0).any(axis=2)
+        g_undec = undecided.any(axis=1)  # (G,)
+        oldest = np.where(undecided, self._slot_alloc_t, np.inf).min(axis=1)
+        oldest_age = np.where(g_undec, now - oldest, 0.0)
+        decided_age = now - self._g_last_decided
+        stalled = np.nonzero(g_undec & (oldest_age > stall_after)
+                             & (decided_age > stall_after))[0]
+        feed_depth: dict[str, int] = {}
+        for (g, p), lst in self._subs.items():
+            d = max((sub.depth() for sub in lst), default=0)
+            if d:
+                feed_depth[f"{g}:{p}"] = d
+        return {
+            "last_retire_age_s": round(now - self._last_retire_t, 6),
+            "stall_after_s": stall_after,
+            "stalled_groups": [int(g) for g in stalled],
+            "oldest_undecided_age_s": round(float(oldest_age.max()), 6)
+            if g_undec.any() else 0.0,
+            "feed_depth": feed_depth,
+            "feed_depth_max": max(feed_depth.values(), default=0),
+        }
 
     def ndecided(self, g: int, seq: int) -> int:
         """Test helper mirroring paxos/test_test.go:32-49: asserts agreement

@@ -35,11 +35,13 @@ FABRIC_RPCS = [
     # clock pacing for group-commit drivers (blocks server-side until the
     # next step or timeout; positional args — the Proxy takes no kwargs)
     "wait_steps",
-    # harness / fault injection
+    # harness / fault injection (set_pipeline_depth: live depth churn —
+    # the nemesis engine treats pipeline depth as a fault dimension)
     "ndecided", "set_unreliable", "partition", "heal", "deafen",
-    "set_link", "kill", "revive", "is_dead",
-    # introspection
-    "dims",
+    "set_link", "kill", "revive", "is_dead", "set_pipeline_depth",
+    # introspection (stats carries the graceful-degradation health block:
+    # last-retire age, feed queue depths, stalled-group detection)
+    "dims", "stats",
 ]
 
 

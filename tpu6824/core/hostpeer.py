@@ -264,6 +264,9 @@ class HostPaxosPeer:
     def deafen(self) -> None:
         self.server.deafen()
 
+    def undeafen(self) -> None:
+        self.server.undeafen()
+
     @property
     def rpc_count(self) -> int:
         return self.server.rpc_count

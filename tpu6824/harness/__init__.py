@@ -1,3 +1,32 @@
 from tpu6824.harness.cluster import Deployment, make_sockdir
+from tpu6824.harness.linearize import (
+    CheckResult,
+    History,
+    HistoryClerk,
+    OpRecord,
+    check_history,
+)
+from tpu6824.harness.nemesis import (
+    DeploymentTarget,
+    FabricTarget,
+    FaultSchedule,
+    Nemesis,
+    ReplayArtifact,
+    seed_from_env,
+)
 
-__all__ = ["Deployment", "make_sockdir"]
+__all__ = [
+    "CheckResult",
+    "Deployment",
+    "DeploymentTarget",
+    "FabricTarget",
+    "FaultSchedule",
+    "History",
+    "HistoryClerk",
+    "Nemesis",
+    "OpRecord",
+    "ReplayArtifact",
+    "check_history",
+    "make_sockdir",
+    "seed_from_env",
+]

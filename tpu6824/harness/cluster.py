@@ -73,6 +73,11 @@ class Deployment:
     def deafen(self, name: str) -> None:
         self._servers[name].deafen()
 
+    def undeafen(self, name: str) -> None:
+        """Restore a deafened service's public socket path (rpc.Server
+        renamed it aside) — deafness is a reversible, schedulable fault."""
+        self._servers[name].undeafen()
+
     def kill(self, name: str) -> None:
         """Socket teardown + object kill() if it has one."""
         srv = self._servers.pop(name, None)

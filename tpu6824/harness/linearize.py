@@ -1,0 +1,289 @@
+"""Wing–Gong linearizability checker over recorded KV histories.
+
+The append-interleaving check (`harness/invariants.py::check_appends`) can
+only judge pure-append workloads; it says nothing about mixed
+Get/Put/Append histories under churn — a stale read or a lost update that
+keeps every marker exactly-once passes it.  This module is the real
+yardstick: given a history of timed invocation/response records, decide
+whether some total order of the operations (a) respects real time — an op
+linearizes somewhere between its call and its return — and (b) is legal
+for a KV register (get returns the current value; put replaces; append
+concatenates).
+
+Algorithm: Wing & Gong's recursive search ("Testing and verifying
+concurrent objects", 1993) with the two refinements Porcupine popularized:
+
+  - **P-compositionality**: linearizability is compositional per object,
+    and each key is an independent register — the history is partitioned
+    by key and each sub-history checked alone, turning one search over N
+    ops into many searches over small per-key windows;
+  - **memoized states**: a (remaining-ops, register-value) pair that
+    already failed is never re-explored (the cache is what keeps the
+    worst case at O(C!) in the concurrency width C, not the history
+    length).
+
+Incomplete operations (an invocation whose response was never observed —
+clerk timeout, killed server) have UNKNOWN fate: a mutation may or may
+not have taken effect, so it may be linearized anywhere after its call or
+omitted entirely; an incomplete get constrains nothing and is dropped.
+
+`HistoryClerk` wraps any clerk exposing get/put/append and stamps
+monotonic call/return instants into a shared `History`, so existing test
+clerks (kvpaxos.Clerk, shardkv.Clerk, wire Proxies behind them) record
+without modification.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+_INF = float("inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class OpRecord:
+    """One invocation/response pair.  `ret` is None when no response was
+    observed (fate unknown); `output` is the returned value for get, and
+    ignored for put/append."""
+
+    client: object
+    kind: str  # 'get' | 'put' | 'append'
+    key: str
+    value: str  # input payload (put/append); "" for get
+    output: str | None
+    call: float
+    ret: float | None
+
+    def describe(self) -> str:
+        arg = f"{self.key!r}, {self.value!r}" if self.kind != "get" \
+            else f"{self.key!r}"
+        out = "?" if self.ret is None else (
+            repr(self.output) if self.kind == "get" else "ok")
+        return (f"[{self.call:.6f},"
+                f"{'inf' if self.ret is None else f'{self.ret:.6f}'}] "
+                f"client {self.client}: {self.kind}({arg}) -> {out}")
+
+
+class History:
+    """Thread-safe recorder shared by every HistoryClerk of a run.  Times
+    are monotonic offsets from construction so artifacts are small and
+    runs comparable."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ops: list[OpRecord] = []
+        self.t0 = time.monotonic()
+
+    def now(self) -> float:
+        return time.monotonic() - self.t0
+
+    def record(self, rec: OpRecord) -> None:
+        with self._lock:
+            self._ops.append(rec)
+
+    def ops(self) -> list[OpRecord]:
+        with self._lock:
+            return list(self._ops)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ops)
+
+
+class HistoryClerk:
+    """Call/return stamping wrapper around any get/put/append clerk.
+
+    One HistoryClerk = one logical client (its ops are sequential, which
+    is what makes the real-time order in the history meaningful).  An
+    exception from the underlying clerk records the op as incomplete
+    (ret=None, fate unknown) and re-raises — at-most-once machinery may
+    still have applied it."""
+
+    _ids = iter(range(1 << 30))
+    _ids_lock = threading.Lock()
+
+    def __init__(self, clerk, history: History, client=None):
+        self.clerk = clerk
+        self.history = history
+        if client is None:
+            with HistoryClerk._ids_lock:
+                client = next(HistoryClerk._ids)
+        self.client = client
+
+    def _timed(self, kind: str, key: str, value: str, fn, *args, **kw):
+        call = self.history.now()
+        try:
+            out = fn(*args, **kw)
+        except Exception:
+            self.history.record(OpRecord(self.client, kind, key, value,
+                                         None, call, None))
+            raise
+        self.history.record(OpRecord(
+            self.client, kind, key, value,
+            out if kind == "get" else None, call, self.history.now()))
+        return out
+
+    def get(self, key: str, **kw) -> str:
+        return self._timed("get", key, "", self.clerk.get, key, **kw)
+
+    def put(self, key: str, value: str, **kw):
+        return self._timed("put", key, value, self.clerk.put, key, value,
+                           **kw)
+
+    def append(self, key: str, value: str, **kw):
+        return self._timed("append", key, value, self.clerk.append, key,
+                           value, **kw)
+
+
+# ---------------------------------------------------------------- checker
+
+
+@dataclasses.dataclass
+class KeyResult:
+    """Verdict for one key's sub-history.  ok is True (linearizable),
+    False (proven non-linearizable), or None (node budget exhausted —
+    verdict unknown, treated as failure by CheckResult.ok)."""
+
+    key: str
+    ok: bool | None
+    nops: int
+    nodes: int
+    stuck_ops: list[str] = dataclasses.field(default_factory=list)
+
+    def describe(self) -> str:
+        if self.ok:
+            return f"key {self.key!r}: linearizable ({self.nops} ops)"
+        verdict = ("NOT linearizable" if self.ok is False
+                   else "UNDECIDED (search budget exhausted)")
+        lines = [f"key {self.key!r}: {verdict} "
+                 f"({self.nops} ops, {self.nodes} nodes searched)"]
+        if self.stuck_ops:
+            lines.append("  cannot linearize past:")
+            lines.extend(f"    {s}" for s in self.stuck_ops)
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class CheckResult:
+    results: list[KeyResult]
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok is True for r in self.results)
+
+    @property
+    def violations(self) -> list[KeyResult]:
+        return [r for r in self.results if r.ok is False]
+
+    @property
+    def undecided(self) -> list[KeyResult]:
+        return [r for r in self.results if r.ok is None]
+
+    def describe(self) -> str:
+        if self.ok:
+            n = sum(r.nops for r in self.results)
+            return (f"linearizable: {n} ops over "
+                    f"{len(self.results)} keys")
+        return "\n".join(r.describe() for r in self.results
+                         if r.ok is not True)
+
+
+def check_history(history, max_nodes_per_key: int = 2_000_000
+                  ) -> CheckResult:
+    """Check a full mixed-key history (a History, or a list of OpRecord)
+    for linearizability, per-key (P-compositionality: a KV map is
+    linearizable iff every per-key register is)."""
+    ops = history.ops() if isinstance(history, History) else list(history)
+    per_key: dict[str, list[OpRecord]] = {}
+    for r in ops:
+        per_key.setdefault(r.key, []).append(r)
+    results = [
+        _check_key(key, recs, max_nodes_per_key)
+        for key, recs in sorted(per_key.items())
+    ]
+    return CheckResult(results)
+
+
+def _check_key(key: str, recs: list[OpRecord], max_nodes: int) -> KeyResult:
+    """Wing–Gong search over one key's records.
+
+    State is the register value (a str; a never-written key reads "" —
+    the clerks' ErrNoKey surface).  The search keeps a `remaining`
+    bitmask; op i is a linearization candidate ("minimal") iff no other
+    remaining op returned before i was invoked.  Every COMPLETED op must
+    be placed; incomplete mutations are optional; incomplete gets are
+    dropped up front (their output is unknown, so they never constrain)."""
+    # Drop incomplete gets; stable order for reproducible diagnostics.
+    recs = [r for r in recs if not (r.ret is None and r.kind == "get")]
+    recs.sort(key=lambda r: (r.call, _INF if r.ret is None else r.ret))
+    n = len(recs)
+    if n == 0:
+        return KeyResult(key, True, 0, 0)
+    call = [r.call for r in recs]
+    ret = [_INF if r.ret is None else r.ret for r in recs]
+    completed = 0
+    for i, r in enumerate(recs):
+        if r.ret is not None:
+            completed |= 1 << i
+
+    def minimal(mask: int) -> list[int]:
+        # i is minimal in mask iff call[i] < min(ret[j] for j != i in mask)
+        idx = [i for i in range(n) if mask >> i & 1]
+        if len(idx) == 1:
+            return idx
+        m1 = m2 = _INF  # two smallest returns
+        a1 = -1
+        for i in idx:
+            if ret[i] < m1:
+                m1, m2, a1 = ret[i], m1, i
+            elif ret[i] < m2:
+                m2 = ret[i]
+        return [i for i in idx
+                if call[i] < (m2 if i == a1 else m1)]
+
+    full = (1 << n) - 1
+    seen: set[tuple[int, str]] = set()
+    nodes = 0
+    # DFS over (remaining mask, register value); stack of frames holding
+    # the candidate list still to try at that node.
+    stack = [(full, "", minimal(full), 0)]
+    best_mask = full  # fewest-completed-remaining point, for diagnostics
+    while stack:
+        mask, state, cands, ci = stack.pop()
+        if bin(mask & completed).count("1") < \
+                bin(best_mask & completed).count("1"):
+            best_mask = mask
+        if mask & completed == 0:
+            return KeyResult(key, True, n, nodes)
+        if ci >= len(cands):
+            continue
+        stack.append((mask, state, cands, ci + 1))
+        i = cands[ci]
+        r = recs[i]
+        if r.kind == "get":
+            if r.output != state:
+                continue
+            nstate = state
+        elif r.kind == "put":
+            nstate = r.value
+        else:  # append
+            nstate = state + r.value
+        nmask = mask & ~(1 << i)
+        # Memo on (mask, hash(state)), not the state string itself — an
+        # append-heavy search would otherwise retain one O(history-bytes)
+        # concatenation per explored node (Porcupine stores state hashes
+        # for the same reason; a 64-bit collision wrongly pruning a
+        # viable branch is ~(nodes²/2⁶⁴) — negligible at the node budget).
+        nk = (nmask, hash(nstate))
+        if nk in seen:
+            continue
+        seen.add(nk)
+        nodes += 1
+        if nodes > max_nodes:
+            return KeyResult(key, None, n, nodes)
+        stack.append((nmask, nstate, minimal(nmask), 0))
+    stuck = [recs[i].describe() for i in range(n)
+             if best_mask >> i & 1 and recs[i].ret is not None][:6]
+    return KeyResult(key, False, n, nodes, stuck_ops=stuck)

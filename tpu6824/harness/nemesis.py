@@ -1,0 +1,554 @@
+"""Deterministic nemesis — seeded, reproducible fault schedules.
+
+Every fault suite in this repo used to hand-script its own churn thread
+(`tests/test_wire_churn.py::churner`, per-test partition loops, ad-hoc
+kill/revive).  The nemesis engine replaces those with ONE schedule
+generator: a `FaultSchedule` is generated entirely up front from a seed —
+a list of `(t, action, args)` events — so any failure reproduces from
+`(seed, schedule)` alone, and a `Nemesis` thread injects the events into
+a target at their offsets, recording each injection with its actual wall
+timestamp.
+
+Two targets ship:
+
+  - `FabricTarget` — an in-process `PaxosFabric` (plus any services on
+    it): partitions/heals via the link masks, per-peer unreliable
+    toggles, kill/revive, clock pauses (GC + retire backlog pressure),
+    live pipeline-depth churn, and arbitrary caller-provided extra
+    actions (e.g. a shardkv reconfiguration trigger);
+  - `DeploymentTarget` — a wire `harness.Deployment`: per-server
+    unreliable accept loops, reversible deafness (socket path renamed
+    aside, `rpc.Server.deafen/undeafen`), and delay-proxy interposition.
+
+Schedule generation is a small state machine, not a memoryless sampler:
+revives target currently-killed peers, kills never exceed a minority per
+group (a majority can always exist once partitions heal), delay/deafen
+don't stack, and a restore tail at the end of the window heals/revives/
+un-delays everything so a soak always ends in a recoverable state (the
+runner ALSO calls `target.restore()` on exit, belt and braces).
+
+Replay: `TPU6824_NEMESIS_SEED` overrides a test's baked-in seed
+(`seed_from_env`), and a failure artifact written by the `nemesis_report`
+fixture (tests/conftest.py) carries the seed, the generated schedule, and
+the as-injected timeline plus the one-command replay line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import re
+import threading
+import time
+
+#: Relative frequency of each action in generated schedules.  Actions a
+#: target does not list in its spec() are skipped; extras default to
+#: EXTRA_WEIGHT unless listed here explicitly.
+DEFAULT_WEIGHTS = {
+    "partition_minority": 3.0,  # majority/minority split (progress holds)
+    "partition_random": 2.0,    # random 3-class split (TestManyPartition)
+    "partition_isolate": 1.0,   # every peer alone: NO majority until heal
+    "heal": 5.0,
+    "unreliable": 2.0,
+    "reliable": 2.0,
+    "kill": 1.5,
+    "revive": 3.0,
+    "clock_pause": 0.7,
+    "pipeline_depth": 0.7,
+    # deployment-target actions
+    "deafen": 1.5,
+    "undeafen": 3.0,
+    "delay_on": 1.5,
+    "delay_off": 3.0,
+}
+EXTRA_WEIGHT = 1.5
+
+
+def seed_from_env(default: int) -> int:
+    """A test's nemesis seed, overridable for one-command replay:
+    TPU6824_NEMESIS_SEED=<seed> python -m pytest <nodeid>."""
+    return int(os.environ.get("TPU6824_NEMESIS_SEED", default))
+
+
+@dataclasses.dataclass(frozen=True)
+class NemesisEvent:
+    t: float      # scheduled offset from nemesis start (seconds)
+    action: str
+    args: dict
+
+    def to_dict(self) -> dict:
+        return {"t": self.t, "action": self.action, "args": dict(self.args)}
+
+
+class FaultSchedule:
+    """An immutable, fully-materialized fault timeline.  Equality is by
+    event content — two schedules generated from the same (seed, spec,
+    params) compare equal, which is the determinism contract the replay
+    tests assert."""
+
+    def __init__(self, events: list[NemesisEvent], seed: int | None = None,
+                 params: dict | None = None):
+        self.events = list(events)
+        self.seed = seed
+        self.params = dict(params or {})
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __len__(self):
+        return len(self.events)
+
+    def __eq__(self, other):
+        return (isinstance(other, FaultSchedule)
+                and self.events == other.events)
+
+    def signature(self) -> list[tuple]:
+        """Content signature (what replay must reproduce exactly)."""
+        return [(round(e.t, 9), e.action, tuple(sorted(e.args.items())))
+                for e in self.events]
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed, "params": self.params,
+                "events": [e.to_dict() for e in self.events]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSchedule":
+        return cls([NemesisEvent(e["t"], e["action"], dict(e["args"]))
+                    for e in d["events"]],
+                   seed=d.get("seed"), params=d.get("params"))
+
+    @classmethod
+    def from_json(cls, path: str) -> "FaultSchedule":
+        """Load the exact event list from a failure artifact — byte-exact
+        replay even if generation parameters have since changed."""
+        with open(path) as f:
+            d = json.load(f)
+        return cls.from_dict(d["schedule"] if "schedule" in d else d)
+
+    # ------------------------------------------------------- generation
+
+    @classmethod
+    def generate(cls, seed: int, duration: float, spec: dict,
+                 weights: dict | None = None,
+                 min_gap: float = 0.05, max_gap: float = 0.25
+                 ) -> "FaultSchedule":
+        """Deterministic schedule over `duration` seconds for a target
+        described by `spec` (target.spec()).  Same (seed, duration, spec,
+        weights, gaps) → identical schedule, always."""
+        rng = random.Random(seed)
+        acts = list(spec["actions"])
+        w = dict(DEFAULT_WEIGHTS)
+        w.update(weights or {})
+        events: list[NemesisEvent] = []
+        st = _GenState(spec)
+        t = 0.0
+        while True:
+            t += rng.uniform(min_gap, max_gap)
+            if t >= duration:
+                break
+            avail = [a for a in acts if st.applicable(a)]
+            if not avail:
+                continue
+            wts = [w.get(a, EXTRA_WEIGHT) for a in avail]
+            action = rng.choices(avail, weights=wts, k=1)[0]
+            args = st.sample(action, rng)
+            if args is None:
+                continue
+            events.append(NemesisEvent(round(t, 6), action, args))
+        # Restore tail: end every schedule in a healed, fully-live state.
+        t = duration
+        for action, args in st.restore_tail():
+            events.append(NemesisEvent(round(t, 6), action, args))
+            t += 0.01
+        return cls(events, seed=seed,
+                   params={"duration": duration, "spec": spec,
+                           "min_gap": min_gap, "max_gap": max_gap,
+                           "weights": weights or {}})
+
+
+class _GenState:
+    """Generation-time bookkeeping so sampled events stay coherent (see
+    module docstring)."""
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.kind = spec.get("kind", "fabric")
+        self.groups = list(spec.get("groups", []))
+        self.P = int(spec.get("npeers", 0))
+        self.names = list(spec.get("names", []))
+        self.killed: dict[int, set] = {g: set() for g in self.groups}
+        self.partitioned: set = set()
+        self.unreliable: set = set()  # (g, p) or name
+        self.deaf: set = set()
+        self.delayed: set = set()
+
+    def _max_killed(self) -> int:
+        return max(0, (self.P - 1) // 2)
+
+    def applicable(self, a: str) -> bool:
+        if a == "revive":
+            return any(self.killed.get(g) for g in self.groups)
+        if a == "kill":
+            return any(len(self.killed.get(g, ())) < self._max_killed()
+                       for g in self.groups)
+        if a == "reliable":
+            return bool(self.unreliable)
+        if a == "undeafen":
+            return bool(self.deaf)
+        if a == "delay_off":
+            return bool(self.delayed)
+        if a in ("deafen", "delay_on"):
+            return bool(self._quiet_names())
+        return True
+
+    def _quiet_names(self):
+        return [x for x in self.names
+                if x not in self.deaf and x not in self.delayed]
+
+    def sample(self, action: str, rng: random.Random) -> dict | None:
+        g = rng.choice(self.groups) if self.groups else None
+        P = self.P
+        if action == "partition_minority":
+            maj = sorted(rng.sample(range(P), P // 2 + 1))
+            minr = [p for p in range(P) if p not in maj]
+            self.partitioned.add(g)
+            return {"g": g, "parts": [maj, minr]}
+        if action == "partition_random":
+            classes: list[list[int]] = [[], [], []]
+            for p in range(P):
+                classes[rng.randrange(3)].append(p)
+            self.partitioned.add(g)
+            return {"g": g, "parts": [c for c in classes if c]}
+        if action == "partition_isolate":
+            self.partitioned.add(g)
+            return {"g": g, "parts": [[p] for p in range(P)]}
+        if action == "heal":
+            # Target an actually-partitioned group when one exists (as
+            # revive targets killed peers): with many groups a uniform
+            # pick would mostly heal healthy groups and leave a
+            # partitioned one majority-less far longer than the heal
+            # weight suggests.
+            if self.partitioned:
+                g = rng.choice(sorted(self.partitioned))
+            self.partitioned.discard(g)
+            return {"g": g}
+        if action == "unreliable":
+            if self.kind == "deployment":
+                name = rng.choice(self.names)
+                self.unreliable.add(name)
+                return {"name": name, "flag": True}
+            p = rng.randrange(P)
+            self.unreliable.add((g, p))
+            return {"g": g, "p": p, "flag": True}
+        if action == "reliable":
+            tgt = rng.choice(sorted(self.unreliable, key=repr))
+            self.unreliable.discard(tgt)
+            if self.kind == "deployment":
+                return {"name": tgt, "flag": False}
+            return {"g": tgt[0], "p": tgt[1], "flag": False}
+        if action == "kill":
+            cands = [gg for gg in self.groups
+                     if len(self.killed[gg]) < self._max_killed()]
+            if not cands:
+                return None
+            g = rng.choice(cands)
+            p = rng.choice([p for p in range(P)
+                            if p not in self.killed[g]])
+            self.killed[g].add(p)
+            return {"g": g, "p": p}
+        if action == "revive":
+            cands = [gg for gg in self.groups if self.killed[gg]]
+            g = rng.choice(cands)
+            p = rng.choice(sorted(self.killed[g]))
+            self.killed[g].discard(p)
+            return {"g": g, "p": p}
+        if action == "clock_pause":
+            return {"dur": round(rng.uniform(0.05, 0.2), 6)}
+        if action == "pipeline_depth":
+            return {"depth": rng.choice([1, 2, 3])}
+        if action == "deafen":
+            name = rng.choice(self._quiet_names())
+            self.deaf.add(name)
+            return {"name": name}
+        if action == "undeafen":
+            name = rng.choice(sorted(self.deaf))
+            self.deaf.discard(name)
+            return {"name": name}
+        if action == "delay_on":
+            name = rng.choice(self._quiet_names())
+            self.delayed.add(name)
+            return {"name": name, "delay": round(rng.uniform(0.01, 0.08), 6)}
+        if action == "delay_off":
+            name = rng.choice(sorted(self.delayed))
+            self.delayed.discard(name)
+            return {"name": name}
+        return {}  # extra action: no args
+
+    def restore_tail(self) -> list[tuple[str, dict]]:
+        tail: list[tuple[str, dict]] = []
+        for g in sorted(self.partitioned):
+            tail.append(("heal", {"g": g}))
+        for g in sorted(self.killed):
+            for p in sorted(self.killed[g]):
+                tail.append(("revive", {"g": g, "p": p}))
+        for tgt in sorted(self.unreliable, key=repr):
+            if self.kind == "deployment":
+                tail.append(("reliable", {"name": tgt, "flag": False}))
+            else:
+                tail.append(("reliable",
+                             {"g": tgt[0], "p": tgt[1], "flag": False}))
+        for name in sorted(self.delayed):
+            tail.append(("delay_off", {"name": name}))
+        for name in sorted(self.deaf):
+            tail.append(("undeafen", {"name": name}))
+        return tail
+
+
+# ------------------------------------------------------------------ targets
+
+
+class FabricTarget:
+    """Nemesis adapter over an in-process PaxosFabric (and the services
+    riding it).  `groups` limits which fabric lanes the nemesis may touch
+    (e.g. exclude a shardmaster group); `extra` maps action-name →
+    zero-arg callable, sampled by the generator like any other action
+    (the hook shardkv soaks use to make reconfiguration a schedule-driven
+    fault dimension)."""
+
+    ACTIONS = ["partition_minority", "partition_random", "partition_isolate",
+               "heal", "unreliable", "reliable", "kill", "revive",
+               "clock_pause", "pipeline_depth"]
+
+    def __init__(self, fabric, groups=None, extra: dict | None = None,
+                 actions: list[str] | None = None):
+        self.fabric = fabric
+        self.groups = list(range(fabric.G) if groups is None else groups)
+        self.extra = dict(extra or {})
+        self.actions = list(self.ACTIONS if actions is None else actions)
+        self._depth0 = fabric.pipeline_depth
+        self._clock0 = fabric.clock_running
+
+    def spec(self) -> dict:
+        return {"kind": "fabric", "groups": self.groups,
+                "npeers": self.fabric.P,
+                "actions": self.actions + sorted(self.extra)}
+
+    def apply(self, action: str, args: dict) -> None:
+        f = self.fabric
+        if action in ("partition_minority", "partition_random",
+                      "partition_isolate"):
+            f.partition(args["g"], *args["parts"])
+        elif action == "heal":
+            f.heal(args["g"])
+        elif action in ("unreliable", "reliable"):
+            f.set_unreliable(args["flag"], g=args["g"], p=args["p"])
+        elif action == "kill":
+            f.kill(args["g"], args["p"])
+        elif action == "revive":
+            f.revive(args["g"], args["p"])
+        elif action == "clock_pause":
+            f.stop_clock()
+            time.sleep(args["dur"])
+            if self._clock0:
+                f.start_clock()  # never start a clock the owner didn't run
+        elif action == "pipeline_depth":
+            f.set_pipeline_depth(args["depth"])
+        elif action in self.extra:
+            self.extra[action](**args)
+        else:
+            raise ValueError(f"unknown fabric nemesis action {action!r}")
+
+    def restore(self) -> None:
+        f = self.fabric
+        for g in self.groups:
+            for p in range(f.P):
+                if f.is_dead(g, p):
+                    f.revive(g, p)
+            f.heal(g)
+            f.set_unreliable(False, g=g)
+        f.set_pipeline_depth(self._depth0)
+        if self._clock0:
+            f.start_clock()  # a clock_pause interrupted mid-flight
+
+
+class DeploymentTarget:
+    """Nemesis adapter over a wire `harness.Deployment`: reversible
+    deafness (socket path renamed aside), per-server unreliable accept
+    loops, and delay-proxy interposition — the same schedule engine, over
+    real sockets."""
+
+    ACTIONS = ["unreliable", "reliable", "deafen", "undeafen",
+               "delay_on", "delay_off"]
+
+    def __init__(self, dep, names: list[str],
+                 actions: list[str] | None = None):
+        self.dep = dep
+        self.names = list(names)
+        self.actions = list(self.ACTIONS if actions is None else actions)
+
+    def spec(self) -> dict:
+        return {"kind": "deployment", "names": self.names,
+                "actions": list(self.actions)}
+
+    def apply(self, action: str, args: dict) -> None:
+        dep = self.dep
+        if action in ("unreliable", "reliable"):
+            dep.set_unreliable(args["name"], args["flag"])
+        elif action == "deafen":
+            dep.deafen(args["name"])
+        elif action == "undeafen":
+            dep.undeafen(args["name"])
+        elif action == "delay_on":
+            dep.interpose_delay(args["name"], args["delay"])
+        elif action == "delay_off":
+            dep.remove_delay(args["name"])
+        else:
+            raise ValueError(f"unknown deployment nemesis action {action!r}")
+
+    def restore(self) -> None:
+        for name in self.names:
+            for fn in (lambda n=name: self.dep.remove_delay(n),
+                       lambda n=name: self.dep.undeafen(n),
+                       lambda n=name: self.dep.set_unreliable(n, False)):
+                try:
+                    fn()
+                except Exception:
+                    pass
+
+
+# ------------------------------------------------------------------- runner
+
+
+class Nemesis:
+    """Executes a FaultSchedule against a target in a daemon thread,
+    recording every injection.  The recorded timeline's (t, action, args)
+    sequence is a pure function of the schedule — replaying the same seed
+    injects the identical fault sequence; only the `wall` stamps differ."""
+
+    def __init__(self, target, schedule: FaultSchedule):
+        self.target = target
+        self.schedule = schedule
+        self.timeline: list[dict] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.t0: float | None = None
+
+    def start(self) -> "Nemesis":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        self.t0 = time.monotonic()
+        try:
+            for ev in self.schedule:
+                while not self._stop.is_set():
+                    dt = ev.t - (time.monotonic() - self.t0)
+                    if dt <= 0:
+                        break
+                    self._stop.wait(min(dt, 0.05))
+                if self._stop.is_set():
+                    break
+                rec = {"t": ev.t,
+                       "wall": round(time.monotonic() - self.t0, 6),
+                       "action": ev.action, "args": dict(ev.args)}
+                try:
+                    self.target.apply(ev.action, ev.args)
+                except Exception as e:  # noqa: BLE001 — recorded, not fatal
+                    rec["error"] = repr(e)
+                self.timeline.append(rec)
+        finally:
+            try:
+                self.target.restore()
+            except Exception:  # noqa: BLE001 — restore is best-effort
+                pass
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def stop(self) -> None:
+        """Abort outstanding events (the target is still restored)."""
+        self._stop.set()
+        self.join()
+
+    @property
+    def done(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()
+
+    def signature(self) -> list[tuple]:
+        """(t, action, args) of every INJECTED event — the replay-identity
+        object (wall stamps and error strings excluded)."""
+        return [(round(r["t"], 9), r["action"],
+                 tuple(sorted(r["args"].items())))
+                for r in self.timeline]
+
+
+# ----------------------------------------------------------------- artifact
+
+
+class ReplayArtifact:
+    """Failure-replay capsule a nemesis test registers with the
+    `nemesis_report` fixture: on test failure the fixture prints the seed
+    + fault timeline and writes /tmp/nemesis-<test>.json carrying
+    everything needed to re-run the identical schedule."""
+
+    def __init__(self, test: str = ""):
+        self.test = test
+        self.seed: int | None = None
+        self.schedule: FaultSchedule | None = None
+        self.nemesis: Nemesis | None = None
+        self.extra: dict = {}
+
+    def attach(self, nemesis: Nemesis | None = None, seed: int | None = None,
+               schedule: FaultSchedule | None = None, **extra) -> None:
+        if nemesis is not None:
+            self.nemesis = nemesis
+            self.schedule = schedule or nemesis.schedule
+        if schedule is not None:
+            self.schedule = schedule
+        if seed is not None:
+            self.seed = seed
+        elif self.schedule is not None and self.schedule.seed is not None:
+            self.seed = self.schedule.seed
+        self.extra.update(extra)
+
+    @property
+    def attached(self) -> bool:
+        return self.schedule is not None or self.nemesis is not None
+
+    def replay_command(self) -> str:
+        seed = "<seed>" if self.seed is None else self.seed
+        return (f"TPU6824_NEMESIS_SEED={seed} "
+                f"python -m pytest '{self.test}'")
+
+    def to_dict(self) -> dict:
+        d = {"test": self.test, "seed": self.seed,
+             "replay": self.replay_command(), "extra": self.extra}
+        if self.schedule is not None:
+            d["schedule"] = self.schedule.to_dict()
+        if self.nemesis is not None:
+            d["timeline"] = list(self.nemesis.timeline)
+        return d
+
+    def write(self, outdir: str = "/tmp") -> str:
+        base = re.sub(r"[^A-Za-z0-9_.-]+", "_",
+                      self.test.split("::")[-1] or "nemesis")
+        path = os.path.join(outdir, f"nemesis-{base}.json")
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, default=str)
+        return path
+
+    def describe(self) -> str:
+        lines = [f"nemesis seed: {self.seed}",
+                 f"replay: {self.replay_command()}"]
+        timeline = (self.nemesis.timeline if self.nemesis is not None
+                    else [e.to_dict() for e in (self.schedule or [])])
+        lines.append(f"fault timeline ({len(timeline)} events):")
+        for r in timeline:
+            err = f"  ERROR {r['error']}" if r.get("error") else ""
+            lines.append(f"  t={r['t']:+8.3f}s {r['action']} "
+                         f"{r['args']}{err}")
+        return "\n".join(lines)

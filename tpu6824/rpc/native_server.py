@@ -131,9 +131,24 @@ class NativeServer:
             return self._final_rpc_count  # post-kill reads stay valid
 
     def deafen(self) -> None:
+        """Reversible deafness, same contract as transport.Server: the
+        socket path is renamed aside in Python (the C++ loop keeps its
+        bound inode and never touches the path again), so undeafen() can
+        restore it.  The lib's rpcsrv_deafen (one-way unlink) is no
+        longer used — rename gives identical dial-failure semantics."""
         with self._lock:
             if self._srv is not None and not self._dead:
-                self._lib.rpcsrv_deafen(self._srv)
+                try:
+                    os.rename(self.addr, self.addr + ".deaf")
+                except FileNotFoundError:
+                    pass
+
+    def undeafen(self) -> None:
+        with self._lock:
+            try:
+                os.rename(self.addr + ".deaf", self.addr)
+            except FileNotFoundError:
+                pass
 
     def kill(self) -> None:
         with self._lock:
@@ -144,6 +159,10 @@ class NativeServer:
                 self._final_rpc_count = int(
                     self._lib.rpcsrv_rpc_count(self._srv))
                 self._lib.rpcsrv_kill(self._srv)
+                try:  # a deafened server's bound inode lives at .deaf
+                    os.unlink(self.addr + ".deaf")
+                except FileNotFoundError:
+                    pass
                 # kill joined the loop → no new callbacks; the lock ensures
                 # no in-flight _send_reply still holds the old pointer.
                 self._lib.rpcsrv_free(self._srv)

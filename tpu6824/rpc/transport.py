@@ -339,7 +339,7 @@ class Server:
     # net/rpc excludes them via its method-signature filter; we use an
     # explicit denylist + opt-in RPC_METHODS).
     _NEVER_EXPORT = frozenset(
-        {"kill", "start", "stop", "deafen", "revive",
+        {"kill", "start", "stop", "deafen", "undeafen", "revive",
          "set_unreliable", "die_after_next_deaf"}
     )
 
@@ -362,10 +362,11 @@ class Server:
             self._sock.close()
         except OSError:
             pass
-        try:
-            os.unlink(self.addr)
-        except FileNotFoundError:
-            pass
+        for path in (self.addr, self.addr + ".deaf"):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
         # Persistent connections may be parked in recv awaiting the next
         # request; close them so serving threads exit and pooled clients
         # see EOF instead of a 30s stall.
@@ -386,9 +387,22 @@ class Server:
     def deafen(self) -> None:
         """Remove the socket path out from under the live server: existing
         inode keeps listening but nobody can dial it
-        (`paxos/test_test.go:194-195`)."""
+        (`paxos/test_test.go:194-195`).  The path is renamed aside rather
+        than unlinked so `undeafen()` can restore it — semantically
+        identical to dialers (the public path is gone either way; pooled
+        clients fail their stat revalidation), but reversible, which is
+        what lets the nemesis engine use deafness as a schedulable fault
+        instead of a one-way door."""
         try:
-            os.unlink(self.addr)
+            os.rename(self.addr, self.addr + ".deaf")
+        except FileNotFoundError:
+            pass  # already deaf, or killed
+
+    def undeafen(self) -> None:
+        """Restore a deafened server's public path (inverse of deafen);
+        a no-op when not deaf."""
+        try:
+            os.rename(self.addr + ".deaf", self.addr)
         except FileNotFoundError:
             pass
 

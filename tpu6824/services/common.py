@@ -10,8 +10,10 @@ accept-loop rates (`paxos/paxos.go:528-544`)."""
 
 from __future__ import annotations
 
+import os
 import random
 import threading
+import time
 
 from tpu6824.utils.errors import RPCError
 
@@ -19,6 +21,60 @@ REQ_DROP = 0.10
 REP_DROP = 0.20
 
 _sysrand = random.SystemRandom()
+
+
+class Backoff:
+    """Clerk retry pacing: capped exponential backoff with DECORRELATED
+    jitter (base 2ms, cap 100ms) by default, or the reference's fixed
+    cadence via TPU6824_CLERK_BACKOFF=fixed.
+
+    The reference clerks sleep a flat 10ms between retries
+    (`kvpaxos/client.go:69-104` and kin) — under partition churn every
+    blocked clerk then retries in phase, hammering the same minority
+    server at 100Hz exactly when it can least make progress.
+    Decorrelated jitter (sleep' = U(base, 3·sleep), capped) spreads the
+    herd AND backs a long outage off toward the cap, while the first
+    retry stays ~2ms so transient blips cost less latency than the flat
+    10ms did.  `reset()` after a success so the next outage starts from
+    the base again.
+
+    Mode resolution: explicit `mode` arg > $TPU6824_CLERK_BACKOFF >
+    jitter.  `fixed` keeps the 10ms cadence (fidelity tests pin this);
+    unknown values fall back to jitter.  Each Backoff owns a seeded RNG,
+    so a seeded clerk's retry pattern is reproducible."""
+
+    FIXED_SLEEP = 0.01  # the reference cadence (fixed mode)
+
+    def __init__(self, base: float = 0.002, cap: float = 0.1,
+                 mode: str | None = None, seed: int | None = None,
+                 fixed_sleep: float = FIXED_SLEEP):
+        self.base = base
+        self.cap = cap
+        self.mode = mode or os.environ.get("TPU6824_CLERK_BACKOFF", "jitter")
+        self.fixed_sleep = fixed_sleep
+        self._rng = random.Random(seed) if seed is not None \
+            else random.Random(_sysrand.getrandbits(62))
+        self._sleep = base
+
+    def next_interval(self) -> float:
+        if self.mode == "fixed":
+            return self.fixed_sleep
+        s = min(self.cap, self._rng.uniform(self.base, self._sleep * 3))
+        self._sleep = s
+        return s
+
+    def sleep(self, max_s: float | None = None) -> float:
+        """Sleep the next interval, clamped to `max_s` (callers pass their
+        remaining deadline so a capped 100ms backoff can never overshoot a
+        short op timeout)."""
+        dt = self.next_interval()
+        if max_s is not None:
+            dt = max(0.0, min(dt, max_s))
+        time.sleep(dt)
+        return dt
+
+    def reset(self) -> None:
+        self._sleep = self.base
 
 
 def fresh_cid() -> int:

@@ -23,7 +23,7 @@ from typing import NamedTuple
 
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
-from tpu6824.services.common import DecidedTap, FlakyNet, fresh_cid
+from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
 from tpu6824.utils.errors import OK, ErrNoKey, RPCError
 from tpu6824.utils.profiling import PhaseProfiler
 
@@ -95,6 +95,13 @@ class KVPaxosServer:
         self.dup: dict[int, tuple[int, object]] = {}  # cid -> (max cseq, reply)
         self.op_timeout = op_timeout
         self.dead = False
+        # TEST-ONLY linearizability fault hook: True disables at-most-once
+        # duplicate suppression everywhere (apply, submit dedup, proposal
+        # collection), so a clerk retry after a dropped reply re-applies —
+        # the classic lost-dup-table bug.  Exists so the Wing–Gong checker
+        # (harness/linearize.py) can prove it catches a real violation;
+        # never set outside tests.
+        self._test_disable_dup = False
         self._waiters: dict[tuple[int, int], _Fut] = {}  # (cid, cseq) -> fut
         self._subq: list[Op] = []        # submitted, not yet proposed
         self._inflight: dict[int, Op] = {}  # seq -> my undecided proposal
@@ -127,7 +134,7 @@ class KVPaxosServer:
         with at-most-once duplicate suppression; resolves any waiter parked
         on this (cid, cseq)."""
         seen, reply = self.dup.get(op.cid, (-1, None))
-        if op.cseq > seen:
+        if op.cseq > seen or self._test_disable_dup:
             if op.kind == "get":
                 reply = ((OK, self.kv[op.key]) if op.key in self.kv
                          else (ErrNoKey, ""))
@@ -167,12 +174,13 @@ class KVPaxosServer:
         kv_get = kv.get
         dup_get = dup.get
         waiters_pop = self._waiters.pop
+        nodup = self._test_disable_dup
         notif = []
         for v in vals:
             self.applied += 1
             if isinstance(v, Op):
                 seen, reply = dup_get(v.cid, (-1, None))
-                if v.cseq > seen:
+                if v.cseq > seen or nodup:
                     kind = v.kind
                     if kind == "get":
                         reply = ((OK, kv[v.key]) if v.key in kv
@@ -321,7 +329,7 @@ class KVPaxosServer:
             if key not in self._waiters:
                 continue  # timed out, resolved, or already applied
             seen, _ = self.dup.get(op.cid, (-1, None))
-            if op.cseq <= seen:
+            if op.cseq <= seen and not self._test_disable_dup:
                 continue  # applied via another replica's proposal
             props.append((nxt, op))
             self._inflight[nxt] = op
@@ -341,6 +349,10 @@ class KVPaxosServer:
 
     def _drive_loop(self):
         px = self.px
+        # Backend-outage retry pacing: jittered exponential backoff (cap
+        # 100ms) instead of a fixed 20ms — N drivers behind one restarting
+        # fabricd must not re-dial it in phase at 50Hz each.
+        bo = Backoff(fixed_sleep=0.02)
         start_many = getattr(px, "start_many", None)
         status_many = getattr(
             px, "status_many",
@@ -426,12 +438,13 @@ class KVPaxosServer:
                             wait_progress(0.25)
                         if time.monotonic() - t0 < 0.001:
                             time.sleep(0.002)
+                bo.reset()  # a full pass succeeded: next outage starts cold
             except RPCError:
                 # Transient backend outage (e.g. a fabricd restarting from
                 # a checkpoint behind a remote_fabric handle): keep the
-                # driver alive and retry at the old ticker's cadence —
+                # driver alive and retry with capped jittered backoff —
                 # shardkv's ticker has the same tolerance.
-                time.sleep(0.02)
+                bo.sleep()
                 continue
             except Exception:  # noqa: BLE001 — singleton thread
                 # The driver is the server's only engine: if it dies, no
@@ -458,9 +471,10 @@ class KVPaxosServer:
             dup = self.dup
             waiters = self._waiters
             subq = self._subq
+            nodup = self._test_disable_dup
             for op in ops:
                 seen, reply = dup.get(op.cid, (-1, None))
-                if op.cseq <= seen:
+                if op.cseq <= seen and not nodup:
                     fut = _Fut()
                     fut.set(reply)
                 else:
@@ -521,6 +535,10 @@ class Clerk:
         self.cid = fresh_cid()
         self.cseq = 0
         self.mu = threading.Lock()
+        # Retry pacing: capped exponential + decorrelated jitter by
+        # default; TPU6824_CLERK_BACKOFF=fixed restores the reference's
+        # flat 10ms (kvpaxos/client.go:69-104) for fidelity runs.
+        self._backoff = Backoff()
 
     def _next(self) -> int:
         with self.mu:
@@ -531,6 +549,7 @@ class Clerk:
         cseq = self._next()
         deadline = time.monotonic() + timeout if timeout else None
         i = 0
+        self._backoff.reset()
         while True:
             srv = self.servers[i % len(self.servers)]
             i += 1
@@ -540,9 +559,10 @@ class Clerk:
                 return err, val
             except RPCError:
                 pass
-            if deadline and time.monotonic() >= deadline:
+            now = time.monotonic()
+            if deadline and now >= deadline:
                 raise RPCError("clerk timeout")
-            time.sleep(0.01)
+            self._backoff.sleep(deadline - now if deadline else None)
 
     def get(self, key: str, timeout=None) -> str:
         err, val = self._loop("get", key, timeout=timeout)
@@ -578,6 +598,7 @@ class PipelinedClerk:
         self.op_timeout = op_timeout
         self.clients = [[fresh_cid(), 0] for _ in range(width)]
         self._leader = 0
+        self._backoff = Backoff()  # same knob semantics as Clerk's
 
     def append_wave(self, key: str, values: list[str]) -> None:
         """Append values[c] as logical client c (len(values) <= width),
@@ -708,6 +729,7 @@ class PipelinedClerk:
         instead of spinning forever."""
         deadline = time.monotonic() + self.op_timeout
         i = self._leader + 1
+        self._backoff.reset()
         while True:
             srv = self.servers[i % len(self.servers)]
             i += 1
@@ -716,15 +738,17 @@ class PipelinedClerk:
                 self._leader = (i - 1) % len(self.servers)
                 return
             except RPCError:
-                if time.monotonic() >= deadline:
+                now = time.monotonic()
+                if now >= deadline:
                     raise RPCError(
                         f"pipelined clerk: op ({op.cid},{op.cseq}) found "
                         f"no live majority within {self.op_timeout}s")
-                time.sleep(0.01)
+                self._backoff.sleep(deadline - now)
 
     def get(self, key: str) -> str:
         """Linearizable read through any live replica (plain path)."""
         i = self._leader
+        self._backoff.reset()
         while True:
             srv = self.servers[i % len(self.servers)]
             i += 1
@@ -735,7 +759,7 @@ class PipelinedClerk:
                 err, val = srv.get(key, cid, cseq)
                 return val if err == OK else ""
             except RPCError:
-                time.sleep(0.01)
+                self._backoff.sleep()
 
 
 def make_cluster(nservers=3, ninstances=64, fabric=None, g=0, **kw):

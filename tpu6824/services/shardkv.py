@@ -39,7 +39,7 @@ from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.ops.hashing import NSHARDS, key2shard
 from tpu6824.services import shardmaster
-from tpu6824.services.common import DecidedTap, FlakyNet, fresh_cid
+from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
 from tpu6824.services.shardmaster import Config
 from tpu6824.utils.errors import (
     OK,
@@ -406,6 +406,10 @@ class Clerk:
         self.cseq = 0
         self.mu = threading.Lock()
         self.config = Config.initial()
+        # Retry pacing: jittered exponential backoff (base 2ms, cap
+        # 100ms); TPU6824_CLERK_BACKOFF=fixed keeps this clerk's original
+        # flat 20ms between config re-queries.
+        self._backoff = Backoff(fixed_sleep=0.02)
 
     def _next(self):
         with self.mu:
@@ -415,6 +419,7 @@ class Clerk:
     def _loop(self, fn_name, key, *args, timeout=None):
         cseq = self._next()
         deadline = time.monotonic() + timeout if timeout else None
+        self._backoff.reset()
         while True:
             shard = key2shard(key)
             gid = self.config.shards[shard]
@@ -431,9 +436,10 @@ class Clerk:
                 if err == ErrWrongGroup:
                     break
                 return err, val
-            if deadline and time.monotonic() >= deadline:
+            now = time.monotonic()
+            if deadline and now >= deadline:
                 raise RPCError("clerk timeout")
-            time.sleep(0.02)
+            self._backoff.sleep(deadline - now if deadline else None)
             self.config = self.smck.query(-1)
 
     def get(self, key: str, timeout=None) -> str:
