@@ -46,6 +46,13 @@ def pytest_configure(config):
         "nemesis_report fixture prints the seed + fault timeline and "
         "writes /tmp/nemesis-<test>.json for one-command replay",
     )
+    config.addinivalue_line(
+        "markers",
+        "sanitize: runs under the tpusan lockwatch runtime sanitizer "
+        "(lock-order cycles + hold-budget violations fail the test); "
+        "smokes are tier-1, soaks carry `slow` too.  TPU6824_SANITIZE=1 "
+        "additionally sanitizes the whole session",
+    )
 
 
 @pytest.hookimpl(tryfirst=True, hookwrapper=True)
@@ -55,6 +62,47 @@ def pytest_runtest_makereport(item, call):
     outcome = yield
     rep = outcome.get_result()
     setattr(item, "rep_" + rep.when, rep)
+
+
+@pytest.fixture
+def sanitize():
+    """Run the test under the tpusan lockwatch sanitizer: locks created
+    during the test (including every fabric/service lock, via the
+    `tpu6824.utils.locks` seam that also attaches names and hold-time
+    budgets) are instrumented; teardown fails the test on lock-order
+    cycles (deadlock potential) or hold-budget violations.  The fixture
+    yields the lockwatch module so tests can also assert on
+    `lockwatch.snapshot()` mid-run."""
+    from tpu6824.analysis import lockwatch
+
+    if lockwatch.enabled():  # TPU6824_SANITIZE=1 session: already on
+        yield lockwatch
+        return
+    lockwatch.enable()
+    try:
+        yield lockwatch
+    finally:
+        report = lockwatch.disable()
+    cycles = report.cycles()
+    assert not cycles, f"lock-order cycle(s):\n{report.describe()}"
+    assert not report.violations, \
+        f"lock hold-budget violation(s):\n{report.describe()}"
+
+
+if os.environ.get("TPU6824_SANITIZE") == "1":
+
+    @pytest.fixture(autouse=True, scope="session")
+    def _sanitize_session():
+        """TPU6824_SANITIZE=1: sanitize the whole pytest session.  The
+        report prints at session end; cycles/violations fail loudly."""
+        from tpu6824.analysis import lockwatch
+
+        lockwatch.enable()
+        yield
+        report = lockwatch.disable()
+        sys.stderr.write("\n" + report.describe() + "\n")
+        assert not report.cycles() and not report.violations, \
+            report.describe()
 
 
 @pytest.fixture

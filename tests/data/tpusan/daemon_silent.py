@@ -1,0 +1,27 @@
+"""tpusan golden fixture: daemon threads dying silently.
+
+Expected findings: daemon-crash-sink at both Thread() spawns (neither
+target routes exceptions to the crash sink) and daemon-bare-except at
+the swallow-everything handler inside the run loop.
+"""
+
+import threading
+
+
+class Service:
+    def start(self):
+        t = threading.Thread(target=self._loop, daemon=True)  # finding
+        t.start()
+        threading.Thread(target=_orphan, daemon=True).start()  # finding
+
+    def _loop(self):
+        while not self.dead:
+            try:
+                self.tick()
+            except Exception:   # finding: swallowed, nothing recorded
+                pass
+
+
+def _orphan():
+    while True:
+        pass

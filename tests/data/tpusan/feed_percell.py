@@ -1,0 +1,18 @@
+"""tpusan golden fixture: decided-feed consumer bypassing the columnar
+contract.
+
+Expected findings: feed-columnar at the private-queue access AND the
+module-level "subscribes but never drains columnar" finding.
+"""
+
+
+class Replica:
+    def __init__(self, fabric, g, p):
+        self.sub = fabric.subscribe_decided(g, p)
+
+    def apply_loop(self):
+        while True:
+            while self.sub._q:               # finding: private queue
+                seqs, vals = self.sub._q.popleft()   # finding: again
+                for s, v in zip(seqs, vals):
+                    self.apply(s, v)

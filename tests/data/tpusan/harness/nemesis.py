@@ -1,0 +1,23 @@
+"""tpusan golden fixture: nondeterminism in a schedule-deterministic path.
+
+(The filename matters: it puts this fixture in the analyzer's
+deterministic-path scope.)  Expected findings: nondet-clock at the wall
+clock read and at both process-global RNG draws.
+"""
+
+import random
+import time
+
+
+def generate_schedule(duration):
+    t0 = time.time()                    # finding: wall clock, not monotonic
+    events = []
+    while time.monotonic() - t0 < duration:   # monotonic itself is fine
+        action = random.choice(["kill", "heal"])   # finding: global RNG
+        events.append((random.random(), action))   # finding: global RNG
+    return events
+
+
+def seeded_ok(seed):
+    rng = random.Random(seed)  # constructing a seeded RNG is the fix
+    return rng.random()

@@ -1,0 +1,25 @@
+"""tpusan golden fixture: malformed / stale suppressions.
+
+Expected findings: bad-suppression at the reason-less and unknown-rule
+comments, unused-suppression at the one matching nothing — and the
+underlying lock-blocking-call still fires because neither bad comment
+suppresses it.
+"""
+
+import time
+
+
+class Sloppy:
+    def hold(self):
+        with self.mu:
+            # tpusan: ok(lock-blocking-call)
+            time.sleep(0.01)
+
+    def wrong_rule(self):
+        with self.mu:
+            # tpusan: ok(no-such-rule) — confidently wrong
+            time.sleep(0.01)
+
+    def stale(self):
+        # tpusan: ok(lock-nested-loop) — nothing here trips that rule
+        return 1

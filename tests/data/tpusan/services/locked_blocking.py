@@ -1,0 +1,22 @@
+"""tpusan golden fixture: blocking calls under a lock region.
+
+Expected findings: lock-blocking-call at the sleep, the socket recv,
+and the device readback.  Never imported — linted by tests/test_analysis.py.
+"""
+
+import time
+
+import jax
+
+
+class Server:
+    def slow_path(self, sock):
+        with self._lock:
+            time.sleep(0.5)            # finding: sleep under the lock
+            data = sock.recv(4096)     # finding: socket read under the lock
+            return data
+
+    def readback_locked(self):
+        # *_locked suffix: runs under the lock by convention.
+        mirror = jax.device_get(self._state)  # finding: device readback
+        return mirror

@@ -1,0 +1,13 @@
+"""tpusan golden fixture: per-cell Python loop under the lock.
+
+Expected findings: lock-nested-loop at the inner loop — the TUNING
+round-7 regression shape (per-cell fan-out under the fabric lock).
+"""
+
+
+class Fanout:
+    def deliver(self, cells):
+        with self.mu:
+            for g in range(self.G):
+                for i in range(self.I):   # finding: nested loop under lock
+                    self.queues[g].append(cells[g][i])

@@ -1,0 +1,15 @@
+"""tpusan golden fixture: a correctly-justified suppression.
+
+Expected: ZERO active findings — the sleep under the lock is suppressed
+with a rule name and a reason, which is the shipped suppression format.
+"""
+
+import time
+
+
+class Cold:
+    def drain(self):
+        with self._lock:
+            # tpusan: ok(lock-blocking-call) — boot-time drain before any
+            # client can contend for this lock; pacing is the point.
+            time.sleep(0.01)

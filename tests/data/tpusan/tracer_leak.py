@@ -1,0 +1,29 @@
+"""tpusan golden fixture: host-state writes inside jit-traced functions.
+
+Expected findings: tracer-leak at the self-attribute write, the closure
+container append, and the global statement.
+"""
+
+import functools
+
+import jax
+
+TRACE_LOG = []
+
+
+class Stepper:
+    @functools.partial(jax.jit, static_argnums=0)
+    def step(self, state):
+        out = state + 1
+        self.last = out          # finding: tracer into host attribute
+        TRACE_LOG.append(out)    # finding: tracer into closure/global list
+        return out
+
+
+def make_step():
+    def body(carry, x):
+        global _steps            # finding: global write while tracing
+        _steps += 1
+        return carry + x, x
+
+    return jax.lax.scan(body, 0, None)

@@ -156,7 +156,13 @@ def test_stale_primary_cannot_serve(sys3):
 
     # Partition `stale` from the viewservice only: stop its ticks.
     stale.dead = True           # stops tick loop and RPC serving...
-    time.sleep(0.01)
+    # Deterministic hand-off: JOIN the ticker instead of sleeping an
+    # arbitrary 10ms and hoping the thread woke inside the window (the
+    # pre-tpusan flake: with TICK=0.02 the loop often slept straight
+    # through dead=True→False and kept pinging the old view forever).
+    # tick() early-returns while dead, so no stray ping escapes.
+    stale._ticker.join(timeout=5.0)
+    assert not stale._ticker.is_alive(), "ticker failed to exit"
     stale.dead = False          # ...but we revive serving: it keeps its old view
     # (tick thread has exited: it will never learn the new view)
 

@@ -1,0 +1,93 @@
+"""Nemesis smokes under the tpusan lockwatch sanitizer.
+
+The `sanitize` fixture instruments every lock created during the test
+(fabric/service locks arrive named + budgeted via tpu6824.utils.locks)
+and fails teardown on lock-order cycles or hold-budget violations — so
+the SAME deterministic fault schedules tier-1 already trusts now also
+prove lock discipline under partitions, unreliable traffic, kill/revive
+and pipeline-depth churn.  The slow soak stretches the schedule; the
+tier-1 smoke keeps the wiring honest on every PR.
+
+Provenance note: the very first sanitized run of this smoke caught a
+real one — `PaxosFabric._next_key_locked` materializing the 256-entry
+key batch as a Python list under the fabric lock (>1s hold per refill
+on the unreliable path); the fix (device-array + countdown cursor)
+ships in the same PR, and the budget assertion here keeps it fixed.
+"""
+
+import pytest
+
+from tpu6824.harness.linearize import check_history
+from tpu6824.harness.nemesis import seed_from_env
+
+from tests.invariants import check_appends
+
+
+@pytest.mark.sanitize
+def test_fabric_locks_are_named_and_budgeted(sanitize):
+    """The annotation seam works end to end: a fabric built under the
+    sanitizer registers its hot lock by NAME with a hold budget, and a
+    plain healthy run produces no cycles/violations."""
+    from tpu6824.core.fabric import PaxosFabric
+
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=16, auto_step=True,
+                      io_mode="compact", steps_per_dispatch=2)
+    for p in range(3):
+        fab.start(0, p, 0, 41 + p)
+    fab.wait_steps(20, timeout=30.0)
+    fab.stop_clock()
+    rep = sanitize.snapshot()
+    assert "PaxosFabric._lock" in rep.nodes.values(), sorted(
+        set(rep.nodes.values()))
+    assert not rep.cycles(), rep.describe()
+    assert not rep.violations, rep.describe()
+
+
+@pytest.mark.sanitize
+@pytest.mark.nemesis
+def test_kvpaxos_nemesis_smoke_sanitized(sanitize, nemesis_report):
+    """The PR-3 fixed-seed kvpaxos smoke (pipelined clock, partitions,
+    unreliable, kill/revive, depth churn), now under lockwatch: the
+    history must still linearize AND the run must hold zero lock-order
+    cycles / zero fabric-lock budget overruns."""
+    from tests.test_nemesis import run_kvpaxos_nemesis
+
+    history, value = run_kvpaxos_nemesis(
+        seed_from_env(24601), duration=2.0, nclients=3, nops=6,
+        nemesis_report=nemesis_report,
+        fabric_kw=dict(io_mode="compact", steps_per_dispatch=2,
+                       pipeline_depth=2))
+    check_appends(value, 3, 6)
+    res = check_history(history)
+    assert res.ok, res.describe()
+    # teardown of `sanitize` asserts cycles/violations are empty
+
+
+@pytest.mark.sanitize
+@pytest.mark.nemesis
+@pytest.mark.slow
+def test_kvpaxos_nemesis_soak_sanitized(sanitize, nemesis_report):
+    """Longer sanitized soak: more clients, more faults, more refills of
+    the PRNG key batch (the original budget-violation trigger)."""
+    from tests.test_nemesis import run_kvpaxos_nemesis
+
+    history, value = run_kvpaxos_nemesis(
+        seed_from_env(77001), duration=8.0, nclients=4, nops=16,
+        nemesis_report=nemesis_report,
+        fabric_kw=dict(io_mode="compact", steps_per_dispatch=2,
+                       pipeline_depth=2))
+    check_appends(value, 4, 16)
+    res = check_history(history)
+    assert res.ok, res.describe()
+
+
+@pytest.mark.sanitize
+@pytest.mark.nemesis
+@pytest.mark.slow
+def test_shardkv_nemesis_reconfig_sanitized(sanitize, nemesis_report):
+    """Shardkv under reconfiguration + faults, sanitized: exercises the
+    cross-group donor pulls (timeout-bounded acquires — excluded from
+    the order graph by design) and the sm/shardkv lock stack."""
+    from tests.test_nemesis import test_shardkv_nemesis_reconfiguration_smoke
+
+    test_shardkv_nemesis_reconfiguration_smoke(nemesis_report)

@@ -25,5 +25,28 @@ Layout:
 
 __version__ = "0.1.0"
 
-from tpu6824.core.fabric import PaxosFabric  # noqa: E402,F401
-from tpu6824.core.peer import Fate, PaxosPeer, make_group  # noqa: E402,F401
+# Lazy top-level exports (PEP 562): `from tpu6824 import PaxosFabric`
+# still works, but importing the bare package no longer drags in JAX —
+# which keeps the tpusan CLI (`python -m tpu6824.analysis`, a pure-AST
+# stdlib pass) and other JAX-free tooling paths fast and light.
+_EXPORTS = {
+    "PaxosFabric": "tpu6824.core.fabric",
+    "Fate": "tpu6824.core.peer",
+    "PaxosPeer": "tpu6824.core.peer",
+    "make_group": "tpu6824.core.peer",
+}
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'tpu6824' has no attribute {name!r}")
+    import importlib
+
+    val = getattr(importlib.import_module(mod), name)
+    globals()[name] = val  # cache: next access skips __getattr__
+    return val
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

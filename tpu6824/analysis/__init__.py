@@ -1,0 +1,34 @@
+"""tpu6824.analysis — tpusan: lock-discipline & determinism analyzer.
+
+Three tools, one package:
+
+  - `lint` — the project-specific AST pass (`python -m tpu6824.analysis
+    <paths>`): lock-region blocking calls, per-cell loops under the
+    fabric lock, nondeterminism in schedule-replay paths, silent daemon
+    deaths, columnar-feed contract, tracer leaks.  Stdlib only — no JAX
+    import, fast enough for tier-1.
+  - `lockwatch` — opt-in runtime lock-order/hold-time sanitizer
+    (`TPU6824_SANITIZE=1` / the `sanitize` pytest fixture).
+  - `jitguard` — steady-state recompile guard (lazy JAX import).
+
+`ANALYZER_VERSION` stamps reports and CHANGES-style artifacts so rule
+additions stay auditable across PRs.
+"""
+
+from tpu6824.analysis.lint import (  # noqa: F401
+    ANALYZER_VERSION,
+    Finding,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+
+__all__ = [
+    "ANALYZER_VERSION",
+    "Finding",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,64 @@
+"""CLI: `python -m tpu6824.analysis [paths...]`.
+
+Exit status 0 iff every finding is suppressed (each suppression carrying
+its mandatory justification).  `--json` emits a machine-readable report
+(stamped with ANALYZER_VERSION, the CHANGES-artifact form); `--all`
+includes suppressed findings in the listing; `--list-rules` documents
+the rule set.  No JAX import on this path — the AST pass is pure stdlib.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu6824.analysis.lint import ANALYZER_VERSION, RULES, lint_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu6824.analysis",
+        description="tpusan — lock-discipline & determinism lint")
+    ap.add_argument("paths", nargs="*", default=["tpu6824"],
+                    help="files or directories to lint (default: tpu6824)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a JSON report on stdout")
+    ap.add_argument("--all", action="store_true",
+                    help="also list suppressed findings")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--version", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.version:
+        print(ANALYZER_VERSION)
+        return 0
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}:\n    {desc}")
+        return 0
+
+    findings = lint_paths(args.paths)
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.json:
+        print(json.dumps({
+            "analyzer": ANALYZER_VERSION,
+            "paths": args.paths,
+            "findings": [vars(f) for f in findings],
+            "active": len(active),
+            "suppressed": len(suppressed),
+        }, indent=2))
+    else:
+        shown = findings if args.all else active
+        for f in sorted(shown, key=lambda f: (f.path, f.line)):
+            tag = " [suppressed]" if f.suppressed else ""
+            print(f.render() + tag)
+        print(f"{ANALYZER_VERSION}: {len(active)} finding(s), "
+              f"{len(suppressed)} suppressed")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

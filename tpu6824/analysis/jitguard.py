@@ -1,0 +1,118 @@
+"""jitguard — JAX recompile / tracer-hygiene guard.
+
+The fabric's whole performance story rests on a FIXED set of compiled
+programs: injection buckets are padded to two fixed sizes, the fused
+step jit has exactly one signature per (reliable?) variant, and the
+clock re-dispatches the same executable forever.  An unexpected
+recompile in steady state means someone broke that contract (a shape
+that varies per batch, a new static arg, a Python float sneaking into a
+traced position) — on TPU that is a multi-second stall per occurrence,
+invisible on CPU tests except as flakiness.
+
+`RecompileGuard` counts backend compiles via `jax.monitoring` duration
+events (one `/jax/core/compile/backend_compile_duration` event per
+actual XLA compile, cache hits emit none) across a region that should
+be steady-state, and `check()` fails when the count exceeds the
+allowance.  `CacheProbe` does the same for an explicit list of jitted
+callables via their `_cache_size()` — sharper attribution when you know
+which functions must stay warm.
+
+JAX is imported lazily: the AST lint half of `tpu6824.analysis` stays
+importable (and fast) without it.
+"""
+
+from __future__ import annotations
+
+_compile_events = 0
+_listener_registered = False
+
+
+def _ensure_listener() -> None:
+    """Register the (process-global, permanent) compile-event listener.
+    jax.monitoring has no unregister that doesn't clobber other
+    listeners, so we register once and count forever; guards take
+    deltas."""
+    global _listener_registered
+    if _listener_registered:
+        return
+    import jax.monitoring
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        global _compile_events
+        if event == "/jax/core/compile/backend_compile_duration":
+            _compile_events += 1
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _listener_registered = True
+
+
+def compile_count() -> int:
+    """Process-lifetime backend-compile count (0 until the first guard
+    registers the listener)."""
+    return _compile_events
+
+
+class RecompileGuard:
+    """Context manager asserting a region performs at most
+    `max_compiles` backend compiles (default 0: steady state).
+
+        fabric.step(30)                  # warm up every variant
+        with RecompileGuard() as g:
+            fabric.step(100)             # must hit caches only
+        g.check()                        # raises RecompileError on miss
+
+    `check()` is implicit at __exit__ when `strict=True` (default); pass
+    strict=False to inspect `g.compiles` without raising.
+    """
+
+    def __init__(self, max_compiles: int = 0, strict: bool = True):
+        self.max_compiles = max_compiles
+        self.strict = strict
+        self.compiles = 0
+        self._t0 = 0
+
+    def __enter__(self) -> "RecompileGuard":
+        _ensure_listener()
+        self._t0 = _compile_events
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.compiles = _compile_events - self._t0
+        if self.strict and exc_type is None:
+            self.check()
+        return False
+
+    def check(self) -> None:
+        if self.compiles > self.max_compiles:
+            raise RecompileError(
+                f"{self.compiles} backend compile(s) in a region budgeted "
+                f"for {self.max_compiles} — a shape/static-arg is varying "
+                "in steady state (see tpusan jitguard)")
+
+
+class RecompileError(AssertionError):
+    pass
+
+
+class CacheProbe:
+    """Per-function cache-miss attribution: snapshot `_cache_size()` of
+    known jitted callables, re-sample later, report which grew."""
+
+    def __init__(self, fns: dict[str, object]):
+        self.fns = dict(fns)
+        self._base = {k: self._size(f) for k, f in self.fns.items()}
+
+    @staticmethod
+    def _size(fn) -> int:
+        try:
+            return fn._cache_size()
+        except AttributeError:
+            return -1  # not a pjit function (or API moved): unattributable
+
+    def misses(self) -> dict[str, int]:
+        out = {}
+        for k, f in self.fns.items():
+            d = self._size(f) - self._base[k]
+            if d > 0:
+                out[k] = d
+        return out

@@ -1,0 +1,610 @@
+"""tpusan — project-specific AST lint for the threaded fabric/service stack.
+
+The Go reference leans on `go vet` + `-race`; this rebuild is ~13k lines
+of threaded Python whose correctness rests on conventions the compiler
+cannot see: what may run under the fabric lock, which paths must be
+schedule-deterministic, how daemon threads are allowed to die, and how
+`subscribe_decided` consumers must drain the feed.  Each convention is a
+rule here, enforced on every PR (tier-1 `tests/test_analysis.py` runs
+this over the whole tree), so the bug classes PRs 1–3 fixed cannot be
+silently reintroduced by the ROADMAP's scale-out work.
+
+Suppressions: a finding is silenced by a justification comment on the
+flagged line (or the line directly above it):
+
+    # tpusan: ok(<rule>[, <rule>...]) — <why this is safe here>
+
+The reason text is mandatory and the rule name must exist — a malformed
+or unused suppression is itself a finding (`bad-suppression`,
+`unused-suppression`), so the suppression inventory stays auditable.
+
+Pure stdlib (ast + tokenize): the whole pass runs without importing JAX
+or any product module.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+ANALYZER_VERSION = "tpusan-1.0.0"
+
+RULES: dict[str, str] = {
+    "lock-blocking-call":
+        "blocking call (sleep/socket/RPC/device readback/fsync) inside a "
+        "fabric/service lock region — stalls every API caller behind it",
+    "lock-nested-loop":
+        "nested Python for-loops under a fabric/service lock — the "
+        "per-cell-loop-under-the-lock regression class (TUNING round 7: "
+        "~160ms/retire, halved clerk throughput); keep the work columnar "
+        "or move it outside the lock",
+    "nondet-clock":
+        "wall clock or process-global RNG in a schedule-deterministic "
+        "path — use the seeded random.Random / time.monotonic so nemesis "
+        "replay stays byte-identical",
+    "daemon-bare-except":
+        "broad except swallowing failures inside a daemon-thread run "
+        "loop without recording them — route through "
+        "tpu6824.utils.crashsink (or re-raise) so thread death is never "
+        "silent",
+    "daemon-crash-sink":
+        "threading.Thread(daemon=True) whose target does not route "
+        "exceptions to the crash sink — wrap it in crashsink.guarded() "
+        "so stats()['health'] reports the death",
+    "feed-columnar":
+        "subscribe_decided consumer bypassing the columnar feed contract "
+        "— drain via DecidedSub.pop()/DecidedTap, never the private "
+        "per-batch queue",
+    "tracer-leak":
+        "jit-traced function writes to host state (self attribute, "
+        "closure container, global) — leaks tracers out of the trace and "
+        "poisons host mirrors",
+    "bad-suppression":
+        "malformed tpusan suppression: needs ok(<known-rule>) and a "
+        "non-empty justification after a dash",
+    "unused-suppression":
+        "tpusan suppression that matches no finding — stale after a "
+        "refactor or rule change; delete it or fix the rule name",
+}
+
+# ---------------------------------------------------------------- scopes
+
+_LOCK_SCOPE = (
+    "core/fabric.py", "core/fabric_service.py", "core/hostpeer.py",
+    "core/intern.py", "services/",
+)
+_DET_SCOPE = ("harness/nemesis.py", "harness/linearize.py")
+_FEED_HOME = "core/fabric.py"  # the only module allowed to touch sub._q
+
+# Attribute names that denote "the lock" in fabric/feed/service code.
+_LOCK_ATTRS = {"_lock", "mu", "_fs_lock"}
+
+# Blocking calls by full dotted name...
+_BLOCKING_DOTTED = {
+    "time.sleep", "jax.device_get", "os.fsync", "socket.create_connection",
+    "subprocess.run", "subprocess.Popen", "subprocess.check_output",
+    "subprocess.check_call", "select.select",
+}
+# ... and by attribute tail on any receiver (sockets, RPC stubs, device
+# arrays).  `.sleep` also catches Backoff.sleep; `.call` catches the
+# pooled transport / FlakyNet RPC legs.
+_BLOCKING_TAILS = {
+    "recv", "recv_into", "sendall", "accept", "connect",
+    "block_until_ready", "device_get", "fsync", "sleep", "call",
+}
+
+# Module-level `random.X` calls that consume the process-global RNG.
+_GLOBAL_RNG = {
+    "random", "randint", "randrange", "choice", "choices", "uniform",
+    "shuffle", "sample", "getrandbits", "gauss", "betavariate", "expovariate",
+}
+_WALL_CLOCK = {"time.time", "time.time_ns", "datetime.now", "datetime.utcnow"}
+
+_SUPPRESS_RE = re.compile(
+    r"tpusan:\s*ok\(\s*([\w*,\s-]+?)\s*\)\s*(?:[—–:]|-{1,2})?\s*(.*)")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    msg: str
+    suppressed: bool = False
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.msg}"
+
+
+@dataclass
+class Suppression:
+    line: int          # source line the comment sits on
+    rules: set[str]
+    reason: str
+    used: bool = field(default=False)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _in_scope(relpath: str, scope: tuple[str, ...]) -> bool:
+    # Scope entries are package-relative path suffixes like
+    # "core/fabric.py" or directory infixes like "services/"; `relpath`
+    # may be absolute — matching is suffix/infix based.
+    p = "/" + relpath.lstrip("/")
+    for s in scope:
+        if s.endswith("/"):
+            if f"/{s}" in p:
+                return True
+        elif p.endswith("/" + s) or relpath == s:
+            return True
+    return False
+
+
+# ------------------------------------------------------------ suppressions
+
+
+def _collect_suppressions(source: str, path: str,
+                          findings: list[Finding]) -> dict[int, Suppression]:
+    sups: dict[int, Suppression] = {}
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except tokenize.TokenError:
+        comments = []
+    for line, text in comments:
+        if "tpusan:" not in text:
+            continue  # prose MENTIONING tpusan is not a suppression
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            findings.append(Finding(
+                path, line, "bad-suppression",
+                "tpusan comment does not parse as ok(<rule>) — <reason>"))
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2).strip()
+        bad = [r for r in rules if r != "*" and r not in RULES]
+        if bad:
+            findings.append(Finding(
+                path, line, "bad-suppression",
+                f"unknown rule(s) in suppression: {', '.join(sorted(bad))}"))
+            continue
+        if not reason:
+            findings.append(Finding(
+                path, line, "bad-suppression",
+                "suppression carries no justification — say WHY it is safe"))
+            continue
+        sups[line] = Suppression(line, rules, reason)
+    return sups
+
+
+# ------------------------------------------------------------ the visitor
+
+
+class _FileLint(ast.NodeVisitor):
+    def __init__(self, path: str, relpath: str, tree: ast.Module):
+        self.path = path
+        self.rel = relpath
+        self.tree = tree
+        self.findings: list[Finding] = []
+        self.lock_scope = _in_scope(relpath, _LOCK_SCOPE)
+        self.det_scope = _in_scope(relpath, _DET_SCOPE)
+        self.feed_home = _in_scope(relpath, (_FEED_HOME,))
+        self._lock_depth = 0       # with <lock> nesting
+        self._loop_depth_in_lock = 0
+        self._daemon_targets = self._resolve_daemon_targets()
+        self._jit_defs = self._resolve_jit_defs()
+        self._fn_stack: list[ast.AST] = []
+        self._calls_subscribe = False
+        self._refs_columnar_consumer = False
+
+    # ------------------------------------------------ module-level scans
+
+    def _all_defs(self) -> dict[str, list[ast.AST]]:
+        defs: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        return defs
+
+    def _resolve_daemon_targets(self) -> dict[int, ast.AST]:
+        """Map Thread(target=..., daemon=True) call sites to the resolved
+        target FunctionDef (None if unresolvable/unguarded) — plus record
+        the daemon-crash-sink findings right here."""
+        defs = self._all_defs()
+        targets: dict[int, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            if fname not in ("threading.Thread", "Thread"):
+                continue
+            if not any(kw.arg == "daemon" and
+                       isinstance(kw.value, ast.Constant) and
+                       kw.value.value is True for kw in node.keywords):
+                continue
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            if target is None:
+                continue
+            # target=crashsink.guarded(...) / guarded(...): satisfied —
+            # but the wrapped function is still a daemon run loop, so
+            # resolve it and lint its except handlers.
+            if isinstance(target, ast.Call):
+                tn = _dotted(target.func) or ""
+                if not tn.endswith("guarded"):
+                    self._flag(node, "daemon-crash-sink",
+                               "daemon thread target is an unrecognized "
+                               "call expression — wrap it in "
+                               "crashsink.guarded()")
+                    continue
+                inner = target.args[0] if target.args else None
+                iname = None
+                if isinstance(inner, ast.Attribute):
+                    iname = inner.attr
+                elif isinstance(inner, ast.Name):
+                    iname = inner.id
+                for fn in defs.get(iname or "", []):
+                    targets[id(fn)] = fn
+                continue
+            name = None
+            if isinstance(target, ast.Attribute):
+                name = target.attr
+            elif isinstance(target, ast.Name):
+                name = target.id
+            cand = defs.get(name or "", [])
+            if not cand:
+                self._flag(node, "daemon-crash-sink",
+                           f"cannot resolve daemon target {name!r} in this "
+                           "module — wrap it in crashsink.guarded()")
+                continue
+            fn = cand[0]
+            if self._mentions_crashsink(fn):
+                targets[id(fn)] = fn
+                continue
+            self._flag(node, "daemon-crash-sink",
+                       f"daemon target {name}() never touches the crash "
+                       "sink — wrap the spawn in crashsink.guarded() or "
+                       "record() from the loop")
+            targets[id(fn)] = fn  # still lint its except handlers
+        return targets
+
+    @staticmethod
+    def _mentions_crashsink(fn: ast.AST) -> bool:
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and n.id == "crashsink":
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in (
+                    "guarded", "record") and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id == "crashsink":
+                return True
+        return False
+
+    def _resolve_jit_defs(self) -> set[int]:
+        """FunctionDefs that are jit-compiled: decorated with jax.jit /
+        (functools.)partial(jax.jit, ...), or passed by name to
+        jax.jit(...) / (jax.)lax.scan(...) anywhere in the module."""
+        defs = self._all_defs()
+        jit: set[int] = set()
+
+        def is_jit_expr(e: ast.AST) -> bool:
+            d = _dotted(e)
+            if d in ("jax.jit", "jit", "pl.pallas_call"):
+                return True
+            if isinstance(e, ast.Call):
+                dc = _dotted(e.func)
+                if dc in ("functools.partial", "partial") and e.args:
+                    return is_jit_expr(e.args[0])
+                return is_jit_expr(e.func)
+            return False
+
+        for name, fns in defs.items():
+            for fn in fns:
+                if any(is_jit_expr(d) for d in fn.decorator_list):
+                    jit.add(id(fn))
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            d = _dotted(node.func)
+            if d in ("jax.jit", "jit"):
+                arg = node.args[0]
+            elif d in ("jax.lax.scan", "lax.scan"):
+                arg = node.args[0]
+            else:
+                continue
+            if isinstance(arg, ast.Name):
+                for fn in defs.get(arg.id, []):
+                    jit.add(id(fn))
+        return jit
+
+    # ------------------------------------------------------------ helpers
+
+    def _flag(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(
+            Finding(self.path, getattr(node, "lineno", 0), rule, msg))
+
+    @staticmethod
+    def _is_lock_expr(e: ast.AST) -> bool:
+        return isinstance(e, ast.Attribute) and e.attr in _LOCK_ATTRS
+
+    # ------------------------------------------------------------ visits
+
+    def visit_With(self, node: ast.With) -> None:
+        is_lock = self.lock_scope and any(
+            self._is_lock_expr(item.context_expr) for item in node.items)
+        if is_lock:
+            self._lock_depth += 1
+            saved_loops = self._loop_depth_in_lock
+            self._loop_depth_in_lock = 0
+            self.generic_visit(node)
+            self._loop_depth_in_lock = saved_loops
+            self._lock_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._lock_depth > 0:
+            self._loop_depth_in_lock += 1
+            if self._loop_depth_in_lock >= 2:
+                self._flag(node, "lock-nested-loop",
+                           "for-loop nested inside another loop under a "
+                           "lock region")
+            self.generic_visit(node)
+            self._loop_depth_in_lock -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A nested def's body does not execute under the enclosing lock —
+        # but a `*_locked` helper runs under it BY CONVENTION (that is
+        # what the suffix promises its callers), so its whole body is a
+        # lock region.
+        saved = (self._lock_depth, self._loop_depth_in_lock)
+        self._lock_depth = (1 if self.lock_scope and
+                            node.name.endswith("_locked") else 0)
+        self._loop_depth_in_lock = 0
+        self._fn_stack.append(node)
+        if id(node) in self._jit_defs:
+            self._lint_jit_body(node)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+        self._lock_depth, self._loop_depth_in_lock = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        if self._lock_depth > 0 and d is not None:
+            tail = d.rsplit(".", 1)[-1]
+            if d in _BLOCKING_DOTTED or (
+                    "." in d and tail in _BLOCKING_TAILS):
+                self._flag(node, "lock-blocking-call",
+                           f"call to {d}() under a lock region")
+        if self.det_scope and d is not None:
+            if d in _WALL_CLOCK:
+                self._flag(node, "nondet-clock",
+                           f"{d}() in a schedule-deterministic path — use "
+                           "time.monotonic()/the schedule clock")
+            elif d.startswith("random.") and \
+                    d.split(".", 1)[1] in _GLOBAL_RNG:
+                self._flag(node, "nondet-clock",
+                           f"{d}() consumes the process-global RNG — use "
+                           "the seeded random.Random instance")
+        if d is not None and d.endswith("subscribe_decided"):
+            # A delegation wrapper (a method itself NAMED subscribe_decided
+            # forwarding to the fabric) is not a consumer.
+            encl = self._fn_stack[-1] if self._fn_stack else None
+            if getattr(encl, "name", None) != "subscribe_decided":
+                self._calls_subscribe = True
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr == "_q" and not self.feed_home:
+            self._flag(node, "feed-columnar",
+                       "direct access to a DecidedSub's private queue — "
+                       "drain via .pop() / DecidedTap")
+        # Evidence of sanctioned columnar consumption.  Bare `.pop` is
+        # deliberately NOT evidence: every RSM module pops dicts, which
+        # would trivially satisfy the rule in exactly the modules it
+        # polices.  A consumer using raw DecidedSub.pop() without the
+        # tap suppresses with a justification instead.
+        if node.attr in ("DecidedTap", "pop_ready"):
+            self._refs_columnar_consumer = True
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id == "DecidedTap":
+            self._refs_columnar_consumer = True
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        if fn is not None and id(fn) in self._daemon_targets:
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name) and
+                node.type.id in ("Exception", "BaseException"))
+            if broad and not self._handler_records(node):
+                self._flag(node, "daemon-bare-except",
+                           "broad except in a daemon run loop neither "
+                           "records the failure nor re-raises")
+        self.generic_visit(node)
+
+    @staticmethod
+    def _handler_records(node: ast.ExceptHandler) -> bool:
+        # `except Exception as e:` whose body actually USES e (stashes it
+        # in a record, replies with it, ...) counts as recording.
+        if node.name:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and n.id == node.name:
+                    return True
+        for n in ast.walk(node):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func) or ""
+                tail = d.rsplit(".", 1)[-1]
+                if tail in ("record", "print_exc", "dprintf", "exception",
+                            "error", "warning", "log", "bump"):
+                    return True
+            if isinstance(n, ast.Name) and n.id == "crashsink":
+                return True
+        return False
+
+    # ------------------------------------------------------------ jit body
+
+    def _lint_jit_body(self, fn: ast.AST) -> None:
+        local: set[str] = {a.arg for a in fn.args.args}
+        local.update(a.arg for a in fn.args.kwonlyargs)
+        if fn.args.vararg:
+            local.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            local.add(fn.args.kwarg.arg)
+        inner_defs: set[int] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n is not fn:
+                inner_defs.add(id(n))
+                continue
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    for nn in ast.walk(t):
+                        if isinstance(nn, ast.Name):
+                            local.add(nn.id)
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                if isinstance(n.target, ast.Name):
+                    local.add(n.target.id)
+            elif isinstance(n, ast.For):
+                for nn in ast.walk(n.target):
+                    if isinstance(nn, ast.Name):
+                        local.add(nn.id)
+            elif isinstance(n, ast.comprehension):
+                for nn in ast.walk(n.target):
+                    if isinstance(nn, ast.Name):
+                        local.add(nn.id)
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                self._flag(n, "tracer-leak",
+                           "global/nonlocal write inside a jit-traced "
+                           "function")
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        self._flag(n, "tracer-leak",
+                                   f"assignment to self.{t.attr} inside a "
+                                   "jit-traced function leaks a tracer "
+                                   "into host state")
+            elif isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                if d and "." in d:
+                    recv, tail = d.rsplit(".", 1)
+                    if tail in ("append", "extend", "add") and \
+                            "." not in recv and recv not in local and \
+                            recv != "self":
+                        self._flag(n, "tracer-leak",
+                                   f"mutation of closure/global container "
+                                   f"{recv!r} inside a jit-traced function")
+
+    # ------------------------------------------------------------ finalize
+
+    def finish(self) -> None:
+        if self._calls_subscribe and not self.feed_home and \
+                not self._refs_columnar_consumer:
+            self.findings.append(Finding(
+                self.path, 1, "feed-columnar",
+                "module subscribes to the decided feed but never drains "
+                "it through DecidedTap/pop_ready — per-cell consumption "
+                "re-creates the fan-out cost the columnar feed removed"))
+
+
+# ------------------------------------------------------------------ driver
+
+
+def lint_file(path: str, relpath: str | None = None) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, path, relpath or path)
+
+
+def lint_source(source: str, path: str,
+                relpath: str | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    sups = _collect_suppressions(source, path, findings)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        findings.append(Finding(path, e.lineno or 0, "bad-suppression",
+                                f"file does not parse: {e.msg}"))
+        return findings
+    v = _FileLint(path, (relpath or path).replace(os.sep, "/"), tree)
+    v.visit(tree)
+    v.finish()
+    findings.extend(v.findings)
+
+    # Apply suppressions: same line, or a comment block directly above —
+    # a suppression line covers everything down through its comment
+    # block to the first source line below it (justifications are
+    # encouraged to be multi-line).
+    lines = source.splitlines()
+
+    def comment_only(ln: int) -> bool:
+        return 1 <= ln <= len(lines) and lines[ln - 1].lstrip().startswith("#")
+
+    for f in findings:
+        if f.rule in ("bad-suppression",):
+            continue
+        candidates = [f.line]
+        ln = f.line - 1
+        while comment_only(ln):
+            candidates.append(ln)
+            if ln in sups:
+                break
+            ln -= 1
+        candidates.append(ln)  # first non-comment line above (same-line tail)
+        for ln in candidates:
+            s = sups.get(ln)
+            if s and ("*" in s.rules or f.rule in s.rules):
+                f.suppressed = True
+                s.used = True
+                break
+    for s in sups.values():
+        if not s.used:
+            findings.append(Finding(
+                path, s.line, "unused-suppression",
+                f"suppression for {sorted(s.rules)} matches no finding"))
+    return findings
+
+
+def iter_py_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git", "build")]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+
+
+def lint_paths(paths: list[str]) -> list[Finding]:
+    out: list[Finding] = []
+    for f in iter_py_files(paths):
+        out.extend(lint_file(f))
+    return out

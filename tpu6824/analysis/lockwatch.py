@@ -1,0 +1,343 @@
+"""lockwatch — runtime lock-order and hold-time sanitizer.
+
+The Go reference gets `-race` for free; this is the slice of it the
+threaded host runtime actually needs: every watched lock acquisition
+records (per thread) the set of locks already held, building a global
+lock ACQUISITION GRAPH whose nodes are lock instances and whose edge
+a→b means "some thread acquired b while holding a".  A cycle in that
+graph is deadlock potential — two threads interleaving the cycle's
+edges block forever — even if the test run happened not to interleave
+them.  Watched locks can also carry a HOLD-TIME BUDGET: holding the
+fabric lock longer than its budget is the PR 2 regression class (a
+per-cell Python loop under `PaxosFabric._lock` halved clerk
+throughput), reported here as a violation instead of a TUNING.md
+post-mortem.
+
+Opt-in, two layers:
+
+  - `TPU6824_SANITIZE=1` (or the `sanitize` pytest fixture) calls
+    `enable()`, which patches `threading.Lock` / `threading.RLock` so
+    every lock created AFTERWARDS is watched (anonymous locks get a
+    creation-site label).  `disable()` restores threading and returns
+    the `Report`.
+  - Product code names its hot locks through `tpu6824.utils.locks.
+    new_lock/new_rlock(name=..., hold_budget_s=...)` — a zero-cost
+    seam when the sanitizer is off, a labeled+budgeted watched lock
+    when it is on.
+
+Pure stdlib: importable (and testable) without JAX.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+# Default hold budget applied when a named lock doesn't set one: generous
+# enough that cold paths (checkpoint copies, first-dispatch staging) pass
+# on a loaded CI box, tight enough to catch the ~160ms/retire class of
+# regression (TUNING round 7).
+DEFAULT_BUDGET_S = float(os.environ.get("TPU6824_LOCK_BUDGET", "0.25"))
+
+_state_mu = _real_lock()  # guards the graph/violation structures below
+_active = False
+_edges: dict[tuple[int, int], dict] = {}    # (node_a, node_b) -> first-seen info
+_nodes: dict[int, str] = {}                  # node id -> label
+_violations: list[dict] = []
+_MAX_VIOLATIONS = 256
+_serial = 0
+_tls = threading.local()  # .held = [[node_id, t0, depth, label], ...]
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+class Report:
+    """What a sanitized run learned: the aggregated acquisition graph,
+    any order cycles, and any hold-budget violations."""
+
+    def __init__(self, nodes, edges, violations):
+        self.nodes = nodes          # node id -> label
+        self.edges = edges          # (a, b) -> {"thread", "count"}
+        self.violations = violations  # [{"lock", "held_s", "budget_s", ...}]
+
+    def cycles(self) -> list[list[str]]:
+        """Cycles in the lock acquisition graph, as label lists.  Node
+        granularity is lock INSTANCES (two locks born at the same line
+        are distinct nodes), so a reported cycle is a real ordering
+        inversion, not a same-site alias."""
+        succ: dict[int, list[int]] = {}
+        for (a, b) in self.edges:
+            succ.setdefault(a, []).append(b)
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = dict.fromkeys(self.nodes, WHITE)
+        out: list[list[str]] = []
+        path: list[int] = []
+
+        def dfs(n: int) -> None:
+            color[n] = GREY
+            path.append(n)
+            for m in succ.get(n, ()):
+                c = color.get(m, BLACK)
+                if c == GREY:
+                    i = path.index(m)
+                    out.append([self.nodes[x] for x in path[i:]] +
+                               [self.nodes[m]])
+                elif c == WHITE:
+                    dfs(m)
+            path.pop()
+            color[n] = BLACK
+
+        for n in list(color):
+            if color[n] == WHITE:
+                dfs(n)
+        return out
+
+    def describe(self) -> str:
+        lines = [f"lockwatch: {len(self.nodes)} locks, "
+                 f"{len(self.edges)} order edges, "
+                 f"{len(self.violations)} budget violations"]
+        for cyc in self.cycles():
+            lines.append("  CYCLE: " + " -> ".join(cyc))
+        for v in self.violations[:16]:
+            lines.append(
+                f"  HOLD {v['lock']}: {v['held_s'] * 1e3:.1f}ms "
+                f"(budget {v['budget_s'] * 1e3:.0f}ms) at {v['site']}")
+        return "\n".join(lines)
+
+
+class _Watched:
+    """Instrumented lock wrapper.  Delegates to a real (R)Lock and keeps
+    the per-thread held-set + global graph current.  Implements the
+    `_release_save`/`_acquire_restore`/`_is_owned` trio so
+    `threading.Condition` waits (which release and re-acquire out of
+    band) keep the bookkeeping consistent."""
+
+    __slots__ = ("_lk", "_node", "_label", "_budget", "_reentrant")
+
+    def __init__(self, lk, node: int, label: str, budget: float | None,
+                 reentrant: bool):
+        self._lk = lk
+        self._node = node
+        self._label = label
+        self._budget = budget
+        self._reentrant = reentrant
+
+    # -------------------------------------------------- bookkeeping
+
+    def _note_acquired(self, ordered: bool = True) -> None:
+        """`ordered=False` for bounded acquires (try-lock / timeout):
+        they cannot participate in a hard deadlock — the acquirer backs
+        off — so they contribute hold-time tracking but no order edge
+        (shardkv's donor `mu.acquire(timeout=...)` pull is the canonical
+        case: symmetric cross-group pulls LOOK like an inversion but
+        resolve by timeout, per the module's divergence note)."""
+        st = _held_stack()
+        for ent in st:
+            if ent[0] == self._node:
+                ent[2] += 1  # reentrant re-acquire: no edge, no new timer
+                return
+        if _active and ordered:
+            with _state_mu:
+                for ent in st:
+                    key = (ent[0], self._node)
+                    e = _edges.get(key)
+                    if e is None:
+                        _edges[key] = {
+                            "thread": threading.current_thread().name,
+                            "count": 1,
+                        }
+                    else:
+                        e["count"] += 1
+        st.append([self._node, time.monotonic(), 1, self._label])
+
+    def _note_released(self) -> None:
+        st = _held_stack()
+        for i in range(len(st) - 1, -1, -1):
+            ent = st[i]
+            if ent[0] != self._node:
+                continue
+            ent[2] -= 1
+            if ent[2] == 0:
+                held = time.monotonic() - ent[1]
+                del st[i]
+                if (_active and self._budget is not None
+                        and held > self._budget):
+                    import traceback
+
+                    # Innermost frame that is NOT lockwatch itself: the
+                    # releasing statement (a fixed index would point one
+                    # frame off for direct .release() callers vs `with`).
+                    site = "?"
+                    for fr in reversed(traceback.extract_stack(limit=8)):
+                        if "lockwatch" in fr.filename:
+                            continue
+                        site = f"{fr.filename}:{fr.lineno}"
+                        break
+                    with _state_mu:
+                        if len(_violations) < _MAX_VIOLATIONS:
+                            _violations.append({
+                                "lock": self._label,
+                                "held_s": held,
+                                "budget_s": self._budget,
+                                "thread": threading.current_thread().name,
+                                "site": site,
+                            })
+            return
+
+    # -------------------------------------------------- Lock protocol
+
+    def acquire(self, *args, **kwargs):
+        got = self._lk.acquire(*args, **kwargs)
+        if got:
+            blocking = args[0] if args else kwargs.get("blocking", True)
+            timeout = (args[1] if len(args) > 1
+                       else kwargs.get("timeout", -1))
+            self._note_acquired(ordered=bool(blocking) and timeout == -1)
+        return got
+
+    def release(self):
+        self._lk.release()
+        self._note_released()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lk.locked()
+
+    # Condition-variable integration (threading.Condition duck-types
+    # these off its lock; without them a cond.wait() would desync the
+    # held-set).
+    def _release_save(self):
+        state = (self._lk._release_save() if hasattr(self._lk, "_release_save")
+                 else self._lk.release())
+        # wait(): the lock is fully released regardless of depth.
+        st = _held_stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == self._node:
+                del st[i]
+                break
+        return state
+
+    def _acquire_restore(self, state):
+        if hasattr(self._lk, "_acquire_restore"):
+            self._lk._acquire_restore(state)
+        else:
+            self._lk.acquire()
+        self._note_acquired()
+
+    def _is_owned(self):
+        if hasattr(self._lk, "_is_owned"):
+            return self._lk._is_owned()
+        # Plain Lock: mimic threading.Condition's probe.
+        if self._lk.acquire(False):
+            self._lk.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<lockwatch {self._label} wrapping {self._lk!r}>"
+
+
+def _creation_site() -> str:
+    import traceback
+
+    for fr in reversed(traceback.extract_stack(limit=8)[:-3]):
+        fn = fr.filename
+        if "lockwatch" in fn or fn.startswith("<"):
+            continue
+        if f"threading{os.sep}" in fn or fn.endswith("threading.py"):
+            continue
+        return f"{os.path.basename(fn)}:{fr.lineno}"
+    return "?"
+
+
+def _make(real_factory, reentrant: bool, name: str | None = None,
+          hold_budget_s: float | None = None):
+    global _serial
+    label = name or f"lock@{_creation_site()}"
+    with _state_mu:
+        _serial += 1
+        node = _serial
+        _nodes[node] = label
+    # Anonymous locks get no budget (short-held framework internals —
+    # Event/Condition plumbing — would drown the report); named locks
+    # default to DEFAULT_BUDGET_S.
+    budget = hold_budget_s if (hold_budget_s is not None or name is None) \
+        else DEFAULT_BUDGET_S
+    return _Watched(real_factory(), node, label, budget, reentrant)
+
+
+def _patched_lock():
+    return _make(_real_lock, reentrant=False)
+
+
+def _patched_rlock():
+    return _make(_real_rlock, reentrant=True)
+
+
+def enabled() -> bool:
+    return _active
+
+
+def enable() -> None:
+    """Start sanitizing: locks created from now on are watched.  Clears
+    any previous run's graph."""
+    global _active
+    with _state_mu:
+        _edges.clear()
+        _nodes.clear()
+        _violations.clear()
+    _active = True
+    threading.Lock = _patched_lock
+    threading.RLock = _patched_rlock
+
+
+def disable() -> Report:
+    """Stop sanitizing, restore `threading`, and return the Report.
+    Locks created while enabled keep working (they are plain wrappers)
+    but stop recording."""
+    global _active
+    _active = False
+    threading.Lock = _real_lock
+    threading.RLock = _real_rlock
+    with _state_mu:
+        return Report(dict(_nodes), dict(_edges), list(_violations))
+
+
+def snapshot() -> Report:
+    """Mid-run report (the sanitize fixture's failure path uses this to
+    assert without tearing instrumentation down first)."""
+    with _state_mu:
+        return Report(dict(_nodes), dict(_edges), list(_violations))
+
+
+def make_lock(name: str | None = None, hold_budget_s: float | None = None):
+    """A watched-if-sanitizing, plain-otherwise Lock.  Product code uses
+    `tpu6824.utils.locks.new_lock`, which forwards here only when the
+    sanitizer is active."""
+    if not _active:
+        return _real_lock()
+    return _make(_real_lock, reentrant=False, name=name,
+                 hold_budget_s=hold_budget_s)
+
+
+def make_rlock(name: str | None = None, hold_budget_s: float | None = None):
+    if not _active:
+        return _real_rlock()
+    return _make(_real_rlock, reentrant=True, name=name,
+                 hold_budget_s=hold_budget_s)

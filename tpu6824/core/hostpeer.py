@@ -41,6 +41,7 @@ from tpu6824.shim import wire
 from tpu6824.shim.gob import Registry
 from tpu6824.shim.netrpc import GobRpcServer, gob_call
 from tpu6824.utils.errors import OK, RPCError
+from tpu6824.utils import crashsink
 from tpu6824.utils.trace import EventLog, dprintf
 
 _REJECTED = "ErrRejected"  # paxos/rpc.go:47
@@ -214,8 +215,9 @@ class HostPaxosPeer:
                 self._prop_q.append((seq, v))
                 return
             self._prop_threads += 1
-        threading.Thread(target=self._proposer_worker, args=(seq, v),
-                         daemon=True).start()
+        threading.Thread(
+            target=crashsink.guarded(self._proposer_worker, "hostpeer-proposer"),
+            args=(seq, v), daemon=True).start()
 
     def status(self, seq: int):
         """Local-only read (paxos/paxos.go:434-447)."""
@@ -443,8 +445,10 @@ class HostPaxosPeer:
                     else:
                         self._prop_threads -= 1
                         raise
-                threading.Thread(target=self._proposer_worker, args=nxt,
-                                 daemon=True).start()
+                threading.Thread(
+                    target=crashsink.guarded(self._proposer_worker,
+                                             "hostpeer-proposer"),
+                    args=nxt, daemon=True).start()
                 raise
             with self.mu:
                 if self._prop_q and not self.dead:
@@ -575,8 +579,10 @@ class HostPaxosPeer:
                     self._redeliver_q[p].append((seq, v1))
                     if not self._redeliver_on[p]:
                         self._redeliver_on[p] = True
-                        threading.Thread(target=self._redeliver_loop,
-                                         args=(p,), daemon=True).start()
+                        threading.Thread(
+                            target=crashsink.guarded(self._redeliver_loop,
+                                                     "hostpeer-redeliver"),
+                            args=(p,), daemon=True).start()
 
     def _redeliver_loop(self, p: int) -> None:
         """Drain peer p's queue of unacked Decided messages.  Exits when the

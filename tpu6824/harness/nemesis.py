@@ -43,6 +43,8 @@ import re
 import threading
 import time
 
+from tpu6824.utils import crashsink
+
 #: Relative frequency of each action in generated schedules.  Actions a
 #: target does not list in its spec() are skipped; extras default to
 #: EXTRA_WEIGHT unless listed here explicitly.
@@ -436,7 +438,9 @@ class Nemesis:
         self.t0: float | None = None
 
     def start(self) -> "Nemesis":
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=crashsink.guarded(self._run, "nemesis-runner"),
+            daemon=True)
         self._thread.start()
         return self
 
@@ -462,8 +466,8 @@ class Nemesis:
         finally:
             try:
                 self.target.restore()
-            except Exception:  # noqa: BLE001 — restore is best-effort
-                pass
+            except Exception as e:  # noqa: BLE001 — restore is best-effort
+                crashsink.record("nemesis-restore", e, fatal=False)
 
     def join(self, timeout: float | None = None) -> None:
         if self._thread is not None:
@@ -525,8 +529,15 @@ class ReplayArtifact:
                 f"python -m pytest '{self.test}'")
 
     def to_dict(self) -> dict:
+        # Analyzer-version stamp (lazy import: the analyzer is stdlib-only
+        # but keep harness import costs flat): artifacts record which
+        # tpusan rule set was in force when the run was taken, so rule
+        # additions across PRs stay auditable against old captures.
+        from tpu6824.analysis import ANALYZER_VERSION
+
         d = {"test": self.test, "seed": self.seed,
-             "replay": self.replay_command(), "extra": self.extra}
+             "replay": self.replay_command(), "extra": self.extra,
+             "analyzer": ANALYZER_VERSION}
         if self.schedule is not None:
             d["schedule"] = self.schedule.to_dict()
         if self.nemesis is not None:

@@ -17,6 +17,8 @@ import socket
 import struct
 import threading
 
+from tpu6824.utils import crashsink
+
 _LEN = struct.Struct(">I")
 
 
@@ -50,14 +52,16 @@ class ToyServer:
         self.sock = sock
         self.handlers = handlers
         self._wlock = threading.Lock()
-        threading.Thread(target=self._loop, daemon=True).start()
+        threading.Thread(target=crashsink.guarded(self._loop, "toyrpc-loop"),
+                         daemon=True).start()
 
     def _loop(self):
         try:
             while True:
                 xid, name, args = _recv(self.sock)
                 threading.Thread(
-                    target=self._handle, args=(xid, name, args), daemon=True
+                    target=crashsink.guarded(self._handle, "toyrpc-handler"),
+                    args=(xid, name, args), daemon=True
                 ).start()
         except (EOFError, OSError):
             pass
@@ -84,7 +88,8 @@ class ToyClient:
         self._xids = itertools.count(1)
         self._pending: dict[int, list] = {}
         self._mu = threading.Lock()
-        threading.Thread(target=self._reader, daemon=True).start()
+        threading.Thread(target=crashsink.guarded(self._reader, "toyrpc-reader"),
+                         daemon=True).start()
 
     def _reader(self):
         try:

@@ -29,6 +29,7 @@ import threading
 from tpu6824.native.build import load
 from tpu6824.rpc import transport
 from tpu6824.utils.errors import RPCError
+from tpu6824.utils import crashsink
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "native", "rpcserver.cpp")
@@ -176,8 +177,9 @@ class NativeServer:
         # in-flight request — the Python accept loop's semantics, so N
         # concurrently blocking handlers never starve request N+1.
         payload = ctypes.string_at(data, length)
-        threading.Thread(target=self._serve, args=(conn_id, payload),
-                         daemon=True).start()
+        threading.Thread(
+            target=crashsink.guarded(self._serve, "native-rpc-serve"),
+            args=(conn_id, payload), daemon=True).start()
 
     def _serve(self, conn_id: int, payload: bytes) -> None:
         try:
@@ -195,6 +197,9 @@ class NativeServer:
                     return
                 except Exception as e:
                     reply = (False, e)
+        # tpusan: ok(daemon-bare-except) — undecodable frame is a
+        # protocol-level drop answered with the close marker, not a
+        # thread death; the client sees the dead connection and retries.
         except Exception:
             self._send_reply(conn_id, b"")  # undecodable frame: drop
             return

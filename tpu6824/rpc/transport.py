@@ -52,6 +52,7 @@ import threading
 import time
 
 from tpu6824.utils.errors import RPCError
+from tpu6824.utils import crashsink
 
 # Reference accept-loop fault rates (paxos/paxos.go:528-544).
 REQ_DROP = 0.10
@@ -327,7 +328,9 @@ class Server:
         self.rpc_count = 0
         self.accept_count = 0
         self._live: set[socket.socket] = set()  # in-flight connections
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread = threading.Thread(
+            target=crashsink.guarded(self._accept_loop, "rpc-accept"),
+            daemon=True)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -426,7 +429,8 @@ class Server:
                 self.accept_count += 1
                 self._live.add(conn)
             t = threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+                target=crashsink.guarded(self._serve_conn, "rpc-serve-conn"),
+                args=(conn,), daemon=True
             )
             t.start()
 
@@ -510,7 +514,9 @@ class DelayProxy:
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.bind(listen_addr)
         self._sock.listen(128)
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread = threading.Thread(
+            target=crashsink.guarded(self._accept_loop, "delay-proxy-accept"),
+            daemon=True)
 
     def start(self) -> "DelayProxy":
         self._thread.start()
@@ -564,7 +570,8 @@ class DelayProxy:
                 self._live.update((conn, up))
             for src, dst in ((conn, up), (up, conn)):
                 threading.Thread(
-                    target=self._pump, args=(src, dst), daemon=True
+                    target=crashsink.guarded(self._pump, "delay-proxy-pump"),
+                    args=(src, dst), daemon=True
                 ).start()
 
     def _pump(self, src: socket.socket, dst: socket.socket) -> None:

@@ -16,6 +16,7 @@ import threading
 import time
 
 from tpu6824.utils.errors import RPCError
+from tpu6824.utils.locks import new_lock
 
 REQ_DROP = 0.10
 REP_DROP = 0.20
@@ -171,7 +172,10 @@ class FlakyNet:
     def __init__(self, seed: int = 0):
         self._rng = random.Random(seed)
         self._unreliable: set[object] = set()
-        self._lock = threading.Lock()
+        # Budgeted tightly: this lock sits on EVERY clerk-leg call; it
+        # may only ever guard the two RNG draws + the membership probe
+        # (the fault-injected fn itself runs outside it).
+        self._lock = new_lock("FlakyNet._lock", hold_budget_s=0.05)
 
     def set_unreliable(self, server_key, flag: bool):
         with self._lock:

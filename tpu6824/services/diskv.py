@@ -37,6 +37,7 @@ from tpu6824.core.peer import Fate
 from tpu6824.ops.hashing import NSHARDS, key2shard
 from tpu6824.services.shardkv import Op, ShardKVServer
 from tpu6824.utils.errors import RPCError
+from tpu6824.utils import crashsink
 
 
 def encode_key(key: str) -> str:
@@ -50,8 +51,17 @@ def decode_key(name: str) -> str:
 
 def _atomic_write(path: str, data: bytes):
     """Write-then-rename (diskv/server.go:92-105): readers never observe a
-    torn file; a crash mid-write leaves only a .tmp that loading ignores."""
-    tmp = path + ".tmp"
+    torn file; a crash mid-write leaves only a .tmp that loading ignores.
+
+    The tmp name is unique PER WRITER (pid + thread id): a reboot puts a
+    fresh server object on the same directory while the old server's
+    driver thread may still be mid-persist, and two writers sharing one
+    `path + ".tmp"` race rename-vs-rename — the loser's os.replace dies
+    with FileNotFoundError (the pre-PR-4 test_diskv flake).  Unique tmp
+    names keep every replace self-contained; last rename wins, which is
+    safe because both writers rename complete value images.  The suffix
+    stays ".tmp" so _load_from_disk's debris sweep still matches."""
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)
@@ -96,8 +106,10 @@ class DisKVServer(ShardKVServer):
             # unbound service sockets; staying quarantined meanwhile is
             # always safe (grants refused, serving/learning unaffected).
             if not self._try_lower_amnesia_floor(deadline_s=0.0):
-                threading.Thread(target=self._floor_retry_loop,
-                                 daemon=True).start()
+                threading.Thread(
+                    target=crashsink.guarded(self._floor_retry_loop,
+                                             "diskv-floor-retry"),
+                    daemon=True).start()
         with self.mu:
             self._snapshot_from_peer()
 
@@ -183,7 +195,16 @@ class DisKVServer(ShardKVServer):
                 continue
             for name in os.listdir(d):
                 if name.endswith(".tmp"):
-                    os.unlink(os.path.join(d, name))  # torn write debris
+                    # Torn-write debris — but a rebooted server shares the
+                    # dir with the old instance's still-draining driver,
+                    # whose in-flight tmp may complete (rename away) or
+                    # lose its tmp to this unlink (its replace then fails,
+                    # swallowed by _apply's dead-server catch).  Either
+                    # way the sweep must not crash the reboot.
+                    try:
+                        os.unlink(os.path.join(d, name))
+                    except FileNotFoundError:
+                        pass
                     continue
                 with open(os.path.join(d, name), "rb") as f:
                     self.kv[decode_key(name)] = f.read().decode("utf-8")
@@ -195,15 +216,23 @@ class DisKVServer(ShardKVServer):
         # Persist BEFORE the caller Done()s the instance: the disk image is
         # always ≥ the log position we allow to be forgotten.
         with self._fs_lock:
-            if op.kind in ("put", "append") and reply is not None and reply[0] == "OK":
-                self._file_put(op.key, self.kv[op.key])
-            elif op.kind == "reconf":
-                cfg, xstate = op.extra
-                if self.config is cfg or self.config.num >= cfg.num:
-                    for k, _ in xstate.kv:
-                        if k in self.kv:
-                            self._file_put(k, self.kv[k])
-            self._persist_meta()
+            try:
+                if op.kind in ("put", "append") and reply is not None and reply[0] == "OK":
+                    self._file_put(op.key, self.kv[op.key])
+                elif op.kind == "reconf":
+                    cfg, xstate = op.extra
+                    if self.config is cfg or self.config.num >= cfg.num:
+                        for k, _ in xstate.kv:
+                            if k in self.kv:
+                                self._file_put(k, self.kv[k])
+                self._persist_meta()
+            except FileNotFoundError:
+                # crash(lose_disk=True) rmtree's our directory while this
+                # (now-dead) server's driver is mid-persist; the write is
+                # moot — the disk is gone by design.  Any other writer
+                # losing its directory is a real bug: re-raise.
+                if not self.dead:
+                    raise
         return reply
 
     def _drain_decided(self):
@@ -269,11 +298,20 @@ class DisKVServer(ShardKVServer):
 
     def disk_bytes(self) -> int:
         """Total persistent footprint (the tc.space() probe,
-        diskv/test_test.go:161-171)."""
+        diskv/test_test.go:161-171).  In-flight ".tmp" files are skipped
+        — they are rename-pending write buffers, not footprint — and a
+        file vanishing between listdir and stat (a concurrent atomic
+        rename completing) is tolerated: THIS was the other half of the
+        pre-PR-4 test_diskv flake."""
         total = 0
         for root, _, files in os.walk(self.dir):
             for f in files:
-                total += os.path.getsize(os.path.join(root, f))
+                if f.endswith(".tmp"):
+                    continue
+                try:
+                    total += os.path.getsize(os.path.join(root, f))
+                except FileNotFoundError:
+                    continue
         return total
 
 

@@ -26,6 +26,8 @@ from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
 from tpu6824.utils.errors import OK, ErrNoKey, RPCError
 from tpu6824.utils.profiling import PhaseProfiler
+from tpu6824.utils import crashsink
+from tpu6824.utils.locks import new_rlock
 
 
 class Op(NamedTuple):
@@ -89,7 +91,10 @@ class KVPaxosServer:
             raise ValueError("KVPaxosServer needs a fabric or an explicit px")
         self.px = px if px is not None else PaxosPeer(fabric, g, me)
         self.me = me
-        self.mu = threading.RLock()
+        # Named + budgeted for the lockwatch sanitizer: the driver's
+        # batched apply passes run under mu; a per-op regression here is
+        # the service-layer twin of the fabric-lock budget.
+        self.mu = new_rlock("kvpaxos.mu")
         self.kv: dict[str, str] = {}
         self.applied = -1  # highest paxos seq applied to kv
         self.dup: dict[int, tuple[int, object]] = {}  # cid -> (max cseq, reply)
@@ -124,7 +129,9 @@ class KVPaxosServer:
         # pin the log forever; shardkv's tick()/catchUp
         # (shardkv/server.go:162-184,488-493) is the pattern generalized
         # here.  Without it the fixed instance window could never recycle.
-        self._driver = threading.Thread(target=self._drive_loop, daemon=True)
+        self._driver = threading.Thread(
+            target=crashsink.guarded(self._drive_loop, "kvpaxos-driver"),
+            daemon=True)
         self._driver.start()
 
     # ------------------------------------------------------------ RSM core
@@ -446,14 +453,16 @@ class KVPaxosServer:
                 # shardkv's ticker has the same tolerance.
                 bo.sleep()
                 continue
-            except Exception:  # noqa: BLE001 — singleton thread
+            except Exception as e:  # noqa: BLE001 — singleton thread
                 # The driver is the server's only engine: if it dies, no
                 # future resolves, this replica stops Done()ing, and the
-                # whole group's window eventually jams.  Surface the bug
-                # loudly but keep driving.
+                # whole group's window eventually jams.  Record the bug in
+                # the crash sink (stats()["health"]["thread_crashes"]) —
+                # AND on stderr — but keep driving.
                 import traceback
 
                 traceback.print_exc()
+                crashsink.record("kvpaxos-driver", e, fatal=False)
                 time.sleep(0.02)
                 continue
 

@@ -22,6 +22,7 @@ from collections import defaultdict
 
 from tpu6824.ops.hashing import ihash, partition_keys
 from tpu6824.utils.errors import RPCError
+from tpu6824.utils import crashsink
 
 
 # --------------------------------------------------------------- data plane
@@ -174,7 +175,12 @@ class Master:
                 self.workers.put(w)
                 done.release()
 
-        threads = [threading.Thread(target=dispatch, daemon=True) for _ in range(8)]
+        threads = [
+            threading.Thread(
+                target=crashsink.guarded(dispatch, "mapreduce-dispatch"),
+                daemon=True)
+            for _ in range(8)
+        ]
         for t in threads:
             t.start()
         for _ in range(len(tasks)):

@@ -21,6 +21,7 @@ import time
 
 from tpu6824.services import viewservice
 from tpu6824.services.common import FlakyNet, fresh_cid
+from tpu6824.utils import crashsink
 from tpu6824.utils.errors import (
     OK,
     ErrNoKey,
@@ -53,7 +54,9 @@ class PBServer:
             if not isinstance(tick_interval, (int, float)):
                 tick_interval = viewservice.PING_INTERVAL
         self.tick_interval = tick_interval
-        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker = threading.Thread(
+            target=crashsink.guarded(self._tick_loop, "pbservice-ticker"),
+            daemon=True)
         self._ticker.start()
 
     # ------------------------------------------------------------- helpers
@@ -89,6 +92,10 @@ class PBServer:
                 # Read through the backup; its answer is the trusted one
                 # (pbservice/server.go:129-141).
                 try:
+                    # tpusan: ok(lock-blocking-call) — reference semantics:
+                    # the primary SERIALIZES through mu while reading via
+                    # the backup (pbservice/server.go:129-141); mu is this
+                    # one server's, not the fabric hot path.
                     err, val = self.net.call(
                         bk, bk.backup_get, self.view.viewnum, key, cid, cseq
                     )
@@ -114,6 +121,9 @@ class PBServer:
             bk = self._backup_srv()
             if bk is not None:
                 try:
+                    # tpusan: ok(lock-blocking-call) — same serialization
+                    # contract as get(): forward-to-backup must complete
+                    # before the primary applies (server.go:196-272).
                     err, _ = self.net.call(
                         bk, bk.backup_put_append,
                         self.view.viewnum, key, kind, value, cid, cseq,
@@ -163,6 +173,9 @@ class PBServer:
         if bk is None:
             return
         try:
+            # tpusan: ok(lock-blocking-call) — whole-state handoff to a
+            # fresh backup; racing a concurrent put would fork the copies
+            # (the reference holds its lock across Transfer too).
             self.net.call(bk, bk.init_state, self.view.viewnum,
                           dict(self.kv), dict(self.dup))
         except RPCError:

@@ -41,6 +41,7 @@ from tpu6824.ops.hashing import NSHARDS, key2shard
 from tpu6824.services import shardmaster
 from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
 from tpu6824.services.shardmaster import Config
+from tpu6824.utils import crashsink
 from tpu6824.utils.errors import (
     OK,
     ErrNoKey,
@@ -115,7 +116,9 @@ class ShardKVServer:
             self._start_ticker()
 
     def _start_ticker(self):
-        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker = threading.Thread(
+            target=crashsink.guarded(self._tick_loop, "shardkv-ticker"),
+            daemon=True)
         self._ticker.start()
 
     # ----------------------------------------------------------- RSM apply

@@ -23,6 +23,7 @@ from tpu6824.ops.hashing import NSHARDS
 from tpu6824.ops.rebalance import UNASSIGNED, rebalance_host
 from tpu6824.services.common import FlakyNet, fresh_cid
 from tpu6824.utils.errors import RPCError
+from tpu6824.utils import crashsink
 
 
 @dataclass(frozen=True)
@@ -69,7 +70,9 @@ class ShardMasterServer:
         self.dup: dict[int, tuple[int, object]] = {}
         self.op_timeout = op_timeout
         self.dead = False
-        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker = threading.Thread(
+            target=crashsink.guarded(self._tick_loop, "shardmaster-ticker"),
+            daemon=True)
         self._ticker.start()
 
     # ----------------------------------------------------------- RSM apply

@@ -20,6 +20,7 @@ import time
 from typing import NamedTuple
 
 from tpu6824.utils.errors import RPCError
+from tpu6824.utils import crashsink
 
 PING_INTERVAL = 0.1  # viewservice/common.go:43 (100ms)
 DEAD_PINGS = 5       # viewservice/common.go:48
@@ -44,7 +45,9 @@ class ViewServer:
         self.dead = False
         self.rpccount = 0
         self.ping_interval = ping_interval
-        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker = threading.Thread(
+            target=crashsink.guarded(self._tick_loop, "viewservice-ticker"),
+            daemon=True)
         self._ticker.start()
 
     # ------------------------------------------------------------- RPCs

@@ -1,0 +1,105 @@
+"""Crash sink for daemon threads.
+
+Every daemon thread in the system (`threading.Thread(daemon=True)`) must
+route its death through here: a daemon that dies silently is how a
+replica stops Done()-ing and jams a whole group's instance window with
+no symptom but clerk timeouts.  The Go reference gets a crashed
+goroutine's stack on stderr for free; this is the equivalent, with the
+record additionally surfaced in `PaxosFabric.stats()["health"]` so a
+harness (or the nemesis failure artifact) can assert on it.
+
+Two idioms, both recognized by the `daemon-crash-sink` tpusan lint rule:
+
+  - `threading.Thread(target=crashsink.guarded(self._loop, "kvpaxos-driver"),
+     daemon=True)` — wraps the target; an escaping exception is recorded
+     (and re-raised, so the interpreter's threading excepthook still
+     prints it).
+  - a run loop that survives per-iteration failures calls
+    `crashsink.record(name, exc, fatal=False)` from its own narrow
+    handler and keeps driving.
+
+The sink is process-global and append-only; `clear()` exists for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+
+_MAX_RECORDS = 256  # bound memory under a crash-looping thread
+
+_lock = threading.Lock()
+_records: list[dict] = []
+_dropped = 0
+
+
+def record(name: str, exc: BaseException, *, fatal: bool = True) -> None:
+    """Record one thread crash.  `fatal=True` means the thread is dying;
+    `fatal=False` is a survived per-iteration failure in a keep-driving
+    loop (still worth surfacing: a driver crash-looping at 50Hz is a bug
+    even if every individual iteration "recovers")."""
+    global _dropped
+    with _lock:
+        if len(_records) >= _MAX_RECORDS:
+            # Bound check BEFORE formatting: a crash-looping thread must
+            # not pay a full traceback.format_exception per dropped
+            # record — the cap exists exactly for that degenerate case.
+            _dropped += 1
+            return
+    rec = {
+        "thread": name,
+        "error": repr(exc),
+        "traceback": "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)),
+        "fatal": fatal,
+        "t": time.monotonic(),
+    }
+    with _lock:
+        if len(_records) >= _MAX_RECORDS:
+            _dropped += 1
+        else:
+            _records.append(rec)
+
+
+def crashes() -> list[dict]:
+    with _lock:
+        return [dict(r) for r in _records]
+
+
+def summary() -> dict:
+    """Compact health-report form: total count + the distinct thread
+    names that have crashed (fatal or not), cheap enough to embed in
+    every stats() call."""
+    with _lock:
+        return {
+            "count": len(_records) + _dropped,
+            "threads": sorted({r["thread"] for r in _records}),
+            "fatal": sum(1 for r in _records if r["fatal"]),
+        }
+
+
+def clear() -> None:
+    global _dropped
+    with _lock:
+        _records.clear()
+        _dropped = 0
+
+
+def guarded(fn, name: str | None = None):
+    """Wrap a daemon-thread target so an escaping exception is recorded
+    before the thread dies.  The exception is re-raised: the standard
+    threading excepthook still prints the stack, and tests that join()
+    the thread see it gone — nothing about thread lifetime changes,
+    death just stops being silent."""
+    label = name or getattr(fn, "__qualname__", repr(fn))
+
+    def _run(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            record(label, e)
+            raise
+
+    _run.__name__ = f"guarded[{label}]"
+    return _run

@@ -1,0 +1,32 @@
+"""Named, budgeted lock constructors — the product-code seam for
+`tpu6824.analysis.lockwatch`.
+
+Hot-path locks are created through `new_lock`/`new_rlock` with a name
+and a hold-time budget, turning perf notes like TUNING round 7's "the
+decided fan-out MUST stay columnar under the fabric lock" into an
+enforced contract: under `TPU6824_SANITIZE=1` (or the `sanitize` pytest
+fixture) the lock is instrumented and holding it past its budget fails
+the sanitized run.  With the sanitizer off this is exactly
+`threading.Lock()` / `threading.RLock()` — no wrapper, no overhead.
+
+Import cost matters (these are constructed on every fabric/server
+boot): lockwatch is stdlib-only and tiny, so importing it here is safe
+even in JAX-free tooling contexts.
+"""
+
+from __future__ import annotations
+
+from tpu6824.analysis import lockwatch
+
+
+def new_lock(name: str, hold_budget_s: float | None = None):
+    """A non-reentrant lock named for sanitizer reports; `hold_budget_s`
+    (None = lockwatch's DEFAULT_BUDGET_S) bounds how long any holder may
+    keep it under a sanitized run."""
+    return lockwatch.make_lock(name=name, hold_budget_s=hold_budget_s)
+
+
+def new_rlock(name: str, hold_budget_s: float | None = None):
+    """Reentrant variant of `new_lock` (RSM servers re-enter their own
+    `mu` through apply → waiter-resolution paths)."""
+    return lockwatch.make_rlock(name=name, hold_budget_s=hold_budget_s)
