@@ -334,6 +334,19 @@ def child_main():
             out["roofline_memres"] = _memres_roofline(jax, jnp, np, on_cpu)
         except Exception as e:  # noqa: BLE001
             out["roofline_memres"] = {"error": repr(e)[:200]}
+        # tpuscope: the process-global metrics snapshot accumulated over
+        # every leg above (rpc transport, clerk retries/backoffs/latency,
+        # service applies, fabric EventLog mirror + health gauges) — one
+        # JSON shape, the same one `fabric_service`'s metrics() RPC
+        # serves, dumped into BENCH_*.json for offline diffing.
+        try:
+            from tpu6824.obs import metrics as _obs_metrics
+            from tpu6824.obs.tracing import SCHEMA_VERSION as _TPUSCOPE_V
+
+            out["tpuscope"] = {"schema": _TPUSCOPE_V,
+                               "metrics": _obs_metrics.snapshot()}
+        except Exception as e:  # noqa: BLE001 — never cost the line
+            out["tpuscope"] = {"error": repr(e)[:200]}
         out["bench_seconds"] = round(time.time() - t_start, 1)
         if alt is not None:
             out["alt_kernel_best"] = alt

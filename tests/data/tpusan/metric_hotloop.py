@@ -1,0 +1,18 @@
+"""tpusan golden fixture: ad-hoc metric creation inside hot loops.
+
+Expected findings: metric-unregistered at both registry get-or-create
+calls inside the function body.  The module-scope creation is the
+sanctioned pattern and must NOT be flagged.
+"""
+
+from tpu6824.obs import metrics
+
+GOOD_COUNTER = metrics.counter("fixture.good")  # module scope: fine
+
+
+def apply_batch(vals):
+    applied = metrics.counter("fixture.applied")     # finding
+    for v in vals:
+        metrics.histogram("fixture.lat").observe(v)  # finding
+        applied.inc()
+        GOOD_COUNTER.inc()                           # use, not create: fine

@@ -43,6 +43,7 @@ GOLDEN_EXPECT = {
     "harness/nemesis.py": {"nondet-clock": 3},
     "daemon_silent.py": {"daemon-crash-sink": 2, "daemon-bare-except": 1},
     "feed_percell.py": {"feed-columnar": 3},
+    "metric_hotloop.py": {"metric-unregistered": 2},
     "tracer_leak.py": {"tracer-leak": 3},
     "services/bad_suppress.py": {"bad-suppression": 2,
                                  "unused-suppression": 1,

@@ -20,8 +20,19 @@ def test_eventlog_counters_and_ring():
     evs = log.events("step")
     assert len(evs) == 4  # bounded ring keeps the newest
     assert [e[2]["n"] for e in evs] == [2, 3, 4, 5]
-    assert log.counters() == {"decided": 5}
+    # Ring overflow is counted, never silent (ISSUE 5 satellite): 6
+    # records into a 4-slot ring dropped the 2 oldest.
+    assert log.counters() == {"decided": 5, "dropped": 2}
     assert log.rates()["decided"] > 0
+
+
+def test_eventlog_capacity_env_knob(monkeypatch):
+    monkeypatch.setenv("TPU6824_EVENTLOG_CAP", "2")
+    log = EventLog()
+    for i in range(5):
+        log.record("e", n=i)
+    assert len(log.events()) == 2
+    assert log.counters()["dropped"] == 3
 
 
 def test_fabric_stats_count_decisions():

@@ -1,0 +1,431 @@
+"""tpuscope (ISSUE 5): causal per-op tracing, the unified metrics
+registry, and the crash flight recorder.
+
+Layout:
+  - metrics registry units (counters / gauges / log2 histograms /
+    snapshot shape / type-conflict loudness);
+  - the acceptance trace-chain tests: a kvpaxos clerk op under
+    TPU6824_TRACE=1 exports a Chrome-trace JSON whose single trace_id
+    covers clerk → rpc → service-submit → fabric-dispatch → apply →
+    reply in causal (parent/child) order, on BOTH the direct and
+    pipelined-clerk paths;
+  - tracing-disabled default: no per-op spans, ops carry no trace
+    metadata (the zero-allocation contract's observable half);
+  - flight recorder: always-on events, bounded ring with counted drops;
+  - wire round-trips (satellite): stats()["phases"]/["feed"] and the
+    new metrics() RPC over the fabric_service socket;
+  - the nemesis-artifact acceptance: a failing (disabled-dup-table)
+    fixed-seed nemesis run produces an artifact whose flight_recorder
+    section holds spans for the violating key's ops, joinable to the
+    fault timeline by timestamp, stamped with the tpuscope schema
+    version.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+
+import pytest
+
+from tpu6824 import obs
+from tpu6824.obs import metrics
+from tpu6824.obs.tracing import FLIGHT, FlightRecorder
+from tpu6824.core.fabric import PaxosFabric
+from tpu6824.services.kvpaxos import Clerk, PipelinedClerk, make_cluster
+
+
+@pytest.fixture
+def tscope():
+    """Tracing ON (sample=1.0) with a clean flight ring; always restored
+    to the default-off state so other tests keep the zero-per-op-cost
+    contract."""
+    FLIGHT.clear()
+    obs.enable(sample=1.0)
+    try:
+        yield obs
+    finally:
+        obs.disable()
+        FLIGHT.clear()
+
+
+def _kv_cluster(**fabric_kw):
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=32, auto_step=True,
+                      **fabric_kw)
+    _, servers = make_cluster(fabric=fab, nservers=3, ninstances=32)
+    return fab, servers
+
+
+def _teardown(fab, servers):
+    for s in servers:
+        s.dead = True
+    fab.stop_clock()
+
+
+# --------------------------------------------------------- metrics units
+
+
+def test_counter_gauge_histogram_snapshot():
+    r = metrics.Registry()
+    c = r.counter("c")
+    c.inc()
+    c.inc(2, key="get")
+    g = r.gauge("g")
+    g.set(7.5)
+    h = r.histogram("h")
+    h.observe(3)     # bucket 2: [2, 4)
+    h.observe(1000)  # bucket 10
+    h.observe_many([5, 6, 7])
+    snap = r.snapshot()
+    assert snap["counters"]["c"] == {"total": 3, "by": {"get": 2}}
+    assert snap["gauges"]["g"] == {"value": 7.5, "by": {}}
+    hs = snap["histograms"]["h"]
+    assert hs["count"] == 5 and hs["sum"] == 3 + 1000 + 5 + 6 + 7
+    assert hs["pow2"]["2"] == 1 and hs["pow2"]["10"] == 1
+    assert hs["pow2"]["3"] == 3  # 5, 6, 7 all in [4, 8)
+    assert json.dumps(snap)  # the whole shape is JSON-safe
+    # The shape is STABLE: an unkeyed/unbumped metric serializes with the
+    # same keys as a busy one (pollers and BENCH differs type the shape).
+    r.counter("c2")
+    assert r.snapshot()["counters"]["c2"] == {"total": 0, "by": {}}
+
+
+def test_registry_get_or_create_and_type_conflict():
+    r = metrics.Registry()
+    assert r.counter("x") is r.counter("x")
+    with pytest.raises(TypeError):
+        r.gauge("x")
+
+
+def test_histogram_per_key_and_quantile():
+    h = metrics.Histogram("lat")
+    for _ in range(100):
+        h.observe(100, key="get")
+    h.observe(100000, key="put")
+    assert h.count == 101
+    assert h.quantile(0.5) <= 256  # p50 lands in the 100s bucket
+    snap = h.snapshot()
+    assert snap["by"]["get"]["count"] == 100
+    assert snap["by"]["put"]["count"] == 1
+
+
+def test_process_global_helpers():
+    name = "tpuscope.test.helper"
+    metrics.counter(name).inc(5)
+    metrics.inc(name, 2)
+    assert metrics.snapshot()["counters"][name]["total"] == 7
+
+
+# ------------------------------------------------------- trace chain
+
+
+CHAIN = ["clerk.op", "rpc.call", "service.submit", "fabric.dispatch",
+         "service.apply", "clerk.reply"]
+
+
+def _assert_chain(path, op_kind):
+    """Load a Chrome-trace export and assert ONE trace_id's spans cover
+    the full clerk→...→reply chain in parent/child order."""
+    with open(path) as f:
+        evs = json.load(f)["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X" and e["args"].get("trace_id")]
+    roots = [e for e in spans if e["name"] == "clerk.op"
+             and e["args"].get("op") == op_kind]
+    assert roots, f"no clerk.op root for {op_kind!r} in {len(spans)} spans"
+    chains = 0
+    for root in roots:
+        tid = root["args"]["trace_id"]
+        trace = [e for e in spans if e["args"]["trace_id"] == tid]
+        by_id = {e["args"]["span_id"]: e for e in trace}
+        by_name = {}
+        for e in trace:
+            by_name.setdefault(e["name"], []).append(e)
+        if not all(n in by_name for n in CHAIN):
+            continue
+        # Walk the chain bottom-up: reply → apply → dispatch → submit →
+        # rpc → clerk.op, each span's parent being the next stage's span.
+        ok = False
+        for reply in by_name["clerk.reply"]:
+            e, good = reply, True
+            for want in ("service.apply", "fabric.dispatch",
+                         "service.submit", "rpc.call", "clerk.op"):
+                parent = by_id.get(e["args"]["parent_id"])
+                if parent is None or parent["name"] != want:
+                    good = False
+                    break
+                e = parent
+            if good and e["args"]["parent_id"] == 0:  # clerk.op is root
+                ok = True
+                break
+        if ok:
+            chains += 1
+    assert chains, "no trace's spans chain clerk→rpc→submit→dispatch→" \
+                   "apply→reply in parent/child order"
+
+
+def test_trace_chain_direct_clerk(tscope, tmp_path):
+    """Acceptance: a kvpaxos clerk op with TPU6824_TRACE on exports a
+    single trace whose spans cover the whole causal chain (direct
+    blocking-clerk path)."""
+    fab, servers = _kv_cluster()
+    try:
+        ck = Clerk(servers)
+        ck.put("k", "v1")
+        assert ck.get("k") == "v1"
+    finally:
+        _teardown(fab, servers)
+    out = obs.export_trace(str(tmp_path / "direct.json"))
+    _assert_chain(out, "put_append")
+    _assert_chain(out, "get")
+    # The fabric's batch events interleave with the op spans in the same
+    # export (the "which batch carried my op" view).
+    with open(out) as f:
+        evs = json.load(f)["traceEvents"]
+    assert any(e["name"] == "fabric.retire.batch" for e in evs)
+
+
+def test_trace_chain_pipelined_clerk(tscope, tmp_path):
+    """Acceptance: the same causal chain on the pipelined-clerk path
+    (futures seam, group-commit driver, decided-feed apply)."""
+    fab, servers = _kv_cluster(io_mode="compact", steps_per_dispatch=2,
+                               pipeline_depth=2)
+    try:
+        ck = PipelinedClerk(servers, width=4)
+        ck.append_stream("k", [["a"], ["b"], ["c"], ["d"]])
+        assert sorted(Clerk(servers).get("k")) == ["a", "b", "c", "d"]
+    finally:
+        _teardown(fab, servers)
+    out = obs.export_trace(str(tmp_path / "pipelined.json"))
+    _assert_chain(out, "append")
+
+
+def test_trace_export_filters_by_trace_id(tscope, tmp_path):
+    fab, servers = _kv_cluster()
+    try:
+        ck = Clerk(servers)
+        ck.put("k1", "a")
+        ck.put("k2", "b")
+    finally:
+        _teardown(fab, servers)
+    spans = [r for r in FLIGHT.snapshot()
+             if r["name"] == "clerk.op" and r["args"].get("op")]
+    tids = {r["trace_id"] for r in spans}
+    assert len(tids) >= 2
+    keep = spans[0]["trace_id"]
+    out = obs.export_trace(str(tmp_path / "one.json"), trace_id=keep)
+    with open(out) as f:
+        evs = json.load(f)["traceEvents"]
+    got = {e["args"]["trace_id"] for e in evs if e["ph"] == "X"}
+    assert got <= {keep, 0}
+
+
+def test_tracing_disabled_is_the_quiet_default():
+    """Default-off: no per-op spans reach the ring and proposed values
+    carry no trace metadata (the observable half of the zero-per-op-
+    allocation contract; the bench leg guards the latency half)."""
+    assert not obs.enabled()
+    FLIGHT.clear()
+    fab, servers = _kv_cluster()
+    try:
+        ck = Clerk(servers)
+        ck.put("k", "v")
+        assert ck.get("k") == "v"
+        assert all(not s._trace_prop for s in servers)
+    finally:
+        _teardown(fab, servers)
+    names = {r["name"] for r in FLIGHT.snapshot()}
+    # batch events are always-on; per-op spans must be absent
+    assert not names & set(CHAIN), names
+
+
+def test_trace_sampling_zero_traces_nothing():
+    obs.enable(sample=0.0)
+    try:
+        FLIGHT.clear()
+        fab, servers = _kv_cluster()
+        try:
+            Clerk(servers).put("k", "v")
+        finally:
+            _teardown(fab, servers)
+        names = {r["name"] for r in FLIGHT.snapshot()}
+        assert not names & set(CHAIN), names
+    finally:
+        obs.disable()
+        FLIGHT.clear()
+
+
+# --------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_always_on_and_bounded():
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record({"ph": "i", "name": f"e{i}", "comp": "t", "trace_id": 0,
+                   "span_id": i, "parent_id": 0, "ts": i, "dur": 0,
+                   "args": {}})
+    snap = fr.snapshot()
+    assert len(snap) == 4 and fr.dropped == 6  # counted, never silent
+    assert [r["name"] for r in snap] == ["e6", "e7", "e8", "e9"]
+
+
+def test_flight_events_record_without_tracing():
+    assert not obs.enabled()
+    FLIGHT.clear()
+    obs.event("nemesis.kill", comp="nemesis", g=0, p=1)
+    recs = FLIGHT.snapshot()
+    assert recs and recs[-1]["name"] == "nemesis.kill"
+    assert recs[-1]["args"] == {"g": 0, "p": 1}
+    FLIGHT.clear()
+
+
+def test_flight_cap_env_knob(monkeypatch):
+    monkeypatch.setenv("TPU6824_FLIGHT_CAP", "8")
+    import importlib
+
+    # Fresh module instance (don't disturb the process-global ring).
+    spec = importlib.util.find_spec("tpu6824.obs.tracing")
+    fresh = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fresh)
+    assert fresh.FLIGHT._ring.maxlen == 8
+
+
+# ------------------------------------------- registry absorbs the stack
+
+
+def test_metrics_absorb_eventlog_and_feed(tscope):
+    """The fabric's EventLog counters and the feed fan-out land in the
+    process-global registry (prefix `fabric.`), and the feed updates are
+    batch-granular (histogram count ≪ delivered cells)."""
+    fab, servers = _kv_cluster()
+    try:
+        ck = Clerk(servers)
+        for i in range(4):
+            ck.append("k", f"v{i}")
+    finally:
+        _teardown(fab, servers)
+    snap = metrics.snapshot()
+    assert snap["counters"]["fabric.steps"]["total"] > 0
+    assert snap["counters"]["fabric.decided_cells"]["total"] > 0
+    delivered = snap["counters"]["fabric.feed_delivered"]["total"]
+    assert delivered > 0
+    fb = snap["histograms"]["fabric.feed_batch_cells"]
+    assert 0 < fb["count"] <= delivered
+    # clerk-side metrics flowed into the same registry
+    assert snap["counters"]["kvpaxos.applied"]["total"] > 0
+    assert snap["histograms"]["clerk.op_latency_us"]["count"] > 0
+
+
+def test_metrics_absorb_rpc_transport():
+    from tpu6824.rpc.transport import Server, call
+
+    d = tempfile.mkdtemp(prefix="tscope-rpc", dir="/var/tmp")
+    addr = os.path.join(d, "srv")
+    srv = Server(addr).register("echo", lambda x: x).start()
+    try:
+        b_tot = metrics.snapshot()["counters"].get(
+            "rpc.client.calls", {"total": 0})["total"]
+        for i in range(5):
+            assert call(addr, "echo", i) == i
+        snap = metrics.snapshot()
+        calls = snap["counters"]["rpc.client.calls"]
+        assert calls["total"] >= b_tot + 5
+        assert calls["by"].get("echo", 0) >= 5
+        lat = snap["histograms"]["rpc.client.latency_us"]
+        assert lat["count"] >= 5
+        assert lat["by"]["echo"]["count"] >= 5
+        assert snap["counters"]["rpc.server.requests"]["by"]["echo"] >= 5
+    finally:
+        srv.kill()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# ------------------------------------------------- wire round-trips
+
+
+def test_stats_and_metrics_round_trip_fabric_service_wire():
+    """Satellite: stats()["phases"]/["feed"]/["events_dropped"] and the
+    new metrics() RPC, asserted over the real fabric_service socket
+    (only health/set_pipeline_depth were wire-asserted before)."""
+    from tpu6824.core.fabric_service import remote_fabric, serve_fabric
+
+    d = tempfile.mkdtemp(prefix="tscope-fs", dir="/var/tmp")
+    fab, servers = _kv_cluster()
+    srv = serve_fabric(fab, d + "/fab")
+    try:
+        ck = Clerk(servers)
+        for i in range(3):
+            ck.append("k", f"v{i}")
+        rf = remote_fabric(d + "/fab", timeout=10.0)
+        st = rf.stats()
+        assert "events_dropped" in st
+        # phases: the host-side profiler breakdown crossed the wire
+        ph = st["phases"]["phases"]
+        assert any(k in ph for k in ("stage", "dispatch", "retire"))
+        assert "apply" in ph  # the service leg's profiler rides the same
+        # feed: the decided fan-out block crossed the wire
+        assert st["feed"]["subscribers"] == 3
+        assert st["feed"]["delivered"] > 0
+        # metrics: one process-global snapshot over the same socket
+        m = rf.metrics()
+        assert m["counters"]["fabric.steps"]["total"] > 0
+        assert "rpc.server.requests" in m["counters"]
+    finally:
+        srv.kill()
+        _teardown(fab, servers)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# --------------------------------------------- nemesis flight artifact
+
+
+@pytest.mark.nemesis
+def test_violation_artifact_carries_flight_recorder(tscope, tmp_path):
+    """Acceptance: the disabled-dup-table violation run (the checker's
+    honesty test) produces a failure artifact whose flight_recorder
+    section holds spans for the violating key's ops, joinable to the
+    as-injected fault timeline by timestamp, stamped with the tpuscope
+    schema version."""
+    from tests.test_nemesis import run_kvpaxos_nemesis
+    from tpu6824.harness.linearize import check_history
+    from tpu6824.harness.nemesis import ReplayArtifact, seed_from_env
+
+    artifact = ReplayArtifact(test="tpuscope-violation")
+    history, _ = run_kvpaxos_nemesis(
+        seed_from_env(31337), duration=1.5, nclients=3, nops=16,
+        nemesis_report=artifact,
+        weights={"kill": 0.0, "clock_pause": 0.0,
+                 "partition_isolate": 0.3},
+        disable_dup=True, flaky_seed=5)
+    res = check_history(history)
+    assert not res.ok and res.violations  # the checker still catches it
+    key = res.violations[0].key
+
+    # Build the artifact exactly as the nemesis_report fixture would on
+    # failure, and write it.
+    d = artifact.to_dict()
+    assert d["tpuscope"] == obs.SCHEMA_VERSION
+    fr = d["flight_recorder"]
+    assert fr["schema"] == obs.SCHEMA_VERSION
+    recs = fr["records"]
+    # Spans for the violating key's ops made it into the ring...
+    applies = [r for r in recs if r["name"] == "service.apply"
+               and r["args"].get("key") == key]
+    assert applies, f"no apply spans for violating key {key!r}"
+    assert all(r["trace_id"] for r in applies)
+    # ...and the as-injected faults are in the SAME ring on the SAME
+    # monotonic clock, so the two join by timestamp:
+    faults = [r for r in recs if r["name"].startswith("nemesis.")]
+    assert faults, "no nemesis injection events in the flight ring"
+    t0 = d["t0_monotonic"]
+    for f in faults:
+        # each ring fault maps back into the recorded timeline's window
+        assert f["ts"] / 1e9 - t0 >= -0.1
+    lo = min(r["ts"] for r in applies)
+    hi = max(r["ts"] for r in applies)
+    assert any(lo - 2e9 <= f["ts"] <= hi + 2e9 for f in faults), \
+        "fault events do not interleave with the violating ops' spans"
+    path = artifact.write(str(tmp_path))
+    with open(path) as f:
+        reloaded = json.load(f)
+    assert reloaded["flight_recorder"]["records"]
+    assert reloaded["analyzer"].startswith("tpusan")

@@ -62,6 +62,13 @@ RULES: dict[str, str] = {
         "jit-traced function writes to host state (self attribute, "
         "closure container, global) — leaks tracers out of the trace and "
         "poisons host mirrors",
+    "metric-unregistered":
+        "metric created through the obs.metrics registry inside a "
+        "function body — metric objects must be created at module scope "
+        "(hot loops only call .inc()/.set()/.observe() on them); per-call "
+        "get-or-create re-enters the registry lock on the hot path and "
+        "hides the metric inventory (registry.inc(), the sanctioned "
+        "dynamic-name path, lives inside obs/)",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -78,6 +85,13 @@ _LOCK_SCOPE = (
 )
 _DET_SCOPE = ("harness/nemesis.py", "harness/linearize.py")
 _FEED_HOME = "core/fabric.py"  # the only module allowed to touch sub._q
+_MET_HOME = "obs/"  # the registry itself may get-or-create anywhere
+
+# Receivers that denote the tpuscope metrics registry, and the
+# get-or-create constructors the metric-unregistered rule polices.
+_MET_RECEIVERS = {"metrics", "_metrics", "obs_metrics", "REGISTRY",
+                  "registry"}
+_MET_CREATORS = {"counter", "gauge", "histogram"}
 
 # Attribute names that denote "the lock" in fabric/feed/service code.
 _LOCK_ATTRS = {"_lock", "mu", "_fs_lock"}
@@ -203,6 +217,7 @@ class _FileLint(ast.NodeVisitor):
         self.lock_scope = _in_scope(relpath, _LOCK_SCOPE)
         self.det_scope = _in_scope(relpath, _DET_SCOPE)
         self.feed_home = _in_scope(relpath, (_FEED_HOME,))
+        self.met_home = _in_scope(relpath, (_MET_HOME,))
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
@@ -404,6 +419,15 @@ class _FileLint(ast.NodeVisitor):
                 self._flag(node, "nondet-clock",
                            f"{d}() consumes the process-global RNG — use "
                            "the seeded random.Random instance")
+        if (d is not None and "." in d and not self.met_home
+                and self._fn_stack):
+            recv, tail = d.rsplit(".", 1)
+            if tail in _MET_CREATORS and \
+                    recv.rsplit(".", 1)[-1] in _MET_RECEIVERS:
+                self._flag(node, "metric-unregistered",
+                           f"{d}() inside a function body — create the "
+                           "metric at module scope and call "
+                           ".inc()/.set()/.observe() here")
         if d is not None and d.endswith("subscribe_decided"):
             # A delegation wrapper (a method itself NAMED subscribe_decided
             # forwarding to the fabric) is not a consumer.

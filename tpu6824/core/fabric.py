@@ -39,10 +39,23 @@ from tpu6824.core.intern import Intern
 from tpu6824.core.kernel import (
     NO_VAL, apply_starts, apply_starts_compact, init_state,
 )
+from tpu6824.obs import metrics as obs_metrics
+from tpu6824.obs import tracing as obs_tracing
 from tpu6824.utils import crashsink
 from tpu6824.utils.locks import new_rlock
 from tpu6824.utils.profiling import PhaseProfiler
 from tpu6824.utils.trace import EventLog, dprintf
+
+# tpuscope metrics (module scope per the metric-unregistered rule):
+# fabric health gauges refreshed at every stats() poll, plus the
+# columnar feed-batch histogram (one observe per retire's fan-out — the
+# feed path's batch-granular registry update).  The `fabric.health.`
+# prefix keeps the gauges clear of the EventLog mirror's `fabric.<name>`
+# counters (the registry rejects name/kind collisions loudly).
+_M_DECIDED = obs_metrics.gauge("fabric.health.decided_cells")
+_M_FEED_DEPTH = obs_metrics.gauge("fabric.health.feed_depth_max")
+_M_STALLED = obs_metrics.gauge("fabric.health.stalled_groups")
+_M_FEED_BATCH = obs_metrics.histogram("fabric.feed_batch_cells")
 
 # Reference unreliable-network rates: 10% of requests dropped before
 # processing, a further ~20% processed but the reply discarded
@@ -347,8 +360,10 @@ class PaxosFabric:
         self._max_seq = np.full((G, P), -1, np.int64)  # Max() running high-water
         # Observability (SURVEY §5 build note): per-step event log + counters.
         # The EventLog counters are the single source of truth for steps/msgs;
-        # steps_total/msgs_total below are read-through views.
-        self.events = EventLog()
+        # steps_total/msgs_total below are read-through views.  The
+        # registry prefix mirrors every bump into the process-global
+        # tpuscope metrics registry (obs/metrics.py).
+        self.events = EventLog(registry_prefix="fabric")
         self._decided_cells = 0  # running count of decided (g, i, p) cells
         # Health bookkeeping (stats()["health"]): when the last dispatch
         # retired into the mirrors, when each group last decided anything,
@@ -569,6 +584,7 @@ class PaxosFabric:
 
     def _step_once_full(self):
         t0 = time.perf_counter_ns()
+        t0_mono = time.monotonic_ns()
         with self._lock:
             (s_arr, r_arr, link, done, reliable, keys, drop_req,
              drop_rep) = self._drain_and_stage_locked()
@@ -618,6 +634,7 @@ class PaxosFabric:
         self._state = state
         self.profiler.add("dispatch", time.perf_counter_ns() - t0)
         t_r = time.perf_counter_ns()
+        t_r_mono = time.monotonic_ns()
         decided, done_view, touched, msgs = jax.device_get(
             (io.decided, io.done_view, touched_acc, msgs_acc)
         )
@@ -676,6 +693,18 @@ class PaxosFabric:
             self._stepped.notify_all()
             self._last_retire_t = time.monotonic()
             self.profiler.add("retire", time.perf_counter_ns() - t_r)
+            if (s_arr is not None or r_arr is not None or int(msgs) > 0
+                    or newly > 0):
+                # Flight-recorder batch spans (always-on, activity-gated
+                # — cf. _retire_compact): the full-io path has no
+                # launch/retire split, so stage+dispatch ride one
+                # dispatch span and retire covers the readback+mirror.
+                obs_tracing.batch("fabric.dispatch.batch", t0_mono,
+                                  steps=self._spd,
+                                  staged=0 if s_arr is None else len(s_arr))
+                obs_tracing.batch("fabric.retire.batch", t_r_mono,
+                                  steps=self._spd, newly=int(newly),
+                                  msgs=int(msgs))
 
     # ------------------------------------------------- compact step path
 
@@ -837,7 +866,15 @@ class PaxosFabric:
 
         last_pads = pads(chunks[-1])
         self.profiler.add("stage", time.perf_counter_ns() - t0)
+        if nr + ns:
+            # Flight-recorder batch span (always-on, activity-gated):
+            # interleaves with any traced op's causal chain by timestamp.
+            obs_tracing.batch("fabric.stage.batch",
+                              time.monotonic_ns()
+                              - (time.perf_counter_ns() - t0),
+                              resets=nr, starts=ns)
         t0 = time.perf_counter_ns()
+        t0_mono = time.monotonic_ns()
         for c in chunks[:-1]:
             state, slot_dev = _apply_compact_jit(state, slot_dev,
                                                  *pads(c, bucket=B))
@@ -845,6 +882,9 @@ class PaxosFabric:
             state, slot_dev, *last_pads, link, done, sub,
             drop_req, drop_rep)
         self.profiler.add("dispatch", time.perf_counter_ns() - t0)
+        if nr + ns:
+            obs_tracing.batch("fabric.dispatch.batch", t0_mono,
+                              steps=self._spd, staged=nr + ns)
         st2, slot_dev = out[0], out[1]
         self._state = st2
         self._slot_seq_dev = slot_dev
@@ -860,6 +900,7 @@ class PaxosFabric:
         feed before GC runs, while the slot map still names their seqs."""
         handles, n_inject, epoch = pending
         t_r = time.perf_counter_ns()
+        t_r_mono = time.monotonic_ns()
         cnt, idx, vals, iseqs, maxseq, done_view, msgs = jax.device_get(
             handles)
         G, I, P = self.G, self.I, self.P
@@ -977,6 +1018,12 @@ class PaxosFabric:
             self._stepped.notify_all()
             self._last_retire_t = time.monotonic()
             self.profiler.add("retire", time.perf_counter_ns() - t_r)
+            if n_inject > 0 or int(msgs) > 0 or newly > 0:
+                # Activity-gated so an idle clock doesn't flood the
+                # flight ring (the recorder is always on).
+                obs_tracing.batch("fabric.retire.batch", t_r_mono,
+                                  steps=self._spd, newly=int(newly),
+                                  msgs=int(msgs))
 
     def _step_once_compact(self):
         self._retire_compact(self._launch_compact())
@@ -1381,6 +1428,8 @@ class PaxosFabric:
         subs = self._subs
         decode = self._feed_decode_locked
         woken: list[DecidedSub] = []
+        tr = obs_tracing.enabled()
+        t0_mono = time.monotonic_ns() if tr else 0
         n = 0
         for a, b in zip(starts, ends):
             g, p = divmod(int(key_o[a]), P)
@@ -1398,8 +1447,16 @@ class PaxosFabric:
                 n += b - a
                 if sub.wake is not None:
                     woken.append(sub)  # one run per (g, p): no dups
+            if tr:
+                # Per-(g, p) feed span, ONE per run (never per cell) —
+                # tracing-gated so the default hot path records nothing.
+                obs_tracing.batch("fabric.feed", t0_mono, g=g, p=p,
+                                  cells=b - a)
         if n:
             self.events.bump("feed_delivered", n)
+            # Columnar registry update: one histogram observation per
+            # retire's whole fan-out, never per cell.
+            _M_FEED_BATCH.observe(n)
         for sub in woken:
             sub.wake()
         self.profiler.add("feed", time.perf_counter_ns() - t0)
@@ -1752,12 +1809,29 @@ class PaxosFabric:
                     "subscribers": sum(len(v) for v in self._subs.values()),
                     "delivered": counters.get("feed_delivered", 0),
                 },
+                # EventLog ring overflow, surfaced per the no-silent-caps
+                # rule (the ring capacity knob is TPU6824_EVENTLOG_CAP).
+                "events_dropped": counters.get("dropped", 0),
                 "health": self._health_locked(
                     _STALL_AFTER if stall_after is None else stall_after),
             }
         out["rates"] = self.events.rates()
         out["phases"] = PhaseProfiler.breakdown(self.profiler.snapshot())
+        # Refresh the registry's fabric-health gauges at every poll —
+        # stats() is the harness's health window, so the registry's view
+        # is exactly as fresh as the last poll.
+        h = out["health"]
+        _M_DECIDED.set(out["decided_cells"])
+        _M_FEED_DEPTH.set(h["feed_depth_max"])
+        _M_STALLED.set(len(h["stalled_groups"]))
         return out
+
+    def metrics(self) -> dict:
+        """The process-global tpuscope metrics snapshot (obs/metrics.py)
+        — exported over the fabric_service wire next to stats(), so one
+        poller sees RPC transport, clerk, service, and fabric counters
+        in a single JSON shape."""
+        return obs_metrics.snapshot()
 
     def _health_locked(self, stall_after: float) -> dict:
         """Graceful-degradation report: how stale the host mirrors are

@@ -40,8 +40,10 @@ FABRIC_RPCS = [
     "ndecided", "set_unreliable", "partition", "heal", "deafen",
     "set_link", "kill", "revive", "is_dead", "set_pipeline_depth",
     # introspection (stats carries the graceful-degradation health block:
-    # last-retire age, feed queue depths, stalled-group detection)
-    "dims", "stats",
+    # last-retire age, feed queue depths, stalled-group detection;
+    # metrics is the process-global tpuscope registry snapshot — one
+    # JSON shape spanning rpc/clerk/service/fabric counters)
+    "dims", "stats", "metrics",
 ]
 
 

@@ -43,7 +43,9 @@ import re
 import threading
 import time
 
+from tpu6824.obs import tracing as _tracing
 from tpu6824.utils import crashsink
+from tpu6824.utils.trace import dprintf
 
 #: Relative frequency of each action in generated schedules.  Actions a
 #: target does not list in its spec() are skipped; extras default to
@@ -458,14 +460,28 @@ class Nemesis:
                 rec = {"t": ev.t,
                        "wall": round(time.monotonic() - self.t0, 6),
                        "action": ev.action, "args": dict(ev.args)}
+                dprintf("nemesis", "inject t=%+.3f %s %r", ev.t,
+                        ev.action, ev.args)
+                # tpuscope flight recorder (always-on): the as-injected
+                # fault, timestamped on the same monotonic clock as every
+                # span — the join key for "what was the system doing when
+                # the violation happened".  Args go as a dict: fault args
+                # like `name` must not collide with event()'s signature.
+                _tracing.event(f"nemesis.{ev.action}", comp="nemesis",
+                               args={"t": ev.t,
+                                     **{k: repr(v)
+                                        for k, v in ev.args.items()}})
                 try:
                     self.target.apply(ev.action, ev.args)
                 except Exception as e:  # noqa: BLE001 — recorded, not fatal
                     rec["error"] = repr(e)
+                    dprintf("nemesis", "inject %s FAILED: %r", ev.action, e)
                 self.timeline.append(rec)
         finally:
             try:
                 self.target.restore()
+                dprintf("nemesis", "restored target after %d injections",
+                        len(self.timeline))
             except Exception as e:  # noqa: BLE001 — restore is best-effort
                 crashsink.record("nemesis-restore", e, fatal=False)
 
@@ -537,11 +553,25 @@ class ReplayArtifact:
 
         d = {"test": self.test, "seed": self.seed,
              "replay": self.replay_command(), "extra": self.extra,
-             "analyzer": ANALYZER_VERSION}
+             "analyzer": ANALYZER_VERSION,
+             # tpuscope schema stamp, next to the analyzer stamp: which
+             # span/metric shapes the flight_recorder section speaks.
+             "tpuscope": _tracing.SCHEMA_VERSION}
         if self.schedule is not None:
             d["schedule"] = self.schedule.to_dict()
         if self.nemesis is not None:
             d["timeline"] = list(self.nemesis.timeline)
+            if self.nemesis.t0 is not None:
+                # Monotonic origin of the timeline's `wall` offsets —
+                # the flight recorder's `ts` (monotonic ns) joins the
+                # fault timeline via ts/1e9 - t0.
+                d["t0_monotonic"] = self.nemesis.t0
+        # The flight recorder dump: recent spans (the violating ops' per-
+        # op chains when tracing was on) + always-on events (nemesis
+        # injections, fabric batch activity), joinable by timestamp and
+        # trace_id — the "what was the system doing at that moment" the
+        # verdict alone cannot answer.
+        d["flight_recorder"] = _tracing.flight_snapshot()
         return d
 
     def write(self, outdir: str = "/tmp") -> str:

@@ -1,0 +1,39 @@
+"""tpu6824.obs — "tpuscope": the observability layer.
+
+Three parts, threaded through every other layer (ISSUE 5):
+
+  - `obs.tracing` — causal per-op spans (clerk → rpc → service-submit →
+    fabric-dispatch → apply → reply) + the always-on flight recorder +
+    Chrome/Perfetto export.  `TPU6824_TRACE=1` turns per-op spans on;
+    default-off costs zero per-op allocations.
+  - `obs.metrics` — the process-global metrics registry (counters,
+    gauges, log2-bucket histograms) absorbing the EventLog counters,
+    RPC transport per-method counts/latencies, clerk backoff/retries,
+    and fabric health; one `snapshot()` JSON shape, served over the
+    fabric_service wire and dumped into BENCH_*.json.
+  - the flight recorder's dump rides the nemesis failure artifact
+    (`harness/nemesis.py::ReplayArtifact`), so a linearizability
+    violation ships with the correlated trace of the offending ops.
+
+Stdlib-only on purpose: importable from the analysis CLI, daemons, and
+clerks without dragging in JAX.
+"""
+
+from tpu6824.obs import metrics, tracing  # noqa: F401
+from tpu6824.obs.tracing import (  # noqa: F401
+    FLIGHT,
+    SCHEMA_VERSION,
+    TraceContext,
+    batch,
+    child,
+    complete,
+    current,
+    disable,
+    enable,
+    enabled,
+    event,
+    export_trace,
+    flight_snapshot,
+    span,
+    use_ctx,
+)

@@ -1,0 +1,240 @@
+"""tpuscope metrics — one process-global registry for every component.
+
+Before this module each layer kept its own counters: the fabric's
+`EventLog`, the RPC servers' `rpc_count`, ad-hoc bench accumulators.
+There was no single surface answering "what is this process doing" — the
+question every production poller asks.  The registry holds three metric
+kinds behind get-or-create constructors:
+
+  - `Counter`  — monotonic totals, with optional per-key sub-counts
+    (e.g. RPC calls by method name);
+  - `Gauge`    — last-written values (feed depth, stalled groups);
+  - `Histogram`— fixed log2 buckets (bucket k counts observations in
+    [2^(k-1), 2^k), i.e. bit_length(v) == k), so `observe()` is a
+    bit_length + one int add — no per-observation allocation, ever.
+
+Hot-path discipline (enforced by the tpusan `metric-unregistered` rule):
+metric OBJECTS are created via `metrics.counter/gauge/histogram` at
+module scope; hot loops only call `.inc()/.set()/.observe()` on the
+already-created object.  Batch producers (the decided-feed fan-out, the
+EventLog mirror) update once per BATCH, columnar, per the feed-columnar
+contract.  `metrics.inc()` is the sanctioned dynamic-name path: the
+get-or-create lives here, inside the registry, not at the call site.
+
+`snapshot()` returns one JSON-safe dict — served over the fabric_service
+wire (`PaxosFabric.metrics`) and dumped by the bench legs into
+`BENCH_*.json`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+           "counter", "gauge", "histogram", "inc", "snapshot", "reset"]
+
+_NBUCKETS = 64  # log2 buckets cover any int64-scale observation
+
+
+class Counter:
+    """Monotonic total + optional per-key sub-totals (key cardinality is
+    the caller's responsibility — method names, not user data)."""
+
+    __slots__ = ("name", "_mu", "total", "by")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mu = threading.Lock()
+        self.total = 0
+        self.by: dict[str, int] = {}
+
+    def inc(self, n: int = 1, key: str | None = None) -> None:
+        with self._mu:
+            self.total += n
+            if key is not None:
+                self.by[key] = self.by.get(key, 0) + n
+
+    def snapshot(self):
+        # Always the same shape — a scalar-until-first-keyed-bump counter
+        # would flip type between polls and break every differ downstream.
+        with self._mu:
+            return {"total": self.total, "by": dict(self.by)}
+
+
+class Gauge:
+    """Last-written value (optionally per key)."""
+
+    __slots__ = ("name", "_mu", "value", "by")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mu = threading.Lock()
+        self.value = 0.0
+        self.by: dict[str, float] = {}
+
+    def set(self, v: float, key: str | None = None) -> None:
+        with self._mu:
+            if key is None:
+                self.value = v
+            else:
+                self.by[key] = v
+
+    def snapshot(self):
+        with self._mu:
+            return {"value": self.value, "by": dict(self.by)}
+
+
+class Histogram:
+    """Fixed log2-bucket histogram: bucket k counts observations v with
+    bit_length(v) == k, i.e. v in [2^(k-1), 2^k) for positive ints —
+    one bit_length + one list-index add per observation, no allocation.
+    Values are rounded to non-negative ints by the caller's choice of
+    unit (latencies in µs, sizes in cells).  `observe_many` takes any
+    iterable for columnar batch updates from feed-path producers."""
+
+    __slots__ = ("name", "_mu", "count", "sum", "_buckets", "by")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mu = threading.Lock()
+        self.count = 0
+        self.sum = 0
+        self._buckets = [0] * _NBUCKETS
+        self.by: dict[str, Histogram] = {}
+
+    def observe(self, v, key: str | None = None) -> None:
+        iv = int(v)
+        if iv < 0:
+            iv = 0
+        b = iv.bit_length()
+        if b >= _NBUCKETS:
+            b = _NBUCKETS - 1
+        with self._mu:
+            self.count += 1
+            self.sum += iv
+            self._buckets[b] += 1
+            if key is not None:
+                sub = self.by.get(key)
+                if sub is None:
+                    sub = self.by[key] = Histogram(f"{self.name}.{key}")
+        if key is not None:
+            sub.observe(iv)
+
+    def observe_many(self, values) -> None:
+        """Columnar batch observe (one lock acquisition per batch)."""
+        ivs = [max(0, int(v)) for v in values]
+        with self._mu:
+            for iv in ivs:
+                b = iv.bit_length()
+                self._buckets[min(b, _NBUCKETS - 1)] += 1
+                self.sum += iv
+            self.count += len(ivs)
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (the bucket's exclusive
+        upper bound, 2^k)."""
+        with self._mu:
+            target = q * self.count
+            seen = 0
+            for k, c in enumerate(self._buckets):
+                seen += c
+                if c and seen >= target:
+                    return float(1 << k)
+        return 0.0
+
+    def snapshot(self):
+        with self._mu:
+            out = {
+                "count": self.count,
+                "sum": self.sum,
+                "pow2": {str(k): c for k, c in enumerate(self._buckets)
+                         if c},
+            }
+            by = {k: h for k, h in self.by.items()}
+        out["by"] = {k: h.snapshot() for k, h in by.items()}
+        return out
+
+
+class Registry:
+    """name → metric, get-or-create, one per process (`REGISTRY`).
+    Re-registering a name with a different kind raises loudly — silent
+    type-shadowing would corrupt every poller downstream."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        with self._mu:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name)
+            elif type(m) is not cls:
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def inc(self, name: str, n: int = 1, key: str | None = None) -> None:
+        """Dynamic-name counter bump — the sanctioned path for producers
+        whose counter names are data (the EventLog mirror): get-or-create
+        happens HERE, inside the registry, not at the hot call site."""
+        self._get(name, Counter).inc(n, key=key)
+
+    def snapshot(self) -> dict:
+        """{"counters": {...}, "gauges": {...}, "histograms": {...}} —
+        JSON-safe, the one shape every consumer (fabric_service wire,
+        bench legs, tests) reads."""
+        with self._mu:
+            items = list(self._metrics.items())
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in items:
+            if isinstance(m, Counter):
+                out["counters"][name] = m.snapshot()
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.snapshot()
+            else:
+                out["histograms"][name] = m.snapshot()
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric (test isolation only — live metric objects
+        held by modules keep working but are no longer snapshot)."""
+        with self._mu:
+            self._metrics.clear()
+
+
+REGISTRY = Registry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return REGISTRY.histogram(name)
+
+
+def inc(name: str, n: int = 1, key: str | None = None) -> None:
+    REGISTRY.inc(name, n, key=key)
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()
+
+
+def reset() -> None:
+    REGISTRY.reset()

@@ -1,0 +1,277 @@
+"""tpuscope tracing — causal per-op spans + an always-on flight recorder.
+
+Before this module a clerk op's life was only visible as aggregates
+(`PhaseProfiler` wall-time buckets, `EventLog` counters).  tpuscope makes
+the op itself the unit: a `TraceContext` (trace_id, span_id) is born at
+the clerk, carried through the RPC envelope (`rpc/transport.py`'s
+optional third frame element), stamped by the service into the proposed
+value's metadata (`Op.tc`), recovered on the decided-feed/apply side,
+and closed at the clerk reply — so one op's spans read
+clerk → rpc → service-submit → fabric-dispatch → apply → reply in
+parent/child order, interleaved with the fabric's batch events
+(stage/dispatch/retire and per-(g, p) feed deliveries).
+
+Two regimes, by design:
+
+  - **Tracing** (`TPU6824_TRACE=1` / `enable()`, default OFF): per-op
+    spans.  When off, every producer's guard (`span()` returns None,
+    `enabled()` is False) keeps the hot path at ZERO per-op allocations
+    — the steady-state jitguard and bench contracts assume this.
+    `TPU6824_TRACE_SAMPLE` (0..1) samples ROOT creation, so a loaded
+    deployment can trace 1% of ops.
+  - **Flight recorder** (always on): a bounded ring of recent spans and
+    instant events across all components (fabric batch events, nemesis
+    injections, any finished span).  Batch/fault granularity only —
+    nothing per-op lands here unless tracing is on.  The nemesis
+    failure artifact dumps the ring, so a linearizability violation
+    ships with the correlated trace of the offending ops
+    (`TPU6824_FLIGHT_CAP` sizes the ring).
+
+Timestamps are `time.monotonic_ns()` throughout — joinable against the
+nemesis timeline's monotonic `wall` offsets via the artifact's `t0`.
+`export_trace(path)` writes Chrome trace-event JSON (load in Perfetto /
+chrome://tracing) alongside the `jax.profiler` device traces
+`utils/profiling.py` already captures.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import NamedTuple
+
+SCHEMA_VERSION = "tpuscope-1.0.0"
+
+_ENABLED = os.environ.get("TPU6824_TRACE", "") in ("1", "true", "yes")
+_SAMPLE = float(os.environ.get("TPU6824_TRACE_SAMPLE", "1.0"))
+_FLIGHT_CAP = int(os.environ.get("TPU6824_FLIGHT_CAP", 16384))
+
+# itertools.count.__next__ is atomic under the GIL — ids are unique
+# across threads without a lock.
+_ids = itertools.count(1)
+_tls = threading.local()
+_rng = random.Random()
+
+
+class TraceContext(NamedTuple):
+    """The portable identity of 'the current span': what rides the RPC
+    envelope and the proposed value's metadata (as a plain 2-tuple)."""
+
+    trace_id: int
+    span_id: int
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable(sample: float = 1.0) -> None:
+    """Turn per-op tracing on (tests / live opt-in)."""
+    global _ENABLED, _SAMPLE
+    _SAMPLE = sample
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+    _tls.ctx = None
+
+
+def current() -> TraceContext | None:
+    """The calling thread's active context (None when untraced)."""
+    return getattr(_tls, "ctx", None)
+
+
+@contextlib.contextmanager
+def use_ctx(ctx: TraceContext | None):
+    """Make `ctx` the thread's active context for the enclosed region
+    (RPC servers wrap handler invocation in this; in-process call legs
+    wrap the downcall)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
+
+
+# ------------------------------------------------------- flight recorder
+
+
+class FlightRecorder:
+    """Bounded, always-on ring of recent span/event records.  Records are
+    flat dicts (see `complete`/`event` for the shape); overflow drops the
+    oldest and counts the drop — no silent caps."""
+
+    def __init__(self, capacity: int = _FLIGHT_CAP):
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, capacity))
+        self.dropped = 0
+
+    def record(self, rec: dict) -> None:
+        with self._mu:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(rec)
+
+    def snapshot(self) -> list[dict]:
+        with self._mu:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._ring.clear()
+            self.dropped = 0
+
+
+FLIGHT = FlightRecorder()
+
+
+# ----------------------------------------------------------------- spans
+
+
+def complete(name: str, trace_id: int, parent_id: int, t0_ns: int,
+             t1_ns: int | None = None, comp: str = "app", **args) -> int:
+    """Record a FINISHED span with explicit timestamps (the apply side
+    emits fabric-dispatch/apply spans retroactively from the proposal
+    record).  Returns the new span's id so the caller can chain
+    children."""
+    sid = next(_ids)
+    if t1_ns is None:
+        t1_ns = time.monotonic_ns()
+    FLIGHT.record({"ph": "X", "name": name, "comp": comp,
+                   "trace_id": trace_id, "span_id": sid,
+                   "parent_id": parent_id, "ts": t0_ns,
+                   "dur": max(0, t1_ns - t0_ns), "args": args})
+    return sid
+
+
+class Span:
+    """One open span; `end()` records it into the flight ring.  Only
+    ever constructed when tracing is enabled (via `span()`/`child()`)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "comp",
+                 "t0_ns", "args")
+
+    def __init__(self, name: str, trace_id: int, parent_id: int,
+                 comp: str, args: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = next(_ids)
+        self.parent_id = parent_id
+        self.comp = comp
+        self.t0_ns = time.monotonic_ns()
+        self.args = args
+
+    @property
+    def ctx(self) -> TraceContext:
+        return TraceContext(self.trace_id, self.span_id)
+
+    def end(self, **more) -> None:
+        if more:
+            self.args.update(more)
+        FLIGHT.record({"ph": "X", "name": self.name, "comp": self.comp,
+                       "trace_id": self.trace_id, "span_id": self.span_id,
+                       "parent_id": self.parent_id, "ts": self.t0_ns,
+                       "dur": time.monotonic_ns() - self.t0_ns,
+                       "args": self.args})
+
+
+def span(name: str, comp: str = "app", **args) -> Span | None:
+    """Open a span: child of the thread's current context when one is
+    active, otherwise a NEW ROOT (subject to `TPU6824_TRACE_SAMPLE`).
+    Returns None when tracing is disabled or the root was sampled out —
+    callers guard with `if sp is not None`."""
+    if not _ENABLED:
+        return None
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        return Span(name, ctx.trace_id, ctx.span_id, comp, args)
+    if _SAMPLE < 1.0 and _rng.random() >= _SAMPLE:
+        return None
+    return Span(name, next(_ids), 0, comp, args)
+
+
+def child(name: str, parent: TraceContext | None = None,
+          comp: str = "app", **args) -> Span | None:
+    """Open a span that must have a parent (explicit, or the thread's
+    current context) — never a root.  None when disabled or parentless,
+    so mid-stack producers cannot accidentally start orphan traces."""
+    if not _ENABLED:
+        return None
+    ctx = parent if parent is not None else getattr(_tls, "ctx", None)
+    if ctx is None:
+        return None
+    return Span(name, ctx.trace_id, ctx.span_id, comp, args)
+
+
+def event(name: str, comp: str = "app", trace_id: int = 0,
+          args: dict | None = None, **kw) -> None:
+    """Instant event straight into the flight ring — ALWAYS ON (fault
+    injections, config pushes; never call per-op on a hot path).  Pass
+    `args` as a dict when payload keys could collide with this
+    signature's parameter names (e.g. a fault's `name` argument)."""
+    a = dict(args) if args else {}
+    if kw:
+        a.update(kw)
+    FLIGHT.record({"ph": "i", "name": name, "comp": comp,
+                   "trace_id": trace_id, "span_id": next(_ids),
+                   "parent_id": 0, "ts": time.monotonic_ns(), "dur": 0,
+                   "args": a})
+
+
+def batch(name: str, t0_ns: int, comp: str = "fabric", **args) -> None:
+    """Batch-granularity span (one per fabric stage/dispatch/retire, not
+    per op) into the flight ring — always on; producers gate on activity
+    so an idle clock doesn't flood the ring."""
+    FLIGHT.record({"ph": "X", "name": name, "comp": comp,
+                   "trace_id": 0, "span_id": next(_ids), "parent_id": 0,
+                   "ts": t0_ns, "dur": time.monotonic_ns() - t0_ns,
+                   "args": args})
+
+
+# ---------------------------------------------------------------- export
+
+
+def export_trace(path: str, trace_id: int | None = None) -> str:
+    """Write the flight ring as Chrome trace-event JSON (Perfetto /
+    chrome://tracing / `perfetto.dev` all load it).  With `trace_id`,
+    only that trace's spans plus the untagged batch events (trace_id 0)
+    are exported, so one op's causal chain stays readable against the
+    fabric batches that carried it.  Returns `path`."""
+    comp_tid: dict[str, int] = {}
+    evs = []
+    for r in FLIGHT.snapshot():
+        if trace_id is not None and r["trace_id"] not in (trace_id, 0):
+            continue
+        tid = comp_tid.setdefault(r["comp"], len(comp_tid) + 1)
+        ev = {"name": r["name"], "ph": r["ph"], "pid": 1, "tid": tid,
+              "ts": r["ts"] / 1e3,  # chrome wants microseconds
+              "args": {"trace_id": r["trace_id"],
+                       "span_id": r["span_id"],
+                       "parent_id": r["parent_id"], **r["args"]}}
+        if r["ph"] == "X":
+            ev["dur"] = r["dur"] / 1e3
+        else:
+            ev["s"] = "g"
+        evs.append(ev)
+    meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+             "args": {"name": comp}} for comp, tid in comp_tid.items()]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": meta + evs, "displayTimeUnit": "ms",
+                   "metadata": {"tpuscope": SCHEMA_VERSION}}, f)
+    return path
+
+
+def flight_snapshot() -> dict:
+    """The flight recorder as one JSON-safe block (the nemesis artifact's
+    `flight_recorder` section)."""
+    return {"schema": SCHEMA_VERSION, "capacity": FLIGHT._ring.maxlen,
+            "dropped": FLIGHT.dropped, "records": FLIGHT.snapshot()}

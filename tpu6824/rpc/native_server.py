@@ -27,6 +27,7 @@ import pickle
 import threading
 
 from tpu6824.native.build import load
+from tpu6824.obs import tracing as _tracing
 from tpu6824.rpc import transport
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils import crashsink
@@ -183,13 +184,22 @@ class NativeServer:
 
     def _serve(self, conn_id: int, payload: bytes) -> None:
         try:
-            rpcname, args = pickle.loads(payload)
+            frame = pickle.loads(payload)
+            # Optional third element: a tpuscope TraceContext from a
+            # tracing-enabled peer (transport.call's envelope; untagged
+            # 2-tuples are the common wire).
+            rpcname, args = frame[0], frame[1]
+            wctx = frame[2] if len(frame) > 2 else None
             fn = self._handlers.get(rpcname)
             if fn is None:
                 reply = (False, f"no such rpc: {rpcname}")
             else:
                 try:
-                    reply = (True, fn(*args))
+                    if wctx is not None:
+                        with _tracing.use_ctx(_tracing.TraceContext(*wctx)):
+                            reply = (True, fn(*args))
+                    else:
+                        reply = (True, fn(*args))
                 except RPCError:
                     # Drop the connection without replying, as
                     # transport.Server does (zero-length = close marker).

@@ -51,12 +51,26 @@ import struct
 import threading
 import time
 
+from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import tracing as _tracing
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils import crashsink
+from tpu6824.utils.trace import dprintf
 
 # Reference accept-loop fault rates (paxos/paxos.go:528-544).
 REQ_DROP = 0.10
 REP_DROP = 0.20
+
+# tpuscope metrics (created HERE, at module scope — the tpusan
+# metric-unregistered contract): per-method client call/failure counts +
+# latency histogram, and the server-side fault-coin outcomes that used
+# to be invisible (a dropped reply looked identical to a dead server).
+_M_CALLS = _metrics.counter("rpc.client.calls")
+_M_FAILS = _metrics.counter("rpc.client.failures")
+_M_LAT = _metrics.histogram("rpc.client.latency_us")
+_M_SRV_REQS = _metrics.counter("rpc.server.requests")
+_M_SRV_DROP_REQ = _metrics.counter("rpc.server.dropped_requests")
+_M_SRV_DROP_REP = _metrics.counter("rpc.server.dropped_replies")
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 << 20
@@ -250,10 +264,21 @@ def call(addr: str, rpcname: str, *args, timeout: float = 10.0,
     — a failed pooled request is NEVER transparently retried, precisely so
     at-most-once stays the caller's job as the contract spells out.
     Application-level errors raised by the handler are re-raised verbatim.
+
+    Trace propagation (tpuscope): when tracing is enabled and the calling
+    thread carries a TraceContext, the request frame grows an optional
+    THIRD element `(trace_id, span_id)` and the call is wrapped in an
+    `rpc.call` span.  Untraced calls (the default) send the classic
+    2-tuple, so the wire is unchanged — backward-compatible with
+    untagged peers in both directions.
     """
     if pooled is None:
         pooled = POOLED_DEFAULT
     sock = ident = None
+    sp = _tracing.child("rpc.call", comp="rpc", method=rpcname) \
+        if _tracing.enabled() else None
+    t0 = time.perf_counter_ns()
+    _M_CALLS.inc(key=rpcname)
     try:
         try:
             if pooled:
@@ -267,7 +292,11 @@ def call(addr: str, rpcname: str, *args, timeout: float = 10.0,
                 sock.connect(addr)
             else:
                 sock.settimeout(timeout)
-            _send_frame(sock, (rpcname, args))
+            if sp is not None:
+                _send_frame(sock, (rpcname, args,
+                                   (sp.trace_id, sp.span_id)))
+            else:
+                _send_frame(sock, (rpcname, args))
             ok, payload = _recv_frame(sock)
         except RPCError:
             raise
@@ -277,11 +306,19 @@ def call(addr: str, rpcname: str, *args, timeout: float = 10.0,
             _pool.give(addr, sock, ident)
             sock = None  # returned healthy — don't close below
         if ok:
+            _M_LAT.observe((time.perf_counter_ns() - t0) // 1000,
+                           key=rpcname)
             return payload
         if isinstance(payload, BaseException):
             raise payload
         raise RPCError(f"{rpcname}@{addr}: {payload}")
+    except RPCError as e:
+        _M_FAILS.inc(key=rpcname)
+        dprintf("rpc", "call %s@%s failed: %s", rpcname, addr, e)
+        raise
     finally:
+        if sp is not None:
+            sp.end()
         if sock is not None:
             try:
                 sock.close()
@@ -445,7 +482,12 @@ class Server:
             conn.settimeout(30.0)
             while not self._dead.is_set():
                 try:
-                    rpcname, args = _recv_frame(conn)
+                    frame = _recv_frame(conn)
+                    # Optional third element: a tpuscope TraceContext
+                    # from a tracing-enabled peer (untagged 2-tuples are
+                    # the common wire; see call()).
+                    rpcname, args = frame[0], frame[1]
+                    wctx = frame[2] if len(frame) > 2 else None
                 except (RPCError, OSError):
                     return  # client hung up / idled out: connection done
                 with self._lock:
@@ -453,20 +495,33 @@ class Server:
                     unrel = self._unreliable
                     r1 = self._rng.random()
                     r2 = self._rng.random()
+                _M_SRV_REQS.inc(key=rpcname)
                 if unrel and r1 < REQ_DROP:
-                    return  # discard unprocessed (op NOT executed)
+                    # discard unprocessed (op NOT executed)
+                    _M_SRV_DROP_REQ.inc(key=rpcname)
+                    dprintf("rpc", "%s: dropped request %s (unreliable)",
+                            self.addr, rpcname)
+                    return
                 discard_reply = unrel and r2 < REP_DROP
                 fn = self._handlers.get(rpcname)
                 if fn is None:
                     reply = (False, f"no such rpc: {rpcname}")
                 else:
                     try:
-                        reply = (True, fn(*args))
+                        if wctx is not None:
+                            with _tracing.use_ctx(
+                                    _tracing.TraceContext(*wctx)):
+                                reply = (True, fn(*args))
+                        else:
+                            reply = (True, fn(*args))
                     except RPCError:
                         return  # transport-level refusal: drop, no reply
                     except Exception as e:  # app-level error → the caller
                         reply = (False, e)
                 if discard_reply:
+                    _M_SRV_DROP_REP.inc(key=rpcname)
+                    dprintf("rpc", "%s: dropped reply %s (unreliable)",
+                            self.addr, rpcname)
                     # Processed, but the client sees a dead connection — the
                     # SHUT_WR trick (paxos/paxos.go:535-538).
                     conn.shutdown(socket.SHUT_WR)

@@ -15,6 +15,8 @@ import random
 import threading
 import time
 
+from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import tracing as _tracing
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils.locks import new_lock
 
@@ -22,6 +24,14 @@ REQ_DROP = 0.10
 REP_DROP = 0.20
 
 _sysrand = random.SystemRandom()
+
+# tpuscope metrics (module scope per the metric-unregistered rule):
+# clerk retry pacing — how often clerks back off and for how long — and
+# the in-process clerk↔server leg's fault-coin outcomes.
+_M_BACKOFFS = _metrics.counter("clerk.backoff.sleeps")
+_M_BACKOFF_US = _metrics.histogram("clerk.backoff.sleep_us")
+_M_FLAKY_DROP_REQ = _metrics.counter("clerk.flaky.dropped_requests")
+_M_FLAKY_DROP_REP = _metrics.counter("clerk.flaky.dropped_replies")
 
 
 class Backoff:
@@ -71,6 +81,8 @@ class Backoff:
         dt = self.next_interval()
         if max_s is not None:
             dt = max(0.0, min(dt, max_s))
+        _M_BACKOFFS.inc()
+        _M_BACKOFF_US.observe(dt * 1e6)
         time.sleep(dt)
         return dt
 
@@ -187,14 +199,31 @@ class FlakyNet:
     def call(self, server_key, fn, *args, **kwargs):
         """Invoke fn; under unreliability, maybe drop the request (RPCError
         before execution) or the reply (fn runs, RPCError after) — the two
-        failure modes at-most-once machinery must survive."""
+        failure modes at-most-once machinery must survive.
+
+        Trace propagation: when the calling thread carries a tpuscope
+        context (the clerk opened a root span), the leg is wrapped in an
+        `rpc.call` child span and the span's context is made current for
+        the downcall — the in-process twin of `transport.call`'s wire
+        envelope, so the server-side submit stamps the same chain."""
         with self._lock:
             unrel = server_key in self._unreliable
             r1 = self._rng.random()
             r2 = self._rng.random()
         if unrel and r1 < REQ_DROP:
+            _M_FLAKY_DROP_REQ.inc()
             raise RPCError("request dropped")
-        out = fn(*args, **kwargs)
+        sp = _tracing.child("rpc.call", comp="rpc") \
+            if _tracing.enabled() else None
+        if sp is None:
+            out = fn(*args, **kwargs)
+        else:
+            try:
+                with _tracing.use_ctx(sp.ctx):
+                    out = fn(*args, **kwargs)
+            finally:
+                sp.end()
         if unrel and r2 < REP_DROP:
+            _M_FLAKY_DROP_REP.inc()
             raise RPCError("reply dropped")
         return out

@@ -23,21 +23,36 @@ from typing import NamedTuple
 
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
+from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import tracing as _tracing
 from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
 from tpu6824.utils.errors import OK, ErrNoKey, RPCError
 from tpu6824.utils.profiling import PhaseProfiler
 from tpu6824.utils import crashsink
 from tpu6824.utils.locks import new_rlock
 
+# tpuscope metrics (module scope per the metric-unregistered rule).
+_M_RETRIES = _metrics.counter("clerk.retries")
+_M_OP_LAT = _metrics.histogram("clerk.op_latency_us")
+_M_APPLIED = _metrics.counter("kvpaxos.applied")
+
 
 class Op(NamedTuple):
-    """One log entry (the gob-encoded Op of kvpaxos/server.go:25-33)."""
+    """One log entry (the gob-encoded Op of kvpaxos/server.go:25-33).
+
+    `tc` is tpuscope trace metadata — the proposer's (trace_id, span_id)
+    2-tuple, stamped at submit when tracing is enabled (None, allocation-
+    free, otherwise).  It rides the proposed value through consensus so
+    the decided-feed/apply side can emit fabric-dispatch/apply spans
+    parented into the clerk's causal chain; it is NOT identity — dup
+    filtering and lost-proposal matching key on (cid, cseq) only."""
 
     kind: str  # 'get' | 'put' | 'append'
     key: str
     value: str
     cid: int
     cseq: int
+    tc: tuple | None = None
 
 
 _DEAD = object()  # future sentinel: server killed while ops waited
@@ -47,14 +62,18 @@ class _Fut:
     """One submitted op's completion slot (value = the RSM reply).
     `t_set` records the resolve instant so latency accounting reads the
     real completion time, not the time a sweeping waiter got around to
-    noticing it (the pipelined clerk parks up to 0.2s between sweeps)."""
+    noticing it (the pipelined clerk parks up to 0.2s between sweeps).
+    `tctx` is the tpuscope context of the apply-side span (set BEFORE
+    the event fires, so the waiter can parent its reply span to the
+    apply that resolved it); None on untraced ops."""
 
-    __slots__ = ("ev", "value", "t_set")
+    __slots__ = ("ev", "value", "t_set", "tctx")
 
     def __init__(self):
         self.ev = threading.Event()
         self.value = None
         self.t_set = None
+        self.tctx = None
 
     def set(self, v):
         self.value = v
@@ -108,6 +127,10 @@ class KVPaxosServer:
         # never set outside tests.
         self._test_disable_dup = False
         self._waiters: dict[tuple[int, int], _Fut] = {}  # (cid, cseq) -> fut
+        # tpuscope: (cid, cseq) -> proposal monotonic_ns for traced ops
+        # (empty when tracing is off) — lets the apply side emit the
+        # fabric-dispatch span with the real propose→decide window.
+        self._trace_prop: dict[tuple[int, int], int] = {}
         self._subq: list[Op] = []        # submitted, not yet proposed
         self._inflight: dict[int, Op] = {}  # seq -> my undecided proposal
         self._next_seq = 0               # next seq I would propose at
@@ -136,6 +159,25 @@ class KVPaxosServer:
 
     # ------------------------------------------------------------ RSM core
 
+    def _trace_resolve(self, v: Op, fut: _Fut) -> None:
+        """tpuscope: the apply side of a traced op — emit the
+        `fabric.dispatch` span (propose→decide window, parented to the
+        proposer's service-submit span carried in `v.tc`) and the
+        `service.apply` span, then park the apply context on the future
+        so the waiter's reply span chains off it.  Only ever called for
+        ops whose value carries trace metadata (tracing was on at
+        submit), and only on the replica resolving a waiter — passive
+        replicas applying the same decided op emit nothing."""
+        now = time.monotonic_ns()
+        t_prop = self._trace_prop.pop((v.cid, v.cseq), now)
+        tid, submit_sid = v.tc
+        did = _tracing.complete("fabric.dispatch", tid, submit_sid,
+                                t_prop, now, comp="fabric", key=v.key)
+        aid = _tracing.complete("service.apply", tid, did, now, now,
+                                comp="kvpaxos", me=self.me, key=v.key,
+                                cid=v.cid, cseq=v.cseq)
+        fut.tctx = _tracing.TraceContext(tid, aid)
+
     def _apply(self, op: Op):
         """Apply one decided op (doGet/doPutAppend, kvpaxos/server.go:115-162)
         with at-most-once duplicate suppression; resolves any waiter parked
@@ -156,6 +198,8 @@ class KVPaxosServer:
             self.dup[op.cid] = (op.cseq, reply)
         fut = self._waiters.pop((op.cid, op.cseq), None)
         if fut is not None:
+            if op.tc is not None:
+                self._trace_resolve(op, fut)
             fut.set(reply)
         return reply
 
@@ -203,6 +247,8 @@ class KVPaxosServer:
                     dup[v.cid] = (v.cseq, reply)
                 fut = waiters_pop((v.cid, v.cseq), None)
                 if fut is not None:
+                    if v.tc is not None:
+                        self._trace_resolve(v, fut)
                     notif.append((fut, reply))
             self._pop_lost_inflight_locked(v)
         return notif
@@ -240,6 +286,7 @@ class KVPaxosServer:
         applied_n = self.applied + 1 - base0
         if applied_n > 0:
             prof.add("apply", apply_ns)
+            _M_APPLIED.inc(applied_n)  # columnar: one bump per drain
             t0 = time.perf_counter_ns()
             for fut, reply in notif:
                 fut.set(reply)
@@ -340,6 +387,8 @@ class KVPaxosServer:
                 continue  # applied via another replica's proposal
             props.append((nxt, op))
             self._inflight[nxt] = op
+            if op.tc is not None:  # tpuscope: dispatch-span start instant
+                self._trace_prop[(op.cid, op.cseq)] = time.monotonic_ns()
             nxt += 1
         self._subq = []
         self._next_seq = nxt
@@ -474,6 +523,8 @@ class KVPaxosServer:
         duplicates).  The in-process seam the pipelined clerk multiplexes
         on; the blocking RPC surface is _submit = submit_batch + wait."""
         futs = []
+        tr = _tracing.enabled()
+        cur = _tracing.current() if tr else None
         with self.mu:
             if self.dead:
                 raise RPCError("dead")
@@ -491,6 +542,21 @@ class KVPaxosServer:
                     fut = waiters.get(key)
                     if fut is None:
                         fut = _Fut()
+                        if tr:
+                            # tpuscope: stamp the op's trace metadata —
+                            # parent is the rpc leg's context (explicit
+                            # on pipelined-clerk ops, the thread's
+                            # current context on the direct path).
+                            pctx = (_tracing.TraceContext(*op.tc)
+                                    if op.tc is not None else cur)
+                            sp = _tracing.child("service.submit",
+                                                parent=pctx,
+                                                comp="kvpaxos",
+                                                key=op.key)
+                            if sp is not None:
+                                op = op._replace(
+                                    tc=(sp.trace_id, sp.span_id))
+                                sp.end()
                         waiters[key] = fut
                         subq.append(op)
                 futs.append(fut)
@@ -506,14 +572,25 @@ class KVPaxosServer:
         retry at-most-once — but the driver stops re-proposing it."""
         with self.mu:
             self._waiters.pop((cid, cseq), None)
+            self._trace_prop.pop((cid, cseq), None)
 
     def _submit(self, op: Op):
+        t0 = time.monotonic()
         fut = self.submit_nowait(op)
         if not fut.wait(self.op_timeout):
             self.abandon(op.cid, op.cseq)
             raise RPCError("op timeout (no majority?)")
         if fut.value is _DEAD:
             raise RPCError("server killed")
+        if fut.tctx is not None:
+            # tpuscope: the reply leg, parented to the apply span that
+            # resolved the future (closes the clerk→...→apply chain).
+            sp = _tracing.child("clerk.reply", parent=fut.tctx,
+                                comp="clerk")
+            if sp is not None:
+                sp.end()
+        done_at = fut.t_set if fut.t_set is not None else time.monotonic()
+        _M_OP_LAT.observe((done_at - t0) * 1e6)
         return fut.value
 
     def get(self, key: str, cid: int, cseq: int):
@@ -528,6 +605,7 @@ class KVPaxosServer:
             for fut in self._waiters.values():
                 fut.set(_DEAD)
             self._waiters.clear()
+            self._trace_prop.clear()
             if self._tap is not None:
                 self._tap.close()  # stop the fabric fanning into a corpse
         self._wake.set()
@@ -559,19 +637,35 @@ class Clerk:
         deadline = time.monotonic() + timeout if timeout else None
         i = 0
         self._backoff.reset()
-        while True:
-            srv = self.servers[i % len(self.servers)]
-            i += 1
-            try:
-                fn = getattr(srv, fn_name)
-                err, val = self.net.call(srv, fn, *args, self.cid, cseq)
-                return err, val
-            except RPCError:
-                pass
-            now = time.monotonic()
-            if deadline and now >= deadline:
-                raise RPCError("clerk timeout")
-            self._backoff.sleep(deadline - now if deadline else None)
+        # tpuscope root span: born here, closed at the clerk reply.  The
+        # root's context is made current around each attempt so the rpc
+        # leg (FlakyNet.call) and the server's submit chain off it.
+        root = _tracing.span("clerk.op", comp="clerk", op=fn_name,
+                             key=args[0] if fn_name == "get"
+                             else args[1] if args else "") \
+            if _tracing.enabled() else None
+        try:
+            while True:
+                srv = self.servers[i % len(self.servers)]
+                i += 1
+                try:
+                    fn = getattr(srv, fn_name)
+                    if root is None:
+                        return self.net.call(srv, fn, *args, self.cid,
+                                             cseq)
+                    with _tracing.use_ctx(root.ctx):
+                        return self.net.call(srv, fn, *args, self.cid,
+                                             cseq)
+                except RPCError:
+                    pass
+                now = time.monotonic()
+                if deadline and now >= deadline:
+                    raise RPCError("clerk timeout")
+                _M_RETRIES.inc()
+                self._backoff.sleep(deadline - now if deadline else None)
+        finally:
+            if root is not None:
+                root.end()
 
     def get(self, key: str, timeout=None) -> str:
         err, val = self._loop("get", key, timeout=timeout)
@@ -609,6 +703,38 @@ class PipelinedClerk:
         self._leader = 0
         self._backoff = Backoff()  # same knob semantics as Clerk's
 
+    # ------------------------------------------------- tpuscope plumbing
+    # The pipelined clerk bypasses the blocking RPC surface (it talks to
+    # submit_batch directly), so it opens its own per-op root + rpc-leg
+    # spans and stamps the op's trace metadata explicitly — the same
+    # chain the direct path gets from Clerk._loop + FlakyNet.call.
+
+    def _trace_open(self, op: Op):
+        """(op', (root, rpc_span)) with op' stamped, or (op, None) when
+        untraced (disabled / sampled out) — zero allocation then."""
+        root = _tracing.span("clerk.op", comp="clerk", op=op.kind,
+                             key=op.key)
+        if root is None:
+            return op, None
+        rsp = _tracing.child("rpc.call", parent=root.ctx, comp="rpc")
+        if rsp is None:
+            return op._replace(tc=(root.trace_id, root.span_id)), \
+                (root, None)
+        return op._replace(tc=(rsp.trace_id, rsp.span_id)), (root, rsp)
+
+    @staticmethod
+    def _trace_close(pair, fut) -> None:
+        """Close a traced op at the clerk reply: emit the reply span
+        (parented to the apply span the future carries) and end the
+        root."""
+        root, _rsp = pair
+        if fut is not None and fut.tctx is not None:
+            sp = _tracing.child("clerk.reply", parent=fut.tctx,
+                                comp="clerk")
+            if sp is not None:
+                sp.end()
+        root.end()
+
     def append_wave(self, key: str, values: list[str]) -> None:
         """Append values[c] as logical client c (len(values) <= width),
         all concurrently in flight; returns when every one is applied.
@@ -622,22 +748,35 @@ class PipelinedClerk:
         kvpaxos/client.go:69-104.)"""
         assert len(values) <= self.width
         srv = self.servers[self._leader % len(self.servers)]
+        tr = _tracing.enabled()
+        spans: dict[int, tuple] = {}
         ops = []
         for c, val in enumerate(values):
             cid, cseq = self.clients[c]
             cseq += 1
             self.clients[c][1] = cseq
-            ops.append(Op("append", key, val, cid, cseq))
+            op = Op("append", key, val, cid, cseq)
+            if tr:
+                op, pair = self._trace_open(op)
+                if pair is not None:
+                    spans[c] = pair
+            ops.append(op)
         try:
             futs = srv.submit_batch(ops)
         except RPCError:
             futs = [None] * len(ops)
+        for pair in spans.values():  # the rpc leg ends at submit return
+            if pair[1] is not None:
+                pair[1].end()
         deadline = time.monotonic() + self.op_timeout
-        for op, fut in zip(ops, futs):
+        for c, (op, fut) in enumerate(zip(ops, futs)):
             ok = False
             if fut is not None:
                 ok = fut.wait(max(0.0, deadline - time.monotonic()))
                 ok = ok and fut.value is not _DEAD
+            pair = spans.pop(c, None)
+            if pair is not None:
+                self._trace_close(pair, fut if ok else None)
             if not ok:
                 self._fail_over(srv, op)
 
@@ -662,6 +801,8 @@ class PipelinedClerk:
         bounds with waitn's poll budget (test_test.go:51-66)."""
         assert len(values_per_client) <= self.width
         srv = self.servers[self._leader % len(self.servers)]
+        tr = _tracing.enabled()
+        spans: dict[int, tuple] = {}
         queues = [list(vs) for vs in values_per_client]
         heads = [0] * len(queues)
         pend: dict[int, tuple[Op, _Fut | None, float]] = {}
@@ -672,7 +813,12 @@ class PipelinedClerk:
                     cid, cseq = self.clients[c]
                     cseq += 1
                     self.clients[c][1] = cseq
-                    ops.append(Op("append", key, q[heads[c]], cid, cseq))
+                    op = Op("append", key, q[heads[c]], cid, cseq)
+                    if tr:
+                        op, pair = self._trace_open(op)
+                        if pair is not None:
+                            spans[c] = pair
+                    ops.append(op)
                     heads[c] += 1
                     cs.append(c)
             if ops:
@@ -683,6 +829,9 @@ class PipelinedClerk:
                 dl = time.monotonic() + self.op_timeout
                 for c, op, fut in zip(cs, ops, futs):
                     pend[c] = (op, fut, dl)
+                    pair = spans.get(c)
+                    if pair is not None and pair[1] is not None:
+                        pair[1].end()  # the rpc leg ends at submit return
             if not pend:
                 return
             # Park on the oldest outstanding future, then sweep them all:
@@ -697,27 +846,39 @@ class PipelinedClerk:
                 time.sleep(0.001)
             now = time.monotonic()
             resolved = 0
+            lat_us: list[float] = []  # columnar: one observe per sweep
             for c in list(pend):
                 op, fut, dl = pend[c]
                 if fut is not None and fut.ev.is_set():
                     del pend[c]
+                    pair = spans.pop(c, None)
+                    if pair is not None:
+                        self._trace_close(
+                            pair, None if fut.value is _DEAD else fut)
                     if fut.value is _DEAD:
                         self._fail_over(srv, op)
                     else:
                         resolved += 1  # fast-path completion only
+                        # submit instant = dl - op_timeout (no extra
+                        # clock read on the submit side); resolve
+                        # instant = fut.t_set, stamped by the driver
+                        # at set() time so the sweep's park interval
+                        # never inflates the percentile tail.
+                        done_at = fut.t_set if fut.t_set is not None \
+                            else now
+                        lat_us.append(
+                            (done_at - (dl - self.op_timeout)) * 1e6)
                         if lat_sink is not None:
-                            # submit instant = dl - op_timeout (no extra
-                            # clock read on the submit side); resolve
-                            # instant = fut.t_set, stamped by the driver
-                            # at set() time so the sweep's park interval
-                            # never inflates the percentile tail.
-                            done_at = fut.t_set if fut.t_set is not None \
-                                else now
                             lat_sink.append(
                                 done_at - (dl - self.op_timeout))
                 elif fut is None or now >= dl:
                     del pend[c]
+                    pair = spans.pop(c, None)
+                    if pair is not None:
+                        self._trace_close(pair, None)
                     self._fail_over(srv, op)
+            if lat_us:
+                _M_OP_LAT.observe_many(lat_us)
             if resolved and on_done is not None:
                 on_done(resolved)
 
@@ -752,6 +913,7 @@ class PipelinedClerk:
                     raise RPCError(
                         f"pipelined clerk: op ({op.cid},{op.cseq}) found "
                         f"no live majority within {self.op_timeout}s")
+                _M_RETRIES.inc()
                 self._backoff.sleep(deadline - now)
 
     def get(self, key: str) -> str:

@@ -37,6 +37,7 @@ from typing import NamedTuple
 
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
+from tpu6824.obs import tracing as _tracing
 from tpu6824.ops.hashing import NSHARDS, key2shard
 from tpu6824.services import shardmaster
 from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
@@ -58,6 +59,10 @@ class Op(NamedTuple):
     cid: str  # string CIDs, as on the reference wire (shardkv/common.go:23)
     cseq: int
     extra: object  # reconf: (Config, xstate)
+    # tpuscope trace metadata: the submitting RPC leg's
+    # (trace_id, span_id), stamped at _serve when tracing is enabled
+    # (None otherwise); never part of op identity (dedup is (cid, cseq)).
+    tc: tuple | None = None
 
 
 class XState(NamedTuple):
@@ -156,6 +161,10 @@ class ShardKVServer:
             self.kv[op.key] = self.kv.get(op.key, "") + op.value
             reply = (OK, "")
         self.dup[op.cid] = (op.cseq, reply)
+        if op.tc is not None:  # tpuscope: apply-side span for traced ops
+            _tracing.complete("service.apply", op.tc[0], op.tc[1],
+                              time.monotonic_ns(), comp="shardkv",
+                              gid=self.gid, me=self.me, key=op.key)
         return reply
 
     def _drain_decided(self):
@@ -376,6 +385,15 @@ class ShardKVServer:
         return self._serve(Op(kind, key, value, cid, cseq, None))
 
     def _serve(self, op: Op):
+        # tpuscope: stamp the caller's trace context into the proposed
+        # value (the clerk/rpc leg set it current; see kvpaxos for the
+        # full span chain — shardkv stamps + emits the apply span only).
+        if _tracing.enabled():
+            sp = _tracing.child("service.submit", comp="shardkv",
+                                key=op.key, gid=self.gid)
+            if sp is not None:
+                op = op._replace(tc=(sp.trace_id, sp.span_id))
+                sp.end()
         with self.mu:
             if self.dead:
                 raise RPCError("dead")

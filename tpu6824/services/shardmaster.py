@@ -19,11 +19,13 @@ from typing import NamedTuple
 
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
+from tpu6824.obs import tracing as _tracing
 from tpu6824.ops.hashing import NSHARDS
 from tpu6824.ops.rebalance import UNASSIGNED, rebalance_host
 from tpu6824.services.common import FlakyNet, fresh_cid
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils import crashsink
+from tpu6824.utils.trace import dprintf
 
 
 @dataclass(frozen=True)
@@ -49,6 +51,10 @@ class Op(NamedTuple):
     shard: int
     cid: int
     cseq: int
+    # tpuscope trace metadata (see kvpaxos.Op.tc): the submitting leg's
+    # (trace_id, span_id) when tracing is enabled, else None; never part
+    # of op identity.
+    tc: tuple | None = None
 
 
 class ShardMasterServer:
@@ -90,6 +96,14 @@ class ShardMasterServer:
         elif op.kind == "query":
             reply = None  # resolved read-side after apply
         self.dup[op.cid] = (op.cseq, reply)
+        if op.kind != "query":
+            dprintf("shardmaster", "s%d applied %s gid=%d shard=%d -> "
+                    "config %d", self.me, op.kind, op.gid, op.shard,
+                    len(self.configs) - 1)
+        if op.tc is not None:  # tpuscope: apply-side span for traced ops
+            _tracing.complete("service.apply", op.tc[0], op.tc[1],
+                              time.monotonic_ns(), comp="shardmaster",
+                              me=self.me, kind=op.kind)
         return reply
 
     def _next_config(self) -> tuple[list, dict]:
@@ -219,6 +233,15 @@ class ShardMasterServer:
         seen, _ = self.dup.get(op.cid, (-1, None))
         if op.cseq <= seen:
             return
+        # tpuscope: stamp the caller's trace context into the proposed
+        # value (the rpc leg made it current) so the apply span joins
+        # the clerk's causal chain.
+        if _tracing.enabled():
+            sp = _tracing.child("service.submit", comp="shardmaster",
+                                kind=op.kind)
+            if sp is not None:
+                op = op._replace(tc=(sp.trace_id, sp.span_id))
+                sp.end()
         self._sync(op)
 
     def kill(self):

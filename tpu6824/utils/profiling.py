@@ -52,7 +52,14 @@ class PhaseProfiler:
     dispatch's staging, a whole retire's device_get, a whole apply batch),
     so the overhead is O(dispatches), not O(ops).  `snapshot()` returns raw
     nanosecond/count totals so callers can diff two snapshots around a
-    measurement window (the bench legs do)."""
+    measurement window (the bench legs do).
+
+    For the per-op view these aggregates cannot give — one clerk op's
+    clerk→rpc→submit→dispatch→apply→reply chain against the fabric
+    batches that carried it — use tpuscope (`tpu6824.obs`): with
+    `TPU6824_TRACE=1` the same pipeline emits causal spans, and
+    `obs.export_trace(path)` writes Chrome trace-event / Perfetto JSON
+    alongside the `trace(outdir)` device traces captured here."""
 
     def __init__(self):
         self._mu = threading.Lock()

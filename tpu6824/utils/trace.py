@@ -8,7 +8,11 @@ per-kernel-step event log with decided/sec counters.
 - `dprintf(tag, fmt, ...)` — per-subsystem debug logging, enabled by
   TPU6824_DEBUG="paxos,kvpaxos" or "all" (runtime, not compile-time).
 - `EventLog` — bounded ring of (ts, tag, payload) records with named
-  counters; the fabric keeps one and exposes `stats()`.
+  counters; the fabric keeps one and exposes `stats()`.  Ring overflow
+  is COUNTED (`counters()["dropped"]`), never silent; capacity defaults
+  from TPU6824_EVENTLOG_CAP.  With `registry_prefix`, every bump is
+  mirrored into the process-global tpuscope metrics registry
+  (`tpu6824.obs.metrics`) so one `snapshot()` spans all components.
 """
 
 from __future__ import annotations
@@ -18,6 +22,8 @@ import os
 import sys
 import threading
 import time
+
+from tpu6824.obs import metrics as _metrics
 
 def _tags() -> set[str]:
     # Re-read every call so a long-lived daemon can have tags toggled at
@@ -41,9 +47,19 @@ def dprintf(tag: str, fmt: str, *args) -> None:
 
 
 class EventLog:
-    """Thread-safe bounded event ring + monotonic counters."""
+    """Thread-safe bounded event ring + monotonic counters.
 
-    def __init__(self, capacity: int = 4096):
+    `capacity=None` reads TPU6824_EVENTLOG_CAP (default 4096) at
+    construction.  A full ring drops the oldest record AND bumps the
+    `dropped` counter — surfaced through `counters()` and the fabric's
+    `stats()["events_dropped"]` (no silent caps)."""
+
+    def __init__(self, capacity: int | None = None,
+                 registry_prefix: str | None = None):
+        if capacity is None:
+            capacity = int(os.environ.get("TPU6824_EVENTLOG_CAP", 4096))
+        self._cap = capacity
+        self._prefix = registry_prefix
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._counters: collections.Counter = collections.Counter()
         self._mu = threading.Lock()
@@ -52,11 +68,17 @@ class EventLog:
 
     def record(self, tag: str, **payload) -> None:
         with self._mu:
+            if len(self._ring) == self._cap:
+                self._counters["dropped"] += 1
             self._ring.append((time.monotonic(), tag, payload))
 
     def bump(self, counter: str, n: int = 1) -> None:
         with self._mu:
             self._counters[counter] += n
+        if self._prefix is not None:
+            # Mirror into the tpuscope registry OUTSIDE self._mu (the
+            # registry takes its own lock; bumps are batch-granular).
+            _metrics.inc(f"{self._prefix}.{counter}", n)
 
     def events(self, tag: str | None = None) -> list:
         with self._mu:
