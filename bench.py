@@ -62,6 +62,38 @@ def emit(obj):
 # Child: the actual measurement (runs in a killable subprocess).
 # --------------------------------------------------------------------------
 
+def _tpuscope_begin():
+    """Registry snapshot at a leg's start — paired with `_tpuscope_delta`
+    so each leg's BENCH section carries ITS OWN counters/histograms
+    (delta since leg start), not the whole process lifetime's."""
+    try:
+        from tpu6824.obs import metrics as _m
+        return _m.snapshot()
+    except Exception:  # noqa: BLE001 — observability never costs the line
+        return None
+
+
+def _tpuscope_delta(before):
+    try:
+        from tpu6824.obs import metrics as _m
+        if before is None:
+            return {"error": "leg-start snapshot failed"}
+        return _m.diff_snapshots(before, _m.snapshot())
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)[:200]}
+
+
+def _fabric_protocol(fab):
+    """The kernelscope device-resident protocol counters for a leg's
+    BENCH section: totals + derived ratios (rounds-per-decide, fast-path
+    fraction), without the per-group arrays (G can be 1024 here)."""
+    try:
+        proto = fab.stats()["protocol"]
+        return {k: v for k, v in proto.items() if k != "per_group"}
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)[:200]}
+
+
 def child_main():
     sys.path.insert(0, REPO)
     import jax
@@ -267,16 +299,22 @@ def child_main():
         lossy_mode = (engine["lossy_mode"]["v"]
                       if "lossy_mode" in engine else "xla")
         dist = distribution(P, 0.10, 0.20)
+        leg0 = _tpuscope_begin()
         wire = _wire_rate()
+        wire["tpuscope"] = _tpuscope_delta(leg0)
         # API-driven configs (never cost the headline line on failure):
+        leg0 = _tpuscope_begin()
         try:
             service = _service_rate()
         except Exception as e:  # noqa: BLE001
             service = {"value": 0.0, "error": repr(e)[:200]}
+        service["tpuscope"] = _tpuscope_delta(leg0)
+        leg0 = _tpuscope_begin()
         try:
             service["clerk"] = _clerk_rate()
         except Exception as e:  # noqa: BLE001
             service["clerk"] = {"value": 0.0, "error": repr(e)[:200]}
+        service["clerk"]["tpuscope"] = _tpuscope_delta(leg0)
 
         # Roofline context: bytes moved per BEST-CASE step.
         #  - pallas: the fused cycle is one kernel — reads 7 state + sa +
@@ -791,6 +829,10 @@ def _service_rate():
             # itself — status/done/start pumping — is the remainder).
             "phases": PhaseProfiler.breakdown(fab.profiler.snapshot(),
                                               prof0, wall_seconds=dt),
+            # kernelscope: what the consensus protocol itself did over
+            # this leg — rounds-per-decide is the number ROADMAP items
+            # 2-3's fast-path variants must move.
+            "protocol": _fabric_protocol(fab),
         }
     finally:
         fab.stop_clock()
@@ -908,6 +950,10 @@ def _clerk_rate():
         lat_hi = [len(s) for s in lat_sinks]
         prof1 = fab.profiler.snapshot()
         steps = fab.steps_total - s0  # clock steps in the measured window
+        # kernelscope: the clerk leg's consensus-protocol evidence
+        # (rounds-per-decide under real clerk traffic), captured while
+        # the fabric is still live.
+        clerk_protocol = _fabric_protocol(fab)
         for t in threads:
             t.join(timeout=15)
         total = sum(counts)
@@ -1012,6 +1058,7 @@ def _clerk_rate():
         "steps_per_sec": round(steps / dt, 1),
         "latency": latency,
         "phases": phases,
+        "protocol": clerk_protocol,
         "thread_per_clerk": {
             "value": round(total2 / dt2, 1),
             "note": f"{NC} blocking clerk threads/group (reference shape); "
@@ -1195,7 +1242,41 @@ def parent_main():
         }
     elif errors:
         result["fallback_reason"] = "; ".join(errors)
+    _attach_benchdiff(result)
     emit(result)
+
+
+def _attach_benchdiff(result):
+    """kernelscope regression gate, wired into the bench flow: compare
+    the fresh line against the newest recorded BENCH_r*.json (or
+    $BENCH_BASELINE) and embed the verdict in the emitted artifact —
+    `benchdiff.regressions > 0` is the same signal
+    `python -m tpu6824.obs.benchdiff <baseline> <new>` exits non-zero
+    on.  The human-readable table goes to stderr (stdout stays the
+    one-JSON-line contract); a missing/broken baseline never costs the
+    bench line."""
+    try:
+        import glob
+
+        from tpu6824.obs import benchdiff
+        base = os.environ.get("BENCH_BASELINE")
+        if not base:
+            recorded = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+            base = recorded[-1] if recorded else None
+        if not base:
+            return
+        report = benchdiff.compare(benchdiff.load_artifact(base), result)
+        print(f"benchdiff vs {os.path.basename(base)}:\n"
+              f"{benchdiff.render(report)}", file=sys.stderr)
+        result["benchdiff"] = {
+            "baseline": os.path.basename(base),
+            "regressions": report["regressions"],
+            "compared": report["compared"],
+            "regressed": [r["metric"] for r in report["results"]
+                          if r["verdict"] == "REGRESSED"],
+        }
+    except Exception as e:  # noqa: BLE001 — the gate never costs the line
+        result["benchdiff"] = {"error": repr(e)[:200]}
 
 
 if __name__ == "__main__":

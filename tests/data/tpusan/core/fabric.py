@@ -1,0 +1,21 @@
+"""Golden: readback-in-step — a device readback added to the fused step
+path (this file's `core/fabric.py` suffix puts it in the step-path lint
+scope).  The kernelscope contract is ONE summary readback per dispatch;
+each of these adds a host round-trip per step.
+"""
+import jax
+
+
+class NotTheFabric:
+    def _step_once(self, io, touched_acc, msgs_acc):
+        # A second fetch next to the sanctioned summary readback: the
+        # exact regression the rule exists to catch.
+        decided = jax.device_get(io.decided)          # finding 1
+        proto = jax.device_get(io.proto)              # finding 2
+        return decided, proto
+
+    def _wait_for_dispatch(self, handle):
+        # Blocking on the device future inside the step path stalls the
+        # clock thread for the whole dispatch instead of overlapping it.
+        handle.block_until_ready()                    # finding 3
+        return handle

@@ -45,6 +45,7 @@ GOLDEN_EXPECT = {
     "feed_percell.py": {"feed-columnar": 3},
     "metric_hotloop.py": {"metric-unregistered": 2},
     "tracer_leak.py": {"tracer-leak": 3},
+    "core/fabric.py": {"readback-in-step": 3},
     "services/bad_suppress.py": {"bad-suppression": 2,
                                  "unused-suppression": 1,
                                  "lock-blocking-call": 2},

@@ -63,6 +63,25 @@ def test_one_json_line_with_required_keys():
     # memory-resident so bw_fraction is judgeable somewhere.
     mr = d["roofline_memres"]
     assert "error" in mr or mr["cache_resident"] is False, mr
+    # kernelscope provenance (ISSUE 6): every recorded run must carry
+    # (a) PER-LEG tpuscope registry deltas — counters attributable to
+    # the leg that produced them, not the process lifetime —
+    assert "tpuscope" in d["wire"], d["wire"].keys()
+    assert "tpuscope" in d["service"], d["service"].keys()
+    clerk_scope = clerk["tpuscope"]
+    assert "error" not in clerk_scope, clerk_scope
+    assert clerk_scope["counters"], clerk_scope  # the leg DID something
+    # (b) the device-resident protocol counters for the fabric legs
+    # (rounds-per-decide is the number the ROADMAP variants must move),
+    for leg in (d["service"], clerk):
+        proto = leg["protocol"]
+        assert "error" not in proto, proto
+        assert proto["totals"]["decides"] > 0, proto
+        assert proto["rounds_per_decide"] >= 1.0, proto
+    # (c) the benchdiff gate's verdict vs the recorded trajectory.
+    assert "benchdiff" in d, d.keys()
+    if "error" not in d["benchdiff"]:
+        assert "regressions" in d["benchdiff"], d["benchdiff"]
 
 
 @pytest.mark.slow

@@ -1,0 +1,693 @@
+"""kernelscope (ISSUE 6): device-resident protocol telemetry, the fleet
+collector, and the bench regression differ.
+
+Layout:
+  - engine parity: the XLA round and the Pallas packed event word must
+    report BIT-IDENTICAL per-group counter totals on fixed workloads
+    (reliable and partitioned) — the two-engine contract;
+  - zero extra readbacks: a steady-state fabric performs EXACTLY ONE
+    jax.device_get per dispatch with telemetry on, on both io modes —
+    the counters ride the existing summary readback or they don't ship;
+  - fabric fold: stats()["protocol"] totals/per-group/derived ratios,
+    the registry gauge mirror, and the health block's stall diagnosis;
+  - obs units: Histogram p50/p95/p99 from log2 buckets,
+    diff_snapshots (the per-leg bench attribution primitive), and
+    namespaced multi-process Chrome-trace export;
+  - wire: stats()["protocol"] + flight() + a Collector snapshot across
+    the real fabric_service socket;
+  - the ≥2-process acceptance: two fabricd OS processes merged by the
+    Collector into ONE namespaced snapshot + ONE Perfetto file, with
+    fleet-summed protocol counters, embedded in a nemesis-style
+    ReplayArtifact;
+  - benchdiff: exit 0 on the real recorded trajectory, exit non-zero on
+    an injected regression and on a silently vanished leg.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu6824.core.fabric import PaxosFabric
+from tpu6824.core.kernel import (
+    NPROTO,
+    PROTO_ENABLED,
+    PROTO_FIELDS,
+    apply_starts,
+    init_state,
+    paxos_step,
+    paxos_step_reliable,
+)
+from tpu6824.core.pallas_kernel import paxos_step_pallas
+from tpu6824.obs import benchdiff, metrics
+from tpu6824.obs.collector import Collector, local_handle
+from tpu6824.obs.tracing import FLIGHT, chrome_events, flight_snapshot
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ helpers
+
+
+def _armed_state(G, I, P, pattern="all"):
+    state = init_state(G, I, P)
+    sa = np.zeros((G, I, P), bool)
+    sv = np.full((G, I, P), -1, np.int32)
+    if pattern == "all":
+        sa[:] = True
+        sv[:] = np.arange(G * I * P).reshape(G, I, P) + 1
+    elif pattern == "one":
+        sa[:, :, 0] = True
+        sv[:, :, 0] = np.arange(G * I).reshape(G, I) + 1
+    return apply_starts(
+        state, jnp.zeros((G, I), bool), jnp.asarray(sa), jnp.asarray(sv))
+
+
+def _fork(state):
+    return (jax.tree.map(jnp.copy, state), jax.tree.map(jnp.copy, state))
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------- engine parity
+
+
+@pytest.mark.skipif(not PROTO_ENABLED, reason="TPU6824_PROTO=0")
+@pytest.mark.parametrize("pattern", ["all", "one"])
+def test_proto_parity_xla_vs_pallas_reliable(pattern):
+    """Bit-parity acceptance: identical per-group counter totals from
+    both engines across a multi-step reliable schedule (same masks, so
+    every event — attempts, rejects, restarts, decides, fast-path — must
+    land identically)."""
+    G, I, P = 2, 8, 3
+    sx, sp = _fork(_armed_state(G, I, P, pattern))
+    link = jnp.ones((G, P, P), bool)
+    done = jnp.full((G, P), -1, jnp.int32)
+    dr = jnp.zeros((G, P, P), jnp.float32)
+    tot_x = np.zeros((G, NPROTO), np.int64)
+    tot_p = np.zeros((G, NPROTO), np.int64)
+    for step in range(6):
+        sub = jax.random.fold_in(jax.random.PRNGKey(7), step)
+        sx, iox = paxos_step(sx, link, done, sub, dr, dr)
+        sp, iop = paxos_step_pallas(sp, link, done, sub, dr, dr,
+                                    interpret=True)
+        px, pp = np.asarray(iox.proto), np.asarray(iop.proto)
+        np.testing.assert_array_equal(px, pp, err_msg=f"step {step}")
+        assert px.shape == (G, NPROTO)
+        tot_x += px
+        tot_p += pp
+    np.testing.assert_array_equal(tot_x, tot_p)
+    # The workload decided: the counters are live, not zero padding.
+    k = PROTO_FIELDS.index("decides")
+    assert tot_x[:, k].sum() > 0
+
+
+@pytest.mark.skipif(not PROTO_ENABLED, reason="TPU6824_PROTO=0")
+def test_proto_parity_partitioned_and_semantics():
+    """Parity under a partition, plus counter semantics: the isolated
+    minority group piles up quorum failures and restarts without a
+    single decide; the healthy group decides."""
+    G, I, P = 2, 4, 3
+    link = np.ones((G, P, P), bool)
+    # group 0: peer 0 isolated from 1 and 2 (no majority for peer 0's
+    # proposals; peers 1+2 still form one).
+    link[0, 0, 1:] = link[0, 1:, 0] = False
+    sx, sp = _fork(_armed_state(G, I, P, "one"))
+    # group 0's only armed proposer is peer 0 — the minority side.
+    lj = jnp.asarray(link)
+    done = jnp.full((G, P), -1, jnp.int32)
+    dr = jnp.zeros((G, P, P), jnp.float32)
+    tot = np.zeros((G, NPROTO), np.int64)
+    for step in range(5):
+        sub = jax.random.fold_in(jax.random.PRNGKey(3), step)
+        sx, iox = paxos_step(sx, lj, done, sub, dr, dr)
+        sp, iop = paxos_step_pallas(sp, lj, done, sub, dr, dr,
+                                    interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(iox.proto), np.asarray(iop.proto),
+            err_msg=f"step {step}")
+        tot += np.asarray(iox.proto)
+    f = {name: k for k, name in enumerate(PROTO_FIELDS)}
+    # Partitioned group: proposing, failing quorum, restarting, never
+    # deciding.
+    assert tot[0, f["prepare_attempts"]] > 0
+    assert tot[0, f["quorum_failures"]] > 0
+    assert tot[0, f["restarts"]] > 0
+    assert tot[0, f["decides"]] == 0
+    # Healthy group: decided, and on the reliable first-proposal fast
+    # path (single proposer, no duels).
+    assert tot[1, f["decides"]] > 0
+    assert tot[1, f["fast_path_decides"]] == tot[1, f["decides"]]
+    assert tot[1, f["quorum_failures"]] == 0
+
+
+@pytest.mark.skipif(not PROTO_ENABLED, reason="TPU6824_PROTO=0")
+def test_proto_multi_step_merge_matches_sum_of_single_steps():
+    """The lax.scan dispatch fold (paxos_multi_step*) must report the SUM
+    of its micro-steps' events — dispatch totals, not the last round."""
+    from tpu6824.core.kernel import paxos_multi_step_reliable
+
+    G, I, P = 1, 6, 3
+    sA, sB = _fork(_armed_state(G, I, P, "all"))
+    link = jnp.ones((G, P, P), bool)
+    done = jnp.full((G, P), -1, jnp.int32)
+    acc = np.zeros((G, NPROTO), np.int64)
+    for _ in range(4):
+        sA, io = paxos_step_reliable(sA, link, done)
+        acc += np.asarray(io.proto)
+    sB, ioB = paxos_multi_step_reliable(sB, link, done, 4)
+    np.testing.assert_array_equal(acc, np.asarray(ioB.proto))
+
+
+# -------------------------------------------------- zero extra readbacks
+
+
+@pytest.mark.skipif(not PROTO_ENABLED, reason="TPU6824_PROTO=0")
+@pytest.mark.parametrize("io_mode", ["full", "compact"])
+def test_exactly_one_device_get_per_dispatch(io_mode, monkeypatch):
+    """THE zero-extra-readback acceptance: with telemetry on, a warmed
+    fabric performs exactly ONE jax.device_get per dispatch — the
+    protocol counters ride the existing summary fetch, they never add
+    one."""
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=16,
+                      auto_step=False, io_mode=io_mode)
+    try:
+        # Traffic so the counters are demonstrably live while we count.
+        for seq in range(3):
+            for p in range(3):
+                fab.start(0, p, seq, f"v{seq}")
+        fab.step(3)  # warmup: compile + first summaries retired
+        assert fab.stats()["protocol"]["totals"]["decides"] > 0
+        calls = {"n": 0}
+        real = jax.device_get
+
+        def counting(x):
+            calls["n"] += 1
+            return real(x)
+
+        monkeypatch.setattr(jax, "device_get", counting)
+        fab.step(5)
+        assert calls["n"] == 5, (
+            f"{io_mode}: {calls['n']} device_gets over 5 dispatches — "
+            "the telemetry added a readback")
+    finally:
+        fab.stop_clock()
+
+
+# ----------------------------------------------------------- fabric fold
+
+
+@pytest.mark.skipif(not PROTO_ENABLED, reason="TPU6824_PROTO=0")
+def test_stats_protocol_and_registry_mirror():
+    """stats()["protocol"] carries totals + per-group columns + derived
+    ratios, and the registry's fabric.protocol.* gauges mirror the
+    totals (the BENCH/tpuscope surface)."""
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=16, auto_step=False)
+    try:
+        for seq in range(4):
+            for p in range(3):
+                fab.start(0, p, seq, f"x{seq}")
+        fab.step(4)
+        proto = fab.stats()["protocol"]
+        assert proto["enabled"] is True
+        assert proto["fields"] == list(PROTO_FIELDS)
+        t = proto["totals"]
+        assert t["decides"] >= 4
+        assert t["prepare_attempts"] >= t["decides"]
+        assert t["fast_path_decides"] <= t["decides"]
+        # Only group 0 got traffic: per-group attribution must show it.
+        pg = proto["per_group"]
+        assert len(pg["decides"]) == 2
+        assert pg["decides"][0] >= 4 and pg["decides"][1] == 0
+        assert sum(pg["decides"]) == t["decides"]
+        assert proto["rounds_per_decide"] >= 1.0
+        assert 0.0 <= proto["fast_path_fraction"] <= 1.0
+        # Registry mirror: one gauge per field, equal to the totals.
+        snap = metrics.snapshot()
+        for f in PROTO_FIELDS:
+            assert snap["gauges"][f"fabric.protocol.{f}"]["value"] == t[f]
+    finally:
+        fab.stop_clock()
+
+
+@pytest.mark.skipif(not PROTO_ENABLED, reason="TPU6824_PROTO=0")
+def test_stall_diagnosis_minority_partition_vs_no_proposals():
+    """The health block's diagnosis tells the two stalls apart: a
+    minority-partitioned group reads as quorum failures climbing; an
+    unproposed-to group reads as no proposals arriving."""
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=8, auto_step=False)
+    # Huge window: the diagnosis buckets cannot roll or go stale under
+    # arbitrary full-suite CPU contention — phase 1 is deterministic.
+    fab._proto_window = 1e9
+    try:
+        # Group 0: its only armed proposer (peer 0) isolated in a
+        # minority — it proposes every step, fails quorum, never
+        # decides.  Group 1 gets no traffic at all (must NOT be
+        # reported: nothing undecided is not a stall).
+        fab.partition(0, [0], [1, 2])
+        fab.start(0, 0, 0, "stuck")
+        fab.step(5)  # quorum failures accrue in the window buckets
+        # Age the undecided slot past stall_after: with warm jit caches
+        # (mid-suite) step(5) completes in single-digit ms, younger than
+        # any usable threshold — the stall detector rightly stays quiet
+        # about fresh work.  The 1e9 window means this sleep cannot
+        # stale the diagnosis buckets.
+        time.sleep(0.06)
+        st = fab.stats(stall_after=0.02)
+        assert st["health"]["stalled_groups"] == [0], st["health"]
+        diag = st["health"]["stall_diagnosis"]
+        assert "quorum failures climbing" in diag["0"], diag
+        assert "minority partition" in diag["0"], diag
+        # stats() is a PURE read: a second concurrent-style poll sees
+        # the same diagnosis (a fleet collector scraping stats() must
+        # not consume the window under an operator's feet).
+        st_again = fab.stats(stall_after=0.01)
+        assert "quorum failures climbing" in \
+            st_again["health"]["stall_diagnosis"]["0"]
+        # Phase 2: the clock stops advancing — once both window buckets
+        # go stale the recent delta reads all-zero, so the SAME stalled
+        # group now diagnoses as "no proposals arriving" (nothing armed
+        # / clock not advancing) instead of quorum failures.  Staleness
+        # is simulated by rewinding the bucket clock (no sleeps — the
+        # phase stays deterministic under load).
+        fab._proto_window = 0.05
+        fab._proto_bucket_t = time.monotonic() - 1.0
+        st2 = fab.stats(stall_after=0.01)
+        assert st2["health"]["stalled_groups"] == [0]
+        assert "no proposals arriving" in \
+            st2["health"]["stall_diagnosis"]["0"]
+    finally:
+        fab.stop_clock()
+
+
+# ------------------------------------------------------------- obs units
+
+
+def test_histogram_snapshot_quantiles():
+    h = metrics.Histogram("ks.test.quantiles")
+    snap = h.snapshot()
+    assert snap["p50"] is None and snap["p95"] is None  # empty = stable
+    for v in [3] * 90 + [1000] * 9 + [100000]:
+        h.observe(v)
+    snap = h.snapshot()
+    # log2 buckets report the bucket's exclusive upper bound: at most 2x
+    # above the true quantile, monotone across quantiles.
+    assert snap["p50"] == 4.0
+    assert snap["p95"] == 1024.0
+    assert snap["p99"] == 1024.0
+    assert snap["count"] == 100
+    h.observe(1, key="sub")
+    assert h.snapshot()["by"]["sub"]["p50"] == 2.0
+
+
+def test_diff_snapshots_attributes_the_leg():
+    """The bench per-leg primitive: diff two registry snapshots and get
+    only what happened in between."""
+    c = metrics.counter("ks.diff.ops")
+    g = metrics.gauge("ks.diff.depth")
+    h = metrics.histogram("ks.diff.lat")
+    c.inc(5, key="warm")
+    h.observe(10)
+    before = metrics.snapshot()
+    c.inc(3, key="leg")
+    g.set(7)
+    h.observe(1000)
+    h.observe(1000)
+    d = metrics.diff_snapshots(before, metrics.snapshot())
+    assert d["counters"]["ks.diff.ops"]["total"] == 3
+    assert d["counters"]["ks.diff.ops"]["by"] == {"leg": 3}  # warm dropped
+    assert d["gauges"]["ks.diff.depth"]["value"] == 7
+    hd = d["histograms"]["ks.diff.lat"]
+    assert hd["count"] == 2 and hd["sum"] == 2000
+    assert hd["p50"] == 1024.0  # quantiles over the DELTA buckets
+    # A metric that did nothing in the window is absent entirely.
+    c2 = metrics.counter("ks.diff.idle")
+    c2.inc()
+    b2 = metrics.snapshot()
+    d2 = metrics.diff_snapshots(b2, metrics.snapshot())
+    assert "ks.diff.idle" not in d2["counters"]
+
+
+def test_chrome_events_namespaced_per_process():
+    """Merged multi-process exports cannot collide: same numeric span
+    ids from two processes land under distinct pids with prefixed
+    thread names and a process_name metadata track each."""
+    recs = [{"name": "op", "ph": "X", "comp": "clerk", "ts": 1000,
+             "dur": 10, "trace_id": 1, "span_id": 1, "parent_id": 0,
+             "args": {}}]
+    a = chrome_events(recs, process="procA", pid=1)
+    b = chrome_events(recs, process="procB", pid=2)
+    evs = a + b
+    pids = {e["pid"] for e in evs}
+    assert pids == {1, 2}
+    names = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert names == {"procA/clerk", "procB/clerk"}
+    procs = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert procs == {"procA", "procB"}
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert all(e["args"]["proc"] in ("procA", "procB") for e in spans)
+    # Same span_id, still distinguishable by (pid, proc).
+    assert len({(e["pid"], e["args"]["span_id"]) for e in spans}) == 2
+
+
+# ------------------------------------------------------------------ wire
+
+
+@pytest.mark.skipif(not PROTO_ENABLED, reason="TPU6824_PROTO=0")
+def test_protocol_and_collector_round_trip_fabric_service_wire():
+    """Satellite acceptance: stats()["protocol"] and a Collector
+    snapshot survive the fabric_service RPC boundary (real Unix socket,
+    real gob frames)."""
+    from tpu6824.core.fabric_service import remote_fabric, serve_fabric
+
+    d = tempfile.mkdtemp(prefix="kscope-fs", dir="/var/tmp")
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=16, auto_step=True)
+    srv = serve_fabric(fab, d + "/fab")
+    try:
+        for seq in range(3):
+            for p in range(3):
+                fab.start(0, p, seq, f"w{seq}")
+        _wait(lambda: fab.stats()["protocol"]["totals"]["decides"] >= 3,
+              msg="decides")
+        rf = remote_fabric(d + "/fab", timeout=10.0)
+        proto = rf.stats()["protocol"]
+        assert proto["totals"]["decides"] >= 3
+        assert proto["fields"] == list(PROTO_FIELDS)
+        # flight() serves the ring over the same socket.
+        fl = rf.flight()
+        assert fl["pid"] == os.getpid()  # in-process serve: same pid
+        assert "records" in fl and "dropped" in fl
+        # A Collector over the REMOTE handle + the local process.
+        col = Collector().add("fabproc", rf).add_local("harness")
+        snap = col.snapshot()
+        assert not snap["errors"], snap["errors"]
+        assert snap["processes"]["fabproc"]["stats"]["protocol"][
+            "totals"]["decides"] >= 3
+        assert "metrics" in snap["processes"]["harness"]
+        merged = Collector.merge_protocol(snap)
+        assert merged["totals"]["decides"] == proto["totals"]["decides"]
+        out = os.path.join(d, "merged.json")
+        col.export_perfetto(out)
+        with open(out) as f:
+            tr = json.load(f)
+        assert any(e.get("name") == "process_name" and
+                   e["args"]["name"] == "fabproc"
+                   for e in tr["traceEvents"])
+    finally:
+        srv.kill()
+        fab.stop_clock()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# ------------------------------------- >= 2-process deployment acceptance
+
+
+_ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    PYTHONPATH=REPO,
+)
+
+
+def _spawn_fabricd(addr):
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpu6824.main.fabricd", "--addr", addr,
+         "--groups", "1", "--peers", "3", "--instances", "16",
+         "--ttl", "120"],
+        env=_ENV, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+@pytest.mark.skipif(not PROTO_ENABLED, reason="TPU6824_PROTO=0")
+def test_collector_merges_two_process_deployment():
+    """The fleet acceptance: two fabricd OS processes → ONE namespaced
+    snapshot (each process's stats/metrics/flight under its own name),
+    ONE merged Perfetto file with a track per process, fleet-summed
+    protocol counters, and a nemesis-style ReplayArtifact embedding the
+    merged view."""
+    from tpu6824.core.fabric_service import remote_fabric
+    from tpu6824.harness.nemesis import ReplayArtifact
+    from tests.test_process_cluster import wait_socket
+
+    d = tempfile.mkdtemp(prefix="kscope-2p", dir="/var/tmp")
+    procs = []
+    try:
+        addrs = [os.path.join(d, n) for n in ("fabA", "fabB")]
+        procs = [_spawn_fabricd(a) for a in addrs]
+        for a in addrs:
+            wait_socket(a, timeout=90.0)
+        rfs = [remote_fabric(a, timeout=30.0) for a in addrs]
+        # Distinct traffic per process so the merged totals are
+        # attributable: 2 ops on A, 3 on B.
+        for rf, nops in zip(rfs, (2, 3)):
+            for seq in range(nops):
+                for p in range(3):
+                    rf.start(0, p, seq, f"op{seq}")
+        for rf, nops in zip(rfs, (2, 3)):
+            _wait(lambda rf=rf, n=nops:
+                  rf.stats()["protocol"]["totals"]["decides"] >= n,
+                  timeout=60.0, msg="remote decides")
+
+        col = (Collector().add("fabA", rfs[0]).add("fabB", rfs[1])
+               .add_local("harness"))
+        snap = col.snapshot()
+        assert not snap["errors"], snap["errors"]
+        assert set(snap["processes"]) == {"fabA", "fabB", "harness"}
+        pa = snap["processes"]["fabA"]["stats"]["protocol"]["totals"]
+        pb = snap["processes"]["fabB"]["stats"]["protocol"]["totals"]
+        assert pa["decides"] >= 2 and pb["decides"] >= 3
+        # Each member's flight ring crossed the wire with ITS OWN pid.
+        flA = snap["processes"]["fabA"]["flight"]
+        flB = snap["processes"]["fabB"]["flight"]
+        assert flA["pid"] != flB["pid"] != os.getpid()
+        assert flA["records"], "fabA flight ring empty under traffic"
+        # Fleet-summed counters, ratios recomputed from merged totals.
+        merged = Collector.merge_protocol(snap)
+        assert merged["totals"]["decides"] == \
+            pa["decides"] + pb["decides"]
+        assert merged["rounds_per_decide"] >= 1.0
+        # ONE Perfetto file, one process track per member.
+        out = os.path.join(d, "fleet.json")
+        Collector.merge_perfetto(snap, out)
+        with open(out) as f:
+            tr = json.load(f)
+        tracks = {e["args"]["name"] for e in tr["traceEvents"]
+                  if e.get("name") == "process_name"}
+        assert {"fabA", "fabB"} <= tracks
+        # The nemesis failure artifact embeds the merged view.
+        art = ReplayArtifact(test="kernelscope-2proc")
+        art.attach(collector=col)
+        blob = art.to_dict()
+        ks = blob["kernelscope"]
+        assert set(ks["snapshot"]["processes"]) == \
+            {"fabA", "fabB", "harness"}
+        assert ks["protocol"]["totals"]["decides"] == \
+            merged["totals"]["decides"]
+        json.dumps(blob)  # the whole artifact stays JSON-serializable
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait(timeout=10)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def test_collector_bounds_a_hung_member():
+    """A partitioned/deafened member mid-nemesis must not stall the
+    merged artifact for the full RPC timeout per surface: members poll
+    concurrently, a straggler is cut off at the poll budget, and the
+    surfaces it already delivered are kept."""
+    hung = threading.Event()
+
+    class Slow:
+        def stats(self):
+            return {"ok": True}  # delivered before the hang
+
+        def metrics(self):
+            hung.wait(30.0)  # simulates a deafened RPC proxy
+
+    col = Collector(poll_timeout=0.5).add("slow", Slow()).add(
+        "me", local_handle())
+    t0 = time.monotonic()
+    snap = col.snapshot()
+    took = time.monotonic() - t0
+    hung.set()  # release the stuck poller thread
+    assert took < 5.0, f"snapshot stalled {took:.1f}s on a hung member"
+    assert "slow.poll" in snap["errors"], snap["errors"]
+    assert snap["processes"]["slow"].get("stats") == {"ok": True}
+    assert "metrics" in snap["processes"]["me"]  # survivors unaffected
+
+
+def test_benchdiff_errored_leg_honors_allow_missing():
+    """bench records an errored leg as value 0.0 — it must take the
+    vanished-leg path (regression by default, skip under
+    --allow-missing / provisional), not compare as a -100% delta that
+    no flag can demote."""
+    new = json.loads(json.dumps(_r07()))
+    new["wire"] = {"value": 0.0, "error": "RPCError: wedged"}
+    rep = benchdiff.compare(_r07(), new)
+    by = {r["metric"]: r for r in rep["results"]}
+    assert by["wire/value"]["verdict"] == "REGRESSED"
+    assert "vanished" in by["wire/value"]["why"]
+    rep2 = benchdiff.compare(_r07(), new, allow_missing=True)
+    by2 = {r["metric"]: r["verdict"] for r in rep2["results"]}
+    assert by2["wire/value"] == "skipped(missing-in-new)"
+    assert rep2["regressions"] == 0, rep2
+    # Same for a leg WITH leg_shape gating: the errored leg has no
+    # shape keys either, and the shape mismatch must not launder the
+    # error into a silent skip.
+    new2 = json.loads(json.dumps(_r07()))
+    new2["service"] = {"value": 0.0, "error": "wedged"}
+    by3 = {r["metric"]: r["verdict"]
+           for r in benchdiff.compare(_r07(), new2)["results"]}
+    assert by3["service/value"] == "REGRESSED", by3["service/value"]
+
+
+@pytest.mark.skipif(not PROTO_ENABLED, reason="TPU6824_PROTO=0")
+def test_collector_records_dead_member_as_error():
+    """Mid-nemesis a member being down is DATA: the snapshot carries the
+    survivors plus an error entry, never raises."""
+    class Dead:
+        def stats(self):
+            raise ConnectionRefusedError("gone")
+
+        def metrics(self):
+            raise ConnectionRefusedError("gone")
+
+    col = Collector().add("dead", Dead()).add("me", local_handle())
+    snap = col.snapshot()
+    assert "dead.stats" in snap["errors"]
+    assert "metrics" in snap["processes"]["me"]
+    assert Collector.merge_protocol(snap) is None  # no protocol anywhere
+
+
+# --------------------------------------------------------------- benchdiff
+
+
+def _r07():
+    return benchdiff.load_artifact(os.path.join(REPO, "BENCH_r07.json"))
+
+
+def test_benchdiff_real_trajectory_is_green():
+    """Acceptance: the real recorded artifacts compare clean (including
+    the r01-style driver-wrapped format unwrapping)."""
+    old = benchdiff.load_artifact(os.path.join(REPO, "BENCH_r06.json"))
+    rep = benchdiff.compare(old, _r07())
+    assert rep["regressions"] == 0, rep
+    assert rep["compared"] >= 8
+    # Wrapped-format artifacts unwrap to the same shape.
+    wrapped = benchdiff.load_artifact(os.path.join(REPO, "BENCH_r01.json"))
+    assert "value" in wrapped
+
+
+def test_benchdiff_catches_injected_regression():
+    new = json.loads(json.dumps(_r07()))
+    new["value"] *= 0.5  # -50% headline >> the 25% device-leg tolerance
+    rep = benchdiff.compare(_r07(), new)
+    assert rep["regressions"] >= 1
+    bad = [r for r in rep["results"] if r["verdict"] == "REGRESSED"]
+    assert any(r["metric"] == "value" for r in bad)
+
+
+def test_benchdiff_vanished_leg_is_a_regression_unless_allowed():
+    new = json.loads(json.dumps(_r07()))
+    del new["service"]  # a leg that stops reporting hides a perf break
+    rep = benchdiff.compare(_r07(), new)
+    assert rep["regressions"] >= 1
+    rep2 = benchdiff.compare(_r07(), new, allow_missing=True)
+    assert all(r["verdict"] != "REGRESSED" or "vanished" not in
+               r.get("why", "") for r in rep2["results"])
+
+
+def test_benchdiff_improvement_and_noise_are_green():
+    new = json.loads(json.dumps(_r07()))
+    new["value"] *= 1.5           # improvement
+    new["wire"]["value"] *= 0.6   # -40%: inside the wire noise floor
+    rep = benchdiff.compare(_r07(), new)
+    assert rep["regressions"] == 0, rep
+
+
+def test_benchdiff_cli_exit_codes(tmp_path):
+    """The one-command gate: exit 0 on the real artifacts, non-zero on
+    an injected regression, 2 on unreadable input."""
+    r07 = os.path.join(REPO, "BENCH_r07.json")
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "tpu6824.obs.benchdiff", *args],
+            capture_output=True, text=True, cwd=REPO, timeout=60)
+
+    ok = run(r07, r07)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "0 regressed" in ok.stdout
+    bad = json.loads(json.dumps(_r07()))
+    bad["value"] *= 0.5
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    r = run(r07, str(p), "--json")
+    assert r.returncode == 1, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["regressions"] >= 1
+    assert run(r07, "/no/such/file.json").returncode == 2
+
+
+def test_benchdiff_leg_shape_mismatch_skips_not_alarms():
+    """An env-trimmed service/clerk leg (BENCH_SERVICE_GROUPS et al.) is
+    not comparable to the full-shape recorded leg: its metrics skip
+    loudly instead of false-alarming — but a leg that VANISHES stays a
+    regression, never a shape skip."""
+    new = json.loads(json.dumps(_r07()))
+    new["service"]["shape"] = {"G": 8, "I": 192, "P": 3, "window": 48}
+    new["service"]["clerk"]["groups"] = 4
+    new["service"]["value"] *= 0.1   # would trip 35% on a real run
+    new["service"]["clerk"]["value"] *= 0.1
+    rep = benchdiff.compare(_r07(), new)
+    by = {r["metric"]: r["verdict"] for r in rep["results"]}
+    assert by["service/value"] == "skipped(leg-shape-mismatch)"
+    assert by["service/clerk/value"] == "skipped(leg-shape-mismatch)"
+    assert rep["regressions"] == 0, rep
+    del new["service"]["clerk"]  # vanished leg: shape can't excuse it
+    rep2 = benchdiff.compare(_r07(), new)
+    by2 = {r["metric"]: r["verdict"] for r in rep2["results"]}
+    assert by2["service/clerk/value"] == "REGRESSED"
+
+
+def test_benchdiff_unsalvageable_wrapped_artifact_raises(tmp_path):
+    """A wrapped artifact with no recoverable bench line must error
+    (CLI exit 2), never gate green on an empty baseline."""
+    p = tmp_path / "corrupt.json"
+    p.write_text(json.dumps({"tail": "garbage no json here", "rc": 1}))
+    with pytest.raises(ValueError, match="no parseable bench line"):
+        benchdiff.load_artifact(str(p))
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu6824.obs.benchdiff", str(p),
+         os.path.join(REPO, "BENCH_r07.json")],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert r.returncode == 2, r.stdout + r.stderr
+
+
+def test_benchdiff_platform_mismatch_skips_loudly():
+    new = json.loads(json.dumps(_r07()))
+    new["platform"] = "TPU v9000"
+    rep = benchdiff.compare(_r07(), new)
+    assert rep["regressions"] == 0
+    assert any("platform mismatch" in n for n in rep["notes"])
+    assert all(r["verdict"].startswith("skipped") for r in rep["results"]
+               if r["old"] is not None)

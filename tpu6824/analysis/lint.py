@@ -69,6 +69,14 @@ RULES: dict[str, str] = {
         "get-or-create re-enters the registry lock on the hot path and "
         "hides the metric inventory (registry.inc(), the sanctioned "
         "dynamic-name path, lives inside obs/)",
+    "readback-in-step":
+        "device readback (jax.device_get / .block_until_ready) in a "
+        "step-path module — the kernelscope contract is ONE summary "
+        "readback per dispatch (the retire fold), and every protocol "
+        "counter rides it; a new readback in the fused step path adds a "
+        "host round-trip per dispatch and breaks the zero-extra-readback "
+        "guarantee (the two sanctioned retire-fold sites carry justified "
+        "suppressions — that inventory IS the contract)",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -84,6 +92,12 @@ _LOCK_SCOPE = (
     "core/intern.py", "services/",
 )
 _DET_SCOPE = ("harness/nemesis.py", "harness/linearize.py")
+# The fused step path: modules whose dispatch loop the zero-extra-readback
+# contract covers (kernel rounds, the fabric clock, the sharded mesh).
+_STEP_SCOPE = ("core/kernel.py", "core/pallas_kernel.py",
+               "core/fabric.py", "parallel/mesh.py")
+# Calls that force a device→host round-trip.
+_READBACK_TAILS = {"device_get", "block_until_ready"}
 _FEED_HOME = "core/fabric.py"  # the only module allowed to touch sub._q
 _MET_HOME = "obs/"  # the registry itself may get-or-create anywhere
 
@@ -216,6 +230,7 @@ class _FileLint(ast.NodeVisitor):
         self.findings: list[Finding] = []
         self.lock_scope = _in_scope(relpath, _LOCK_SCOPE)
         self.det_scope = _in_scope(relpath, _DET_SCOPE)
+        self.step_scope = _in_scope(relpath, _STEP_SCOPE)
         self.feed_home = _in_scope(relpath, (_FEED_HOME,))
         self.met_home = _in_scope(relpath, (_MET_HOME,))
         self._lock_depth = 0       # with <lock> nesting
@@ -409,6 +424,13 @@ class _FileLint(ast.NodeVisitor):
                     "." in d and tail in _BLOCKING_TAILS):
                 self._flag(node, "lock-blocking-call",
                            f"call to {d}() under a lock region")
+        if self.step_scope and d is not None:
+            tail = d.rsplit(".", 1)[-1]
+            if tail in _READBACK_TAILS:
+                self._flag(node, "readback-in-step",
+                           f"{d}() forces a device→host round-trip in a "
+                           "step-path module — piggyback on the once-per-"
+                           "dispatch summary readback instead")
         if self.det_scope and d is not None:
             if d in _WALL_CLOCK:
                 self._flag(node, "nondet-clock",

@@ -39,11 +39,15 @@ FABRIC_RPCS = [
     # the nemesis engine treats pipeline depth as a fault dimension)
     "ndecided", "set_unreliable", "partition", "heal", "deafen",
     "set_link", "kill", "revive", "is_dead", "set_pipeline_depth",
-    # introspection (stats carries the graceful-degradation health block:
-    # last-retire age, feed queue depths, stalled-group detection;
-    # metrics is the process-global tpuscope registry snapshot — one
-    # JSON shape spanning rpc/clerk/service/fabric counters)
-    "dims", "stats", "metrics",
+    # introspection (stats carries the graceful-degradation health block
+    # — last-retire age, feed queue depths, stalled-group detection with
+    # kernelscope protocol diagnosis — plus stats()["protocol"], the
+    # device-resident per-group consensus counters; metrics is the
+    # process-global tpuscope registry snapshot — one JSON shape spanning
+    # rpc/clerk/service/fabric counters; flight is the process-global
+    # flight-recorder dump the kernelscope fleet collector merges into
+    # one cross-process Perfetto timeline)
+    "dims", "stats", "metrics", "flight",
 ]
 
 

@@ -37,6 +37,7 @@ on int32 value *ids* (-1 = none).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -44,6 +45,43 @@ import jax.numpy as jnp
 
 I32 = jnp.int32
 NO_VAL = -1  # value-id sentinel: no value
+
+# ---------------------------------------------------------------- kernelscope
+# Device-resident protocol telemetry: per-group event counts accumulated
+# INSIDE the consensus round (both engines) and read back only on the
+# existing once-per-dispatch summary — zero additional host round-trips.
+# Field order is the contract between the XLA round, the Pallas packed
+# event word (pallas_kernel._unpack_proto), the fabric's host mirror, and
+# stats()["protocol"] — append only, never reorder.
+PROTO_FIELDS = (
+    "prepare_attempts",   # proposer prepare rounds run (1/active proposer/step)
+    "prepare_rejects",    # delivered prepares refused (n <= promised)
+    "accept_rejects",     # delivered accepts that did not take (refused or
+                          # lost the per-step duel serialization)
+    "quorum_failures",    # phase majorities missed (prepare + accept)
+    "restarts",           # proposers still undecided after a full round
+                          # (they re-prepare at a higher n next step)
+    "decides",            # decide events — once per decided instance tenancy
+                          # (a late proposer re-deciding an already-decided
+                          # instance under partitions counts again; monotone)
+    "fast_path_decides",  # decides won at the proposer's FIRST proposal
+                          # number (n <= 2P): the 1-round fast-path cohort
+                          # the flexible-quorum variants target
+)
+NPROTO = len(PROTO_FIELDS)
+# Packed per-cell event word (the Pallas engine's proto output): field k
+# occupies PROTO_PACK_BITS[k] bits at PROTO_PACK_SHIFT[k].  Widths bound
+# the per-STEP per-cell event counts: reject counts reach P (so P <= 15),
+# quorum failures reach 2 (prepare + accept), everything else is 0/1.
+# 14 bits total — one int32 word per cell carries the whole step.
+PROTO_PACK_BITS = (1, 4, 4, 2, 1, 1, 1)
+PROTO_PACK_SHIFT = tuple(
+    sum(PROTO_PACK_BITS[:k]) for k in range(NPROTO))
+# Kill switch for overhead A/B measurement (TUNING round 11): with
+# TPU6824_PROTO=0 the round returns all-zero counters (a trace-time
+# constant XLA folds away), the fabric omits them from the summary
+# readback, and the Pallas kernel skips the event-word output entirely.
+PROTO_ENABLED = os.environ.get("TPU6824_PROTO", "1") not in ("0", "false")
 
 
 class PaxosState(NamedTuple):
@@ -90,6 +128,8 @@ class StepIO(NamedTuple):
     done_view: jnp.ndarray  # (G, P, P) i32
     touched: jnp.ndarray    # (G, I, P) bool — peer participated in the slot (for Max())
     msgs: jnp.ndarray       # () i32 — remote messages sent this step (RPC-count analog)
+    proto: jnp.ndarray      # (G, NPROTO) i32 — per-group protocol event
+                            # counts this step (kernelscope; see PROTO_FIELDS)
 
 
 def _edge_masks(key, shape, link, drop, eye):
@@ -151,15 +191,17 @@ def paxos_step_reliable(
     return _paxos_round(state, done, eye, L, L, L, L, L, link | eye)
 
 
-def _merge_scan_io(state: PaxosState, touched_k, msgs_k) -> StepIO:
-    """Fold a scan's per-round (touched, msgs) stacks into the one merged
-    StepIO a multi-step dispatch reports: decided/done_view are the final
-    round's (both monotone within a dispatch — decided is sticky per
+def _merge_scan_io(state: PaxosState, touched_k, msgs_k, proto_k) -> StepIO:
+    """Fold a scan's per-round (touched, msgs, proto) stacks into the one
+    merged StepIO a multi-step dispatch reports: decided/done_view are the
+    final round's (both monotone within a dispatch — decided is sticky per
     tenancy, done_view max-accumulates), touched is the union (Max() needs
-    every slot any round touched), msgs is the dispatch total."""
+    every slot any round touched), msgs and the protocol event counts are
+    dispatch totals."""
     return StepIO(decided=state.decided, done_view=state.done_view,
                   touched=touched_k.any(axis=0),
-                  msgs=msgs_k.sum().astype(I32))
+                  msgs=msgs_k.sum().astype(I32),
+                  proto=proto_k.sum(axis=0))
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -179,10 +221,10 @@ def paxos_multi_step(
 
     def body(st, key):
         st2, io = paxos_step(st, link, done, key, drop_req, drop_rep)
-        return st2, (io.touched, io.msgs)
+        return st2, (io.touched, io.msgs, io.proto)
 
-    st, (touched_k, msgs_k) = jax.lax.scan(body, state, keys)
-    return st, _merge_scan_io(st, touched_k, msgs_k)
+    st, (touched_k, msgs_k, proto_k) = jax.lax.scan(body, state, keys)
+    return st, _merge_scan_io(st, touched_k, msgs_k, proto_k)
 
 
 @functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
@@ -197,10 +239,11 @@ def paxos_multi_step_reliable(
 
     def body(st, _):
         st2, io = paxos_step_reliable(st, link, done)
-        return st2, (io.touched, io.msgs)
+        return st2, (io.touched, io.msgs, io.proto)
 
-    st, (touched_k, msgs_k) = jax.lax.scan(body, state, None, length=nsteps)
-    return st, _merge_scan_io(st, touched_k, msgs_k)
+    st, (touched_k, msgs_k, proto_k) = jax.lax.scan(body, state, None,
+                                                    length=nsteps)
+    return st, _merge_scan_io(st, touched_k, msgs_k, proto_k)
 
 
 def _paxos_round(state, done, eye, Mreq1, Mreq2, Mreq3, Mrep1, Mrep2, hb):
@@ -292,6 +335,29 @@ def _paxos_round(state, done, eye, Mreq1, Mreq2, Mreq3, Mrep1, Mrep2, hb):
         (D1 & offdiag).sum() + (D2 & offdiag).sum() + (D3 & offdiag).sum()
     ).astype(I32)
 
+    # kernelscope protocol counters (PROTO_FIELDS order): per-group event
+    # sums over booleans the round already materialized — the Pallas
+    # kernel packs the identical per-cell events (pallas_kernel
+    # _round_kernel proto path), so the two engines report bit-identical
+    # totals under the same delivery masks.
+    def _gsum(x):
+        return x.sum(axis=tuple(range(1, x.ndim))).astype(I32)
+
+    if PROTO_ENABLED:
+        proto = jnp.stack([
+            _gsum(send1),
+            _gsum(D1 & ~grant),
+            _gsum(D2 & ~win),
+            _gsum(send1 & ~maj1) + _gsum(send2 & ~maj2),
+            _gsum(send1 & (decided_new < 0)),
+            _gsum(decider),
+            _gsum(decider & (n_prop <= 2 * P)),
+        ], axis=-1)
+    else:
+        # Trace-time constant: consumers that don't read it cost nothing,
+        # and XLA folds the zeros out of any summary that does.
+        proto = jnp.zeros((G, NPROTO), I32)
+
     new_state = PaxosState(
         np_=np_post2,
         na=na_new,
@@ -303,7 +369,8 @@ def _paxos_round(state, done, eye, Mreq1, Mreq2, Mreq3, Mrep1, Mrep2, hb):
         done_view=done_view,
     )
     touched = (np_post2 > 0) | (na_new > 0) | (decided_new >= 0) | active_new
-    io = StepIO(decided=decided_new, done_view=done_view, touched=touched, msgs=msgs)
+    io = StepIO(decided=decided_new, done_view=done_view, touched=touched,
+                msgs=msgs, proto=proto)
     return new_state, io
 
 

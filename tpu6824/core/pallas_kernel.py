@@ -56,7 +56,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpu6824.core.kernel import NO_VAL, PaxosState, StepIO, _edge_masks
+from tpu6824.core.kernel import (
+    NO_VAL, NPROTO, PROTO_ENABLED, PROTO_PACK_BITS, PROTO_PACK_SHIFT,
+    PaxosState, StepIO, _edge_masks,
+)
 
 I32 = jnp.int32
 LANES = 128  # TPU lane width; cell blocks are multiples of this
@@ -66,7 +69,7 @@ _BIT_M1, _BIT_M2, _BIT_M3, _BIT_R1, _BIT_R2 = range(5)
 
 
 def _round_kernel(P: int, mode: str, cycle: bool,
-                  count_msgs: bool, *refs):
+                  count_msgs: bool, proto: bool, *refs):
     """One consensus round for a (P, C) block of cells.
 
     `mode` selects the delivery-mask source:
@@ -88,8 +91,17 @@ def _round_kernel(P: int, mode: str, cycle: bool,
     read/write, round read/write).  Outputs grow to include act/propv and
     a per-cell recycled indicator.
 
+    `proto=True` (kernelscope) additionally writes a (P, C) packed
+    per-cell EVENT WORD — the seven PROTO_FIELDS counts at their
+    PROTO_PACK_SHIFT bit offsets, computed from the very same delivery/
+    grant/win/decide booleans the round already holds in registers — so
+    the caller can reduce per-group protocol totals XLA-side without a
+    second pass over the state.  Events are bit-identical to the XLA
+    round's reductions under the same masks (the two-engine parity
+    contract).
+
     refs order: [cfg?] np, na, va, dec, act, propv, ms, [sa, sv], [mask],
-    then outputs: np, na, va, dec, ms, [act, propv, rec], [msgs]
+    then outputs: np, na, va, dec, ms, [act, propv, rec], [msgs], [proto]
     (`count_msgs=False` drops the msgs output entirely — the RPC-budget
     counter is one full (P, C) write per block that steady-state
     throughput loops never read).
@@ -111,7 +123,8 @@ def _round_kernel(P: int, mode: str, cycle: bool,
     else:
         (np_out, na_out, va_out, dec_out, ms_out) = refs[:5]
         refs = refs[5:]
-    msgs_out = refs[0] if count_msgs else None
+    msgs_out = refs.pop(0) if count_msgs else None
+    proto_out = refs.pop(0) if proto else None
 
     C = np_ref.shape[1]
 
@@ -291,6 +304,33 @@ def _round_kernel(P: int, mode: str, cycle: bool,
                        + D3[p][q].astype(I32))
             msgs.append(cnt)
 
+    # ---- kernelscope packed event word (PROTO_FIELDS order) ----------------
+    # One int32 per cell carrying every protocol event of this step, from
+    # booleans already in registers — the device-resident telemetry's whole
+    # per-step cost is this pack + one (P, C) write per block.
+    if proto:
+        (s_att, s_prej, s_arej, s_qf,
+         s_rst, s_dec, s_fast) = PROTO_PACK_SHIFT
+        words = []
+        for p in range(P):
+            prej = zero
+            arej = zero
+            for q in range(P):
+                prej = prej + (D1[p][q]
+                               & ~(n_prop[p] > np_pre[q])).astype(I32)
+                arej = arej + (D2[p][q] & ~win[p][q]).astype(I32)
+            words.append(
+                active[p].astype(I32) << s_att
+                | prej << s_prej
+                | arej << s_arej
+                | ((active[p] & ~maj1[p]).astype(I32)
+                   + (send2[p] & ~maj2[p]).astype(I32)) << s_qf
+                | (active[p] & (dec_new[p] < 0)).astype(I32) << s_rst
+                | decider[p].astype(I32) << s_dec
+                | (decider[p]
+                   & (n_prop[p] <= 2 * P)).astype(I32) << s_fast)
+        proto_out[...] = jnp.concatenate(words, axis=0)
+
     np_out[...] = jnp.concatenate(np_post2, axis=0)
     na_out[...] = jnp.concatenate(na_new, axis=0)
     va_out[...] = jnp.concatenate(va_new, axis=0)
@@ -429,18 +469,25 @@ def apply_starts_lane(l: LaneState, reset: jnp.ndarray,
 
 def _lane_round(l: LaneState, packed_mask, interpret,
                 *, mode=None, cycle=False, sa=None, sv=None, cfg=None,
-                count_msgs=True):
+                count_msgs=True, proto=False):
     """Invoke the fused round on lane-resident state.
 
     Back-compat form: `packed_mask` is the (P, P, Np) int32 bitplane array
     (mode="packed") or None (mode="reliable").  `mode` overrides when
     given.  With `cycle=True`, sa/sv (P, Np) i32 arm inputs are fused in
     and the return gains the per-cell recycled vector (see _round_kernel).
-    mode="prng" requires `cfg` = int32[3] [seed, thresh_req, thresh_rep]
-    and, off-TPU, the TPU interpreter (plain interpret mode has no PRNG
-    rules; InterpretParams emulates them — degenerately, all-zero bits)."""
+    With `proto=True` the return additionally gains the (P, Np) packed
+    per-cell event-word array (kernelscope; unpack per group with
+    `_unpack_proto`).  mode="prng" requires `cfg` = int32[3]
+    [seed, thresh_req, thresh_rep] and, off-TPU, the TPU interpreter
+    (plain interpret mode has no PRNG rules; InterpretParams emulates
+    them — degenerately, all-zero bits)."""
     P, Np = l.np_.shape
     C, _ = _block(Np)  # Np is already block-aligned
+    if proto and P > 15:
+        raise ValueError(
+            f"kernelscope event-word packing holds reject counts in 4 "
+            f"bits (P <= 15); got P={P}")
     if mode is None:
         mode = "packed" if packed_mask is not None else "reliable"
     if mode == "prng" and interpret is True:
@@ -478,27 +525,49 @@ def _lane_round(l: LaneState, packed_mask, interpret,
     if count_msgs:
         out_specs.append(cell)
         out_shape_l.append(out_shape)
-    outs = pl.pallas_call(
-        functools.partial(_round_kernel, P, mode, cycle, count_msgs),
+    if proto:
+        out_specs.append(cell)
+        out_shape_l.append(out_shape)
+    outs = list(pl.pallas_call(
+        functools.partial(_round_kernel, P, mode, cycle, count_msgs, proto),
         grid=(Np // C,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape_l,
         interpret=interpret,
-    )(*ops)
+    )(*ops))
     if cycle:
         (np_post2, na_new, va_new, dec_new, ms_new,
          act_new, propv_new, rec) = outs[:8]
-        msgs_l = outs[8] if count_msgs else None
+        outs = outs[8:]
+    else:
+        (np_post2, na_new, va_new, dec_new, ms_new) = outs[:5]
+        outs = outs[5:]
+    msgs_l = outs.pop(0) if count_msgs else None
+    proto_pk = outs.pop(0) if proto else None
+    if cycle:
         l2 = LaneState(np_=np_post2, na=na_new, va=va_new, dec=dec_new,
                        act=act_new, propv=propv_new, ms=ms_new)
-        return l2, msgs_l, rec
-    (np_post2, na_new, va_new, dec_new, ms_new) = outs[:5]
-    msgs_l = outs[5] if count_msgs else None
+        return (l2, msgs_l, rec, proto_pk) if proto else (l2, msgs_l, rec)
     act_new = ((l.act != 0) & (dec_new < 0)).astype(I32)
     l2 = LaneState(np_=np_post2, na=na_new, va=va_new, dec=dec_new,
                    act=act_new, propv=l.propv, ms=ms_new)
-    return l2, msgs_l
+    return (l2, msgs_l, proto_pk) if proto else (l2, msgs_l)
+
+
+def _unpack_proto(packed, G: int, I: int, P: int):
+    """(P, Np) packed per-cell event words → (G, NPROTO) per-group totals
+    in PROTO_FIELDS order.  Pure XLA reductions inside the caller's jit —
+    the per-group fold costs no extra dispatch and no readback.  Pad
+    cells are inactive and emit all-zero words, so no masking is needed
+    beyond the slice to the live N cells."""
+    N = G * I
+    w = packed[:, :N]
+    cols = []
+    for bits, shift in zip(PROTO_PACK_BITS, PROTO_PACK_SHIFT):
+        f = (w >> shift) & ((1 << bits) - 1)
+        cols.append(f.reshape(P, G, I).sum(axis=(0, 2)))
+    return jnp.stack(cols, axis=-1).astype(I32)
 
 
 def _pack_masks(key, G, I, P, link, drop_req, drop_rep, Np):
@@ -524,7 +593,8 @@ def _pack_masks(key, G, I, P, link, drop_req, drop_rep, Np):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("G", "I", "masked", "interpret"))
+                   static_argnames=("G", "I", "masked", "interpret",
+                                    "with_proto"))
 def paxos_step_lanes(
     l: LaneState,
     done_view: jnp.ndarray,  # (G, P, P) i32
@@ -538,6 +608,7 @@ def paxos_step_lanes(
     I: int,
     masked: bool = True,
     interpret: bool = False,
+    with_proto: bool = False,
 ):
     """One fused round on lane-resident state.
 
@@ -547,7 +618,10 @@ def paxos_step_lanes(
     mask HBM traffic.
 
     Returns (LaneState, done_view, msgs) — decided values live in the
-    returned state's `.dec`.
+    returned state's `.dec`.  With `with_proto=True` (kernelscope) the
+    return gains a fourth element: the (G, NPROTO) per-group protocol
+    event totals, packed in-kernel and unpacked here inside the same jit
+    (no extra dispatch, no readback).
     """
     P = l.np_.shape[0]
     N = G * I
@@ -556,7 +630,7 @@ def paxos_step_lanes(
     if masked:
         packed, M1, khb = _pack_masks(
             key, G, I, P, link, drop_req, drop_rep, l.np_.shape[1])
-        l2, msgs_l = _lane_round(l, packed, interpret)
+        out = _lane_round(l, packed, interpret, proto=with_proto)
         # Done piggyback (paxos/rpc.go:74-80): rides prepare traffic + the
         # once-per-step heartbeat (bit-identical to the XLA path at drop=0,
         # where the heartbeat covers every live edge).
@@ -564,12 +638,18 @@ def paxos_step_lanes(
             l.act, M1, khb, link, drop_req, done_view, done, G, I, P, N,
             eye)
     else:
-        l2, msgs_l = _lane_round(l, None, interpret)
+        out = _lane_round(l, None, interpret, proto=with_proto)
         # Reliable full mesh: every peer hears every peer each step.
         done_view = jnp.maximum(done_view, done[:, None, :])
+    if with_proto:
+        l2, msgs_l, proto_pk = out
+    else:
+        l2, msgs_l = out
     done_view = jnp.maximum(
         done_view, jnp.where(eye[None], done[:, None, :], -1))
     msgs = msgs_l[:, :N].sum().astype(I32)
+    if with_proto:
+        return l2, done_view, msgs, _unpack_proto(proto_pk, G, I, P)
     return l2, done_view, msgs
 
 
@@ -727,15 +807,21 @@ def paxos_step_pallas(
     LaneState and call `paxos_step_lanes` instead."""
     G, I, P = state.np_.shape
     l = to_lane_state(state)
-    l2, done_view, msgs = paxos_step_lanes(
-        l, state.done_view, link, done, key, drop_req, drop_rep,
-        G=G, I=I, masked=True, interpret=interpret)
+    if PROTO_ENABLED:
+        l2, done_view, msgs, proto = paxos_step_lanes(
+            l, state.done_view, link, done, key, drop_req, drop_rep,
+            G=G, I=I, masked=True, interpret=interpret, with_proto=True)
+    else:
+        l2, done_view, msgs = paxos_step_lanes(
+            l, state.done_view, link, done, key, drop_req, drop_rep,
+            G=G, I=I, masked=True, interpret=interpret)
+        proto = jnp.zeros((G, NPROTO), I32)
     new_state = from_lane_state(l2, done_view, G, I)
     new_state = new_state._replace(propv=state.propv)
     touched = ((new_state.np_ > 0) | (new_state.na > 0)
                | (new_state.decided >= 0) | new_state.active)
     io = StepIO(decided=new_state.decided, done_view=done_view,
-                touched=touched, msgs=msgs)
+                touched=touched, msgs=msgs, proto=proto)
     return new_state, io
 
 

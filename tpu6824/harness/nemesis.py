@@ -520,10 +520,12 @@ class ReplayArtifact:
         self.seed: int | None = None
         self.schedule: FaultSchedule | None = None
         self.nemesis: Nemesis | None = None
+        self.collector = None  # kernelscope fleet Collector (optional)
         self.extra: dict = {}
 
     def attach(self, nemesis: Nemesis | None = None, seed: int | None = None,
-               schedule: FaultSchedule | None = None, **extra) -> None:
+               schedule: FaultSchedule | None = None, collector=None,
+               **extra) -> None:
         if nemesis is not None:
             self.nemesis = nemesis
             self.schedule = schedule or nemesis.schedule
@@ -533,6 +535,12 @@ class ReplayArtifact:
             self.seed = seed
         elif self.schedule is not None and self.schedule.seed is not None:
             self.seed = self.schedule.seed
+        if collector is not None:
+            # kernelscope: a soak over a multi-process wire deployment
+            # registers its fleet collector here, and the failure
+            # artifact embeds the MERGED cross-process view (to_dict)
+            # instead of only this process's flight ring.
+            self.collector = collector
         self.extra.update(extra)
 
     @property
@@ -572,6 +580,21 @@ class ReplayArtifact:
         # trace_id — the "what was the system doing at that moment" the
         # verdict alone cannot answer.
         d["flight_recorder"] = _tracing.flight_snapshot()
+        # kernelscope: when a fleet collector is attached (wire-deployment
+        # soaks), the artifact carries the merged multi-process snapshot —
+        # every process's metrics/stats/flight under its own namespace,
+        # plus the fleet-summed device protocol counters.  Polled AT
+        # FAILURE TIME; members the faults killed show up in `errors`,
+        # which is itself evidence.
+        if self.collector is not None:
+            try:
+                snap = self.collector.snapshot()
+                d["kernelscope"] = {
+                    "snapshot": snap,
+                    "protocol": self.collector.merge_protocol(snap),
+                }
+            except Exception as e:  # noqa: BLE001 — never cost the artifact
+                d["kernelscope"] = {"error": repr(e)[:200]}
         return d
 
     def write(self, outdir: str = "/tmp") -> str:

@@ -15,11 +15,22 @@ Three parts, threaded through every other layer (ISSUE 5):
     (`harness/nemesis.py::ReplayArtifact`), so a linearizability
     violation ships with the correlated trace of the offending ops.
 
+kernelscope (ISSUE 6) adds two fleet-level tools on top:
+
+  - `obs.collector` — poll `stats()/metrics()/flight()` from every
+    process of a wire deployment (plus the local process) into ONE
+    namespaced snapshot and ONE merged Perfetto timeline; sums the
+    device-resident per-group protocol counters fleet-wide.
+  - `obs.benchdiff` — `python -m tpu6824.obs.benchdiff OLD NEW`
+    compares two BENCH_*.json artifacts per leg/metric with noise
+    thresholds and exits non-zero on regression.
+
 Stdlib-only on purpose: importable from the analysis CLI, daemons, and
 clerks without dragging in JAX.
 """
 
-from tpu6824.obs import metrics, tracing  # noqa: F401
+from tpu6824.obs import collector, metrics, tracing  # noqa: F401
+from tpu6824.obs.collector import Collector, local_handle  # noqa: F401
 from tpu6824.obs.tracing import (  # noqa: F401
     FLIGHT,
     SCHEMA_VERSION,

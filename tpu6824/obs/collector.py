@@ -1,0 +1,225 @@
+"""kernelscope fleet collector — one view of a multi-process deployment.
+
+tpuscope (ISSUE 5) gave every PROCESS a metrics registry, a stats()
+health block, and a flight recorder, each served over the fabric_service
+wire (`metrics`/`stats`/`flight` RPCs).  But a wire deployment is
+several processes — fabricd owning the device, replica daemons, the
+driving harness — and until now a nemesis soak over one produced only
+per-process fragments: N metrics files that can't be summed, N Perfetto
+exports whose span ids collide (every process counts ids from 1).
+
+The `Collector` closes that gap:
+
+  - `add(name, handle)` registers any fabric-shaped handle — a local
+    `PaxosFabric`, a `remote_fabric()` proxy, or anything exposing some
+    subset of `stats()/metrics()/flight()` (absent surfaces are skipped,
+    dead processes are recorded as errors, never raised — mid-nemesis a
+    collector member being down IS data);
+  - `snapshot()` polls every member once into ONE namespaced dict
+    `{processes: {name: {stats, metrics, flight}}, errors: {...}}` —
+    the artifact every soak embeds and every fleet poller scrapes;
+  - `export_perfetto(path)` merges every member's flight ring into ONE
+    Chrome/Perfetto file, one process track per member (distinct pids,
+    `name/component` thread labels via `tracing.chrome_events`) — all
+    rings share `time.monotonic_ns()` so cross-process causality reads
+    directly off the one timeline;
+  - `protocol_totals()` sums the kernelscope per-group device counters
+    (`stats()["protocol"]`) across every device-owning member — the
+    fleet-wide rounds-per-decide the ROADMAP variants are judged by.
+
+Stdlib-only like the rest of `obs/` (plus `utils/crashsink`, itself
+stdlib-only): handles are duck-typed, so this module imports neither
+JAX nor the rpc layer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tpu6824.obs import metrics as obs_metrics
+from tpu6824.obs import tracing as obs_tracing
+from tpu6824.utils import crashsink
+
+__all__ = ["Collector", "derive_protocol_ratios", "local_handle"]
+
+
+def derive_protocol_ratios(totals: dict) -> dict:
+    """The derived protocol ratios, in ONE place: rounds-per-decide (how
+    many prepare rounds a decide actually cost) and the fast-path
+    fraction (decides won at the proposer's first proposal number — the
+    1-round cohort the ROADMAP flexible-quorum variants target).  Both
+    `PaxosFabric.stats()["protocol"]` and the fleet-merged
+    `Collector.merge_protocol` derive through here, so a variant PR that
+    redefines a cohort cannot silently diverge the per-fabric numbers
+    from the fleet numbers."""
+    decides = totals.get("decides", 0)
+    return {
+        "rounds_per_decide": (
+            round(totals.get("prepare_attempts", 0) / decides, 4)
+            if decides else None),
+        "fast_path_fraction": (
+            round(totals.get("fast_path_decides", 0) / decides, 4)
+            if decides else None),
+    }
+
+
+class _LocalProcess:
+    """The calling process as a collector member: registry + flight ring
+    directly, stats() only when a local fabric was given (the surface is
+    simply absent otherwise — absent, not erroring, so a fabric-less
+    harness process doesn't pollute the snapshot's error map)."""
+
+    def __init__(self, fabric=None):
+        if fabric is not None:
+            self.stats = fabric.stats
+
+    def metrics(self):
+        return obs_metrics.snapshot()
+
+    def flight(self):
+        return obs_tracing.flight_snapshot()
+
+
+def local_handle(fabric=None) -> _LocalProcess:
+    """A collector handle for THIS process (the harness/driver process is
+    part of the fleet too — its clerk retries and rpc latencies belong in
+    the merged snapshot)."""
+    return _LocalProcess(fabric)
+
+
+class Collector:
+    """Named fabric-shaped handles → one merged observability artifact."""
+
+    _SURFACES = ("stats", "metrics", "flight")
+
+    def __init__(self, poll_timeout: float = 15.0):
+        # Per-MEMBER wall budget for one snapshot poll: a hung member
+        # (partitioned/deafened mid-nemesis — exactly when snapshots
+        # matter) must not stall the merged artifact for the full RPC
+        # timeout × surfaces × members; members are polled concurrently
+        # and a straggler is cut off at the budget with whatever
+        # surfaces it already delivered.
+        self._members: dict[str, object] = {}
+        self._poll_timeout = poll_timeout
+
+    def add(self, name: str, handle) -> "Collector":
+        if name in self._members:
+            raise ValueError(f"collector member {name!r} already added")
+        self._members[name] = handle
+        return self
+
+    def add_local(self, name: str = "local", fabric=None) -> "Collector":
+        return self.add(name, local_handle(fabric))
+
+    def names(self) -> list[str]:
+        return sorted(self._members)
+
+    # ------------------------------------------------------------ snapshot
+
+    def snapshot(self, timeout: float | None = None) -> dict:
+        """Poll every member once, CONCURRENTLY, bounded by the per-
+        member poll budget.  Per-member per-surface failures land in
+        `errors["name.surface"]` as strings, and a member still hanging
+        at the deadline lands in `errors["name.poll"]` with whatever
+        surfaces it already delivered kept — a half-dead deployment
+        still yields the surviving processes' view promptly (exactly
+        the moment a merged snapshot matters most)."""
+        budget = self._poll_timeout if timeout is None else timeout
+        processes: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+        mu = threading.Lock()
+
+        def poll(name, h, out):
+            for surface in self._SURFACES:
+                fn = getattr(h, surface, None)
+                if fn is None:
+                    continue
+                try:
+                    val = fn()
+                except Exception as e:  # noqa: BLE001 — a dead member is data
+                    with mu:
+                        errors[f"{name}.{surface}"] = repr(e)[:200]
+                else:
+                    with mu:
+                        out[surface] = val
+
+        threads = []
+        for name in self.names():
+            out: dict = {}
+            processes[name] = out
+            # Surface failures are caught per-call above; guarded() is
+            # the daemon-death contract for anything that still escapes.
+            t = threading.Thread(
+                target=crashsink.guarded(poll, f"collector[{name}]"),
+                args=(name, self._members[name], out), daemon=True)
+            t.start()
+            threads.append((name, t))
+        deadline = time.monotonic() + budget
+        for name, t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                with mu:
+                    errors[f"{name}.poll"] = (
+                        f"member still polling after {budget}s budget — "
+                        "partial surfaces kept")
+        # Copy under the lock: a straggler thread cut off at the budget
+        # is still alive and will keep writing into its `out` dict (and
+        # `errors`) — returning the live dicts would let json.dumps over
+        # the artifact race those writes ("dict changed size during
+        # iteration" at exactly the failure moment the artifact exists
+        # for).  Surface values are never mutated after assignment, so
+        # shallow copies of the containers suffice.
+        with mu:
+            return {"schema": obs_tracing.SCHEMA_VERSION,
+                    "t_mono_ns": time.monotonic_ns(),
+                    "processes": {n: dict(o) for n, o in processes.items()},
+                    "errors": dict(errors)}
+
+    # ------------------------------------------------------------- derived
+
+    @staticmethod
+    def merge_protocol(snapshot: dict) -> dict | None:
+        """Sum `stats()["protocol"]` totals across every device-owning
+        member of a snapshot (None when no member reported protocol
+        counters).  Derived ratios are recomputed from the merged totals
+        — averaging per-process ratios would weight idle fabrics equally
+        with loaded ones."""
+        totals: dict[str, int] = {}
+        fields: list[str] | None = None
+        for proc in snapshot["processes"].values():
+            proto = proc.get("stats", {}).get("protocol")
+            if not proto:
+                continue
+            fields = fields or list(proto["fields"])
+            for k, v in proto["totals"].items():
+                totals[k] = totals.get(k, 0) + int(v)
+        if fields is None:
+            return None
+        return {"fields": fields, "totals": totals,
+                **derive_protocol_ratios(totals)}
+
+    def protocol_totals(self) -> dict | None:
+        return self.merge_protocol(self.snapshot())
+
+    # ------------------------------------------------------------- perfetto
+
+    @staticmethod
+    def merge_perfetto(snapshot: dict, path: str) -> str:
+        """One Perfetto file from a snapshot's flight rings: member k
+        renders as process track pid=k+1 (stable name order) labeled with
+        the member name — span/trace ids that collide across processes
+        stay distinguishable because every event carries its process name
+        and lives under its own pid."""
+        events: list[dict] = []
+        for pid, (name, proc) in enumerate(
+                sorted(snapshot["processes"].items()), start=1):
+            flight = proc.get("flight")
+            if not flight:
+                continue
+            events.extend(obs_tracing.chrome_events(
+                flight["records"], process=name, pid=pid))
+        return obs_tracing.write_chrome_trace(path, events)
+
+    def export_perfetto(self, path: str) -> str:
+        return self.merge_perfetto(self.snapshot(), path)

@@ -31,9 +31,22 @@ from __future__ import annotations
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
-           "counter", "gauge", "histogram", "inc", "snapshot", "reset"]
+           "counter", "gauge", "histogram", "inc", "snapshot",
+           "diff_snapshots", "reset"]
 
 _NBUCKETS = 64  # log2 buckets cover any int64-scale observation
+
+
+def _bucket_quantile(buckets, count: int, q: float) -> float:
+    """Bucket-resolution quantile over a log2 bucket list: the exclusive
+    upper bound (2^k) of the bucket holding the q'th observation."""
+    target = q * count
+    seen = 0
+    for k, c in enumerate(buckets):
+        seen += c
+        if c and seen >= target:
+            return float(1 << k)
+    return 0.0
 
 
 class Counter:
@@ -134,13 +147,7 @@ class Histogram:
         """Bucket-resolution quantile estimate (the bucket's exclusive
         upper bound, 2^k)."""
         with self._mu:
-            target = q * self.count
-            seen = 0
-            for k, c in enumerate(self._buckets):
-                seen += c
-                if c and seen >= target:
-                    return float(1 << k)
-        return 0.0
+            return _bucket_quantile(self._buckets, self.count, q)
 
     def snapshot(self):
         with self._mu:
@@ -149,6 +156,17 @@ class Histogram:
                 "sum": self.sum,
                 "pow2": {str(k): c for k, c in enumerate(self._buckets)
                          if c},
+                # Estimated quantiles straight from the log2 buckets
+                # (bucket upper bound, so at most 2x above the true
+                # value) — bench legs and obs/benchdiff consume these
+                # without re-deriving bucket math.  Always present, None
+                # when the histogram is empty (stable snapshot shape).
+                "p50": (_bucket_quantile(self._buckets, self.count, 0.50)
+                        if self.count else None),
+                "p95": (_bucket_quantile(self._buckets, self.count, 0.95)
+                        if self.count else None),
+                "p99": (_bucket_quantile(self._buckets, self.count, 0.99)
+                        if self.count else None),
             }
             by = {k: h for k, h in self.by.items()}
         out["by"] = {k: h.snapshot() for k, h in by.items()}
@@ -234,6 +252,63 @@ def inc(name: str, n: int = 1, key: str | None = None) -> None:
 
 def snapshot() -> dict:
     return REGISTRY.snapshot()
+
+
+def diff_snapshots(before: dict, after: dict) -> dict:
+    """`after − before` over two registry `snapshot()` shapes — the
+    attribution primitive behind bench's PER-LEG tpuscope sections: take
+    a snapshot when a leg starts, diff at its end, and the counters/
+    histograms in the result are the leg's own, not the process
+    lifetime's.  Counters and histogram counts/sums/buckets subtract
+    (metrics absent from `before` diff against zero); gauges are
+    last-written values, not accumulators, so the `after` value is kept
+    as-is.  Zero-delta counters and histograms are dropped — a leg's
+    section names what the leg DID."""
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    b_c = before.get("counters", {})
+    for name, a in after.get("counters", {}).items():
+        b = b_c.get(name, {})
+        total = a["total"] - b.get("total", 0)
+        by = {k: v - b.get("by", {}).get(k, 0)
+              for k, v in a.get("by", {}).items()
+              if v - b.get("by", {}).get(k, 0)}
+        if total or by:
+            out["counters"][name] = {"total": total, "by": by}
+    out["gauges"] = {name: dict(g)
+                     for name, g in after.get("gauges", {}).items()}
+    b_h = before.get("histograms", {})
+    for name, a in after.get("histograms", {}).items():
+        d = _diff_hist(b_h.get(name, {}), a)
+        if d is not None:
+            out["histograms"][name] = d
+    return out
+
+
+def _diff_hist(b: dict, a: dict) -> dict | None:
+    count = a.get("count", 0) - b.get("count", 0)
+    if count <= 0:
+        return None
+    b_pow = b.get("pow2", {})
+    pow2 = {k: v - b_pow.get(k, 0) for k, v in a.get("pow2", {}).items()
+            if v - b_pow.get(k, 0)}
+    buckets = [0] * _NBUCKETS
+    for k, v in pow2.items():
+        buckets[int(k)] = v
+    out = {
+        "count": count,
+        "sum": a.get("sum", 0) - b.get("sum", 0),
+        "pow2": pow2,
+        "p50": _bucket_quantile(buckets, count, 0.50),
+        "p95": _bucket_quantile(buckets, count, 0.95),
+        "p99": _bucket_quantile(buckets, count, 0.99),
+    }
+    sub = {}
+    for k, ah in a.get("by", {}).items():
+        dh = _diff_hist(b.get("by", {}).get(k, {}), ah)
+        if dh is not None:
+            sub[k] = dh
+    out["by"] = sub
+    return out
 
 
 def reset() -> None:

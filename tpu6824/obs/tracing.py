@@ -240,38 +240,74 @@ def batch(name: str, t0_ns: int, comp: str = "fabric", **args) -> None:
 # ---------------------------------------------------------------- export
 
 
-def export_trace(path: str, trace_id: int | None = None) -> str:
-    """Write the flight ring as Chrome trace-event JSON (Perfetto /
-    chrome://tracing / `perfetto.dev` all load it).  With `trace_id`,
+def chrome_events(records, process: str | None = None, pid: int = 1,
+                  trace_id: int | None = None) -> list[dict]:
+    """Flight-ring records → Chrome trace events, NAMESPACED per process.
+
+    Every span/instant is emitted under `pid`; component thread names are
+    prefixed with `process` (when given), and `process`/the raw
+    trace/span/parent ids ride in args qualified by the process name — so
+    when the kernelscope collector concatenates several processes' rings
+    into ONE file, span ids that collide numerically (every process
+    counts from 1) stay distinguishable and the timelines render as
+    separate process tracks instead of interleaving into one.  A
+    `process_name` metadata event labels the track.  With `trace_id`,
     only that trace's spans plus the untagged batch events (trace_id 0)
-    are exported, so one op's causal chain stays readable against the
-    fabric batches that carried it.  Returns `path`."""
+    are kept."""
     comp_tid: dict[str, int] = {}
     evs = []
-    for r in FLIGHT.snapshot():
+    for r in records:
         if trace_id is not None and r["trace_id"] not in (trace_id, 0):
             continue
         tid = comp_tid.setdefault(r["comp"], len(comp_tid) + 1)
-        ev = {"name": r["name"], "ph": r["ph"], "pid": 1, "tid": tid,
+        args = {"trace_id": r["trace_id"], "span_id": r["span_id"],
+                "parent_id": r["parent_id"], **r["args"]}
+        if process is not None:
+            args["proc"] = process
+        ev = {"name": r["name"], "ph": r["ph"], "pid": pid, "tid": tid,
               "ts": r["ts"] / 1e3,  # chrome wants microseconds
-              "args": {"trace_id": r["trace_id"],
-                       "span_id": r["span_id"],
-                       "parent_id": r["parent_id"], **r["args"]}}
+              "args": args}
         if r["ph"] == "X":
             ev["dur"] = r["dur"] / 1e3
         else:
             ev["s"] = "g"
         evs.append(ev)
-    meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
-             "args": {"name": comp}} for comp, tid in comp_tid.items()]
+    meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": (f"{process}/{comp}" if process else comp)}}
+            for comp, tid in comp_tid.items()]
+    if process is not None:
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": process}})
+    return meta + evs
+
+
+def write_chrome_trace(path: str, events: list[dict]) -> str:
+    """Wrap prepared Chrome events in the trace-file envelope."""
     with open(path, "w") as f:
-        json.dump({"traceEvents": meta + evs, "displayTimeUnit": "ms",
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms",
                    "metadata": {"tpuscope": SCHEMA_VERSION}}, f)
     return path
+
+
+def export_trace(path: str, trace_id: int | None = None,
+                 process: str | None = None) -> str:
+    """Write the flight ring as Chrome trace-event JSON (Perfetto /
+    chrome://tracing / `perfetto.dev` all load it).  With `trace_id`,
+    only that trace's spans plus the untagged batch events (trace_id 0)
+    are exported, so one op's causal chain stays readable against the
+    fabric batches that carried it.  `process` namespaces the export's
+    pid/thread names (see `chrome_events`) for merge-safe multi-process
+    use; single-process exports keep the bare component names.  Returns
+    `path`."""
+    return write_chrome_trace(
+        path, chrome_events(FLIGHT.snapshot(), process=process,
+                            pid=(os.getpid() if process else 1),
+                            trace_id=trace_id))
 
 
 def flight_snapshot() -> dict:
     """The flight recorder as one JSON-safe block (the nemesis artifact's
     `flight_recorder` section)."""
     return {"schema": SCHEMA_VERSION, "capacity": FLIGHT._ring.maxlen,
-            "dropped": FLIGHT.dropped, "records": FLIGHT.snapshot()}
+            "dropped": FLIGHT.dropped, "pid": os.getpid(),
+            "records": FLIGHT.snapshot()}

@@ -265,7 +265,11 @@ def sharded_step_pallas(mesh: Mesh, interpret: bool | None = None):
     s3 = P("g", None, None)
     st_spec = PaxosState(np_=s3, na=s3, va=s3, decided=s3, active=s3,
                          propv=s3, maxseen=s3, done_view=s3)
-    io_spec = StepIO(decided=s3, done_view=s3, touched=s3, msgs=P("g"))
+    # proto is (G, NPROTO) per-group event totals: shards cleanly over
+    # 'g' (groups never communicate, and this mesh keeps 'p'/'i' local so
+    # each shard's per-group sums are already complete).
+    io_spec = StepIO(decided=s3, done_view=s3, touched=s3, msgs=P("g"),
+                     proto=P("g", None))
 
     def local(state, link, done, key, drop_req, drop_rep):
         key = jax.random.fold_in(key, jax.lax.axis_index("g"))
