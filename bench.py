@@ -315,6 +315,14 @@ def child_main():
         except Exception as e:  # noqa: BLE001
             service["clerk"] = {"value": 0.0, "error": repr(e)[:200]}
         service["clerk"]["tpuscope"] = _tpuscope_delta(leg0)
+        # Durability leg (durafault): recovery-time percentiles, gated by
+        # benchdiff like every throughput leg.
+        leg0 = _tpuscope_begin()
+        try:
+            recovery = _recovery_rate()
+        except Exception as e:  # noqa: BLE001
+            recovery = {"error": repr(e)[:200]}
+        recovery["tpuscope"] = _tpuscope_delta(leg0)
 
         # Roofline context: bytes moved per BEST-CASE step.
         #  - pallas: the fused cycle is one kernel — reads 7 state + sa +
@@ -360,6 +368,7 @@ def child_main():
             },
             "wire": wire,
             "service": service,
+            "recovery": recovery,
             "roofline": _roofline(
                 jax, jnp, on_cpu, impl, state_bytes, STEPS / best_dt,
                 measured_bytes=cost_bytes,
@@ -1065,6 +1074,72 @@ def _clerk_rate():
                     f"GIL-bound on a single-core host",
         },
     }
+
+
+def _recovery_rate():
+    """Durability leg (durafault): wall time from "process gone" to
+    "recovered fabric serving its decided state", via the continuous-
+    checkpoint recovery path (`core/checkpointd.py::recover_newest` —
+    checksum scan, newest valid snapshot, full restore).  Recorded as
+    p50/p95 ms over several restore trials (first trial dropped: it pays
+    one-time jit warmup the others — and any long-lived reboot — do
+    not), plus the snapshot footprint, so benchdiff gates recovery-time
+    regressions exactly like throughput ones."""
+    import shutil
+    import tempfile
+
+    from tpu6824.core.checkpointd import ContinuousCheckpointer, recover_newest
+    from tpu6824.core.fabric import PaxosFabric
+    from tpu6824.core.peer import Fate
+
+    G = int(os.environ.get("BENCH_RECOVERY_GROUPS", 8))
+    I = int(os.environ.get("BENCH_RECOVERY_INSTANCES", 64))
+    P = 3
+    nseq = I // 2  # half the window decided at snapshot time
+    trials = max(2, int(os.environ.get("BENCH_RECOVERY_TRIALS", 6)))
+    d = tempfile.mkdtemp(prefix="brec", dir="/var/tmp")
+    fab = None
+    try:
+        fab = PaxosFabric(ngroups=G, npeers=P, ninstances=I)
+        fab.start_many([(g, 0, s, f"v{g}-{s}")
+                        for g in range(G) for s in range(nseq)])
+        fab.step(6)  # reliable net: everything decides + gossip settles
+        decided = sum(fab.ndecided(g, s) > 0
+                      for g in range(G) for s in range(nseq))
+        ck = ContinuousCheckpointer(fab, d, interval=60.0, keep=2)
+        path = ck.snapshot_once()
+        snap_bytes = os.path.getsize(path)
+        times = []
+        decided_at_restore = 0
+        for t in range(trials):
+            t0 = time.perf_counter()
+            fab2, report = recover_newest(d)
+            f0, v0 = fab2.status(0, 1, 0)
+            dt = time.perf_counter() - t0
+            assert f0 == Fate.DECIDED and v0 == "v0-0", (f0, v0)
+            assert report["restored_from"], report
+            decided_at_restore = fab2.stats()["decided_cells"]
+            times.append(dt * 1e3)
+        times = sorted(times[1:])  # drop the warmup trial
+        n = len(times)
+        return {
+            "recovery_time_ms": {
+                "p50": round(times[n // 2], 3),
+                "p95": round(times[min(n - 1, round(0.95 * (n - 1)))], 3),
+            },
+            "snapshot_bytes": snap_bytes,
+            "decided_instances": int(decided),
+            "decided_at_restore": int(decided_at_restore),
+            "trials": n,
+            "shape": {"G": G, "I": I, "P": P, "nseq": nseq},
+            "note": ("ms from dead process to a restored fabric serving "
+                     "its decided state (recover_newest: checksum scan + "
+                     "full restore; first trial dropped as jit warmup)"),
+        }
+    finally:
+        if fab is not None:
+            fab.stop_clock()
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def _wire_rate(n_instances=120):

@@ -1,0 +1,30 @@
+"""tpusan golden fixture: hand-rolled write-then-rename persistence.
+
+Expected findings: durable-write-discipline at BOTH write-opens — each
+function reimplements the atomic-persist pattern outside the durafs
+seam (no tmp fsync, no dir fsync, no fault injection).
+"""
+
+import os
+import pickle
+
+
+def save_meta(path, meta):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:   # finding: bypasses durafs.atomic_write
+        f.write(pickle.dumps(meta))
+    os.replace(tmp, path)
+
+
+def save_report(path, text):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:    # finding: same pattern, text mode
+        f.write(text)
+    os.rename(tmp, path)
+
+
+def plain_log(path, line):
+    # No rename in sight: an append-only log is not the atomic-persist
+    # pattern, so this function must NOT trip the rule.
+    with open(path, "a") as f:
+        f.write(line)

@@ -49,6 +49,7 @@ GOLDEN_EXPECT = {
     "services/bad_suppress.py": {"bad-suppression": 2,
                                  "unused-suppression": 1,
                                  "lock-blocking-call": 2},
+    "services/persist_rename.py": {"durable-write-discipline": 2},
 }
 
 

@@ -59,6 +59,15 @@ def test_one_json_line_with_required_keys():
     assert clerk["phases"]["total_seconds"] >= 0, clerk
     assert "outside_framework_wall_fraction" in clerk["phases"], clerk
     assert d["service"]["phases"]["total_seconds"] >= 0, d["service"]
+    # Durability provenance (ISSUE 7, durafault): every recorded run
+    # must carry the recovery leg — restore-from-snapshot wall-time
+    # percentiles + snapshot footprint — or recovery-time regressions
+    # have no artifact trail for benchdiff to gate on.
+    rec = d["recovery"]
+    assert "error" not in rec, rec
+    assert rec["recovery_time_ms"]["p50"] > 0, rec
+    assert rec["recovery_time_ms"]["p95"] >= rec["recovery_time_ms"]["p50"]
+    assert rec["snapshot_bytes"] > 0 and rec["decided_at_restore"] > 0, rec
     # Roofline honesty (ISSUE satellite): at least one shape must be
     # memory-resident so bw_fraction is judgeable somewhere.
     mr = d["roofline_memres"]

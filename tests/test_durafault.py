@@ -1,0 +1,568 @@
+"""durafault — deterministic disk faults, whole-process crash/reboot, and
+continuous fabric checkpointing with crash-consistent recovery (ISSUE 7).
+
+Layers, mirroring the tentpole:
+
+  - checkpoint recovery honesty: `recover_newest` must DISCARD a torn/
+    truncated snapshot (checksum frame) and fall back to an older valid
+    one — never serve garbage as decided state;
+  - the continuous checkpointer under live traffic: snapshots flow while
+    groups decide, health["recovery"] reports progress, the daemon adds
+    zero steady-state recompiles (jitguard), and a snapshot taken
+    mid-traffic restores with mirrors matching the live fabric at the
+    snapshot horizon on BOTH kernel engines;
+  - diskv under a hostile disk: a replica whose persist fails HALTS
+    before Done() (durability over availability), a power-crashed disk
+    (fsync lies rolled back) reboots into peer-repair instead of serving
+    stale state, and a reboot over an intact disk replays ONLY the
+    un-truncated log suffix (instance-count accounting);
+  - the acceptance soak: one seeded schedule mixing disk faults, whole-
+    process crash/reboot (with keep/dirty/lose disks), and network
+    faults against diskv on a checkpointing fabric, on both engines,
+    judged by the Wing–Gong checker;
+  - nemesis artifact compatibility: pre-durafault (v1, unstamped)
+    capture files still load and replay.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu6824.analysis.jitguard import RecompileGuard
+from tpu6824.core.checkpointd import (
+    ContinuousCheckpointer, NoValidCheckpointError, list_checkpoints,
+    recover_newest,
+)
+from tpu6824.core.fabric import CorruptCheckpointError, PaxosFabric
+from tpu6824.core.peer import Fate
+from tpu6824.harness.linearize import History, HistoryClerk, check_history
+from tpu6824.harness.nemesis import (
+    CompositeTarget, DiskTarget, FabricTarget, FaultSchedule, Nemesis,
+    ProcessTarget, seed_from_env,
+)
+from tpu6824.services.diskv import DisKVSystem
+from tpu6824.utils.timing import wait_until
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def _wait_decided(fab, cells, timeout=20.0):
+    """cells: list of (g, p, seq) that must all reach DECIDED."""
+    ok = wait_until(
+        lambda: all(fab.status(g, p, s)[0] == Fate.DECIDED
+                    for g, p, s in cells), timeout)
+    assert ok, [(g, p, s, fab.status(g, p, s)[0]) for g, p, s in cells]
+
+
+# ------------------------------------------------- recovery honesty
+
+
+def test_recover_newest_discards_torn_snapshot(tmp_path):
+    """The acceptance property: recovery REFUSES a torn snapshot.  Two
+    snapshots exist; the newest is truncated mid-file (exactly what a
+    crash mid-write leaves if the discipline was violated); recovery
+    must discard it by checksum and restore the older valid one."""
+    d = str(tmp_path)
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=16)
+    ck = ContinuousCheckpointer(fab, d, interval=60.0, keep=4)
+    fab.start(0, 0, 0, "epoch-1")
+    fab.step(4)
+    ck.snapshot_once()
+    fab.start(0, 0, 1, "epoch-2")
+    fab.step(4)
+    newest = ck.snapshot_once()
+    # Tear the newest snapshot: drop its tail.
+    blob = open(newest, "rb").read()
+    with open(newest, "wb") as f:
+        f.write(blob[:len(blob) // 2])
+    fab2, report = recover_newest(d)
+    assert report["discarded"] and \
+        report["discarded"][0]["path"] == os.path.basename(newest)
+    assert report["restored_from"] != os.path.basename(newest)
+    # The older epoch is served; the torn epoch never is.
+    assert fab2.status(0, 1, 0) == (Fate.DECIDED, "epoch-1")
+    assert fab2.status(0, 1, 1)[0] != Fate.DECIDED
+    h = fab2.stats()["health"]["recovery"]
+    assert h["restored_from"] == report["restored_from"]
+    assert h["discarded"] == [os.path.basename(newest)]
+    assert h["recovery_time_s"] > 0
+    # Bit-rot (bad crc, right length) is refused the same way.
+    with open(newest, "wb") as f:
+        f.write(blob[:-3] + b"XXX")
+    with pytest.raises(CorruptCheckpointError):
+        PaxosFabric.restore(newest)
+
+
+def test_recover_newest_all_torn_raises(tmp_path):
+    d = str(tmp_path)
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=8)
+    ck = ContinuousCheckpointer(fab, d, interval=60.0)
+    p = ck.snapshot_once()
+    with open(p, "wb") as f:
+        f.write(b"not a checkpoint at all")
+    with pytest.raises(NoValidCheckpointError) as ei:
+        recover_newest(d)
+    assert ei.value.report["discarded"]
+
+
+def test_checkpointer_prunes_and_numbers_monotonically(tmp_path):
+    d = str(tmp_path)
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=8)
+    ck = ContinuousCheckpointer(fab, d, interval=60.0, keep=2)
+    for _ in range(5):
+        ck.snapshot_once()
+    seqs = [s for s, _ in list_checkpoints(d)]
+    assert seqs == [5, 4]  # newest-first, pruned to keep=2
+    # A restarted checkpointer continues the numbering (never reuses a
+    # sequence number an old snapshot might still hold).
+    ck2 = ContinuousCheckpointer(fab, d, interval=60.0, keep=2)
+    ck2.snapshot_once()
+    assert [s for s, _ in list_checkpoints(d)][0] == 6
+
+
+# ------------------------------------- continuous checkpointing, live
+
+
+def test_continuous_checkpointer_under_traffic_and_health(tmp_path):
+    """Daemon mode: snapshots flow while a live clock decides ops; the
+    fabric's health block reports durability progress; recovery from the
+    daemon's directory serves the decided prefix."""
+    d = str(tmp_path)
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=32, auto_step=True,
+                      io_mode="compact")
+    ck = ContinuousCheckpointer(fab, d, interval=0.05, keep=3).start()
+    try:
+        for s in range(10):
+            fab.start_many([(g, s % 3, s, f"v{g}-{s}") for g in range(2)])
+            time.sleep(0.02)
+        _wait_decided(fab, [(g, 0, s) for g in range(2) for s in range(10)])
+        assert wait_until(lambda: ck.written >= 2, 10.0), ck.written
+    finally:
+        ck.stop(final=True)
+        fab.stop_clock()
+    h = fab.stats()["health"]["recovery"]
+    assert h["snapshots_written"] == ck.written >= 2
+    assert h["snapshot_bytes"] > 0 and h["snapshot_seq"] >= 2
+    assert "truncated_horizon" in h
+    fab2, report = recover_newest(d)
+    assert report["restored_from"]
+    # The final snapshot (stop(final=True), clock already stopped) holds
+    # everything decided.
+    for g in range(2):
+        for s in range(10):
+            assert fab2.status(g, 1, s) == (Fate.DECIDED, f"v{g}-{s}")
+
+
+def test_checkpoint_daemon_zero_steady_state_recompiles(tmp_path):
+    """Acceptance: the checkpoint daemon must not perturb the jit caches
+    — snapshot cycles interleaved with warmed steady-state traffic
+    compile NOTHING new."""
+    d = str(tmp_path)
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=16,
+                      io_mode="compact", steps_per_dispatch=2)
+    ck = ContinuousCheckpointer(fab, d, interval=60.0)
+    seq = 0
+    for _ in range(6):  # warm every variant the loop touches
+        fab.start_many([(g, p, seq + g, f"w{seq}") for g in range(2)
+                        for p in range(3)])
+        seq += 2
+        fab.step(2)
+    ck.snapshot_once()  # warm the snapshot path too (np copies, no jit)
+    with RecompileGuard() as g:
+        for _ in range(6):
+            fab.start_many([(gg, p, seq + gg, f"s{seq}") for gg in range(2)
+                            for p in range(3)])
+            seq += 2
+            fab.step(2)
+            ck.snapshot_once()
+    assert g.compiles == 0
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_checkpoint_under_traffic_parity(kernel, tmp_path):
+    """Satellite: snapshot WHILE groups are actively deciding, on both
+    engines.  The restored fabric's decided mirror must match the
+    snapshot bit-for-bit at the horizon (same decided mask, same decoded
+    values as the live fabric), and keep deciding afterward."""
+    d = str(tmp_path)
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=32, auto_step=True,
+                      kernel=kernel, io_mode="compact",
+                      steps_per_dispatch=2, pipeline_depth=2)
+    ck = ContinuousCheckpointer(fab, d, interval=60.0)
+    stop = threading.Event()
+
+    def pump():
+        for s in range(24):
+            if stop.is_set():
+                return
+            fab.start_many([(g, s % 3, s, f"v{g}-{s}") for g in range(2)])
+            time.sleep(0.004)
+
+    th = threading.Thread(target=pump, daemon=True)
+    th.start()
+    try:
+        # Snapshot once real decisions exist AND the pump is still
+        # injecting (first dispatch pays jit warmup, so a fixed sleep
+        # could catch an empty universe).
+        assert wait_until(lambda: fab.stats()["decided_cells"] > 0, 20.0)
+        path = ck.snapshot_once()  # mid-traffic snapshot
+        th.join(30.0)
+        assert not th.is_alive()
+        _wait_decided(fab, [(g, 0, s) for g in range(2) for s in range(24)])
+    finally:
+        stop.set()
+        fab.stop_clock()
+    fab2 = PaxosFabric.restore(path)
+    # Parity at the snapshot horizon: every cell the snapshot recorded
+    # as decided is decided with the SAME value on the live fabric (the
+    # live one has since decided more — agreement on the common prefix
+    # is the bit-identity claim, modulo vid remapping).
+    decided_cells = 0
+    for g in range(2):
+        for seq in list(fab2._seq2slot[g]):
+            for p in range(3):
+                f2, v2 = fab2.status(g, p, seq)
+                if f2 != Fate.DECIDED:
+                    continue
+                decided_cells += 1
+                f1, v1 = fab.status(g, p, seq)
+                assert (f1, v1) == (Fate.DECIDED, v2), (g, p, seq)
+    assert decided_cells > 0, "snapshot caught no decided state"
+    mask = np.asarray(fab2.m_decided >= 0)
+    assert int(mask.sum()) == decided_cells  # mirror == status() view
+    # The restored fabric still decides fresh instances.
+    fab2.start(0, 0, 30, "post-restore")
+    fab2.step(4)
+    assert fab2.status(0, 1, 30) == (Fate.DECIDED, "post-restore")
+
+
+# ------------------------------------------------- diskv under faults
+
+
+@pytest.fixture
+def dsys(tmp_path):
+    s = DisKVSystem(str(tmp_path), ngroups=1, nreplicas=3, ninstances=32,
+                    fault_disks=True)
+    s.join(s.gids[0])
+    yield s
+    s.shutdown()
+
+
+def test_diskv_halts_on_failed_persist_then_reboot_recovers(dsys):
+    """A replica whose persist fails must STOP before Done() — serving
+    on would let the cluster GC log entries its disk image lacks.  The
+    injected ENOSPC kills exactly one replica; the group keeps serving;
+    a reboot brings the replica back consistent."""
+    gid = dsys.gids[0]
+    ck = dsys.clerk()
+    ck.put("a", "v1", timeout=30.0)
+    victim = dsys.groups[gid][2]
+    dsys.disks[victim.name].arm("enospc")
+    # Keep writing until the armed fault lands on the victim's persist.
+    for i in range(40):
+        ck.put(f"k{i}", f"v{i}", timeout=30.0)
+        if victim.dead:
+            break
+    assert wait_until(lambda: victim.dead, 20.0), \
+        "victim never halted on the injected ENOSPC"
+    assert victim.name not in dsys.directory
+    # Durability > availability, but the MAJORITY still serves.
+    ck.put("after", "crash", timeout=30.0)
+    assert ck.get("after", timeout=30.0) == "crash"
+    dsys.reboot(gid, 2)
+    fresh = dsys.groups[gid][2]
+    ok = wait_until(
+        lambda: fresh.applied >= dsys.groups[gid][0].applied - 1, 30.0)
+    assert ok, (fresh.applied, dsys.groups[gid][0].applied)
+    assert ck.get("after", timeout=30.0) == "crash"
+
+
+def test_power_crash_exposes_fsync_lies_and_reboot_repairs(dsys):
+    """THE non-durable-write test: a replica's disk starts lying about
+    fsync; a power crash rolls those writes back; the reboot must come
+    back CONSISTENT (catching the lost suffix up from the log/peers)
+    rather than serving its stale disk image as current state."""
+    gid = dsys.gids[0]
+    ck = dsys.clerk()
+    ck.put("x", "durable", timeout=30.0)
+    victim = dsys.groups[gid][0]
+    # Wait until every replica persisted the first write, then lie about
+    # every fsync on the victim's disk while more writes land.
+    assert wait_until(lambda: victim.applied >= 0, 20.0)
+    disk = dsys.disks[victim.name]
+    for _ in range(64):
+        disk.arm("fsync_lie")
+    ck.append("x", "+1", timeout=30.0)
+    ck.put("y", "late", timeout=30.0)
+    assert wait_until(
+        lambda: dsys.groups[gid][0].applied
+        == dsys.groups[gid][1].applied, 20.0)
+    applied_pre = victim.applied
+    # Power crash: the lies are exposed — disk reverts to pre-lie state.
+    dsys.crash(gid, 0, power_crash=True)
+    disk.disarm()
+    reverted = True  # crash() already applied the journal via durafs
+    assert reverted
+    dsys.reboot(gid, 0)
+    fresh = dsys.groups[gid][0]
+    # The rebooted replica's DISK was stale (meta rolled back), so its
+    # boot watermark is strictly behind where the live one was...
+    assert fresh is not victim
+    ok = wait_until(lambda: fresh.applied >= applied_pre, 30.0)
+    assert ok, (fresh.applied, applied_pre)
+    # ...but after catch-up it serves the full, correct state.
+    assert ck.get("x", timeout=30.0) == "durable+1"
+    assert ck.get("y", timeout=30.0) == "late"
+    assert fresh.kv["x"] == "durable+1"
+
+
+def test_single_fsync_lie_partial_image_detected_and_repaired(dsys):
+    """The nastiest dirty-reboot shape: ONE fsync lie lands on a KEY
+    FILE write while the meta write right after it is fully durable.  A
+    power crash then reverts only the key file — the meta watermark
+    says the op is applied, the dup table dedups any log replay of it,
+    and without the content-checksum cross-check the rebooted replica
+    would serve the lost update's OLD value forever.  The cross-check
+    must flag the image and boot-repair it from a peer."""
+    gid = dsys.gids[0]
+    ck = dsys.clerk()
+    ck.put("a", "v1", timeout=30.0)
+    victim = dsys.groups[gid][0]
+    assert wait_until(lambda: victim.kv.get("a") == "v1", 20.0)
+    assert wait_until(
+        lambda: victim.applied == dsys.groups[gid][1].applied, 20.0)
+    # Exactly one lie: the victim's next durable write is the key file
+    # of the next applied op; the meta write after it runs clean.
+    dsys.disks[victim.name].arm("fsync_lie")
+    ck.put("a", "v2", timeout=30.0)
+    assert wait_until(lambda: victim.kv.get("a") == "v2", 20.0)
+    assert wait_until(
+        lambda: victim.applied == dsys.groups[gid][1].applied, 20.0)
+    dsys.crash(gid, 0, power_crash=True)  # key file -> v1, meta stays
+    dsys.reboot(gid, 0)
+    fresh = dsys.groups[gid][0]
+    # The boot cross-check must have caught the torn image and pulled:
+    # the replica serves v2, never the resurrected v1.
+    assert wait_until(lambda: fresh.kv.get("a") == "v2", 30.0), fresh.kv
+    assert fresh._image_inconsistent == [], fresh._image_inconsistent
+    assert ck.get("a", timeout=30.0) == "v2"
+
+
+def test_reboot_with_disk_replays_only_untruncated_suffix(dsys, monkeypatch):
+    """Instance-count accounting: a reboot over an INTACT disk resumes
+    from its meta snapshot and replays exactly the ops it missed — it
+    neither re-applies its own prefix nor takes the full-state pull that
+    disk LOSS needs."""
+    from tpu6824.services import diskv as diskv_mod
+
+    gid = dsys.gids[0]
+    ck = dsys.clerk()
+    for i in range(6):
+        ck.put(f"pre{i}", f"v{i}", timeout=30.0)
+    # Let replica 1 fully catch up, then crash it with its disk kept.
+    lead = dsys.groups[gid][0]
+    assert wait_until(
+        lambda: dsys.groups[gid][1].applied == lead.applied, 20.0)
+    k = dsys.groups[gid][1].applied
+    dsys.crash(gid, 1)
+    missed = 5
+    for i in range(missed):
+        ck.put(f"post{i}", f"w{i}", timeout=30.0)
+    assert wait_until(lambda: lead.applied >= k + missed, 20.0)
+    applied_by = []
+    orig_apply = diskv_mod.DisKVServer._apply
+
+    def counting(self, op):
+        applied_by.append(self.name)
+        return orig_apply(self, op)
+
+    monkeypatch.setattr(diskv_mod.DisKVServer, "_apply", counting)
+    pulls = []
+    orig_pull = diskv_mod.DisKVServer._snapshot_from_peer
+
+    def counting_pull(self):
+        pulls.append(self.name)
+        return orig_pull(self)
+
+    monkeypatch.setattr(diskv_mod.DisKVServer, "_snapshot_from_peer",
+                        counting_pull)
+    dsys.reboot(gid, 1)
+    fresh = dsys.groups[gid][1]
+    # (fresh.applied may ALREADY be past k here — the ctor's ticker races
+    # this read — so resumption-from-snapshot is proven by the replay
+    # count below, not by a flaky watermark equality.)
+    assert wait_until(lambda: fresh.applied >= lead.applied, 30.0)
+    replayed = sum(1 for n in applied_by if n == fresh.name)
+    # Exactly the missed suffix (plus anything that landed during
+    # catch-up), never the k+1-op prefix again.
+    assert replayed == fresh.applied - k, (replayed, fresh.applied, k)
+    assert replayed < k, f"full replay detected: {replayed} ops for k={k}"
+    assert not pulls, "intact-disk reboot must not need a peer pull"
+    assert ck.get("post4", timeout=30.0) == "w4"
+
+
+# ---------------------------------------------------- acceptance soak
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_disk_fault_soak_checkpointing_fabric(kernel, tmp_path,
+                                              nemesis_report):
+    """The durafault acceptance soak, on both engines: ONE seeded
+    schedule drives network faults (partitions/unreliable), whole-
+    process crash/reboot with keep/dirty/lose disks, and per-replica
+    disk faults (torn writes, fsync lies, ENOSPC, crash-after-rename)
+    against diskv riding a continuously-checkpointing fabric — and the
+    full client history must linearize (Wing–Gong)."""
+    heavy = kernel == "xla"
+    dsys = DisKVSystem(str(tmp_path / "kv"), ngroups=1, nreplicas=3,
+                       ninstances=32, fault_disks=True,
+                       fabric_kw=dict(kernel=kernel, io_mode="compact",
+                                      steps_per_dispatch=2))
+    dsys.join(dsys.gids[0])
+    gid = dsys.gids[0]
+    names = [f"g{gid}-{p}" for p in range(3)]
+    ckptd = ContinuousCheckpointer(dsys.fabric, str(tmp_path / "ckpt"),
+                                   interval=0.1, keep=3).start()
+    history = History()
+    try:
+        def crash_fn(name, disk):
+            p = int(name.rsplit("-", 1)[1])
+            dsys.crash(gid, p, lose_disk=(disk == "lose"),
+                       power_crash=(disk == "dirty"))
+
+        def reboot_fn(name):
+            p = int(name.rsplit("-", 1)[1])
+            dsys.reboot(gid, p)
+
+        target = CompositeTarget(
+            FabricTarget(dsys.fabric, groups=[1],
+                         actions=["partition_minority", "partition_random",
+                                  "heal", "unreliable", "reliable"]),
+            ProcessTarget(names, crash_fn, reboot_fn,
+                          proc_groups={n: f"g{gid}" for n in names}),
+            DiskTarget({n: dsys.disks[n] for n in names}),
+        )
+        seed = seed_from_env(62824 if heavy else 62825)
+        sched = FaultSchedule.generate(
+            seed, 2.5 if heavy else 1.8, target.spec(),
+            weights={"disk_fault": 3.0, "crash_process": 1.5,
+                     "reboot_process": 4.0})
+        acts = {e.action for e in sched}
+        assert {"disk_fault", "crash_process"} <= acts, acts
+        nem = Nemesis(target, sched).start()
+        nemesis_report.attach(nemesis=nem, seed=seed)
+
+        errs: list = []
+
+        def client(idx):
+            try:
+                ck = HistoryClerk(dsys.clerk(), history, client=idx)
+                for j in range(6 if heavy else 4):
+                    ck.append("k", f"x {idx} {j} y", timeout=120.0)
+                    if j % 2 == 1:
+                        ck.get("k", timeout=120.0)
+            except Exception as e:  # pragma: no cover
+                errs.append((idx, e))
+
+        def trickle():
+            # Keeps durable writes flowing for the WHOLE schedule window
+            # so every armed disk fault meets a persist to fire on (the
+            # append clients can finish early under a quiet seed).
+            tck = dsys.clerk()
+            i = 0
+            while not nem.done:
+                try:
+                    tck.put("trickle", f"i{i}", timeout=120.0)
+                except Exception:  # noqa: BLE001 — mid-fault put may fail
+                    pass
+                i += 1
+                time.sleep(0.04)
+
+        nclients = 3 if heavy else 2
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(nclients)]
+        tr = threading.Thread(target=trickle, daemon=True)
+        tr.start()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=240.0)
+        assert not any(t.is_alive() for t in ts), "client stuck past 240s"
+        nem.join(60.0)
+        tr.join(timeout=120.0)
+        assert not tr.is_alive(), "trickle writer stuck"
+        assert nem.done
+        assert nem.signature() == sched.signature()
+        assert not errs, errs
+        # Revive anything that self-halted on a disk fault (the nemesis
+        # restore tail only reboots processes IT crashed).
+        for p in range(3):
+            if dsys.groups[gid][p].dead:
+                dsys.reboot(gid, p)
+        fired = sum(sum(v for kk, v in d.stats()["counts"].items()
+                        if kk != "writes") for d in dsys.disks.values())
+        assert fired >= 1, "schedule injected no disk fault that fired"
+        final = HistoryClerk(dsys.clerk(), history, client="final")
+        value = final.get("k", timeout=60.0)
+        for idx in range(nclients):
+            for j in range(6 if heavy else 4):
+                assert f"x {idx} {j} y" in value, (idx, j)
+        res = check_history(history)
+        assert res.ok, res.describe()
+        # The checkpoint daemon ran through all of it.
+        assert ckptd.written >= 2
+        dsys.fabric.stop_clock()
+        fab2, report = recover_newest(str(tmp_path / "ckpt"))
+        assert report["restored_from"]
+    finally:
+        ckptd.stop(final=False)
+        dsys.shutdown()
+
+
+# --------------------------------------------- artifact compatibility
+
+
+def test_pre_durafault_v1_artifact_still_loads():
+    """Replay compatibility: an unstamped (schema-1) capture from before
+    the durafault action vocabulary loads cleanly and keeps its exact
+    event list."""
+    sched = FaultSchedule.from_json(os.path.join(DATA, "nemesis_v1.json"))
+    assert sched.schema == 1
+    assert sched.seed == 1234
+    assert [e.action for e in sched] == [
+        "partition_minority", "kill", "clock_pause", "revive", "heal"]
+    # Round-trips preserving the original stamp (identity, not upgrade).
+    again = FaultSchedule.from_dict(sched.to_dict())
+    assert again.schema == 1 and again == sched
+
+
+def test_new_vocabulary_schedules_are_stamped_and_round_trip(tmp_path):
+    spec = {"kind": "process", "procs": ["a", "b", "c"],
+            "disk_modes": ["keep", "dirty", "lose"],
+            "scopes": ["a", "b"], "actions": [
+                "crash_process", "reboot_process", "disk_fault"]}
+    sched = FaultSchedule.generate(99, 4.0, spec)
+    assert sched.schema == FaultSchedule.SCHEMA == 2
+    acts = [e.action for e in sched]
+    assert "crash_process" in acts and "disk_fault" in acts
+    # Every crash ends rebooted (the revival guarantee).
+    crashed: set = set()
+    for e in sched:
+        if e.action == "crash_process":
+            crashed.add(e.args["name"])
+            assert e.args["disk"] in ("keep", "dirty", "lose")
+        elif e.action == "reboot_process":
+            crashed.discard(e.args["name"])
+    assert not crashed, f"schedule left {crashed} dead"
+    p = str(tmp_path / "sched.json")
+    with open(p, "w") as f:
+        json.dump(sched.to_dict(), f)
+    again = FaultSchedule.from_json(p)
+    assert again == sched and again.schema == 2
+    assert again.signature() == sched.signature()
+    # Determinism across the new vocabulary.
+    assert FaultSchedule.generate(99, 4.0, spec) == sched

@@ -1,0 +1,178 @@
+"""durafs unit tests — the durable-write discipline and every injected
+fault kind, including the power-loss model's central asymmetry: a write
+that completed the full discipline (tmp fsync + rename + dir fsync) is
+NEVER rolled back by a power crash; a write whose durability was faked
+(fsync lie, un-synced rename) ALWAYS is."""
+
+import errno
+import os
+
+import pytest
+
+from tpu6824.utils import durafs
+from tpu6824.utils.durafs import DiskFault, DuraDisk, FaultPlan
+
+
+def _read(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def test_plain_atomic_write_roundtrip(tmp_path):
+    p = str(tmp_path / "f.bin")
+    durafs.atomic_write(p, b"hello")
+    assert _read(p) == b"hello"
+    durafs.atomic_write(p, b"world")
+    assert _read(p) == b"world"
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+def test_registry_longest_prefix_routing(tmp_path):
+    outer = DuraDisk(str(tmp_path))
+    inner_dir = tmp_path / "inner"
+    inner_dir.mkdir()
+    inner = DuraDisk(str(inner_dir))
+    durafs.register(outer)
+    durafs.register(inner)
+    try:
+        durafs.atomic_write(str(inner_dir / "x"), b"a")
+        durafs.atomic_write(str(tmp_path / "y"), b"b")
+        assert inner.counts["writes"] == 1
+        assert outer.counts["writes"] == 1
+        assert durafs.lookup(str(tmp_path / "elsewhere")) is outer
+    finally:
+        durafs.unregister(outer)
+        durafs.unregister(inner)
+    assert durafs.lookup(str(inner_dir / "x")) is None
+
+
+def test_torn_write_leaves_debris_target_untouched(tmp_path):
+    p = str(tmp_path / "meta.bin")
+    disk = DuraDisk(str(tmp_path))
+    disk.atomic_write(p, b"original-durable")
+    disk.arm("torn", frac=0.25)
+    with pytest.raises(DiskFault) as ei:
+        disk.atomic_write(p, b"X" * 100)
+    assert ei.value.kind == "torn"
+    # Target still serves the previous complete image; the torn payload
+    # sits only in rename-pending .tmp debris (25 of 100 bytes).
+    assert _read(p) == b"original-durable"
+    debris = [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    assert len(debris) == 1
+    assert len(_read(str(tmp_path / debris[0]))) == 25
+
+
+def test_enospc_has_real_errno(tmp_path):
+    p = str(tmp_path / "f")
+    disk = DuraDisk(str(tmp_path))
+    disk.arm("enospc")
+    with pytest.raises(OSError) as ei:
+        disk.atomic_write(p, b"data")
+    assert ei.value.errno == errno.ENOSPC
+    assert not os.path.exists(p)
+
+
+def test_fsync_lie_reverts_on_power_crash(tmp_path):
+    p = str(tmp_path / "f")
+    disk = DuraDisk(str(tmp_path))
+    disk.atomic_write(p, b"durable-v1")
+    disk.arm("fsync_lie")
+    disk.atomic_write(p, b"volatile-v2")  # "succeeds" — no exception
+    assert _read(p) == b"volatile-v2"    # visible while power stays on
+    reverted = disk.power_crash()
+    assert reverted == [p]
+    assert _read(p) == b"durable-v1"     # the lie is exposed
+
+
+def test_fsync_lie_on_fresh_file_vanishes_on_power_crash(tmp_path):
+    p = str(tmp_path / "fresh")
+    disk = DuraDisk(str(tmp_path))
+    disk.arm("fsync_lie")
+    disk.atomic_write(p, b"never-durable")
+    assert os.path.exists(p)
+    disk.power_crash()
+    assert not os.path.exists(p)
+
+
+def test_crash_rename_dies_then_reverts(tmp_path):
+    p = str(tmp_path / "f")
+    disk = DuraDisk(str(tmp_path))
+    disk.atomic_write(p, b"v1")
+    disk.arm("crash_rename")
+    with pytest.raises(DiskFault) as ei:
+        disk.atomic_write(p, b"v2")
+    assert ei.value.kind == "crash_rename"
+    assert _read(p) == b"v2"  # rename landed — READS new...
+    disk.power_crash()
+    assert _read(p) == b"v1"  # ...but the dir entry was never synced
+
+
+def test_full_discipline_survives_power_crash(tmp_path):
+    p = str(tmp_path / "f")
+    disk = DuraDisk(str(tmp_path))
+    disk.atomic_write(p, b"v1")
+    disk.arm("fsync_lie")
+    disk.atomic_write(p, b"lie")
+    disk.atomic_write(p, b"v2-durable")  # full discipline: clears the lie
+    assert disk.power_crash() == []
+    assert _read(p) == b"v2-durable"
+
+
+def test_journal_keeps_oldest_durable_content(tmp_path):
+    p = str(tmp_path / "f")
+    disk = DuraDisk(str(tmp_path))
+    disk.atomic_write(p, b"durable-base")
+    disk.arm("fsync_lie")
+    disk.arm("fsync_lie")
+    disk.atomic_write(p, b"lie-1")
+    disk.atomic_write(p, b"lie-2")
+    disk.power_crash()
+    # Reverts to the last DURABLE content, not the first lie.
+    assert _read(p) == b"durable-base"
+
+
+def test_lose_disk_destroys_scope(tmp_path):
+    root = tmp_path / "scope"
+    root.mkdir()
+    disk = DuraDisk(str(root))
+    disk.atomic_write(str(root / "f"), b"x")
+    disk.arm("lose_disk")
+    with pytest.raises(DiskFault) as ei:
+        disk.atomic_write(str(root / "g"), b"y")
+    assert ei.value.kind == "lose_disk"
+    assert not os.path.exists(root)
+    assert disk.lost
+
+
+def test_faultplan_deterministic_and_outcome_independent(tmp_path):
+    rates = {"torn": 0.2, "enospc": 0.1, "fsync_lie": 0.2}
+    plan_a, plan_b, plan_c = (FaultPlan(s, rates) for s in (7, 7, 8))
+    seq_a = [plan_a.draw() for _ in range(200)]
+    seq_b = [plan_b.draw() for _ in range(200)]
+    assert seq_a == seq_b
+    assert [plan_c.draw() for _ in range(200)] != seq_a
+    kinds = {d["kind"] for d in seq_a if d}
+    assert kinds == {"torn", "enospc", "fsync_lie"}
+    # Placement is per-op-index, independent of earlier outcomes: a plan
+    # driving real writes faults at the same op indexes as a bare plan.
+    disk = DuraDisk(str(tmp_path), plan=FaultPlan(7, rates))
+    got = []
+    for i in range(200):
+        try:
+            disk.atomic_write(str(tmp_path / "f"), b"payload")
+            got.append(None)
+        except DiskFault as e:
+            got.append(e.kind)
+    # fsync_lie raises nothing (that is the lie) — it reads as a clean
+    # write here; every raising kind lands at exactly the planned op.
+    expected = [d["kind"] if d and d["kind"] != "fsync_lie" else None
+                for d in seq_a]
+    assert got == expected
+    assert any(got), "plan never fired — rates/seed mismatch"
+
+
+def test_scope_contextmanager(tmp_path):
+    with durafs.scope(str(tmp_path)) as disk:
+        durafs.atomic_write(str(tmp_path / "f"), b"x")
+        assert disk.counts["writes"] == 1
+    assert durafs.lookup(str(tmp_path / "f")) is None

@@ -77,6 +77,13 @@ RULES: dict[str, str] = {
         "host round-trip per dispatch and breaks the zero-extra-readback "
         "guarantee (the two sanctioned retire-fold sites carry justified "
         "suppressions — that inventory IS the contract)",
+    "durable-write-discipline":
+        "open(..., 'w'/'wb') + os.rename/os.replace persistence pattern "
+        "outside utils/durafs.py — the bare write-then-rename skips the "
+        "tmp fsync (a crash after the rename can publish a file whose "
+        "data never hit the platter) and the dir fsync (the rename "
+        "itself can be lost), and it bypasses the durafault injection "
+        "seam; route the write through durafs.atomic_write()",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -100,6 +107,10 @@ _STEP_SCOPE = ("core/kernel.py", "core/pallas_kernel.py",
 _READBACK_TAILS = {"device_get", "block_until_ready"}
 _FEED_HOME = "core/fabric.py"  # the only module allowed to touch sub._q
 _MET_HOME = "obs/"  # the registry itself may get-or-create anywhere
+# The one module allowed to write-then-rename raw: the durable-write seam
+# itself (which is also where the disk-fault injector lives).
+_DURAFS_HOME = "utils/durafs.py"
+_RENAME_CALLS = {"os.rename", "os.replace"}
 
 # Receivers that denote the tpuscope metrics registry, and the
 # get-or-create constructors the metric-unregistered rule polices.
@@ -233,10 +244,12 @@ class _FileLint(ast.NodeVisitor):
         self.step_scope = _in_scope(relpath, _STEP_SCOPE)
         self.feed_home = _in_scope(relpath, (_FEED_HOME,))
         self.met_home = _in_scope(relpath, (_MET_HOME,))
+        self.durafs_home = _in_scope(relpath, (_DURAFS_HOME,))
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
         self._jit_defs = self._resolve_jit_defs()
+        self._scan_persistence()
         self._fn_stack: list[ast.AST] = []
         self._calls_subscribe = False
         self._refs_columnar_consumer = False
@@ -323,6 +336,46 @@ class _FileLint(ast.NodeVisitor):
                     n.value.id == "crashsink":
                 return True
         return False
+
+    def _scan_persistence(self) -> None:
+        """durable-write-discipline: a function that opens a file for
+        writing AND renames/replaces is (re)implementing the atomic-
+        persist pattern by hand — outside utils/durafs.py that skips the
+        fsync discipline and the fault-injection seam.  Flagged at each
+        write-open (the write is what loses data)."""
+        if self.durafs_home:
+            return
+
+        def write_mode(call: ast.Call):
+            mode = None
+            if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+                mode = call.args[1].value
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            return isinstance(mode, str) and ("w" in mode or "x" in mode)
+
+        flagged: set[int] = set()  # a nested def is walked twice
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            opens, renames = [], False
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                d = _dotted(n.func)
+                if d in ("open", "io.open") and write_mode(n):
+                    opens.append(n)
+                elif d in _RENAME_CALLS:
+                    renames = True
+            if renames:
+                for n in opens:
+                    if id(n) not in flagged:
+                        flagged.add(id(n))
+                        self._flag(n, "durable-write-discipline",
+                                   "write-then-rename persistence outside "
+                                   "the durafs seam — use "
+                                   "durafs.atomic_write()")
 
     def _resolve_jit_defs(self) -> set[int]:
         """FunctionDefs that are jit-compiled: decorated with jax.jit /

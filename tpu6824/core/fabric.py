@@ -43,7 +43,7 @@ from tpu6824.core.kernel import (
 from tpu6824.obs import collector as obs_collector
 from tpu6824.obs import metrics as obs_metrics
 from tpu6824.obs import tracing as obs_tracing
-from tpu6824.utils import crashsink
+from tpu6824.utils import crashsink, durafs
 from tpu6824.utils.locks import new_rlock
 from tpu6824.utils.profiling import PhaseProfiler
 from tpu6824.utils.trace import EventLog, dprintf
@@ -66,6 +66,10 @@ _M_FEED_BATCH = obs_metrics.histogram("fabric.feed_batch_cells")
 # hot path.
 _M_PROTO = {f: obs_metrics.gauge(f"fabric.protocol.{f}")
             for f in PROTO_FIELDS}
+# durafault recovery gauge (its siblings — snapshot age/bytes/seq and the
+# truncated horizon — live with their writer in core/checkpointd.py):
+# wall seconds the last PaxosFabric.restore spent, file-read to serving.
+_M_RECOVERY_TIME = obs_metrics.gauge("fabric.recovery.recovery_time_s")
 
 # Reference unreliable-network rates: 10% of requests dropped before
 # processing, a further ~20% processed but the reply discarded
@@ -141,6 +145,50 @@ def _apply_compact_jit(state, slot_seq, reset_rows, cells, vids, seqs):
 # the payload.  Interned ids grow from 0 and are bounded by the live
 # window (G·I values at most), so the spaces cannot collide.
 IMM_BASE = 1 << 30
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint file failed its checksum/length frame — torn write,
+    truncation, or bit rot.  Restoring it would serve garbage as decided
+    state; recovery must discard it and fall back to an older snapshot
+    (core/checkpointd.py::recover_newest does exactly that)."""
+
+
+# Checkpoint file frame: magic + crc32 + payload length, then the pickle
+# payload.  The frame is what lets recovery tell "newest valid snapshot"
+# from "the snapshot the process died in the middle of writing" — a torn
+# file fails the length or the crc, never loads.
+_CKPT_MAGIC = b"TPU6824K"
+_CKPT_HDR = "!8sIQ"  # magic, crc32(payload), len(payload)
+
+
+def frame_checkpoint(payload: bytes) -> bytes:
+    import struct
+    import zlib
+
+    return struct.pack(_CKPT_HDR, _CKPT_MAGIC,
+                       zlib.crc32(payload) & 0xFFFFFFFF,
+                       len(payload)) + payload
+
+
+def unframe_checkpoint(buf: bytes, path: str = "<buf>") -> bytes:
+    """Verified payload of a framed checkpoint; raw pre-frame files pass
+    through unchanged (they carry no integrity evidence — the legacy
+    trade-off, kept so old checkpoints keep restoring)."""
+    import struct
+    import zlib
+
+    hdr = struct.calcsize(_CKPT_HDR)
+    if len(buf) < hdr or not buf.startswith(_CKPT_MAGIC):
+        return buf  # pre-frame raw pickle
+    _, crc, n = struct.unpack(_CKPT_HDR, buf[:hdr])
+    payload = buf[hdr:]
+    if len(payload) != n:
+        raise CorruptCheckpointError(
+            f"{path}: truncated checkpoint ({len(payload)} of {n} bytes)")
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise CorruptCheckpointError(f"{path}: checkpoint crc mismatch")
+    return payload
 
 
 class WindowFullError(RuntimeError):
@@ -442,10 +490,20 @@ class PaxosFabric:
         self._pending_starts: list[tuple[int, int, int, int, int]] = []  # (g, slot, p, vid, seq)
         self._pending_resets: list[tuple[int, int]] = []  # (g, slot)
         self._dead = np.zeros((G, P), bool)
+        # Durability/recovery status (stats()["health"]["recovery"]):
+        # merged via set_recovery_info by restore() and the continuous
+        # checkpointer.  Empty = this fabric neither restored from a
+        # snapshot nor has a checkpoint daemon attached.
+        self._recovery: dict = {}
 
         self._running = False
         self._last_step_active = True  # idle-adaptive clock (see _clock_loop)
         self._clock_wake = threading.Event()
+        # Start/stop transition mutex (RLock: resume_clock restarts the
+        # clock while holding it) + the stop-intent counter backing the
+        # pause/resume arbitration (see pause_clock).
+        self._clock_mu = threading.RLock()
+        self._clock_stop_intents = 0
         self._thread: threading.Thread | None = None
         self._step_sleep = step_sleep
         self._stepped = threading.Condition(self._lock)
@@ -455,21 +513,60 @@ class PaxosFabric:
     # ------------------------------------------------------------------ clock
 
     def start_clock(self):
-        with self._lock:
-            if self._running:
-                return
-            self._running = True
-        self._thread = threading.Thread(
-            target=crashsink.guarded(self._clock_loop, "fabric-clock"),
-            daemon=True)
-        self._thread.start()
+        # _clock_mu serializes start/stop TRANSITIONS (never held by the
+        # clock thread itself): without it, a stop_clock racing another
+        # caller's start_clock could observe _thread created but not yet
+        # started and join() it (RuntimeError) — the continuous
+        # checkpointer cycles the clock around every snapshot, so
+        # concurrent stop/start is now an ordinary interleaving, not a
+        # harness bug.
+        with self._clock_mu:
+            with self._lock:
+                if self._running:
+                    return
+                self._running = True
+            self._thread = threading.Thread(
+                target=crashsink.guarded(self._clock_loop, "fabric-clock"),
+                daemon=True)
+            self._thread.start()
 
     def stop_clock(self):
-        with self._lock:
-            self._running = False
-        if self._thread:
-            self._thread.join()
-            self._thread = None
+        with self._clock_mu:
+            # An explicit stop VOTE: any pause_clock holder's deferred
+            # resume_clock observes the bump and leaves the clock
+            # stopped — the stop_clock caller now owns that state.
+            self._clock_stop_intents += 1
+            with self._lock:
+                self._running = False
+            if self._thread:
+                self._thread.join()
+                self._thread = None
+
+    def pause_clock(self) -> tuple[bool, int]:
+        """Borrow-the-clock arbitration (the continuous checkpointer's
+        snapshot pause): atomically stop the clock and return
+        (was_running, token) for a later `resume_clock(was, token)`.
+        Unlike stop_clock, a pause casts no stop vote — but the resume
+        is SKIPPED if anyone called stop_clock in between, so a
+        harness/test that stops the clock mid-snapshot is never undone
+        by the daemon's restart."""
+        with self._clock_mu:
+            with self._lock:
+                was = self._running
+                self._running = False
+            if self._thread:
+                self._thread.join()
+                self._thread = None
+            return was, self._clock_stop_intents
+
+    def resume_clock(self, was_running: bool, token: int) -> bool:
+        """Second half of pause_clock: restart only if the clock was
+        running at pause time AND no stop_clock intervened."""
+        with self._clock_mu:
+            if not was_running or self._clock_stop_intents != token:
+                return False
+            self.start_clock()  # RLock: safe to re-enter _clock_mu
+            return True
 
     def _clock_loop(self):
         # Idle-adaptive pacing: a step that injected nothing, delivered no
@@ -1654,6 +1751,17 @@ class PaxosFabric:
 
     # ------------------------------------------------------- checkpoint
 
+    def set_recovery_info(self, **kw) -> None:
+        """Merge durability/recovery status into stats()["health"]
+        ["recovery"] — written by PaxosFabric.restore (recovery_time_s,
+        source) and by the continuous checkpointer daemon
+        (core/checkpointd.py: snapshot age/bytes/seq, truncated
+        horizon).  One dict so the harness has ONE window on "how stale
+        is the newest durable image and how long did the last recovery
+        take"."""
+        with self._lock:
+            self._recovery.update(kw)
+
     @staticmethod
     def _start_is_live(slot_seq, t, known_vids=None) -> bool:
         """Keep predicate for a queued (g, slot, p, vid, seq) start: its
@@ -1669,9 +1777,9 @@ class PaxosFabric:
     def checkpoint(self, path: str) -> None:
         """Snapshot the ENTIRE consensus universe — device state, host
         mirrors, slot/window bookkeeping, network condition, queued ops,
-        and every live value payload — to one file, atomically
-        (write-tmp + fsync + rename, the diskv file discipline,
-        diskv/server.go:92-105).
+        and every live value payload — to one checksummed file, with the
+        full durafs crash-consistency discipline (tmp fsync + rename +
+        dir fsync; `utils/durafs.py`).
 
         The reference's paxos is explicitly not crash-safe
         (paxos/paxos.go:3-11); its persistence story lives in diskv and in
@@ -1680,10 +1788,22 @@ class PaxosFabric:
         framework checkpoints a training state pytree.
 
         Must be called with the clock stopped (deterministic snapshot —
-        a step in flight would leave device state and mirrors torn).
+        a step in flight would leave device state and mirrors torn); the
+        continuous checkpointer (`core/checkpointd.py`) wraps the pause
+        so live traffic only waits out the state COPY, not the pickle or
+        the disk write.
         """
         import pickle
 
+        blob = self.snapshot_blob()
+        payload = pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL)
+        durafs.atomic_write(path, frame_checkpoint(payload))
+
+    def snapshot_blob(self) -> dict:
+        """The copy half of checkpoint(): every array/queue copied under
+        the lock into a self-contained dict (nothing aliases live fabric
+        state), so serialization and IO can run OFF the lock while other
+        API threads — or a restarted clock — keep going."""
         with self._lock:
             # Guard BEFORE flushing: flush races a live clock thread's
             # step_async on the in-flight deque — the misuse must raise
@@ -1743,12 +1863,7 @@ class PaxosFabric:
                 "pending_resets": [],  # applied into the snapshot above
                 "key_data": np.array(jax.random.key_data(self._key)),
             }
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "wb") as f:
-            f.write(pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        return blob
 
     @classmethod
     def restore(cls, path: str, **kw) -> "PaxosFabric":
@@ -1758,11 +1873,19 @@ class PaxosFabric:
         old→new lookup; immediate-tagged ids pass through unchanged.
         PRNG subkey batching restarts at the saved base key, so post-
         restore lossy draws differ from an uninterrupted run (determinism
-        holds per process lifetime, not across the boundary)."""
+        holds per process lifetime, not across the boundary).
+
+        The file's checksum frame is VERIFIED first: a torn or truncated
+        checkpoint raises `CorruptCheckpointError` instead of restoring
+        garbage (the recovery scanner in core/checkpointd.py turns that
+        into "discard and fall back to the previous snapshot").  Unframed
+        files from before the durafault PR still load (raw pickle)."""
         import pickle
 
+        t0 = time.monotonic()
         with open(path, "rb") as f:
-            blob = pickle.loads(f.read())
+            raw = f.read()
+        blob = pickle.loads(unframe_checkpoint(raw, path=path))
         G, I, P = blob["dims"]
         kw.setdefault("kernel", blob["kernel"])
         if blob.get("io_mode"):
@@ -1850,6 +1973,11 @@ class PaxosFabric:
             fab._key = jax.random.wrap_key_data(jnp.asarray(blob["key_data"]))
             fab._key_arr = None
             fab._key_buf_n = 0
+        dt = round(time.monotonic() - t0, 6)
+        _M_RECOVERY_TIME.set(dt)
+        fab.set_recovery_info(
+            restored_from=os.path.basename(path), recovery_time_s=dt,
+            decided_at_restore=int(fab._decided_cells))
         if auto_step:
             fab.start_clock()
         return fab
@@ -2041,6 +2169,12 @@ class PaxosFabric:
             # though the thread belongs to a service, because this stats
             # call is the harness's one health window.
             "thread_crashes": crashsink.summary(),
+            # Durability/recovery progress (durafault): restore() stamps
+            # restored_from/recovery_time_s/decided_at_restore; an
+            # attached continuous checkpointer keeps snapshot_seq/
+            # snapshot_age_s/snapshot_bytes/truncated_horizon/
+            # snapshots_written fresh.  {} = no durability story yet.
+            "recovery": dict(self._recovery),
         }
 
     def ndecided(self, g: int, seq: int) -> int:

@@ -41,7 +41,7 @@ from tpu6824.shim import wire
 from tpu6824.shim.gob import Registry
 from tpu6824.shim.netrpc import GobRpcServer, gob_call
 from tpu6824.utils.errors import OK, RPCError
-from tpu6824.utils import crashsink
+from tpu6824.utils import crashsink, durafs
 from tpu6824.utils.trace import EventLog, dprintf
 
 _REJECTED = "ErrRejected"  # paxos/rpc.go:47
@@ -280,13 +280,23 @@ class HostPaxosPeer:
 
     def _persist(self, name: str, obj) -> None:
         """Atomic write-via-rename + fsync — durable before the caller's
-        RPC reply leaves the process."""
-        tmp = self._pfile(f".{name}.{os.getpid()}.tmp")
-        with open(tmp, "wb") as f:
-            f.write(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._pfile(name))
+        RPC reply leaves the process.  Routed through the one durafs
+        seam (tmp fsync + rename + DIR fsync — the old local version
+        skipped the dir sync, so the rename itself could be lost), which
+        is also where the durafault nemesis injects torn writes and
+        fsync lies against the acceptor ledger."""
+        try:
+            durafs.atomic_write(
+                self._pfile(name),
+                pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        except FileNotFoundError:
+            # A rebooted peer's _reload swept OUR in-flight .tmp out
+            # from under the rename (same dir, old instance still
+            # draining) — the write is moot, we are dead; any live
+            # writer losing its file is a real bug (diskv's _apply has
+            # the identical tolerance).
+            if not self.dead:
+                raise
 
     def _persist_acc_locked(self, seq: int) -> None:
         if not self.persist_dir:
@@ -312,6 +322,17 @@ class HostPaxosPeer:
         Done window from disk."""
         for fn in os.listdir(self.persist_dir):
             path = self._pfile(fn)
+            if fn.endswith(".tmp"):
+                # Torn-write debris (durafs names scratch files
+                # `<name>.<pid>.<tid>.tmp`; the injector's torn fault
+                # leaves them behind deliberately): swept at reboot like
+                # diskv's _load_from_disk sweep, or a fault-heavy soak
+                # grows the ledger dir without bound.
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+                continue
             try:
                 if fn.startswith("acc-"):
                     seq = int(fn[4:])

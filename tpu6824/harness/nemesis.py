@@ -66,8 +66,19 @@ DEFAULT_WEIGHTS = {
     "undeafen": 3.0,
     "delay_on": 1.5,
     "delay_off": 3.0,
+    # durafault actions (process crash/reboot + disk-fault dimension)
+    "crash_process": 1.2,
+    "reboot_process": 3.0,
+    "disk_fault": 1.5,
 }
 EXTRA_WEIGHT = 1.5
+
+#: Disk-fault kinds a `disk_fault` event may arm (utils/durafs.py), and
+#: the disk dispositions a `crash_process` may carry: keep the disk,
+#: reboot over a power-crashed disk (un-synced writes rolled back), or
+#: lose it entirely.
+DISK_FAULT_KINDS = ("torn", "fsync_lie", "enospc", "crash_rename")
+CRASH_DISK_MODES = ("keep", "dirty", "lose")
 
 
 def seed_from_env(default: int) -> int:
@@ -92,11 +103,21 @@ class FaultSchedule:
     params) compare equal, which is the determinism contract the replay
     tests assert."""
 
+    #: Artifact schema version.  1 = the original (implicit) vocabulary;
+    #: 2 adds the durafault actions (crash_process/reboot_process/
+    #: disk_fault) and stamps artifacts explicitly.  `from_dict` accepts
+    #: unstamped v1 artifacts — old /tmp/nemesis-*.json captures keep
+    #: replaying — and never rejects a NEWER stamp (events are plain
+    #: (t, action, args) rows; unknown actions fail loudly at apply
+    #: time, which is the right place).
+    SCHEMA = 2
+
     def __init__(self, events: list[NemesisEvent], seed: int | None = None,
-                 params: dict | None = None):
+                 params: dict | None = None, schema: int | None = None):
         self.events = list(events)
         self.seed = seed
         self.params = dict(params or {})
+        self.schema = self.SCHEMA if schema is None else int(schema)
 
     def __iter__(self):
         return iter(self.events)
@@ -114,14 +135,16 @@ class FaultSchedule:
                 for e in self.events]
 
     def to_dict(self) -> dict:
-        return {"seed": self.seed, "params": self.params,
+        return {"schema": self.schema, "seed": self.seed,
+                "params": self.params,
                 "events": [e.to_dict() for e in self.events]}
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultSchedule":
         return cls([NemesisEvent(e["t"], e["action"], dict(e["args"]))
                     for e in d["events"]],
-                   seed=d.get("seed"), params=d.get("params"))
+                   seed=d.get("seed"), params=d.get("params"),
+                   schema=d.get("schema", 1))
 
     @classmethod
     def from_json(cls, path: str) -> "FaultSchedule":
@@ -187,9 +210,35 @@ class _GenState:
         self.unreliable: set = set()  # (g, p) or name
         self.deaf: set = set()
         self.delayed: set = set()
+        # durafault: whole-process crash/reboot + disk-fault dimension.
+        # Procs are grouped (proc_groups: name -> label, default ONE
+        # shared group) so concurrent crashes stay a minority per group
+        # — the same liveness bound kills obey.
+        self.procs = list(spec.get("procs", []))
+        self.proc_groups = dict(spec.get("proc_groups", {}))
+        self.disk_modes = list(spec.get("disk_modes", CRASH_DISK_MODES))
+        self.scopes = list(spec.get("scopes", []))
+        self.disk_kinds = list(spec.get("disk_kinds", DISK_FAULT_KINDS))
+        self.crashed: set = set()
 
     def _max_killed(self) -> int:
         return max(0, (self.P - 1) // 2)
+
+    def _proc_group(self, name) -> str:
+        return self.proc_groups.get(name, "_all")
+
+    def _crashable(self) -> list:
+        """Procs whose crash keeps every proc-group at a minority down."""
+        out = []
+        for n in self.procs:
+            if n in self.crashed:
+                continue
+            grp = self._proc_group(n)
+            size = sum(1 for m in self.procs if self._proc_group(m) == grp)
+            down = sum(1 for m in self.crashed if self._proc_group(m) == grp)
+            if down < max(0, (size - 1) // 2):
+                out.append(n)
+        return out
 
     def applicable(self, a: str) -> bool:
         if a == "revive":
@@ -205,6 +254,12 @@ class _GenState:
             return bool(self.delayed)
         if a in ("deafen", "delay_on"):
             return bool(self._quiet_names())
+        if a == "crash_process":
+            return bool(self._crashable())
+        if a == "reboot_process":
+            return bool(self.crashed)
+        if a == "disk_fault":
+            return bool(self.scopes)
         return True
 
     def _quiet_names(self):
@@ -288,6 +343,28 @@ class _GenState:
             name = rng.choice(sorted(self.delayed))
             self.delayed.discard(name)
             return {"name": name}
+        if action == "crash_process":
+            cands = self._crashable()
+            if not cands:
+                return None
+            name = rng.choice(cands)
+            self.crashed.add(name)
+            # Disk disposition rides the event: mostly keep the disk,
+            # sometimes reboot over a power-crashed one (un-synced
+            # writes rolled back by durafs), rarely lose it outright.
+            weights = {"keep": 3.0, "dirty": 2.0, "lose": 1.0}
+            disk = rng.choices(self.disk_modes,
+                               weights=[weights.get(m, 1.0)
+                                        for m in self.disk_modes], k=1)[0]
+            return {"name": name, "disk": disk}
+        if action == "reboot_process":
+            name = rng.choice(sorted(self.crashed))
+            self.crashed.discard(name)
+            return {"name": name}
+        if action == "disk_fault":
+            return {"scope": rng.choice(sorted(self.scopes)),
+                    "kind": rng.choice(self.disk_kinds),
+                    "frac": round(rng.random(), 6)}
         return {}  # extra action: no args
 
     def restore_tail(self) -> list[tuple[str, dict]]:
@@ -307,6 +384,11 @@ class _GenState:
             tail.append(("delay_off", {"name": name}))
         for name in sorted(self.deaf):
             tail.append(("undeafen", {"name": name}))
+        # Revival guarantee: every scheduled crash ends rebooted (the
+        # runner's target.restore() re-reboots as belt and braces for
+        # crashes injected before a stop()).
+        for name in sorted(self.crashed):
+            tail.append(("reboot_process", {"name": name}))
         return tail
 
 
@@ -377,26 +459,167 @@ class FabricTarget:
             f.start_clock()  # a clock_pause interrupted mid-flight
 
 
+class ProcessTarget:
+    """Whole-process crash/reboot as a nemesis dimension (durafault).
+
+    `crash_fn(name, disk)` and `reboot_fn(name)` are caller-provided
+    (e.g. `DisKVSystem.crash`/`.reboot`, or SIGKILL+respawn for real OS
+    processes); `disk` is one of CRASH_DISK_MODES — "keep" reboots over
+    the intact directory, "dirty" models a power crash first (durafs
+    rolls un-synced writes back), "lose" wipes it.  The generator bounds
+    concurrent crashes to a minority per proc-group and the restore tail
+    reboots everything, so a soak always ends with every process
+    revivable; `restore()` re-reboots runtime-tracked crashes as the
+    belt-and-braces half (a stop() mid-schedule skips the tail)."""
+
+    ACTIONS = ["crash_process", "reboot_process"]
+
+    def __init__(self, procs: list[str], crash_fn, reboot_fn,
+                 proc_groups: dict | None = None,
+                 disk_modes: tuple = CRASH_DISK_MODES):
+        self.procs = list(procs)
+        self.crash_fn = crash_fn
+        self.reboot_fn = reboot_fn
+        self.proc_groups = dict(proc_groups or {})
+        self.disk_modes = tuple(disk_modes)
+        self._crashed: set = set()
+
+    def spec(self) -> dict:
+        return {"kind": "process", "procs": self.procs,
+                "proc_groups": self.proc_groups,
+                "disk_modes": list(self.disk_modes),
+                "actions": list(self.ACTIONS)}
+
+    def apply(self, action: str, args: dict) -> None:
+        if action == "crash_process":
+            self._crashed.add(args["name"])
+            self.crash_fn(args["name"], args.get("disk", "keep"))
+        elif action == "reboot_process":
+            self.reboot_fn(args["name"])
+            self._crashed.discard(args["name"])
+        else:
+            raise ValueError(f"unknown process nemesis action {action!r}")
+
+    def restore(self) -> None:
+        for name in sorted(self._crashed):
+            try:
+                self.reboot_fn(name)
+            except Exception as e:  # noqa: BLE001 — restore is best-effort
+                crashsink.record("nemesis-reboot", e, fatal=False)
+        self._crashed.clear()
+
+
+class DiskTarget:
+    """Disk faults as a nemesis dimension: each `disk_fault` event arms
+    ONE deterministic fault (kind + tear fraction, both carried in the
+    event args) on a named `durafs.DuraDisk` scope, firing at that
+    scope's next durable write.  Because arming is a pure function of
+    the schedule and firing is a pure function of the write sequence,
+    replaying a seed replays the disk faults byte-exactly like any other
+    nemesis event."""
+
+    ACTIONS = ["disk_fault"]
+
+    def __init__(self, disks: dict, kinds: tuple = DISK_FAULT_KINDS):
+        self.disks = dict(disks)  # scope name -> DuraDisk
+        self.kinds = tuple(kinds)
+
+    def spec(self) -> dict:
+        return {"kind": "disk", "scopes": sorted(self.disks),
+                "disk_kinds": list(self.kinds),
+                "actions": list(self.ACTIONS)}
+
+    def apply(self, action: str, args: dict) -> None:
+        if action != "disk_fault":
+            raise ValueError(f"unknown disk nemesis action {action!r}")
+        self.disks[args["scope"]].arm(args["kind"],
+                                      frac=args.get("frac", 0.5))
+
+    def restore(self) -> None:
+        for disk in self.disks.values():
+            disk.disarm()  # armed-but-unfired faults must not leak
+
+
+class CompositeTarget:
+    """One schedule over several targets (e.g. FabricTarget +
+    ProcessTarget + DiskTarget): specs merge — the FIRST target's kind
+    wins (put the fabric/deployment target first, it shapes the
+    partition/unreliable sampling) — action vocabularies must be
+    disjoint, and apply() dispatches each event to the target that owns
+    its action."""
+
+    def __init__(self, *targets):
+        self.targets = list(targets)
+        self._owner: dict[str, object] = {}
+        for t in self.targets:
+            for a in t.spec()["actions"]:
+                if a in self._owner:
+                    raise ValueError(
+                        f"action {a!r} claimed by two targets")
+                self._owner[a] = t
+
+    def spec(self) -> dict:
+        merged: dict = {"actions": []}
+        for t in reversed(self.targets):  # first target's keys win
+            s = t.spec()
+            merged.update({k: v for k, v in s.items() if k != "actions"})
+        for t in self.targets:
+            merged["actions"] += list(t.spec()["actions"])
+        return merged
+
+    def apply(self, action: str, args: dict) -> None:
+        t = self._owner.get(action)
+        if t is None:
+            raise ValueError(f"unknown composite nemesis action {action!r}")
+        t.apply(action, args)
+
+    def restore(self) -> None:
+        # Reverse order: disks disarm before processes reboot before the
+        # fabric heals/revives (a reboot over a still-armed disk would
+        # fire a stale fault into the recovery write path).
+        for t in reversed(self.targets):
+            t.restore()
+
+
 class DeploymentTarget:
     """Nemesis adapter over a wire `harness.Deployment`: reversible
     deafness (socket path renamed aside), per-server unreliable accept
     loops, and delay-proxy interposition — the same schedule engine, over
-    real sockets."""
+    real sockets.  With `crash_fn`/`reboot_fn` provided, the durafault
+    `crash_process`/`reboot_process` actions join the vocabulary (an
+    embedded ProcessTarget tracks crash state and the restore
+    guarantee)."""
 
     ACTIONS = ["unreliable", "reliable", "deafen", "undeafen",
                "delay_on", "delay_off"]
 
     def __init__(self, dep, names: list[str],
-                 actions: list[str] | None = None):
+                 actions: list[str] | None = None,
+                 crash_fn=None, reboot_fn=None, procs=None,
+                 proc_groups: dict | None = None):
         self.dep = dep
         self.names = list(names)
         self.actions = list(self.ACTIONS if actions is None else actions)
+        self._proc: ProcessTarget | None = None
+        if crash_fn is not None:
+            self._proc = ProcessTarget(
+                list(procs if procs is not None else names),
+                crash_fn, reboot_fn, proc_groups=proc_groups)
 
     def spec(self) -> dict:
-        return {"kind": "deployment", "names": self.names,
-                "actions": list(self.actions)}
+        s = {"kind": "deployment", "names": self.names,
+             "actions": list(self.actions)}
+        if self._proc is not None:
+            ps = self._proc.spec()
+            s.update({k: v for k, v in ps.items()
+                      if k not in ("kind", "actions")})
+            s["actions"] += ps["actions"]
+        return s
 
     def apply(self, action: str, args: dict) -> None:
+        if self._proc is not None and action in self._proc.ACTIONS:
+            self._proc.apply(action, args)
+            return
         dep = self.dep
         if action in ("unreliable", "reliable"):
             dep.set_unreliable(args["name"], args["flag"])
@@ -420,6 +643,8 @@ class DeploymentTarget:
                     fn()
                 except Exception:
                     pass
+        if self._proc is not None:
+            self._proc.restore()
 
 
 # ------------------------------------------------------------------- runner

@@ -28,14 +28,27 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ttl", type=float, default=600.0)
     ap.add_argument("--restore", default=None, metavar="CKPT",
-                    help="resume from a fabric checkpoint file")
+                    help="resume from a fabric checkpoint file, or from "
+                         "the newest VALID snapshot in a checkpoint "
+                         "directory (torn snapshots are discarded)")
     ap.add_argument("--checkpoint", default=None, metavar="CKPT",
                     help="write a checkpoint here on shutdown (and every "
                          "--checkpoint-interval seconds)")
+    ap.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="continuous checkpointing (durafault): a daemon "
+                         "snapshots into DIR/ckpt-<seq>.bin every "
+                         "--checkpoint-interval seconds (default 0.5), "
+                         "pruning old snapshots; one final snapshot on "
+                         "shutdown")
     ap.add_argument("--checkpoint-interval", type=float, default=0.0)
+    ap.add_argument("--checkpoint-keep", type=int, default=3)
     args = ap.parse_args(argv)
-    if args.checkpoint_interval and not args.checkpoint:
-        ap.error("--checkpoint-interval requires --checkpoint")
+    if args.checkpoint_interval and not (args.checkpoint
+                                         or args.checkpoint_dir):
+        ap.error("--checkpoint-interval requires --checkpoint or "
+                 "--checkpoint-dir")
+    if args.checkpoint and args.checkpoint_dir:
+        ap.error("--checkpoint and --checkpoint-dir are exclusive")
     if args.restore:
         clash = [k for k in ("groups", "peers", "instances", "seed")
                  if getattr(args, k) != ap.get_default(k)]
@@ -43,10 +56,17 @@ def main(argv=None):
             ap.error(f"--restore takes its dimensions from the checkpoint; "
                      f"conflicting flags: {', '.join('--' + c for c in clash)}")
 
+    import os
+
+    from tpu6824.core.checkpointd import ContinuousCheckpointer, recover_newest
     from tpu6824.core.fabric import PaxosFabric
     from tpu6824.core.fabric_service import serve_fabric
 
-    if args.restore:
+    if args.restore and os.path.isdir(args.restore):
+        fabric, report = recover_newest(args.restore, auto_step=True)
+        print(f"fabricd: recovered from {report['restored_from']} "
+              f"({len(report['discarded'])} discarded)", flush=True)
+    elif args.restore:
         fabric = PaxosFabric.restore(args.restore, auto_step=True)
     else:
         fabric = PaxosFabric(
@@ -54,6 +74,12 @@ def main(argv=None):
             ninstances=args.instances, seed=args.seed, auto_step=True,
         )
     srv = serve_fabric(fabric, args.addr)
+    ckptd = None
+    if args.checkpoint_dir:
+        ckptd = ContinuousCheckpointer(
+            fabric, args.checkpoint_dir,
+            interval=args.checkpoint_interval or 0.5,
+            keep=args.checkpoint_keep).start()
     print(f"fabricd: serving (G={fabric.G}, I={fabric.I}, "
           f"P={fabric.P}) at {args.addr}", flush=True)
 
@@ -85,6 +111,8 @@ def main(argv=None):
         # A second SIGTERM must not abort the final checkpoint mid-write.
         signal.signal(signal.SIGTERM, signal.SIG_IGN)
         srv.kill()
+        if ckptd is not None:
+            ckptd.stop(final=True)  # snapshots anything after the last tick
         fabric.stop_clock()
         if args.checkpoint:
             fabric.checkpoint(args.checkpoint)

@@ -117,6 +117,14 @@ METRICS = [
            higher_is_better=False,
            leg_shape=[("service", "clerk", "groups"),
                       ("service", "clerk", "width")]),
+    # Recovery leg (durafault): restore-from-snapshot wall time — host
+    # + disk bound, so it gets the host-edge noise floor; gates on its
+    # own shape like the service legs (a BENCH_RECOVERY_GROUPS-trimmed
+    # run must skip, not false-alarm).
+    Metric(("recovery", "recovery_time_ms", "p50"), 0.65,
+           higher_is_better=False, leg_shape=[("recovery", "shape")]),
+    Metric(("recovery", "recovery_time_ms", "p95"), 0.65,
+           higher_is_better=False, leg_shape=[("recovery", "shape")]),
     # Per-leg tpuscope histogram percentiles (new in kernelscope): log2
     # buckets quantize to powers of two, so anything under one bucket
     # (2×) is noise and two buckets (4×) is real — gate between them.

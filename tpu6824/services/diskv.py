@@ -31,13 +31,14 @@ import os
 import pickle
 import threading
 import time
+import zlib
 
 from tpu6824.core.hostpeer import FLOOR_ALL as _FLOOR_ALL
 from tpu6824.core.peer import Fate
 from tpu6824.ops.hashing import NSHARDS, key2shard
 from tpu6824.services.shardkv import Op, ShardKVServer
 from tpu6824.utils.errors import RPCError
-from tpu6824.utils import crashsink
+from tpu6824.utils import crashsink, durafs
 
 
 def encode_key(key: str) -> str:
@@ -53,18 +54,17 @@ def _atomic_write(path: str, data: bytes):
     """Write-then-rename (diskv/server.go:92-105): readers never observe a
     torn file; a crash mid-write leaves only a .tmp that loading ignores.
 
-    The tmp name is unique PER WRITER (pid + thread id): a reboot puts a
-    fresh server object on the same directory while the old server's
-    driver thread may still be mid-persist, and two writers sharing one
-    `path + ".tmp"` race rename-vs-rename — the loser's os.replace dies
-    with FileNotFoundError (the pre-PR-4 test_diskv flake).  Unique tmp
-    names keep every replace self-contained; last rename wins, which is
-    safe because both writers rename complete value images.  The suffix
-    stays ".tmp" so _load_from_disk's debris sweep still matches."""
-    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+    Routed through the one `utils/durafs.py` seam (the durable-write-
+    discipline tpusan rule enforces this tree-wide), which HARDENS the
+    old open+replace: the tmp file is fsync'd before the rename and the
+    directory after it — without the tmp fsync, a crash shortly after
+    the rename could publish a file whose payload never hit the platter
+    (exactly the bug the durafault torn-write injector surfaces), and
+    without the dir fsync the rename itself could be lost.  durafs also
+    keeps the per-writer-unique tmp naming from PR 4 (pid + thread id;
+    two writers sharing one `path + ".tmp"` raced rename-vs-rename) with
+    the ".tmp" suffix `_load_from_disk`'s debris sweep matches."""
+    durafs.atomic_write(path, data)
 
 
 class DisKVServer(ShardKVServer):
@@ -75,6 +75,22 @@ class DisKVServer(ShardKVServer):
                  dir: str, restart: bool = False, **kw):
         self.dir = dir
         self._fs_lock = threading.Lock()
+        # Set by the harness's crash(lose_disk=True) BEFORE it wipes the
+        # directory: a still-draining driver of the dead instance must
+        # not resurrect the wiped dir with a partial image (makedirs in
+        # _shard_dir) that a later reboot would mistake for a disk —
+        # the zombie-writer race the durafault suffix accounting
+        # surfaced once boot-time peer pulls became conditional.
+        self._disk_gone = False
+        # Content checksums of every key file AS WRITTEN, persisted in
+        # the meta snapshot: the boot-time cross-check that catches a
+        # power crash exposing an fsync lie on one half of the
+        # file-then-meta pair (stale key file under a fresh meta, or a
+        # fresh key file under a rolled-back meta — both otherwise
+        # silently serve a lost/doubled update, since log replay dedups
+        # seqs <= applied through the dup table).
+        self._sums: dict[str, int] = {}
+        self._image_inconsistent: list[str] = []
         os.makedirs(dir, exist_ok=True)
         super().__init__(fabric, fg, gid, me, sm_clerk_servers, directory,
                          start_ticker=False, **kw)
@@ -111,7 +127,35 @@ class DisKVServer(ShardKVServer):
                                              "diskv-floor-retry"),
                     daemon=True).start()
         with self.mu:
-            self._snapshot_from_peer()
+            # Pull a full snapshot ONLY when the disk image cannot be
+            # trusted or the log cannot carry us: (a) the load-time
+            # content-checksum cross-check found key files inconsistent
+            # with the meta snapshot (a power crash exposed fsync lies
+            # on ONE side of the file-then-meta write pair — in either
+            # direction, the image at `applied` is wrong and log replay
+            # cannot repair seqs <= applied because the dup table
+            # dedups them); or (b) the cluster GC'd (Min()) past our
+            # applied watermark — disk loss, or an outage longer than
+            # the window.  A reboot over an intact, CONSISTENT disk
+            # replays just the un-truncated suffix through the ordinary
+            # drain instead (durafault asserts this via instance-count
+            # accounting); anything truncated later surfaces as
+            # FORGOTTEN in the drain, which retries this pull.
+            if self._image_inconsistent:
+                # require_ahead=False: repairing CONTENT at our own
+                # watermark — a donor at exactly `applied` is a valid
+                # source (the default applied+1 floor is for catch-up
+                # pulls, where a same-level donor has nothing new).
+                if self._snapshot_from_peer(require_ahead=False) != "ok":
+                    crashsink.record(
+                        f"diskv-dirty-image-{self.name}",
+                        RuntimeError(
+                            f"inconsistent disk image (keys "
+                            f"{sorted(self._image_inconsistent)[:8]}) and "
+                            "no donor reachable — serving the image as-is"),
+                        fatal=False)
+            elif self.px.min() > self.applied + 1:
+                self._snapshot_from_peer()
 
     def _group_peers(self):
         """Live directory entries of this group's OTHER replicas —
@@ -167,28 +211,53 @@ class DisKVServer(ShardKVServer):
         return d
 
     def _file_put(self, key: str, value: str):
+        data = value.encode("utf-8")
         _atomic_write(
             os.path.join(self._shard_dir(key2shard(key)), encode_key(key)),
-            value.encode("utf-8"),
+            data,
         )
+        # Maintained incrementally (never recomputed over the whole kv)
+        # and persisted with the NEXT meta write, so the meta snapshot
+        # always records what each key file must contain at `applied`.
+        self._sums[key] = zlib.crc32(data) & 0xFFFFFFFF
 
-    def _persist_meta(self):
+    def _persist_meta(self, applied: int | None = None):
+        """`applied` lets _apply persist the watermark of the op it just
+        applied (self.applied + 1 — every RSM drain applies at exactly
+        that seq and increments AFTER _apply returns).  Persisting the
+        pre-increment counter understated the disk image by one op,
+        which made every intact-disk reboot look one op behind Min() and
+        take the full-state peer pull meant for disk LOSS — surfaced by
+        the durafault suffix-replay accounting test."""
         meta = {
-            "applied": self.applied,
+            "applied": self.applied if applied is None else applied,
             "config": self.config,
             "dup": self.dup,
             "gid": self.gid,
+            "sums": self._sums,
         }
         _atomic_write(os.path.join(self.dir, "meta.bin"), pickle.dumps(meta))
 
     def _load_from_disk(self):
         metap = os.path.join(self.dir, "meta.bin")
+        sums = None
         if os.path.exists(metap):
             with open(metap, "rb") as f:
                 meta = pickle.load(f)
             self.applied = meta["applied"]
             self.config = meta["config"]
             self.dup = meta["dup"]
+            sums = meta.get("sums")  # absent in pre-durafault metas
+        # Root-level debris sweep (meta.bin's torn tmps — meta is
+        # written on EVERY applied op, so it is the most likely torn-
+        # fault victim); the per-shard sweep below covers key files.
+        for name in os.listdir(self.dir):
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except FileNotFoundError:
+                    pass
+        loaded_crc: dict[str, int] = {}
         for s in range(NSHARDS):
             d = os.path.join(self.dir, f"shard-{s}")
             if not os.path.isdir(d):
@@ -207,7 +276,24 @@ class DisKVServer(ShardKVServer):
                         pass
                     continue
                 with open(os.path.join(d, name), "rb") as f:
-                    self.kv[decode_key(name)] = f.read().decode("utf-8")
+                    data = f.read()
+                key = decode_key(name)
+                self.kv[key] = data.decode("utf-8")
+                loaded_crc[key] = zlib.crc32(data) & 0xFFFFFFFF
+        if sums is not None:
+            # Cross-check: every key file must hold exactly what the
+            # meta snapshot says was durably written at `applied` — a
+            # mismatch (either direction) or a missing/extra key means
+            # a power crash exposed an un-synced write on one side of
+            # the file-then-meta pair, and the image must be repaired
+            # from a peer, not served (_boot_recover).
+            self._image_inconsistent = sorted(
+                set(k for k, c in sums.items()
+                    if loaded_crc.get(k) != c)
+                | set(loaded_crc) - set(sums))
+            self._sums = dict(sums)
+        else:
+            self._sums = dict(loaded_crc)
 
     # ------------------------------------------------------------ RSM hooks
 
@@ -216,6 +302,11 @@ class DisKVServer(ShardKVServer):
         # Persist BEFORE the caller Done()s the instance: the disk image is
         # always ≥ the log position we allow to be forgotten.
         with self._fs_lock:
+            if self._disk_gone:
+                # crash(lose_disk=True) wiped the dir (serialized on
+                # this lock): the write is moot by design, and writing
+                # anyway would RECREATE the wiped directory.
+                return reply
             try:
                 if op.kind in ("put", "append") and reply is not None and reply[0] == "OK":
                     self._file_put(op.key, self.kv[op.key])
@@ -225,15 +316,42 @@ class DisKVServer(ShardKVServer):
                         for k, _ in xstate.kv:
                             if k in self.kv:
                                 self._file_put(k, self.kv[k])
-                self._persist_meta()
-            except FileNotFoundError:
+                # This op sits at seq self.applied + 1 (the caller
+                # increments after we return): persist THAT watermark.
+                self._persist_meta(self.applied + 1)
+            except OSError as e:
                 # crash(lose_disk=True) rmtree's our directory while this
                 # (now-dead) server's driver is mid-persist; the write is
-                # moot — the disk is gone by design.  Any other writer
-                # losing its directory is a real bug: re-raise.
-                if not self.dead:
-                    raise
+                # moot — the disk is gone by design.
+                if isinstance(e, FileNotFoundError) and self.dead:
+                    return reply
+                # Any other failed persist (injected DiskFault, real
+                # ENOSPC/EIO, a live server's directory vanishing):
+                # durability demands we HALT before the caller can Done()
+                # this instance — a replica that serves on after a failed
+                # persist would let the cluster GC log entries its disk
+                # image does not cover.  Die like a crashed process
+                # (paxos lane silent, dropped from the directory); a
+                # reboot re-syncs from disk + peers.  The exception
+                # re-raises so _drain_decided never advances `applied`
+                # past the unpersisted op.
+                crashsink.record(f"diskv-persist-{self.name}", e,
+                                 fatal=False)
+                self._halt_for_disk_fault()
+                raise
         return reply
+
+    def _halt_for_disk_fault(self):
+        """Self-crash on a failed persist (see _apply): equivalent to the
+        harness's crash() but initiated by the replica itself — the same
+        state a nemesis `crash_process` leaves, so the soak tail's
+        reboot-everything pass revives it identically."""
+        self.dead = True
+        self.directory.pop(self.name, None)
+        try:
+            self.px.fabric.kill(self.px.g, self.px.me)
+        except Exception as e:  # noqa: BLE001 — halting must not throw
+            crashsink.record(f"diskv-halt-{self.name}", e, fatal=False)
 
     def _drain_decided(self):
         """Like shardkv's, but a FORGOTTEN instance at applied+1 means the
@@ -246,22 +364,62 @@ class DisKVServer(ShardKVServer):
                 self.applied += 1
                 self.px.done(self.applied)
             elif fate == Fate.FORGOTTEN:
-                if not self._snapshot_from_peer():
-                    self.applied += 1  # no peer available; limp forward
+                # Single-pass pull (deadline 0): this runs under mu on
+                # every tick, so the TICK CADENCE is the retry loop —
+                # sleeping here would block this replica's client ops
+                # and its own donor duties for the whole deadline.  The
+                # multi-second patience is reserved for boot
+                # (_boot_recover), where nothing is being served yet.
+                st = self._snapshot_from_peer(deadline_s=0.0)
+                if st == "behind":
+                    # Every REACHABLE peer is at/behind our watermark (a
+                    # whole-group blank restart): nothing to pull, ever —
+                    # skip the forgotten seq so the group keeps living.
+                    self.applied += 1
+                elif st != "ok":
+                    # Peers exist but were busy/unreachable this pass:
+                    # limping here would permanently skip GC'd data a
+                    # donor could still supply — retry next tick instead.
+                    return
             else:
                 return
 
-    def _snapshot_from_peer(self) -> bool:
+    def _snapshot_from_peer(self, deadline_s: float = 3.0,
+                            require_ahead: bool = True) -> str:
         """Full-state recovery from a live replica of this group (the rejoin
         path the reference's Test5RejoinMix scenarios demand).  Peers are
         selected by directory NAME (g<gid>-<p>), not object attributes, so
-        entries may be in-process servers or socket proxies alike."""
+        entries may be in-process servers or socket proxies alike.
+
+        Returns "ok" (state adopted), "behind" (every REACHABLE peer is
+        at/behind our watermark — nothing to pull), or "unreachable"
+        (no peer answered within `deadline_s`).  The distinction is
+        load-bearing: a donor whose mu is busy (its own drain mid-
+        persist — fsync-heavy under the durafs discipline) answers
+        "busy" transiently, and treating that like "no donor exists"
+        used to let the caller's limp-forward path permanently skip the
+        GC'd prefix (surfaced as a rare {'m0': '+more'} full-suite-
+        contention flake in the disk-loss rejoin test).  Retries until
+        the deadline, then reports WHY it failed so callers limp only
+        when limping is actually safe."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            st = self._snapshot_from_peer_once(require_ahead)
+            if st != "unreachable" or self.dead or \
+                    time.monotonic() >= deadline:
+                return st
+            time.sleep(0.15)
+
+    def _snapshot_from_peer_once(self, require_ahead: bool = True) -> str:
+        behind = False
+        floor = self.applied + (1 if require_ahead else 0)
         for name, srv in self._group_peers():
             try:
-                snap = srv.full_snapshot(self.applied + 1)
+                snap = srv.full_snapshot(floor)
             except RPCError:
                 continue
             if snap is None:
+                behind = True
                 continue
             kv, dup, config, applied = snap
             self.kv = dict(kv)
@@ -269,12 +427,15 @@ class DisKVServer(ShardKVServer):
             self.config = config
             self.applied = applied
             with self._fs_lock:
-                for k, val in self.kv.items():
-                    self._file_put(k, val)
-                self._persist_meta()
+                if not self._disk_gone:
+                    self._sums = {}  # rebuilt below; stale sums must go
+                    for k, val in self.kv.items():
+                        self._file_put(k, val)
+                    self._persist_meta()
+            self._image_inconsistent = []  # image now donor-consistent
             self.px.done(self.applied)
-            return True
-        return False
+            return "ok"
+        return "behind" if behind else "unreachable"
 
     def full_snapshot(self, min_applied: int):
         """Donor side of crash recovery."""
@@ -321,13 +482,31 @@ class DisKVSystem:
     mirror the reference harness (`diskv/test_test.go:62-233`)."""
 
     def __init__(self, base_dir: str, ngroups=2, nreplicas=3, ninstances=32,
-                 base_gid=500):
+                 base_gid=500, fault_disks: bool = False,
+                 fabric_kw: dict | None = None):
+        """`fault_disks=True` registers a `durafs.DuraDisk` over every
+        server directory, so the durafault nemesis (`DiskTarget`) can arm
+        torn writes / fsync lies / ENOSPC per replica and `crash(...,
+        power_crash=True)` can model losing the un-synced page cache.
+        `fabric_kw` passes through to the PaxosFabric ctor (kernel
+        engine, io mode, pipelining — the durafault soak runs on both
+        engines)."""
         from tpu6824.core.fabric import PaxosFabric
         from tpu6824.services import shardmaster
 
         self.base_dir = base_dir
+        self.disks: dict[str, durafs.DuraDisk] = {}
+        if fault_disks:
+            for i in range(ngroups):
+                for p in range(nreplicas):
+                    gid = base_gid + i
+                    d = self._server_dir(gid, p)
+                    os.makedirs(d, exist_ok=True)
+                    self.disks[f"g{gid}-{p}"] = durafs.register(
+                        durafs.DuraDisk(d))
         self.fabric = PaxosFabric(ngroups=1 + ngroups, npeers=nreplicas,
-                                  ninstances=ninstances, auto_step=True)
+                                  ninstances=ninstances, auto_step=True,
+                                  **(fabric_kw or {}))
         self.sm_servers = [
             shardmaster.ShardMasterServer(self.fabric, 0, p)
             for p in range(nreplicas)
@@ -356,22 +535,46 @@ class DisKVSystem:
             dir=self._server_dir(gid, p), restart=restart,
         )
 
-    def crash(self, gid: int, p: int, lose_disk: bool = False):
+    def crash(self, gid: int, p: int, lose_disk: bool = False,
+              power_crash: bool = False):
         """kill1 (diskv/test_test.go:173-233): real crash — the server stops
-        serving AND its paxos lane goes silent; optionally wipe the disk."""
+        serving AND its paxos lane goes silent; optionally wipe the disk
+        (`lose_disk`) or model a POWER loss (`power_crash`: every write
+        whose fsync was a lie / whose rename was never dir-synced reverts
+        to the last durable content — needs `fault_disks=True`)."""
         srv = self.groups[gid][p]
         srv.dead = True
         self.directory.pop(srv.name, None)
         fg = 1 + self.gids.index(gid)
         self.fabric.kill(fg, p)
+        disk = self.disks.get(srv.name) or \
+            durafs.lookup(self._server_dir(gid, p))
         if lose_disk:
-            import shutil
+            # Flag first, wipe under the server's fs lock: any persist
+            # in flight completes BEFORE the wipe, and every later one
+            # sees _disk_gone and skips — the dead instance can never
+            # resurrect the directory (see DisKVServer.__init__).
+            srv._disk_gone = True
+            with srv._fs_lock:
+                if disk is not None:
+                    disk.lose()
+                else:
+                    import shutil
 
-            shutil.rmtree(self._server_dir(gid, p), ignore_errors=True)
+                    shutil.rmtree(self._server_dir(gid, p),
+                                  ignore_errors=True)
+        elif power_crash and disk is not None:
+            with srv._fs_lock:
+                disk.power_crash()
 
     def reboot(self, gid: int, p: int):
         """Restart the server process against whatever its dir holds."""
         fg = 1 + self.gids.index(gid)
+        disk = self.disks.get(f"g{gid}-{p}")
+        if disk is not None:
+            # New process, (possibly replacement) disk: lost flag, armed
+            # faults, and the volatile journal do not survive a reboot.
+            disk.reset()
         self.fabric.revive(fg, p)
         self.groups[gid][p] = self._boot(fg, gid, p, restart=True)
 
@@ -397,4 +600,6 @@ class DisKVSystem:
         for grp in self.groups.values():
             for s in grp:
                 s.dead = True
+        for disk in self.disks.values():
+            durafs.unregister(disk)
         self.fabric.stop_clock()

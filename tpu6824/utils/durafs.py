@@ -1,0 +1,346 @@
+"""durafs — the ONE durable-write seam, with deterministic disk faults.
+
+Every durable write in the tree (`services/diskv.py` key/meta files,
+`HostPaxosPeer(persist_dir=...)` ledger records, the fabric checkpoint
+path) routes through `atomic_write()` here, which implements the full
+crash-consistency discipline the reference's Lab 5 on-disk contract
+implies but `diskv/server.go:92-105` only half-does:
+
+    write tmp  →  fsync(tmp)  →  rename(tmp, path)  →  fsync(dir)
+
+Without the tmp fsync, a crash after the rename can publish a file whose
+DATA never reached the platter (the rename is durable before the
+content); without the dir fsync, the rename itself can be lost.  Both
+halves are exactly what the fault injector below tears.
+
+Fault injection: a `DuraDisk` registered over a directory intercepts
+every durable write under it and consults (a) a FIFO of one-shot armed
+faults (the nemesis `DiskTarget` arms these from a seeded
+`FaultSchedule`, so disk faults replay byte-exactly like any other
+nemesis event) and (b) an optional seeded `FaultPlan` drawing per-op
+faults at fixed rates.  Supported faults:
+
+    torn           write only the first ``frac`` of the payload into the
+                   tmp file, then die (DiskFault) — tmp debris remains,
+                   the target file is untouched;
+    enospc         the write fails up front with ENOSPC;
+    fsync_lie      the write "succeeds" but NEITHER the data nor the
+                   rename was synced — a later `power_crash()` reverts
+                   the file to its previous durable content;
+    crash_rename   data synced, rename done, dir-sync skipped, writer
+                   dies — the file READS new but `power_crash()` undoes
+                   the un-synced directory entry;
+    lose_disk      the whole scope directory is destroyed mid-write.
+
+`power_crash()` is the power-loss model: everything written through the
+disk whose durability was a lie is rolled back to the last state that
+was actually synced.  A write that completed the full discipline is
+never rolled back — that asymmetry is the whole point, and the
+durafault tests assert both directions.
+
+Determinism: armed faults fire in FIFO order against the disk's
+monotonically-numbered durable ops; `FaultPlan(seed, rates)` consumes a
+private `random.Random(seed)` one draw per op.  Same op sequence, same
+plan → identical fault placement.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import random
+import shutil
+import threading
+
+#: Sentinel for "the path did not durably exist" in the volatile journal.
+MISSING = object()
+
+FAULT_KINDS = ("torn", "enospc", "fsync_lie", "crash_rename", "lose_disk")
+
+
+class DiskFault(OSError):
+    """An injected durable-write fault.  Subclasses OSError so existing
+    handlers for real disk errors (ENOSPC, EIO) treat it identically —
+    the injector must never need special-cased catches in product code."""
+
+    def __init__(self, eno: int, msg: str, path: str, kind: str):
+        super().__init__(eno, msg, path)
+        self.kind = kind
+
+
+class FaultPlan:
+    """Seeded per-op fault sampler: one draw per durable op, at fixed
+    per-kind rates.  `rates` maps fault kind → probability; the draws
+    come off a private Random(seed), so the same op sequence replays the
+    same faults."""
+
+    def __init__(self, seed: int, rates: dict[str, float] | None = None):
+        bad = set(rates or ()) - set(FAULT_KINDS)
+        if bad:
+            raise ValueError(f"unknown fault kinds: {sorted(bad)}")
+        self.seed = seed
+        self.rates = dict(rates or {})
+        self._rng = random.Random(seed)
+
+    def draw(self) -> dict | None:
+        """Fault for the next durable op, or None.  ALWAYS consumes
+        exactly two rng draws so fault placement is a pure function of
+        the op index, not of which earlier ops faulted."""
+        u, frac = self._rng.random(), self._rng.random()
+        acc = 0.0
+        for kind in FAULT_KINDS:
+            acc += self.rates.get(kind, 0.0)
+            if u < acc:
+                return {"kind": kind, "frac": frac}
+        return None
+
+
+class DuraDisk:
+    """One fault-injectable durable-write scope rooted at a directory.
+
+    Tracks a volatile journal — for every write whose durability was
+    faked (fsync_lie / crash_rename), the previous DURABLE content of
+    the path — so `power_crash()` can model what a real power loss
+    would do to the un-synced page cache and directory entries."""
+
+    def __init__(self, root: str, plan: FaultPlan | None = None):
+        self.root = os.path.abspath(root)
+        self.plan = plan
+        self._mu = threading.Lock()
+        self._armed: list[dict] = []  # FIFO of one-shot faults
+        self._journal: dict[str, object] = {}  # path -> prev durable bytes
+        self.op_index = 0
+        self.counts: dict[str, int] = {"writes": 0}
+        self.lost = False
+
+    # ------------------------------------------------------------ arming
+
+    def arm(self, kind: str, frac: float = 0.5) -> None:
+        """Queue a one-shot fault for the next durable write in this
+        scope (FIFO).  This is the nemesis DiskTarget's injection point:
+        the schedule event carries (kind, frac), so replay re-arms the
+        identical fault at the identical event offset."""
+        if kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        with self._mu:
+            self._armed.append({"kind": kind, "frac": frac})
+
+    def disarm(self) -> None:
+        """Drop every armed-but-unfired fault (nemesis restore tail)."""
+        with self._mu:
+            self._armed.clear()
+
+    def _next_fault(self) -> dict | None:
+        # Callers hold self._mu.
+        if self._armed:
+            return self._armed.pop(0)
+        if self.plan is not None:
+            return self.plan.draw()
+        return None
+
+    # ------------------------------------------------------------ writes
+
+    def atomic_write(self, path: str, data: bytes) -> None:
+        path = os.path.abspath(path)
+        with self._mu:
+            if self.lost:
+                # Lost stays lost until reset(): a writer that raced the
+                # loss must not resurrect the directory with a partial
+                # image a later reboot would mistake for a disk.
+                raise DiskFault(errno.EIO, "durafs: disk is lost",
+                                path, "lose_disk")
+            self.op_index += 1
+            self.counts["writes"] += 1
+            fault = self._next_fault()
+            kind = fault["kind"] if fault else None
+            if kind:
+                self.counts[kind] = self.counts.get(kind, 0) + 1
+            if kind == "enospc":
+                raise DiskFault(errno.ENOSPC,
+                                "durafs: injected ENOSPC", path, kind)
+            if kind == "lose_disk":
+                self.lost = True
+                self._journal.clear()
+                shutil.rmtree(self.root, ignore_errors=True)
+                raise DiskFault(errno.EIO, "durafs: disk lost mid-write",
+                                path, kind)
+            tmp = _tmp_name(path)
+            if kind == "torn":
+                k = int(len(data) * fault.get("frac", 0.5))
+                with open(tmp, "wb") as f:
+                    f.write(data[:k])
+                    f.flush()
+                    os.fsync(f.fileno())
+                raise DiskFault(
+                    errno.EIO, f"durafs: torn write at byte {k}", path, kind)
+            lie = kind == "fsync_lie"
+            prev = self._prev_durable_locked(path) \
+                if kind in ("fsync_lie", "crash_rename") else None
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                if not lie:
+                    os.fsync(f.fileno())
+            os.replace(tmp, path)
+            if lie:
+                # The write "succeeded": no exception, but neither the
+                # data nor the rename is durable.
+                self._journal[path] = prev
+                return
+            if kind == "crash_rename":
+                # Data synced, rename visible, dir entry NOT synced —
+                # and the writer dies right here.
+                self._journal[path] = prev
+                raise DiskFault(
+                    errno.EIO,
+                    "durafs: crashed after rename, before dir fsync",
+                    path, kind)
+            _fsync_dir(os.path.dirname(path))
+            # The full discipline ran: this path's content is durable.
+            self._journal.pop(path, None)
+
+    def _prev_durable_locked(self, path: str):
+        if path in self._journal:
+            return self._journal[path]  # oldest durable content wins
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return MISSING
+
+    # ----------------------------------------------------------- crashes
+
+    def power_crash(self) -> list[str]:
+        """Model a power loss: every path whose last write skipped part
+        of the sync discipline reverts to its previous durable content
+        (or vanishes, if it never durably existed).  Fully-synced writes
+        are untouched.  Returns the reverted paths (tests assert on
+        them)."""
+        with self._mu:
+            reverted = []
+            for path, prev in self._journal.items():
+                try:
+                    if prev is MISSING:
+                        os.unlink(path)
+                    else:
+                        with open(path, "wb") as f:
+                            f.write(prev)
+                except OSError:
+                    continue  # scope since lost / path since removed
+                reverted.append(path)
+            self._journal.clear()
+            return sorted(reverted)
+
+    def lose(self) -> None:
+        """Destroy the scope (the harness's rmtree disk loss, routed so
+        the journal cannot resurrect files into a lost disk).  Writes
+        through this disk fail until `reset()` — the replaced-disk
+        half of a reboot."""
+        with self._mu:
+            self.lost = True
+            self._journal.clear()
+            shutil.rmtree(self.root, ignore_errors=True)
+
+    def reset(self) -> None:
+        """Fresh-disk reset at reboot: clears the lost flag, armed-but-
+        unfired faults, and the volatile journal (a new process starts
+        from whatever is durably on disk, with a clean page cache)."""
+        with self._mu:
+            self.lost = False
+            self._armed.clear()
+            self._journal.clear()
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"root": self.root, "ops": self.op_index,
+                    "volatile": len(self._journal), "lost": self.lost,
+                    "counts": dict(self.counts),
+                    "armed": len(self._armed)}
+
+
+# ---------------------------------------------------------------- registry
+
+_reg_mu = threading.Lock()
+_disks: dict[str, DuraDisk] = {}  # abspath root -> disk
+
+
+def register(disk: DuraDisk) -> DuraDisk:
+    with _reg_mu:
+        _disks[disk.root] = disk
+    return disk
+
+
+def unregister(disk_or_root) -> None:
+    root = disk_or_root.root if isinstance(disk_or_root, DuraDisk) \
+        else os.path.abspath(disk_or_root)
+    with _reg_mu:
+        _disks.pop(root, None)
+
+
+def lookup(path: str) -> DuraDisk | None:
+    """The registered disk covering `path` (longest root wins)."""
+    p = os.path.abspath(path)
+    with _reg_mu:
+        best = None
+        for root, disk in _disks.items():
+            if p == root or p.startswith(root + os.sep):
+                if best is None or len(root) > len(best.root):
+                    best = disk
+        return best
+
+
+@contextlib.contextmanager
+def scope(root: str, plan: FaultPlan | None = None):
+    """Register a DuraDisk over `root` for the duration of a with-block
+    (the test-side arming surface)."""
+    disk = register(DuraDisk(root, plan=plan))
+    try:
+        yield disk
+    finally:
+        unregister(disk)
+
+
+# -------------------------------------------------------------- primitives
+
+
+def _tmp_name(path: str) -> str:
+    """Per-writer-unique scratch name.  pid+tid keeps concurrent writers
+    (a rebooted server sharing a dir with the old instance's still-
+    draining driver) from racing rename-vs-rename on one shared tmp —
+    the pre-PR-4 test_diskv flake.  The suffix stays ".tmp" so debris
+    sweeps (diskv `_load_from_disk`) and footprint probes keep matching."""
+    return f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+
+
+def _fsync_dir(d: str) -> None:
+    """Make a rename in `d` durable.  Directory fds are not a universal
+    POSIX guarantee (and some filesystems refuse O_DIRECTORY fsync);
+    failure to sync the dir is not failure to write."""
+    try:
+        fd = os.open(d or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """THE durable write: tmp + fsync(tmp) + rename + fsync(dir).  Routes
+    through the registered DuraDisk covering `path` when one exists (the
+    fault-injection seam); identical discipline either way."""
+    disk = lookup(path)
+    if disk is not None:
+        disk.atomic_write(os.path.abspath(path), data)
+        return
+    tmp = _tmp_name(path)
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
