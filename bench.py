@@ -315,6 +315,15 @@ def child_main():
         except Exception as e:  # noqa: BLE001
             service["clerk"] = {"value": 0.0, "error": repr(e)[:200]}
         service["clerk"]["tpuscope"] = _tpuscope_delta(leg0)
+        # The batched request path (ISSUE 8): clerk ops through the
+        # event-loop frontend over real sockets, conns × batch sweep.
+        leg0 = _tpuscope_begin()
+        try:
+            service["clerk_frontend"] = _clerk_frontend_rate()
+        except Exception as e:  # noqa: BLE001
+            service["clerk_frontend"] = {"value": 0.0,
+                                         "error": repr(e)[:200]}
+        service["clerk_frontend"]["tpuscope"] = _tpuscope_delta(leg0)
         # Durability leg (durafault): recovery-time percentiles, gated by
         # benchdiff like every throughput leg.
         leg0 = _tpuscope_begin()
@@ -1073,6 +1082,164 @@ def _clerk_rate():
             "note": f"{NC} blocking clerk threads/group (reference shape); "
                     f"GIL-bound on a single-core host",
         },
+    }
+
+
+def _clerk_frontend_rate():
+    """service.clerk_frontend (ISSUE 8): aggregate clerk ops/sec through
+    the BATCHED request path — FrontendStream clients speaking multi-op
+    frames over real Unix sockets into ONE event-loop ClerkFrontend
+    (native epoll server, inline decode, deferred replies) that fronts
+    every group, one columnar submit_batch per group per engine pass,
+    futures resolved by the group-commit drivers' one-sweep notify.
+
+    Sweeps connection count × batch width (the scale levers that replace
+    thread count) and reports the whole table plus the best point as the
+    leg value.  Latency is per-op frame round-trip (submit→reply over
+    the wire), measured inside the timed window."""
+    import threading as _th
+    import time as _t
+
+    from tpu6824.core.fabric import PaxosFabric
+    from tpu6824.services.frontend import ClerkFrontend, FrontendStream
+    from tpu6824.services.kvpaxos import KVPaxosServer
+
+    G = int(os.environ.get("BENCH_FE_GROUPS", 8))
+    I = int(os.environ.get("BENCH_FE_INSTANCES", 2048))
+    P = 3
+    seconds = float(os.environ.get("BENCH_FE_SECONDS",
+                                   os.environ.get("BENCH_SERVICE_SECONDS",
+                                                  4.0)))
+    # conns×width sweep: half the window stays as in-flight headroom.
+    sweep_spec = os.environ.get("BENCH_FE_SWEEP", "8x2048,16x4096")
+    points = []
+    for part in sweep_spec.split(","):
+        c, w = part.strip().split("x")
+        points.append((int(c), int(w)))
+    fab = PaxosFabric(ngroups=G, npeers=P, ninstances=I, auto_step=True,
+                      io_mode="compact", steps_per_dispatch=1,
+                      pipeline_depth=2,
+                      # decided cells per dispatch can reach inflight×P:
+                      # size the compaction buffer so deep batches never
+                      # fall into the full-fetch resync path.
+                      summary_k=max(16384, (G * I * 3) // 2))
+    clusters = [[KVPaxosServer(fab, g, p, op_timeout=30.0)
+                 for p in range(P)] for g in range(G)]
+    fe = ClerkFrontend(addr=f"/tmp/bench-fe-{os.getpid()}.sock",
+                       groups=clusters,
+                       route=lambda key: int(key[1:key.index("-")]),
+                       op_timeout=30.0)
+    sweep = []
+    best = None
+    try:
+        for pt, (conns, width) in enumerate(points):
+            count = [0]
+            primed = [False]
+            lat: list = []
+            stop = _th.Event()
+            go = _th.Event()
+
+            def run(pt=pt, conns=conns, width=width, count=count,
+                    primed=primed, lat=lat, stop=stop, go=go):
+                st = FrontendStream(fe.addr, conns=conns, width=width,
+                                    op_timeout=60.0)
+
+                def on_done(n):
+                    primed[0] = True
+                    if go.is_set() and not stop.is_set():
+                        count[0] += n
+
+                # Keys namespaced PER SWEEP POINT: each point's stream is
+                # a fresh set of logical clients (fresh cids), so reusing
+                # a key across points would interleave two independent
+                # streams on it and break the order spot-check below.
+                st.run_appends(lambda c: f"k{c % G}-s{pt}-{c}",
+                               lambda c, i: f"x {c} {i} y",
+                               stop=stop, on_done=on_done, lat_sink=lat)
+
+            th = _th.Thread(target=run, daemon=True)
+            th.start()
+            t_hard = _t.monotonic() + 90.0
+            while not primed[0] and _t.monotonic() < t_hard:
+                _t.sleep(0.1)
+            _t.sleep(0.75)
+            go.set()
+            lat_lo = len(lat)
+            s0 = fab.steps_total
+            t0 = _t.perf_counter()
+            _t.sleep(seconds)
+            stop.set()
+            dt = _t.perf_counter() - t0
+            lat_hi = len(lat)
+            steps = fab.steps_total - s0
+            th.join(timeout=90)
+            point = {"conns": conns, "batch_width": width,
+                     "value": round(count[0] / dt, 1),
+                     "steps_per_sec": round(steps / dt, 1)}
+            import numpy as _np
+
+            lats = _np.array(lat[lat_lo:lat_hi])
+            if len(lats):
+                point["latency"] = {
+                    "p50_ms": round(float(_np.percentile(lats, 50)) * 1e3, 2),
+                    "p95_ms": round(float(_np.percentile(lats, 95)) * 1e3, 2),
+                    "p99_ms": round(float(_np.percentile(lats, 99)) * 1e3, 2),
+                    "n": int(len(lats)),
+                    "note": "per-op frame round-trip over the wire, "
+                            "inside the timed window",
+                }
+            sweep.append(point)
+            if best is None or point["value"] > best["value"]:
+                best = point
+        assert best is not None and best["value"] > 0, \
+            "no frontend clerk op completed"
+        # Per-client order + exact-once spot check: a client key holds
+        # exactly its consecutive markers from 0 (prefix of its stream).
+        from tpu6824.rpc import transport as _tr
+
+        last = len(points) - 1
+        for c in (0, 1):
+            conn = _tr.FramedConn(fe.addr, timeout=30.0)
+            # Distinct cid per probe: at G=1 both gets hit one group and
+            # a shared (cid, cseq) would dup-filter the second into the
+            # first's cached reply.
+            ok, reply = conn.request(
+                ("get", (f"k{c % G}-s{last}-{c}", 999000 + c, 1)))
+            conn.close()
+            assert ok and reply[0] == "OK", reply
+            val = reply[1]
+            i = 0
+            while val:
+                marker = f"x {c} {i} y"
+                assert val.startswith(marker), (
+                    f"client {c} stream corrupt at marker {i}: "
+                    f"{val[:40]!r}")
+                val = val[len(marker):]
+                i += 1
+            assert i > 0, f"client {c} appended nothing"
+        clerk_protocol = _fabric_protocol(fab)
+    finally:
+        fe.kill()
+        for cl in clusters:
+            for s in cl:
+                s.dead = True
+        fab.stop_clock()
+    return {
+        "value": best["value"],
+        "note": (f"batched event-loop frontend, {G} groups x {P} servers "
+                 f"on one fabric behind ONE frontend socket; multi-op "
+                 f"frames, best of conns x batch-width sweep; per-client "
+                 f"order + exact-once spot-checked"),
+        "groups": G,
+        "instances": I,
+        "conns": best["conns"],
+        "batch_width": best["batch_width"],
+        "steps_per_sec": best["steps_per_sec"],
+        "latency": best.get("latency"),
+        "sweep": sweep,
+        "protocol": clerk_protocol,
+        "knobs": "TPU6824_FRONTEND_OP_TIMEOUT, TPU6824_FRONTEND_DEPTH; "
+                 "BENCH_FE_GROUPS/INSTANCES/SWEEP/SECONDS",
     }
 
 

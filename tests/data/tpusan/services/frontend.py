@@ -1,0 +1,23 @@
+"""tpusan golden: blocking-in-eventloop — a frontend event-loop callback
+that sleeps, waits on a lock, and makes blocking calls.  Callbacks run ON
+the epoll loop (or the driver's notify sweep): decode/enqueue/wake only."""
+
+import time
+
+
+class BadFrontend:
+    def _on_batch(self, conn_id, args, wctx):
+        time.sleep(0.001)                 # finding: sleep in callback
+        self.big_lock.acquire()           # finding: lock wait
+        reply = self.net.call(args)       # finding: blocking RPC leg
+        self.ready.wait(0.1)              # finding: event wait
+        self.pending.append((conn_id, reply))
+
+    def reply_cb(self, fut):
+        with self.mu:                     # finding: `with` on a lock
+            self.done.append(fut)
+
+    def _engine_pass(self):
+        # NOT a callback (no _on_* / *_cb name): the engine thread may
+        # block on the submit handoff — no findings here.
+        time.sleep(0.001)

@@ -29,7 +29,9 @@ def test_one_json_line_with_required_keys():
                    "BENCH_INSTANCES": "16", "BENCH_REPS": "1",
                    # keep the API-driven configs quick for the contract run
                    "BENCH_SERVICE_GROUPS": "16", "BENCH_SERVICE_SECONDS": "1",
-                   "BENCH_CLERK_GROUPS": "4"})
+                   "BENCH_CLERK_GROUPS": "4",
+                   "BENCH_FE_GROUPS": "2", "BENCH_FE_INSTANCES": "128",
+                   "BENCH_FE_SWEEP": "2x32", "BENCH_FE_SECONDS": "1"})
     assert r.returncode == 0, r.stderr[-500:]
     lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, r.stdout
@@ -59,6 +61,21 @@ def test_one_json_line_with_required_keys():
     assert clerk["phases"]["total_seconds"] >= 0, clerk
     assert "outside_framework_wall_fraction" in clerk["phases"], clerk
     assert d["service"]["phases"]["total_seconds"] >= 0, d["service"]
+    # Batched-request-path provenance (ISSUE 8): every recorded run must
+    # carry the clerk_frontend leg — the conns × batch-width sweep table
+    # plus the best point's shape — or the frontend's scaling claims
+    # have no artifact trail and benchdiff cannot gate the new leg.
+    few = d["service"]["clerk_frontend"]
+    assert "error" not in few, few
+    assert few["value"] > 0, few
+    assert few["conns"] >= 1 and few["batch_width"] >= 1, few
+    assert few["groups"] >= 1 and few["sweep"], few
+    assert all("value" in p and "conns" in p and "batch_width" in p
+               for p in few["sweep"]), few["sweep"]
+    assert few["latency"] and few["latency"]["p50_ms"] > 0, few
+    proto = few["protocol"]
+    assert "error" not in proto and proto["totals"]["decides"] > 0, proto
+    assert "tpuscope" in few and "error" not in few["tpuscope"], few
     # Durability provenance (ISSUE 7, durafault): every recorded run
     # must carry the recovery leg — restore-from-snapshot wall-time
     # percentiles + snapshot footprint — or recovery-time regressions

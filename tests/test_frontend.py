@@ -1,0 +1,561 @@
+"""Columnar event-loop clerk frontend (tpu6824/services/frontend.py).
+
+Covers the ISSUE 8 acceptance surface:
+  - exact-once, per-client-ordered appends through the batched wire path
+    (multi-op frames, event-loop engine, one columnar submit per pass);
+  - wire-format back-compat BOTH directions in a mixed fleet: old
+    single-op frames against the frontend, the new clerk against an
+    old-style server, plus the optional trace-context frame element;
+  - at-most-once across retries and reconnects (same cseqs replayed);
+  - event-loop failover: leader partition and killed server, no client
+    thread ever sleeping on behalf of an op;
+  - zero steady-state recompiles under frontend traffic (jitguard);
+  - per-op tpuscope traces threading clerk→frontend→fabric→apply→reply;
+  - fixed-seed nemesis soak (partitions + unreliable wire + kill/revive)
+    with the Wing–Gong checker green, on both kernel engines;
+  - the shardkv reuse (one frontend per group over submit_batch);
+  - ColumnarDups + connection-pool metrics satellites.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpu6824.core.fabric import PaxosFabric
+from tpu6824.obs import tracing as obs
+from tpu6824.obs.tracing import FLIGHT
+from tpu6824.rpc import transport
+from tpu6824.services.common import ColumnarDups
+from tpu6824.services.frontend import (
+    FE_BATCH,
+    ClerkFrontend,
+    FrontendClerk,
+    FrontendStream,
+)
+from tpu6824.services.kvpaxos import Clerk, KVPaxosServer
+from tpu6824.utils.errors import OK, RPCError
+
+from tests.invariants import check_appends
+
+
+def _cluster(tmp_path, g=0, nservers=3, ninstances=256, fabric=None,
+             addr_name="fe.sock", **fe_kw):
+    if fabric is None:
+        fabric = PaxosFabric(ngroups=1, npeers=nservers,
+                             ninstances=ninstances, auto_step=True,
+                             io_mode="compact", pipeline_depth=2)
+    servers = [KVPaxosServer(fabric, g, p) for p in range(nservers)]
+    fe = ClerkFrontend(servers, str(tmp_path / addr_name), **fe_kw)
+    return fabric, servers, fe
+
+
+def _teardown(fabric, servers, *fes):
+    for fe in fes:
+        fe.kill()
+    for s in servers:
+        s.dead = True
+    fabric.stop_clock()
+
+
+# ------------------------------------------------------------ core path
+
+
+def test_frontend_exact_once_in_order(tmp_path):
+    """The batched wire path end to end: W logical clients × C conns of
+    multi-op frames; every client's markers land exactly once, in order
+    (checkAppends), on every replica."""
+    fabric, servers, fe = _cluster(tmp_path)
+    try:
+        st = FrontendStream(fe.addr, conns=3, width=12)
+        total = st.run_appends(lambda c: "k", lambda c, i: f"x {c} {i} y",
+                               stop=None, max_per_client=4)
+        assert total == 12 * 4
+        ck = FrontendClerk([fe.addr])
+        final = ck.get("k")
+        check_appends(final, 12, 4, exact_length=True)
+        # All replicas agree (feed drains catch every server up).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            vals = {Clerk([s]).get("k") for s in servers}
+            if vals == {final}:
+                break
+            time.sleep(0.05)
+        assert vals == {final}
+        ck.close()
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+def test_frontend_clerk_basic_ops(tmp_path):
+    fabric, servers, fe = _cluster(tmp_path)
+    try:
+        ck = FrontendClerk([fe.addr])
+        assert ck.get("nope") == ""
+        ck.put("a", "1")
+        ck.append("a", "2")
+        assert ck.get("a") == "12"
+        ck.close()
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+def test_multi_group_routing(tmp_path):
+    """ONE frontend fronting two groups: route(key) partitions ops per
+    group; each group's log carries only its own keys."""
+    fabric = PaxosFabric(ngroups=2, npeers=3, ninstances=64,
+                         auto_step=True, io_mode="compact")
+    clusters = [[KVPaxosServer(fabric, g, p) for p in range(3)]
+                for g in range(2)]
+    fe = ClerkFrontend(addr=str(tmp_path / "mg.sock"), groups=clusters,
+                       route=lambda key: int(key[1]))
+    try:
+        ck = FrontendClerk([fe.addr])
+        for g in range(2):
+            for i in range(3):
+                ck.append(f"g{g}", f"({g},{i})")
+        for g in range(2):
+            assert ck.get(f"g{g}") == "".join(
+                f"({g},{i})" for i in range(3))
+            # The op really sequenced through group g's servers:
+            assert any(f"g{g}" in s.kv for s in clusters[g])
+            assert all(f"g{g}" not in s.kv for s in clusters[1 - g])
+        ck.close()
+    finally:
+        fe.kill()
+        for cl in clusters:
+            for s in cl:
+                s.dead = True
+        fabric.stop_clock()
+
+
+def test_blocking_fallback_path(tmp_path):
+    """prefer_native=False: the transport.Server fallback serves the
+    same wire (multi-op + classic frames) with blocking handlers."""
+    fabric, servers, fe = _cluster(tmp_path, addr_name="fb.sock",
+                                   prefer_native=False)
+    try:
+        assert not fe.deferred
+        ck = FrontendClerk([fe.addr])
+        ck.put("b", "x")
+        ck.append("b", "y")
+        assert ck.get("b") == "xy"
+        # classic single-op frame against the fallback too
+        assert transport.call(fe.addr, "get", "b", 7001, 1) == (OK, "xy")
+        ck.close()
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+# ------------------------------------------------- wire back-compat
+
+
+def test_old_single_op_frames_against_frontend(tmp_path):
+    """Old clerk → new frontend: the classic `get`/`put_append` frames
+    (transport.call — the PRE-frontend wire) served by the same batching
+    engine, at-most-once preserved."""
+    fabric, servers, fe = _cluster(tmp_path)
+    try:
+        cid = 424242
+        assert transport.call(fe.addr, "put_append", "append", "ok", "A",
+                              cid, 1) == (OK, "")
+        # Same (cid, cseq) replayed: dup-filtered, not re-applied.
+        assert transport.call(fe.addr, "put_append", "append", "ok", "A",
+                              cid, 1) == (OK, "")
+        assert transport.call(fe.addr, "get", "ok", cid, 2) == (OK, "A")
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+def test_new_clerk_against_old_server(tmp_path):
+    """New clerk → old server: a pre-frontend endpoint (rpc server
+    exposing KVPaxosServer's blocking surface) answers `fe_batch` with
+    "no such rpc"; the clerk detects it ONCE and falls back to classic
+    single-op frames."""
+    from tpu6824.rpc.native_server import make_server
+
+    fabric = PaxosFabric(ngroups=1, npeers=3, ninstances=64,
+                         auto_step=True)
+    servers = [KVPaxosServer(fabric, 0, p) for p in range(3)]
+    old = make_server(str(tmp_path / "old.sock"))
+    old.register_obj(servers[0])
+    old.start()
+    try:
+        ck = FrontendClerk([old.addr])
+        ck.put("mx", "1")
+        ck.append("mx", "2")
+        assert ck.get("mx") == "12"
+        assert old.addr in ck._legacy  # fell back after one refusal
+        ck.close()
+    finally:
+        old.kill()
+        for s in servers:
+            s.dead = True
+        fabric.stop_clock()
+
+
+def test_mixed_fleet_one_clerk(tmp_path):
+    """A mixed fleet behind one clerk: frontend endpoint + old-style
+    endpoint for the SAME group; the clerk lands ops through either (old
+    endpoint after a deafened frontend), dup filter spanning both."""
+    from tpu6824.rpc.native_server import make_server
+
+    fabric, servers, fe = _cluster(tmp_path)
+    old = make_server(str(tmp_path / "old2.sock"))
+    old.register_obj(servers[1])
+    old.start()
+    try:
+        ck = FrontendClerk([fe.addr, old.addr], timeout=5.0)
+        ck.append("mf", "1")          # via the frontend
+        fe.deafen()                    # frontend unreachable...
+        ck.append("mf", "2", timeout=30.0)  # ...rotates to the old wire
+        fe.undeafen()
+        assert ck.get("mf", timeout=30.0) == "12"
+        ck.close()
+    finally:
+        old.kill()
+        _teardown(fabric, servers, fe)
+
+
+def test_trace_context_frame_element_interop(tmp_path):
+    """The optional PR-5 trace-context third frame element rides both
+    frame formats against the frontend (untagged frames stay the common
+    wire)."""
+    fabric, servers, fe = _cluster(tmp_path)
+    FLIGHT.clear()
+    obs.enable(sample=1.0)
+    try:
+        # multi-op frame with an explicit wire context
+        conn = transport.FramedConn(fe.addr)
+        ok, replies = conn.request(
+            (FE_BATCH, ((("append", "tc", "z", 31337, 1),),), (7, 9)))
+        assert ok and replies[0] == (OK, "")
+        # classic frame with a context (transport.call tags it itself
+        # when the calling thread carries one)
+        sp = obs.span("clerk.op", comp="clerk", op="get")
+        with obs.use_ctx(sp.ctx):
+            assert transport.call(fe.addr, "get", "tc", 31337, 2) \
+                == (OK, "z")
+        sp.end()
+        conn.close()
+        names = {r["name"] for r in FLIGHT.snapshot()}
+        assert "frontend.submit" in names  # wire ctx reached the engine
+    finally:
+        obs.disable()
+        FLIGHT.clear()
+        _teardown(fabric, servers, fe)
+
+
+# --------------------------------------------- retries / failover
+
+
+def test_empty_batch_frame_answers_immediately(tmp_path):
+    """A degenerate zero-op fe_batch frame gets an empty reply instead
+    of parking in the engine forever (reply FIFO stays in sync)."""
+    fabric, servers, fe = _cluster(tmp_path)
+    try:
+        conn = transport.FramedConn(fe.addr)
+        ok, replies = conn.request((FE_BATCH, ((),)))
+        assert ok and replies == ()
+        ok, r = conn.request(  # same connection still serves ops
+            (FE_BATCH, ((("append", "eb", "x", 9123, 1),),)))
+        assert ok and r[0] == (OK, "")
+        conn.close()
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+def test_at_most_once_across_reconnects(tmp_path):
+    """A whole multi-op frame replayed over a FRESH connection (the
+    client reconnect path) resolves from the dup table — same replies,
+    no double-apply."""
+    fabric, servers, fe = _cluster(tmp_path)
+    try:
+        ops = tuple(("append", "amo", f"v{i}", 555000 + i, 1)
+                    for i in range(4))
+        c1 = transport.FramedConn(fe.addr)
+        ok, r1 = c1.request((FE_BATCH, (ops,)))
+        assert ok and all(r == (OK, "") for r in r1)
+        c1.close()  # reconnect: replay the identical frame
+        c2 = transport.FramedConn(fe.addr)
+        ok, r2 = c2.request((FE_BATCH, (ops,)))
+        assert ok and r2 == r1
+        c2.close()
+        ck = FrontendClerk([fe.addr])
+        assert ck.get("amo") == "v0v1v2v3"  # each op applied ONCE
+        ck.close()
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+def test_event_loop_failover_on_killed_server(tmp_path):
+    """The submit target dying mid-op: _DEAD futures route back into the
+    event loop, which re-submits to the next replica immediately — the
+    client just sees its reply."""
+    fabric, servers, fe = _cluster(tmp_path, op_timeout=20.0)
+    try:
+        ck = FrontendClerk([fe.addr], timeout=30.0)
+        ck.append("ko", "a")
+        servers[fe._leaders[0] % 3].kill()
+        ck.append("ko", "b", timeout=30.0)
+        assert ck.get("ko", timeout=30.0) == "ab"
+        ck.close()
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+def test_event_loop_failover_on_partitioned_leader(tmp_path):
+    """Minority-partitioned submit target: its proposals can't decide,
+    the frame's retry deadline rotates the unresolved ops to a majority
+    replica (same cseq — dup-filtered), no thread sleeping per op."""
+    fabric, servers, fe = _cluster(tmp_path, op_timeout=20.0)
+    try:
+        ck = FrontendClerk([fe.addr], timeout=40.0)
+        ck.append("pf", "1")
+        leader = fe._leaders[0] % 3
+        others = [p for p in range(3) if p != leader]
+        fabric.partition(0, others, [leader])
+        ck.append("pf", "2", timeout=40.0)  # lands via event-loop failover
+        fabric.heal(0)
+        assert ck.get("pf", timeout=40.0) == "12"
+        ck.close()
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+# ------------------------------------------------ jitguard / tpuscope
+
+
+def test_zero_steady_state_recompiles_under_frontend_traffic(tmp_path):
+    """Acceptance: warmed fabric + flowing frontend traffic compiles
+    NOTHING new (the whole batched request path reuses the same compiled
+    variants)."""
+    from tpu6824.analysis.jitguard import RecompileGuard
+
+    fabric, servers, fe = _cluster(tmp_path, ninstances=128)
+    try:
+        st = FrontendStream(fe.addr, conns=2, width=8)
+        st.run_appends(lambda c: "wj", lambda c, i: f"w {c} {i} y",
+                       stop=None, max_per_client=6)  # warm every variant
+        time.sleep(0.5)
+        with RecompileGuard() as g:
+            st2 = FrontendStream(fe.addr, conns=2, width=8)
+            st2.run_appends(lambda c: "wj2", lambda c, i: f"s {c} {i} y",
+                            stop=None, max_per_client=6)
+        assert g.compiles == 0
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+CHAIN = ["clerk.op", "rpc.call", "frontend.submit", "service.submit",
+         "fabric.dispatch", "service.apply", "frontend.reply"]
+
+
+def test_trace_chain_through_frontend(tmp_path):
+    """Acceptance: per-op tpuscope traces still thread clerk→frontend→
+    fabric→apply→reply — one trace_id, spans in parent/child order."""
+    FLIGHT.clear()
+    obs.enable(sample=1.0)
+    fabric, servers, fe = _cluster(tmp_path)
+    try:
+        ck = FrontendClerk([fe.addr])
+        ck.append("tr", "v")
+        ck.close()
+    finally:
+        _teardown(fabric, servers, fe)
+        obs.disable()
+    out = obs.export_trace(str(tmp_path / "fe.json"))
+    FLIGHT.clear()
+    with open(out) as f:
+        evs = json.load(f)["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X" and e["args"].get("trace_id")]
+    roots = [e for e in spans if e["name"] == "clerk.op"]
+    assert roots
+    chained = 0
+    for root in roots:
+        tid = root["args"]["trace_id"]
+        trace = [e for e in spans if e["args"]["trace_id"] == tid]
+        by_id = {e["args"]["span_id"]: e for e in trace}
+        by_name: dict = {}
+        for e in trace:
+            by_name.setdefault(e["name"], []).append(e)
+        if not all(n in by_name for n in CHAIN):
+            continue
+        for reply in by_name["frontend.reply"]:
+            e, good = reply, True
+            for want in ("service.apply", "fabric.dispatch",
+                         "service.submit", "frontend.submit", "rpc.call",
+                         "clerk.op"):
+                parent = by_id.get(e["args"]["parent_id"])
+                if parent is None or parent["name"] != want:
+                    good = False
+                    break
+                e = parent
+            if good and e["args"]["parent_id"] == 0:
+                chained += 1
+                break
+    assert chained, \
+        "no trace chains clerk→rpc→frontend→submit→dispatch→apply→reply"
+
+
+# --------------------------------------------------- nemesis soak
+
+
+def _frontend_nemesis_soak(tmp_path, kernel, seed, duration, nemesis_report):
+    from tpu6824.harness.linearize import History, HistoryClerk, \
+        check_history
+    from tpu6824.harness.nemesis import FabricTarget, FaultSchedule, Nemesis
+
+    fabric = PaxosFabric(ngroups=1, npeers=3, ninstances=64,
+                         auto_step=True, kernel=kernel, io_mode="compact",
+                         pipeline_depth=2)
+    servers = [KVPaxosServer(fabric, 0, p, op_timeout=4.0)
+               for p in range(3)]
+    fe = ClerkFrontend(servers, str(tmp_path / f"nem-{kernel}.sock"),
+                       op_timeout=4.0)
+    fe.set_unreliable(True)  # lossy WIRE: dropped frames force clerk
+    #                          replays — at-most-once under reconnects
+    history = History()
+    try:
+        target = FabricTarget(fabric)
+        sched = FaultSchedule.generate(seed, duration, target.spec())
+        nem = Nemesis(target, sched).start()
+        nemesis_report.attach(nemesis=nem, seed=seed)
+        errs: list = []
+
+        def client(idx):
+            try:
+                ck = HistoryClerk(FrontendClerk([fe.addr], timeout=8.0),
+                                  history)
+                for j in range(6):
+                    ck.append("k", f"x {idx} {j} y", timeout=120.0)
+                    if j % 3 == 2:
+                        ck.get("k", timeout=120.0)
+            except Exception as e:  # pragma: no cover
+                errs.append((idx, e))
+
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=240.0)
+        assert not any(t.is_alive() for t in ts), "client stuck past 240s"
+        nem.join(60.0)
+        assert nem.done
+        assert nem.signature() == sched.signature()
+        assert not errs, errs
+        fe.set_unreliable(False)
+        final = HistoryClerk(FrontendClerk([fe.addr], timeout=30.0),
+                             history)
+        value = final.get("k", timeout=60.0)
+        check_appends(value, 3, 6)
+        res = check_history(history)
+        assert res.ok, res.describe()
+    finally:
+        _teardown(fabric, servers, fe)
+
+
+@pytest.mark.nemesis
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_frontend_nemesis_soak(tmp_path, kernel, nemesis_report):
+    """Acceptance: fixed-seed nemesis (partitions incl. majority-less,
+    kill/revive, clock pauses, pipeline churn) + an UNRELIABLE frontend
+    wire, on both kernel engines; ops stay at-most-once across retries
+    and reconnects and the full history linearizes (Wing–Gong)."""
+    from tpu6824.harness.nemesis import seed_from_env
+
+    _frontend_nemesis_soak(tmp_path, kernel, seed_from_env(8088),
+                           duration=1.5 if kernel == "pallas" else 2.0,
+                           nemesis_report=nemesis_report)
+
+
+# --------------------------------------------------- shardkv reuse
+
+
+def test_shardkv_frontend_reuse(tmp_path):
+    """The same frontend fronts a shardkv group (op_factory=shardkv_op,
+    submit_batch seam + lazy driver): owned keys serve, foreign keys
+    answer ErrWrongGroup so the clerk can re-route."""
+    from tpu6824.ops.hashing import key2shard
+    from tpu6824.services.frontend import shardkv_op
+    from tpu6824.services.shardkv import ShardSystem
+    from tpu6824.utils.errors import ErrWrongGroup
+
+    system = ShardSystem(ngroups=2, nreplicas=3)
+    try:
+        for gid in system.gids:
+            system.join(gid)
+        system.clerk().put("warm", "1")  # wait for config propagation
+        cfg = system.sm_clerk().query(-1)
+        fes = [ClerkFrontend(system.groups[g],
+                             str(tmp_path / f"skv{i}.sock"),
+                             op_factory=shardkv_op)
+               for i, g in enumerate(system.gids)]
+        try:
+            key = "skv-key"
+            own = system.gids.index(cfg.shards[key2shard(key)])
+            ck = FrontendClerk([fes[own].addr])
+            ck.put(key, "A")
+            ck.append(key, "B")
+            assert ck.get(key) == "AB"
+            ck.close()
+            wrong = FrontendClerk([fes[1 - own].addr])
+            err, _ = wrong._call(("get", key, "", wrong.cid, 1))
+            assert err == ErrWrongGroup
+            wrong.close()
+        finally:
+            for fe in fes:
+                fe.kill()
+    finally:
+        system.shutdown()
+
+
+# ------------------------------------------------------- satellites
+
+
+def test_columnar_dups_store():
+    d = ColumnarDups()
+    assert d.seen(1) == -1 and d.get(1) == (-1, None)
+    d[1] = (3, (OK, "a"))
+    assert d.seen(1) == 3 and d.reply(1) == (OK, "a") and 1 in d
+    d.put(1, 5, (OK, "b"))
+    assert d.get(1) == (5, (OK, "b"))
+    d.apply_batch({1: (7, (OK, "c")), 2: (1, (OK, "z"))})
+    assert d.seen(1) == 7 and d.seen(2) == 1 and len(d) == 2
+    assert dict(d.items()) == {1: (7, (OK, "c")), 2: (1, (OK, "z"))}
+    d2 = ColumnarDups(d.to_dict())
+    assert d2.to_dict() == d.to_dict()
+
+
+def test_conn_pool_metrics(tmp_path):
+    """rpc.pool.{hits,misses,evictions}: reuse shows as hits, the first
+    dial as a miss, and a server restart (stale identity) as an
+    eviction — the per-leg tpuscope evidence that frontend connections
+    actually persist."""
+    from tpu6824.obs import metrics as _m
+    from tpu6824.rpc.native_server import make_server
+
+    addr = str(tmp_path / "pool.sock")
+    srv = make_server(addr).register("echo", lambda x: x).start()
+    before = _m.snapshot()["counters"]
+    try:
+        for i in range(3):
+            assert transport.call(addr, "echo", i, pooled=True) == i
+    finally:
+        srv.kill()
+    srv2 = make_server(addr).register("echo", lambda x: x + 1).start()
+    try:
+        assert transport.call(addr, "echo", 1, pooled=True) == 2
+    finally:
+        srv2.kill()
+    after = _m.snapshot()["counters"]
+
+    def delta(name):
+        b = before.get(name, {}).get("total", 0)
+        return after[name]["total"] - b
+
+    assert delta("rpc.pool.misses") >= 2   # first dial + post-restart
+    assert delta("rpc.pool.hits") >= 2     # calls 2..3 reused
+    assert delta("rpc.pool.evictions") >= 1  # stale ident after restart

@@ -77,6 +77,13 @@ RULES: dict[str, str] = {
         "host round-trip per dispatch and breaks the zero-extra-readback "
         "guarantee (the two sanctioned retire-fold sites carry justified "
         "suppressions — that inventory IS the contract)",
+    "blocking-in-eventloop":
+        "sleep / lock-wait / blocking call inside a frontend event-loop "
+        "callback (`_on_*` / `*_cb` in the event-loop scope) — the "
+        "callback runs ON the epoll loop (or the driver's notify sweep), "
+        "so one blocked callback stalls EVERY connection behind it; "
+        "callbacks may only decode, enqueue (deque.append), and wake "
+        "(Event.set) — park the work on the engine thread instead",
     "durable-write-discipline":
         "open(..., 'w'/'wb') + os.rename/os.replace persistence pattern "
         "outside utils/durafs.py — the bare write-then-rename skips the "
@@ -111,6 +118,10 @@ _MET_HOME = "obs/"  # the registry itself may get-or-create anywhere
 # itself (which is also where the disk-fault injector lives).
 _DURAFS_HOME = "utils/durafs.py"
 _RENAME_CALLS = {"os.rename", "os.replace"}
+# Event-loop callback scope (blocking-in-eventloop): the clerk frontend's
+# inline callbacks and the native server's epoll-thread hooks.  Callback
+# convention: `_on_*` / `*_cb` function names inside these modules.
+_EVENTLOOP_SCOPE = ("services/frontend.py", "rpc/native_server.py")
 
 # Receivers that denote the tpuscope metrics registry, and the
 # get-or-create constructors the metric-unregistered rule polices.
@@ -141,6 +152,11 @@ _GLOBAL_RNG = {
     "shuffle", "sample", "getrandbits", "gauss", "betavariate", "expovariate",
 }
 _WALL_CLOCK = {"time.time", "time.time_ns", "datetime.now", "datetime.utcnow"}
+
+# Additional blocking tails for the event-loop rule: a callback must not
+# even WAIT on a lock/event (lock-blocking-call tolerates `with mu` and
+# polices only what runs inside; a loop callback may not pause at all).
+_EVENTLOOP_BLOCK_TAILS = _BLOCKING_TAILS | {"acquire", "wait", "join"}
 
 _SUPPRESS_RE = re.compile(
     r"tpusan:\s*ok\(\s*([\w*,\s-]+?)\s*\)\s*(?:[—–:]|-{1,2})?\s*(.*)")
@@ -245,11 +261,13 @@ class _FileLint(ast.NodeVisitor):
         self.feed_home = _in_scope(relpath, (_FEED_HOME,))
         self.met_home = _in_scope(relpath, (_MET_HOME,))
         self.durafs_home = _in_scope(relpath, (_DURAFS_HOME,))
+        self.eventloop_scope = _in_scope(relpath, _EVENTLOOP_SCOPE)
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
         self._jit_defs = self._resolve_jit_defs()
         self._scan_persistence()
+        self._scan_eventloop_callbacks()
         self._fn_stack: list[ast.AST] = []
         self._calls_subscribe = False
         self._refs_columnar_consumer = False
@@ -376,6 +394,46 @@ class _FileLint(ast.NodeVisitor):
                                    "write-then-rename persistence outside "
                                    "the durafs seam — use "
                                    "durafs.atomic_write()")
+
+    def _scan_eventloop_callbacks(self) -> None:
+        """blocking-in-eventloop: inside an event-loop callback (`_on_*`
+        / `*_cb` in the event-loop scope) flag every blocking call —
+        sleeps, socket/RPC legs, device readbacks, and any lock/event
+        wait (`.acquire`/`.wait`/`.join`, `with <lock>`).  Nested defs
+        are excluded (a closure handed elsewhere runs elsewhere)."""
+        if not self.eventloop_scope:
+            return
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (fn.name.startswith("_on_") or fn.name.endswith("_cb")):
+                continue
+            skip: set[int] = set()
+            for n in ast.walk(fn):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n is not fn:
+                    skip.update(id(m) for m in ast.walk(n))
+            for n in ast.walk(fn):
+                if id(n) in skip:
+                    continue
+                if isinstance(n, ast.Call):
+                    d = _dotted(n.func)
+                    if d is None:
+                        continue
+                    tail = d.rsplit(".", 1)[-1]
+                    if d in _BLOCKING_DOTTED or (
+                            "." in d and tail in _EVENTLOOP_BLOCK_TAILS):
+                        self._flag(n, "blocking-in-eventloop",
+                                   f"{d}() inside event-loop callback "
+                                   f"{fn.name}() — decode/enqueue/wake "
+                                   "only; hand the work to the engine "
+                                   "thread")
+                elif isinstance(n, ast.With):
+                    if any(self._is_lock_expr(item.context_expr)
+                           for item in n.items):
+                        self._flag(n, "blocking-in-eventloop",
+                                   f"lock wait (`with` on a lock) inside "
+                                   f"event-loop callback {fn.name}()")
 
     def _resolve_jit_defs(self) -> set[int]:
         """FunctionDefs that are jit-compiled: decorated with jax.jit /

@@ -404,6 +404,13 @@ class PaxosFabric:
         self._link_dev = None  # device copy; None = stale (net changed)
         self._unreliable = np.zeros((G, P), bool)  # per receiving server
         self._done = np.full((G, P), -1, np.int32)
+        # Lock-free Done staging (done_deferred): RSM drivers write their
+        # watermark here WITHOUT the fabric lock; the clock folds it into
+        # _done/m_done_view/_peer_min under its own lock at the next
+        # staging.  One writer per (g, p) cell (that replica's driver),
+        # GIL-atomic numpy scalar stores, max-monotone — so the fold can
+        # never regress a watermark.
+        self._done_async = np.full((G, P), -1, np.int32)
         self._pmin_i32 = np.empty((G, P), np.int32)  # scratch for min-reduce
 
         # Host mirrors of device outputs (device dtype — int32 — so the
@@ -677,6 +684,7 @@ class PaxosFabric:
         and network snapshot need the lock — callers do the heavy pad/
         dedup work outside it so API threads keep running while a
         dispatch is being staged."""
+        self._fold_done_async_locked()
         starts = self._pending_starts
         resets = self._pending_resets
         self._pending_starts = []
@@ -832,7 +840,7 @@ class PaxosFabric:
                 s_arr is not None or r_arr is not None or int(msgs) > 0
                 or newly > 0
                 or self._live_slots * self.P > self._decided_cells)
-            self._gc_locked()
+            gc_drops = self._gc_locked()
             self._stepped.notify_all()
             self._last_retire_t = time.monotonic()
             self.profiler.add("retire", time.perf_counter_ns() - t_r)
@@ -848,6 +856,7 @@ class PaxosFabric:
                 obs_tracing.batch("fabric.retire.batch", t_r_mono,
                                   steps=self._spd, newly=int(newly),
                                   msgs=int(msgs))
+        self._decref_many(gc_drops)
 
     # ------------------------------------------------- compact step path
 
@@ -1177,7 +1186,7 @@ class PaxosFabric:
             self._last_step_active = (
                 n_inject > 0 or int(msgs) > 0 or newly > 0
                 or self._live_slots * P > self._decided_cells)
-            self._gc_locked()
+            gc_drops = self._gc_locked()
             self._stepped.notify_all()
             self._last_retire_t = time.monotonic()
             self.profiler.add("retire", time.perf_counter_ns() - t_r)
@@ -1187,6 +1196,7 @@ class PaxosFabric:
                 obs_tracing.batch("fabric.retire.batch", t_r_mono,
                                   steps=self._spd, newly=int(newly),
                                   msgs=int(msgs))
+        self._decref_many(gc_drops)
 
     def _step_once_compact(self):
         self._retire_compact(self._launch_compact())
@@ -1239,14 +1249,18 @@ class PaxosFabric:
         # once *every* peer has forgotten it.
         return int(self._peer_min[g].min())
 
-    def _gc_locked(self):
+    def _gc_locked(self) -> list[int]:
         # Vectorized staleness scan: one (G, I) compare against the per-group
         # global min, instead of a Python dict walk per group per step.  The
         # common case (nothing to collect) costs one reduce + one any().
+        # Returns the interned-value ids whose GC refs must be dropped —
+        # the CALLER decrefs them after releasing the fabric lock (each
+        # decref is a store call with its own mutex; at clerk-frontend
+        # load the retire hold must not serialize on them).
         gmin = self._peer_min.min(axis=1)  # (G,)
         stale = (self._slot_seq >= 0) & (self._slot_seq < gmin[:, None])
         if not stale.any():
-            return
+            return []
         gs, slots = np.nonzero(stale)
         seqs = self._slot_seq[gs, slots]
         # Array-side reclamation in bulk; only the dict/freelist/intern
@@ -1259,8 +1273,8 @@ class PaxosFabric:
         self.m_decided[gs, slots, :] = NO_VAL
         self._slot_seq[gs, slots] = -1
         self._pending_resets.extend(zip(gs.tolist(), slots.tolist()))
-        decref = self.intern.decref
         self._live_slots -= len(gs)
+        drops: list[int] = []
         for g, slot, seq in zip(gs.tolist(), slots.tolist(), seqs.tolist()):
             del self._seq2slot[g][seq]
             heapq.heappush(self._free[g], slot)
@@ -1269,12 +1283,9 @@ class PaxosFabric:
                 fv.pop(seq, None)  # decode cache lives per tenancy
             vids = self._slot_vids[g][slot]
             if vids:
-                # tpusan: ok(lock-nested-loop) — bounded by the GC batch's
-                # interned-id count (ints only, no decode); the array-side
-                # reclamation above is the vectorized bulk of the work.
-                for vid in vids:
-                    decref(vid)
+                drops.extend(vids)
                 self._slot_vids[g][slot] = []
+        return drops
 
     # ---------------------------------------------------------------- API
 
@@ -1356,68 +1367,103 @@ class PaxosFabric:
 
         Semantically N scalar start() calls; the body is the same logic with
         the per-op numpy-scalar reads hoisted to plain-int lists (this is
-        the service driver's hottest call).
+        the service driver's hottest call).  Payloads are interned BEFORE
+        the fabric lock is taken: pickle + store call are the loop's
+        dominant per-op cost, and under the lock they serialized every
+        driver behind the clock's retire fold (sampled at ~47% of busy
+        time on the clerk-frontend path); refs taken for ops the locked
+        pass then skips are dropped after release.
 
         NOT atomic: on WindowFullError the prefix ops[:e.index] has been
         applied and the rest dropped — resume the batch from `e.index`
         after GC frees slots (retrying from 0 is safe but re-queues the
         prefix).  The same contract holds for the `fabric_service`
         start_many RPC."""
+        ops = ops if isinstance(ops, list) else list(ops)
+        put = self.intern.put
+        vids_pre = [
+            (IMM_BASE | value)
+            if type(value) is int and 0 <= value < IMM_BASE
+            else put(value)
+            for (_g, _p, _seq, value) in ops
+        ]
+        drop: list[int] = []
         try:
-            self._start_many_locked(ops)
+            self._start_many_locked(ops, vids_pre, drop)
         finally:
+            self._decref_many(drop)
             # Even a WindowFullError mid-batch pended a prefix: wake the
             # idle clock so backpressure-retry loops never pay the idle
             # sleep.
             self._clock_wake.set()
 
-    def _start_many_locked(self, ops) -> None:
-        with self._lock:
-            dead = self._dead.tolist()
-            pmin = self._peer_min.tolist()
-            s2s = self._seq2slot
-            item = self.m_decided.item
-            free = self._free
-            slot_seq = self._slot_seq
-            vids = self._slot_vids
-            put = self.intern.put
-            pend = self._pending_starts.append
-            mx = self._max_seq
-            alloc_t = self._slot_alloc_t
-            now = time.monotonic()  # batch-granular is plenty for health
-            for n, (g, p, seq, value) in enumerate(ops):
-                if seq >= _SEQ_LIMIT:
-                    raise OverflowError(
-                        f"start seq {seq} exceeds int32 "
-                        f"(batch applied up to index {n})")
-                if dead[g][p] or seq < pmin[g][p]:
-                    continue
-                slot = s2s[g].get(seq)
-                if slot is not None:
-                    if item(g, slot, p) >= 0:
-                        continue  # already decided locally
-                else:
-                    fl = free[g]
-                    if not fl:
-                        raise WindowFullError(
-                            f"group {g}: all {self.I} instance slots live; "
-                            f"call Done() to advance Min() "
-                            f"(global_min={self._global_min_locked(g)}); "
-                            f"batch applied up to index {n}",
-                            index=n)
-                    slot = heapq.heappop(fl)
-                    self._live_slots += 1
-                    slot_seq[g, slot] = seq
-                    s2s[g][seq] = slot
-                    alloc_t[g, slot] = now
-                if type(value) is int and 0 <= value < IMM_BASE:
-                    vid = IMM_BASE | value  # immediate (see IMM_BASE)
-                else:
-                    vid = put(value)
-                    vids[g][slot].append(vid)
-                pend((g, slot, p, vid, seq))
-                if seq > mx[g, p]:
-                    mx[g, p] = seq
+    def _decref_many(self, vids) -> None:
+        """Drop a batch of interning refs OUTSIDE the fabric lock — the
+        store has its own mutex (see _gc_locked / start_many)."""
+        if vids:
+            decref = self.intern.decref
+            for vid in vids:
+                decref(vid)
+
+    def _start_many_locked(self, ops, vids_pre, drop) -> None:
+        """The locked half of start_many: slot allocation + staging.
+        `vids_pre[n]` is op n's pre-interned value id (one ref owned by
+        this batch); a skipped or never-reached op's ref is pushed onto
+        `drop` for the caller to release outside the lock."""
+        n = -1
+        try:
+            with self._lock:
+                dead = self._dead.tolist()
+                pmin = self._peer_min.tolist()
+                s2s = self._seq2slot
+                item = self.m_decided.item
+                free = self._free
+                slot_seq = self._slot_seq
+                vids = self._slot_vids
+                pend = self._pending_starts.append
+                mx = self._max_seq
+                alloc_t = self._slot_alloc_t
+                now = time.monotonic()  # batch-granular: plenty for health
+                for n, (g, p, seq, value) in enumerate(ops):
+                    vid = vids_pre[n]
+                    if seq >= _SEQ_LIMIT:
+                        raise OverflowError(
+                            f"start seq {seq} exceeds int32 "
+                            f"(batch applied up to index {n})")
+                    if dead[g][p] or seq < pmin[g][p]:
+                        if vid < IMM_BASE:
+                            drop.append(vid)
+                        continue
+                    slot = s2s[g].get(seq)
+                    if slot is not None:
+                        if item(g, slot, p) >= 0:
+                            if vid < IMM_BASE:
+                                drop.append(vid)
+                            continue  # already decided locally
+                    else:
+                        fl = free[g]
+                        if not fl:
+                            raise WindowFullError(
+                                f"group {g}: all {self.I} instance slots "
+                                f"live; call Done() to advance Min() "
+                                f"(global_min="
+                                f"{self._global_min_locked(g)}); "
+                                f"batch applied up to index {n}",
+                                index=n)
+                        slot = heapq.heappop(fl)
+                        self._live_slots += 1
+                        slot_seq[g, slot] = seq
+                        s2s[g][seq] = slot
+                        alloc_t[g, slot] = now
+                    if vid < IMM_BASE:
+                        vids[g][slot].append(vid)
+                    pend((g, slot, p, vid, seq))
+                    if seq > mx[g, p]:
+                        mx[g, p] = seq
+        except (OverflowError, WindowFullError):
+            # Ops the raise cut off never consumed their pre-taken ref.
+            drop.extend(v for v in vids_pre[max(n, 0):] if v < IMM_BASE)
+            raise
 
     def status_many(self, queries) -> list:
         """Batched Status: `queries` iterates (g, p, seq); returns a
@@ -1650,6 +1696,30 @@ class PaxosFabric:
         with self._lock:
             self._done_locked(g, p, seq)
 
+    def done_deferred(self, g: int, p: int, seq: int) -> None:
+        """Lock-free Done: record the watermark into the async staging
+        array; the clock folds it at its next dispatch staging.  Done is
+        an advisory GC floor, so one dispatch of staleness is always
+        safe — and the caller (a hot RSM driver) never convoys behind a
+        retire fold holding the fabric lock (sampled at ~11% of busy
+        time on the clerk-frontend path before this existed)."""
+        if seq > self._done_async[g, p]:
+            self._done_async[g, p] = seq
+
+    def _fold_done_async_locked(self) -> None:
+        """Fold done_deferred watermarks into _done / own done-view /
+        peer_min — called at dispatch staging, before _done ships to the
+        device for gossip."""
+        pend = self._done_async
+        mask = pend > self._done
+        if not mask.any():
+            return
+        np.maximum(self._done, pend, out=self._done)
+        gs, ps = np.nonzero(mask)
+        self.m_done_view[gs, ps, ps] = np.maximum(
+            self.m_done_view[gs, ps, ps], self._done[gs, ps])
+        self._peer_min[gs, ps] = self.m_done_view[gs, ps].min(axis=1) + 1
+
     def _done_locked(self, g: int, p: int, seq: int) -> None:
         if seq > self._done[g, p]:
             self._done[g, p] = seq
@@ -1814,6 +1884,7 @@ class PaxosFabric:
         with self._lock:
             if self._running:
                 raise RuntimeError("stop_clock() before checkpoint()")
+            self._fold_done_async_locked()  # deferred Done → the snapshot
             state_np = {f: np.array(x)
                         for f, x in zip(self._state._fields, self._state)}
             # Pending window-GC resets are applied INTO the snapshot (their

@@ -83,6 +83,16 @@ class PaxosPeer:
     def done(self, seq: int) -> None:
         self.fabric.done(self.g, self.me, seq)
 
+    def done_deferred(self, seq: int) -> None:
+        """Lock-free Done (fabric.done_deferred): folded by the clock at
+        its next dispatch staging — the hot RSM drivers' variant, so a
+        driver never convoys behind a retire fold holding the fabric
+        lock.  Falls back to the locked path off-fabric."""
+        if not isinstance(self.fabric, PaxosFabric):
+            self.fabric.done(self.g, self.me, seq)
+            return
+        self.fabric.done_deferred(self.g, self.me, seq)
+
     def min(self) -> int:
         return self.fabric.peer_min(self.g, self.me)
 

@@ -101,6 +101,21 @@ METRICS = [
     Metric(("service", "clerk", "value"), 0.45,
            leg_shape=[("service", "clerk", "groups"),
                       ("service", "clerk", "width")]),
+    # Batched frontend leg (ISSUE 8): host-edge noisy like the clerk leg
+    # (the box's effective CPU swings 2-3× run to run — measured during
+    # r08 bring-up), gated on its OWN sweep shape so env-trimmed
+    # contract runs (BENCH_FE_GROUPS=2, 2x32 sweep) skip loudly.  First
+    # recorded artifact (r08) baselines it: r07 has no leg → this entry
+    # reports skipped(no-baseline) once, then gates every round after.
+    Metric(("service", "clerk_frontend", "value"), 0.65,
+           leg_shape=[("service", "clerk_frontend", "groups"),
+                      ("service", "clerk_frontend", "conns"),
+                      ("service", "clerk_frontend", "batch_width")]),
+    Metric(("service", "clerk_frontend", "latency", "p50_ms"), 0.65,
+           higher_is_better=False,
+           leg_shape=[("service", "clerk_frontend", "groups"),
+                      ("service", "clerk_frontend", "conns"),
+                      ("service", "clerk_frontend", "batch_width")]),
     # Host-edge legs: the demonstrated noise floor is −55% (wire
     # −40%/−53%, thread-per-clerk −55% between real artifacts).
     Metric(("wire", "value"), 0.65),

@@ -84,6 +84,10 @@ class NativeServer:
         os.makedirs(os.path.dirname(addr) or ".", exist_ok=True)
         self._lib = lib
         self._handlers: dict[str, callable] = {}
+        # Event-loop handlers (register_inline): run ON the C++ epoll
+        # callback thread, no per-request handler thread, reply deferred
+        # via send_reply() from any thread — the clerk-frontend seam.
+        self._inline: dict[str, callable] = {}
         self._lock = threading.Lock()  # serializes reply vs kill/free
         self._dead = False
         self._srv = None
@@ -106,6 +110,43 @@ class NativeServer:
         for m in transport.exported_methods(obj, methods):
             self._handlers[m] = getattr(obj, m)
         return self
+
+    def register_inline(self, name: str, fn) -> "NativeServer":
+        """Register an EVENT-LOOP handler: `fn(conn_id, args, wctx)` runs
+        inline on the C++ epoll callback thread — no per-request handler
+        thread is spawned, so a frontend multiplexing thousands of
+        connections costs zero threads per request.  The contract is the
+        event-loop discipline (tpusan `blocking-in-eventloop`): the
+        handler must only decode/enqueue/wake — never sleep, wait on a
+        lock, or make a blocking call — and it does NOT return a reply;
+        it (or any other thread) answers later via `send_reply(conn_id,
+        obj)` / `send_close(conn_id)`.  A handler that raises drops the
+        connection (close marker), like an undecodable frame."""
+        self._inline[name] = fn
+        return self
+
+    def send_reply(self, conn_id: int, obj) -> None:
+        """Deferred ok-reply for an inline-handled request: pickles
+        `(True, obj)` and hands it to the epoll loop (eventfd wake) —
+        callable from any thread, non-blocking."""
+        try:
+            raw = pickle.dumps((True, obj), protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:  # noqa: BLE001 — degrade like _serve does
+            raw = pickle.dumps(
+                (False, f"unserializable reply ({e!r:.100})"),
+                protocol=pickle.HIGHEST_PROTOCOL)
+        self._send_reply(conn_id, raw)
+
+    def send_error(self, conn_id: int, msg: str) -> None:
+        """Deferred app-level error reply ((False, msg) — the caller's
+        transport.call raises RPCError(msg))."""
+        self._send_reply(conn_id, pickle.dumps(
+            (False, msg), protocol=pickle.HIGHEST_PROTOCOL))
+
+    def send_close(self, conn_id: int) -> None:
+        """Drop the connection without replying (the RPCError-refusal
+        path of the threaded handlers)."""
+        self._send_reply(conn_id, b"")
 
     def start(self) -> "NativeServer":
         with self._lock:
@@ -177,14 +218,36 @@ class NativeServer:
         # hand off so the loop returns to epoll immediately.  One thread per
         # in-flight request — the Python accept loop's semantics, so N
         # concurrently blocking handlers never starve request N+1.
+        # With inline handlers registered, the frame is decoded HERE and an
+        # inline rpc is served on this thread (decode + enqueue + wake; the
+        # event-loop discipline) — zero handler threads on the batched path.
         payload = ctypes.string_at(data, length)
+        frame = None
+        if self._inline:
+            try:
+                frame = pickle.loads(payload)
+                fn = self._inline.get(frame[0])
+            except Exception:  # undecodable frame: drop (cf. _serve)
+                self._send_reply(conn_id, b"")
+                return
+            if fn is not None:
+                try:
+                    fn(conn_id, frame[1],
+                       frame[2] if len(frame) > 2 else None)
+                except Exception as e:  # noqa: BLE001 — loop must survive
+                    crashsink.record("native-rpc-inline", e, fatal=False)
+                    self._send_reply(conn_id, b"")
+                return
+            # Non-inline rpc on a mixed server: hand the ALREADY-decoded
+            # frame to the worker (never decode twice).
         threading.Thread(
             target=crashsink.guarded(self._serve, "native-rpc-serve"),
-            args=(conn_id, payload), daemon=True).start()
+            args=(conn_id, payload, frame), daemon=True).start()
 
-    def _serve(self, conn_id: int, payload: bytes) -> None:
+    def _serve(self, conn_id: int, payload: bytes, frame=None) -> None:
         try:
-            frame = pickle.loads(payload)
+            if frame is None:
+                frame = pickle.loads(payload)
             # Optional third element: a tpuscope TraceContext from a
             # tracing-enabled peer (transport.call's envelope; untagged
             # 2-tuples are the common wire).

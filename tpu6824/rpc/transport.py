@@ -71,6 +71,13 @@ _M_LAT = _metrics.histogram("rpc.client.latency_us")
 _M_SRV_REQS = _metrics.counter("rpc.server.requests")
 _M_SRV_DROP_REQ = _metrics.counter("rpc.server.dropped_requests")
 _M_SRV_DROP_REP = _metrics.counter("rpc.server.dropped_replies")
+# Connection-pool economics (ISSUE 8 satellite): reuse vs redial vs
+# eviction, so a frontend leg's per-leg tpuscope delta shows whether its
+# connections actually persisted.  Eviction reasons ride the per-key
+# breakdown (stale identity / aged out / liveness fail / cap overflow).
+_M_POOL_HITS = _metrics.counter("rpc.pool.hits")
+_M_POOL_MISSES = _metrics.counter("rpc.pool.misses")
+_M_POOL_EVICT = _metrics.counter("rpc.pool.evictions")
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 << 20
@@ -130,21 +137,26 @@ class _ConnPool:
                 self._fork_guard_locked()
                 entries = self._idle.get(addr)
                 if not entries:
+                    _M_POOL_MISSES.inc()
                     return None, ident
                 sock, sid, t = entries.pop()
                 self._total -= 1
             if sid != ident or now - t > _POOL_MAX_AGE:
                 self._close(sock)
+                _M_POOL_EVICT.inc(
+                    key="stale" if sid != ident else "aged")
                 continue
             try:  # liveness peek: EOF/reset from a dead server shows here
                 sock.setblocking(False)
                 try:
                     if sock.recv(1, socket.MSG_PEEK) == b"":
                         self._close(sock)
+                        _M_POOL_EVICT.inc(key="liveness")
                         continue
                     # Unexpected readable bytes on an idle conn: protocol
                     # desync — never reuse it.
                     self._close(sock)
+                    _M_POOL_EVICT.inc(key="liveness")
                     continue
                 except (BlockingIOError, InterruptedError):
                     pass  # no data, still open: healthy
@@ -152,7 +164,9 @@ class _ConnPool:
                     sock.setblocking(True)
             except OSError:
                 self._close(sock)
+                _M_POOL_EVICT.inc(key="liveness")
                 continue
+            _M_POOL_HITS.inc()
             return sock, ident
 
     def give(self, addr: str, sock, ident) -> None:
@@ -162,6 +176,7 @@ class _ConnPool:
             entries = self._idle.setdefault(addr, [])
             if len(entries) >= _POOL_MAX_IDLE:
                 self._close(sock)
+                _M_POOL_EVICT.inc(key="cap")
                 return
             entries.append((sock, ident, time.monotonic()))
             self._total += 1
@@ -195,6 +210,8 @@ class _ConnPool:
                             del self._idle[a]
                         evicted.append(e[0])
                         self._total -= 1
+        if evicted:
+            _M_POOL_EVICT.inc(len(evicted), key="cap")
         for s in evicted:
             self._close(s)
 
@@ -248,6 +265,91 @@ def _recv_frame(sock: socket.socket):
         return pickle.loads(data)
     except Exception as e:  # corrupt frame or a non-round-trippable payload
         raise RPCError(f"undecodable frame: {e!r}") from e
+
+
+class FramedConn:
+    """One persistent framed connection with BUFFERED, batched reads —
+    the client leg of the clerk-frontend protocol (services/frontend.py).
+
+    `transport.call` pays two recv() syscalls per reply (length, then
+    payload) and re-enters the pool per request; a frontend clerk keeps
+    one of these per connection instead: `send()` writes a frame,
+    `recv()` decodes the next frame out of a rolling buffer that is
+    refilled 64KB at a time — so a burst of replies (or one multi-op
+    reply riding with the next) costs one syscall, not two per frame.
+    Single-threaded per instance (one event-loop/driver owns it); any
+    IO failure raises RPCError and the connection is garbage — redial,
+    exactly the transport contract (the op may or may not have run)."""
+
+    __slots__ = ("addr", "sock", "_buf")
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self.addr = addr
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            self.sock.settimeout(timeout)
+            self.sock.connect(addr)
+        except OSError as e:
+            self._close_sock()
+            raise RPCError(f"dial {addr}: {e}") from e
+        self._buf = bytearray()
+
+    def fileno(self) -> int:
+        return self.sock.fileno()
+
+    def settimeout(self, t: float | None) -> None:
+        self.sock.settimeout(t)
+
+    def send(self, obj) -> None:
+        try:
+            _send_frame(self.sock, obj)
+        except OSError as e:
+            raise RPCError(f"send {self.addr}: {e}") from e
+
+    def _pop_frame(self):
+        """Decode one frame from the buffer, or None if incomplete."""
+        buf = self._buf
+        if len(buf) < _LEN.size:
+            return None
+        (n,) = _LEN.unpack_from(buf)
+        if n > _MAX_FRAME:
+            raise RPCError(f"frame too large: {n}")
+        if len(buf) < _LEN.size + n:
+            return None
+        data = bytes(buf[_LEN.size:_LEN.size + n])
+        del buf[:_LEN.size + n]
+        try:
+            return (pickle.loads(data),)
+        except Exception as e:
+            raise RPCError(f"undecodable frame: {e!r}") from e
+
+    def recv(self):
+        """Next reply frame (blocking up to the socket timeout)."""
+        while True:
+            got = self._pop_frame()
+            if got is not None:
+                return got[0]
+            try:
+                chunk = self.sock.recv(65536)
+            except OSError as e:
+                raise RPCError(f"recv {self.addr}: {e}") from e
+            if not chunk:
+                raise RPCError("connection closed mid-frame")
+            self._buf += chunk
+
+    def request(self, obj):
+        """send + recv: one frame round-trip."""
+        self.send(obj)
+        return self.recv()
+
+    def close(self) -> None:
+        self._close_sock()
+
+    def _close_sock(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
 
 def call(addr: str, rpcname: str, *args, timeout: float = 10.0,

@@ -14,6 +14,7 @@ import os
 import random
 import threading
 import time
+from array import array
 
 from tpu6824.obs import metrics as _metrics
 from tpu6824.obs import tracing as _tracing
@@ -88,6 +89,99 @@ class Backoff:
 
     def reset(self) -> None:
         self._sleep = self.base
+
+
+class ColumnarDups:
+    """Array-backed at-most-once duplicate store: cid → (max cseq, reply).
+
+    The per-client dup filter is the hottest host-side state on the
+    request path — every submit checks it and every applied op updates
+    it.  The dict-of-tuples version allocates a fresh `(cseq, reply)`
+    tuple per update and per miss-default; this store keeps one slot
+    per client with the cseq column in a C int64 array and the reply
+    refs in a parallel list, so the apply batch updates cells in place
+    (zero allocation for a returning client) and the submit-side check
+    is a dict probe + array read.
+
+    `apply_batch` is the once-per-drain columnar update path: the apply
+    loop collects its (cid → cseq, reply) writes in a plain dict (which
+    also gives intra-batch read-your-writes via `pend`) and this folds
+    them into the columns in one pass — one slot lookup per unique
+    client per drain instead of one per op.
+
+    NOT thread-safe: callers hold the server mutex, exactly as they did
+    for the dict it replaces."""
+
+    __slots__ = ("_slot", "_cseqs", "_replies")
+
+    def __init__(self, items=()):
+        self._slot: dict[object, int] = {}
+        self._cseqs = array("q")
+        self._replies: list[object] = []
+        for cid, (cseq, reply) in dict(items).items():
+            self.put(cid, cseq, reply)
+
+    def __len__(self) -> int:
+        return len(self._slot)
+
+    def __contains__(self, cid) -> bool:
+        return cid in self._slot
+
+    def seen(self, cid) -> int:
+        """Highest applied cseq for `cid` (-1 for a new client) — the
+        submit-side dedup probe, tuple-free."""
+        i = self._slot.get(cid)
+        return -1 if i is None else self._cseqs[i]
+
+    def get(self, cid, default=(-1, None)):
+        """Dict-compatible read: (max cseq, reply) or `default`."""
+        i = self._slot.get(cid)
+        if i is None:
+            return default
+        return (self._cseqs[i], self._replies[i])
+
+    def reply(self, cid):
+        """The cached reply ref for `cid` (caller checked `seen`)."""
+        return self._replies[self._slot[cid]]
+
+    def put(self, cid, cseq, reply) -> None:
+        i = self._slot.get(cid)
+        if i is None:
+            self._slot[cid] = len(self._cseqs)
+            self._cseqs.append(cseq)
+            self._replies.append(reply)
+        else:
+            self._cseqs[i] = cseq
+            self._replies[i] = reply
+
+    def __setitem__(self, cid, pair) -> None:
+        self.put(cid, pair[0], pair[1])
+
+    def apply_batch(self, pend: dict) -> None:
+        """Fold a drain's collected (cid → (cseq, reply)) writes into the
+        columns — the once-per-drain batch update."""
+        slot_get = self._slot.get
+        cseqs = self._cseqs
+        replies = self._replies
+        for cid, (cseq, reply) in pend.items():
+            i = slot_get(cid)
+            if i is None:
+                self._slot[cid] = len(cseqs)
+                cseqs.append(cseq)
+                replies.append(reply)
+            else:
+                cseqs[i] = cseq
+                replies[i] = reply
+
+    def items(self):
+        cseqs = self._cseqs
+        replies = self._replies
+        for cid, i in self._slot.items():
+            yield cid, (cseqs[i], replies[i])
+
+    def to_dict(self) -> dict:
+        """Plain-dict snapshot (persistence / shard-transfer interop)."""
+        return dict(self.items())
 
 
 def fresh_cid() -> int:

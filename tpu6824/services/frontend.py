@@ -1,0 +1,850 @@
+"""Columnar event-loop clerk frontend — the batched request path (ROADMAP
+item 1, the "millions of users" bet).
+
+The published clerk leg topped out around the host's thread-per-clerk
+ceiling (BENCH_r05/r07 `service.clerk.phases`: fabric idle, Python
+burning the core, clerk p50 421ms) — the same diagnosis *Network
+Hardware-Accelerated Consensus* and *Paxos Made Switch-y* make for
+host-bound consensus message handling: per-connection request paths do
+not amortize, batched dataplanes do.  This module is that dataplane for
+the clerk leg:
+
+  - `ClerkFrontend` fronts one replica group on a Unix socket served by
+    the NATIVE EPOLL LOOP (`rpc/native_server.py`): requests are decoded
+    inline on the loop's callback thread (`register_inline` — zero
+    handler threads per request) and enqueued; replies are deferred and
+    re-enter the loop via eventfd from the frontend's engine thread.
+  - The wire grows a MULTI-OP frame (`fe_batch`: many clerk ops per
+    frame); classic single-op frames (`get`/`put_append`) keep working —
+    both interop in a mixed fleet, in both directions, including the
+    optional trace-context frame element (PR 5).
+  - One engine thread drains everything queued since its last pass into
+    ONE `KVPaxosServer.submit_batch` call — one columnar propose batch
+    per fabric tick — and the group-commit driver resolves the futures
+    in its existing one-sweep retire notify, which lands them right back
+    here through the future `sink` hook (no per-op waiter thread,
+    anywhere).
+  - Clerk retry/backoff state lives IN the event loop: per-frame retry
+    deadlines rotate unresolved ops across replicas with growing
+    intervals — no thread ever sleeps on behalf of an op.
+
+Event-loop discipline (tpusan `blocking-in-eventloop`): every `_on_*`
+callback in this module only decodes/enqueues/wakes — no sleeps, no
+lock waits, no blocking calls.  The engine thread MAY block briefly
+(submit_batch takes the server mutex): that is the batching handoff,
+one acquisition per pass, not per op.
+
+Scale shape: ops/s grows with connection count × batch width, not
+thread count — the frontend adds THREE threads total (epoll loop,
+engine, and the server's reply path is the loop itself) no matter how
+many clerks connect.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import tracing as _tracing
+from tpu6824.rpc import transport
+from tpu6824.rpc.native_server import NativeServer, make_server
+from tpu6824.services.common import Backoff, fresh_cid
+from tpu6824.services.kvpaxos import _DEAD, Op
+from tpu6824.utils import crashsink
+from tpu6824.utils.errors import OK, RPCError
+
+# The multi-op frame's rpc name.  An old server answers it with
+# (False, "no such rpc: fe_batch") → RPCError at the client → the clerk
+# falls back to single-op frames (mixed-fleet interop, new→old).
+FE_BATCH = "fe_batch"
+
+# Knobs (TUNING round 13): the frontend's per-op budget (retry deadlines
+# and the hard frame timeout derive from it) and the stream clerk's
+# default wire-pipelining depth (cohorts per connection).
+OP_TIMEOUT = float(os.environ.get("TPU6824_FRONTEND_OP_TIMEOUT", 8.0))
+STREAM_DEPTH = int(os.environ.get("TPU6824_FRONTEND_DEPTH", 2))
+
+# tpuscope metrics (module scope per the metric-unregistered rule).
+_M_FRAMES = _metrics.counter("frontend.frames")
+_M_OPS = _metrics.counter("frontend.ops")
+_M_WIDTH = _metrics.histogram("frontend.frame_width")
+_M_SUBMIT = _metrics.histogram("frontend.submit_ops")  # columnar batch size
+_M_RETRIES = _metrics.counter("frontend.retries")
+_M_TIMEOUTS = _metrics.counter("frontend.timeouts")
+
+_UNSET = object()  # reply slot not yet resolved
+
+
+def _kv_op(kind, key, value, cid, cseq, tc):
+    """Default op factory: the kvpaxos log entry."""
+    return Op(kind, key, value, cid, cseq, tc)
+
+
+class _Frame:
+    """One in-flight request frame: conn + per-op reply slots + the
+    event-loop retry state that replaces per-thread clerk sleeps."""
+
+    __slots__ = ("conn_id", "single", "ops", "gids", "futs", "replies",
+                 "remaining", "deadline", "retry_at", "interval", "srv",
+                 "last_remaining")
+
+    def __init__(self, conn_id, single, nops, now, op_timeout):
+        self.conn_id = conn_id
+        self.single = single
+        self.ops = None
+        self.gids = None            # per-slot target group index
+        self.futs = [None] * nops
+        self.replies = [_UNSET] * nops
+        self.remaining = nops
+        self.deadline = now + op_timeout
+        # First failover attempt after a good slice of the op budget
+        # (the pipelined clerk waits the WHOLE budget before failing
+        # over); under deep in-flight load a frame legitimately takes a
+        # few dispatch periods, and an eager retry re-proposes its ops
+        # on another replica — a self-amplifying storm.  The interval
+        # then doubles, capped at half the budget — the clerk Backoff
+        # curve, expressed as event-loop deadlines instead of sleeps.
+        self.interval = max(1.0, op_timeout / 4.0)
+        self.retry_at = now + self.interval
+        self.srv = {}               # gid → replica idx last submitted to
+        self.last_remaining = nops
+
+
+class ClerkFrontend:
+    """Batched event-loop frontend over one or many replica groups.
+
+    `servers` is a single group's replica list (objects with the
+    `submit_batch(ops, sink=)`/`abandon` seam — KVPaxosServer, or
+    ShardKVServer via `op_factory=shardkv_op`), or — with `route` given
+    — `groups` is a list of such replica lists and `route(key)` picks
+    the group index per op, so ONE frontend (one socket, one engine
+    thread) fronts a whole fleet of groups: every engine pass becomes
+    one columnar submit_batch per group per fabric tick, and the thread
+    count stays constant no matter how many groups or connections ride
+    it.  Per group, all ops of a pass go to one leader replica;
+    unresolved ops rotate to the next replica on event-loop retry
+    deadlines."""
+
+    def __init__(self, servers=None, addr: str = "", *,
+                 op_timeout: float = OP_TIMEOUT, seed: int | None = None,
+                 prefer_native: bool = True, op_factory=_kv_op,
+                 groups=None, route=None):
+        if groups is None:
+            groups = [list(servers)]
+        self.groups = [list(g) for g in groups]
+        self._route = route if route is not None else (lambda key: 0)
+        self._leaders = [0] * len(self.groups)
+        self.addr = addr
+        self.op_timeout = op_timeout
+        self.op_factory = op_factory
+        self._pending: deque = deque()   # (conn_id, ops_wire, wctx, single)
+        self._doneq: deque = deque()     # resolved futures (sink hook)
+        self._wake = threading.Event()
+        self._dead = False
+        srv = make_server(addr, seed=seed, prefer_native=prefer_native)
+        self._srv = srv
+        self.deferred = isinstance(srv, NativeServer)
+        if self.deferred:
+            srv.register_inline(FE_BATCH, self._on_batch)
+            srv.register_inline("get", self._on_get)
+            srv.register_inline("put_append", self._on_put_append)
+        else:
+            # Python accept-loop fallback (no C++ toolchain): blocking
+            # handlers, one thread per CONNECTION — the batch still
+            # amortizes per-frame, only the thread economics degrade.
+            srv.register(FE_BATCH, self._fe_batch_blocking)
+            srv.register("get", self._get_blocking)
+            srv.register("put_append", self._put_append_blocking)
+        srv.start()
+        self._engine = None
+        if self.deferred:
+            self._engine = threading.Thread(
+                target=crashsink.guarded(self._engine_loop,
+                                         "frontend-engine"),
+                daemon=True)
+            self._engine.start()
+
+    # ------------------------------------------------ event-loop callbacks
+    # tpusan blocking-in-eventloop scope: decode + enqueue + wake ONLY.
+
+    def _on_batch(self, conn_id, args, wctx) -> None:
+        self._pending.append((conn_id, args[0], wctx, False))
+        if not self._wake.is_set():
+            self._wake.set()
+
+    def _on_get(self, conn_id, args, wctx) -> None:
+        key, cid, cseq = args
+        self._pending.append(
+            (conn_id, (("get", key, "", cid, cseq),), wctx, True))
+        if not self._wake.is_set():
+            self._wake.set()
+
+    def _on_put_append(self, conn_id, args, wctx) -> None:
+        kind, key, value, cid, cseq = args
+        self._pending.append(
+            (conn_id, ((kind, key, value, cid, cseq),), wctx, True))
+        if not self._wake.is_set():
+            self._wake.set()
+
+    def _on_fut_done(self, fut) -> None:
+        # The future sink: runs on the group-commit driver's notify
+        # sweep, under the server mutex — O(1), no locks, no blocking.
+        # The is_set guard matters: a notify sweep delivers THOUSANDS of
+        # futures back-to-back, and Event.set() takes the event's
+        # condition lock every call — sampled at 14% of busy time before
+        # the guard; is_set() is a lock-free flag read.
+        self._doneq.append(fut)
+        wake = self._wake
+        if not wake.is_set():
+            wake.set()
+
+    # ------------------------------------------------------------- engine
+
+    def _make_op(self, t, wctx):
+        """Wire op tuple → log entry, trace-stamped when the op (len-6
+        tuple tail) or the frame (wire envelope) carries a context."""
+        kind, key, value, cid, cseq = t[:5]
+        tc = None
+        if _tracing.enabled():
+            ptc = t[5] if len(t) > 5 else wctx
+            if ptc is not None:
+                sp = _tracing.child("frontend.submit",
+                                    parent=_tracing.TraceContext(*ptc),
+                                    comp="frontend", key=key)
+                if sp is not None:
+                    tc = (sp.trace_id, sp.span_id)
+                    sp.end()
+        return self.op_factory(kind, key, value, cid, cseq, tc)
+
+    def _submit(self, ops, owners, gids, futmap) -> None:
+        """This pass's ops, ONE columnar submit_batch per target group
+        (to that group's leader replica; rotates on a refused/dead
+        replica — with every replica refusing, the frames' retry
+        deadlines take over)."""
+        if len(self.groups) == 1:
+            by_group = {0: range(len(ops))}
+        else:
+            by_group = {}
+            for i, gid in enumerate(gids):
+                by_group.setdefault(gid, []).append(i)
+        for gid, idxs in by_group.items():
+            gops = ops if len(self.groups) == 1 \
+                else [ops[i] for i in idxs]
+            servers = self.groups[gid]
+            nsrv = len(servers)
+            futs = None
+            for _ in range(nsrv):
+                srv = servers[self._leaders[gid] % nsrv]
+                try:
+                    futs = srv.submit_batch(gops, sink=self._on_fut_done)
+                    break
+                except RPCError:
+                    self._leaders[gid] += 1
+            now = None
+            if futs is None:
+                now = time.monotonic()  # group dead right now: retry soon
+            _M_SUBMIT.observe(len(gops))
+            for i, j in enumerate(idxs):
+                fr, slot = owners[j]
+                if futs is None:
+                    fr.retry_at = min(fr.retry_at, now + 0.05)
+                    continue
+                fut = futs[i]
+                fr.futs[slot] = fut
+                fr.srv[gid] = self._leaders[gid]
+                futmap.setdefault(id(fut), []).append((fr, slot))
+
+    def _complete(self, fr, slot, fut, live, futmap) -> None:
+        if fr.replies[slot] is not _UNSET:
+            return  # late resolution of a slot a retry already answered
+        v = fut.value
+        if v is _DEAD:
+            # Server killed under us: fail over NOW — and sync
+            # last_remaining so a sibling slot resolving in the same
+            # pass cannot flip the retry pass into its "actively
+            # resolving, re-arm" branch and defer this rotation a
+            # whole backoff interval.
+            fr.retry_at = 0.0
+            fr.last_remaining = fr.remaining
+            return
+        fr.replies[slot] = v
+        fr.remaining -= 1
+        if fut.tctx is not None:
+            sp = _tracing.child("frontend.reply", parent=fut.tctx,
+                                comp="frontend")
+            if sp is not None:
+                sp.end()
+        if fr.remaining == 0:
+            self._finish(fr, live, futmap)
+
+    def _finish(self, fr, live, futmap) -> None:
+        live.pop(id(fr), None)
+        for fut in fr.futs:
+            self._unlink(futmap, fut, fr)
+        payload = fr.replies[0] if fr.single else tuple(fr.replies)
+        self._srv.send_reply(fr.conn_id, payload)
+        _M_OPS.inc(len(fr.replies))
+
+    @staticmethod
+    def _unlink(futmap, fut, fr) -> None:
+        """Remove `fr`'s ownership entries for `fut` from the fut→slots
+        map (leaving other frames' entries on a shared future intact)."""
+        if fut is None:
+            return
+        ent = futmap.get(id(fut))
+        if ent is not None:
+            ent[:] = [p for p in ent if p[0] is not fr]
+            if not ent:
+                del futmap[id(fut)]
+
+    def _abandon(self, fr, slot) -> None:
+        """Stop the slot's last submit target re-proposing it."""
+        gid = fr.gids[slot]
+        servers = self.groups[gid]
+        srv = servers[fr.srv.get(gid, 0) % len(servers)]
+        op = fr.ops[slot]
+        try:
+            srv.abandon(op.cid, op.cseq)
+        except RPCError:
+            pass
+
+    def _drop_frame(self, fr, live, futmap, msg) -> None:
+        live.pop(id(fr), None)
+        for slot, fut in enumerate(fr.futs):
+            if fut is None:
+                continue
+            self._unlink(futmap, fut, fr)
+            if fr.replies[slot] is _UNSET:
+                self._abandon(fr, slot)
+        self._srv.send_error(fr.conn_id, msg)
+        _M_TIMEOUTS.inc()
+
+    def _retry_frame(self, fr, now, futmap) -> None:
+        """Event-loop failover: abandon this frame's unresolved ops on
+        the replica they were submitted to and re-submit them to the
+        next one (same cid/cseq — the dup filter keeps retries
+        at-most-once).  The retry interval doubles toward half the op
+        budget."""
+        ops, owners, gids = [], [], []
+        for slot, op in enumerate(fr.ops):
+            if fr.replies[slot] is _UNSET:
+                self._unlink(futmap, fr.futs[slot], fr)
+                self._abandon(fr, slot)
+                ops.append(op)
+                owners.append((fr, slot))
+                gids.append(fr.gids[slot])
+        if not ops:
+            return
+        _M_RETRIES.inc(len(ops))
+        for gid in set(gids):  # rotate each involved group's leader
+            self._leaders[gid] = fr.srv.get(gid, self._leaders[gid]) + 1
+        fr.interval = min(fr.interval * 2.0, self.op_timeout / 2.0)
+        fr.retry_at = now + fr.interval
+        self._submit(ops, owners, gids, futmap)
+
+    def _engine_loop(self) -> None:
+        live: dict[int, _Frame] = {}
+        futmap: dict[int, list] = {}
+        pending = self._pending
+        doneq = self._doneq
+        wake = self._wake
+        while True:
+            wake.wait(0.05 if live else None)
+            wake.clear()
+            if self._dead:
+                for fr in list(live.values()):
+                    self._drop_frame(fr, live, futmap, "frontend killed")
+                return
+            now = time.monotonic()
+            # ---- ingest: everything queued since the last pass becomes
+            # ONE columnar submit_batch (one lock acquisition, one
+            # consecutive seq block in the group-commit driver).
+            if pending:
+                batch_ops, owners, gids = [], [], []
+                route = self._route
+                multi = len(self.groups) > 1
+                ngroups = len(self.groups)
+                while True:
+                    try:
+                        conn_id, ops_wire, wctx, single = pending.popleft()
+                    except IndexError:
+                        break
+                    # EVERYTHING frame-derived stays inside the guard: a
+                    # malformed payload (ops_wire not a sequence, bad op
+                    # tuples, an out-of-range route result) must answer
+                    # with an error, never kill the engine thread.
+                    try:
+                        nops = len(ops_wire)
+                        if not single and nops == 0:
+                            # Degenerate empty batch: answer now — a
+                            # frame with no ops would otherwise park in
+                            # `live` forever (nothing ever resolves it)
+                            # and desync the connection's reply FIFO.
+                            self._srv.send_reply(conn_id, ())
+                            continue
+                        fr = _Frame(conn_id, single, nops, now,
+                                    self.op_timeout)
+                        fr.ops = [self._make_op(t, wctx) for t in ops_wire]
+                        if multi:
+                            fr.gids = [route(op.key) for op in fr.ops]
+                            for gid in fr.gids:
+                                if not 0 <= gid < ngroups:
+                                    raise ValueError(
+                                        f"route() -> {gid} outside "
+                                        f"[0, {ngroups})")
+                        else:
+                            fr.gids = [0] * nops
+                    except Exception as e:  # noqa: BLE001 — bad frame ≠ dead loop
+                        self._srv.send_error(
+                            conn_id,
+                            f"frontend: undecodable op tuple ({e!r:.100})")
+                        continue
+                    _M_FRAMES.inc()
+                    _M_WIDTH.observe(len(ops_wire))
+                    live[id(fr)] = fr
+                    for i, op in enumerate(fr.ops):
+                        batch_ops.append(op)
+                        owners.append((fr, i))
+                        gids.append(fr.gids[i])
+                if batch_ops:
+                    self._submit(batch_ops, owners, gids, futmap)
+            # ---- completions: the driver's one-sweep notify delivered
+            # futures into the done queue via the sink hook.
+            while True:
+                try:
+                    fut = doneq.popleft()
+                except IndexError:
+                    break
+                for fr, slot in futmap.pop(id(fut), ()):
+                    self._complete(fr, slot, fut, live, futmap)
+            # ---- retry/timeout pass (event-loop backoff, no sleeps)
+            if live:
+                now = time.monotonic()
+                for fr in list(live.values()):
+                    if not fr.remaining or now < fr.retry_at:
+                        continue
+                    if now >= fr.deadline:
+                        self._drop_frame(fr, live, futmap,
+                                         "frontend: op timeout "
+                                         "(no majority?)")
+                    elif fr.retry_at > 0.0 \
+                            and fr.remaining < fr.last_remaining:
+                        # The frame is actively resolving — under load a
+                        # wide frame legitimately drains over several
+                        # dispatches; failing over mid-drain would
+                        # re-propose its tail for nothing.  retry_at ==
+                        # 0.0 is the _DEAD override: a slot KNOWN to sit
+                        # on a killed server rotates now, regardless of
+                        # sibling progress in the same pass.
+                        fr.last_remaining = fr.remaining
+                        fr.retry_at = now + fr.interval
+                    else:
+                        fr.last_remaining = fr.remaining
+                        self._retry_frame(fr, now, futmap)
+
+    # ------------------------------------------- blocking fallback path
+
+    def _serve_blocking(self, ops_wire, single):
+        """transport.Server fallback: same wire semantics, thread-per-
+        connection economics.  The whole frame is still ONE submit_batch
+        per group; unresolved ops fail over across replicas within the
+        op budget."""
+        ops = [self._make_op(t, None) for t in ops_wire]
+        multi = len(self.groups) > 1
+        gids = [self._route(op.key) for op in ops] if multi \
+            else [0] * len(ops)
+        deadline = time.monotonic() + self.op_timeout
+        replies = [_UNSET] * len(ops)
+        todo = list(range(len(ops)))
+        bo = Backoff()
+        while todo:
+            for gid in {gids[i] for i in todo}:
+                idxs = [i for i in todo if gids[i] == gid]
+                servers = self.groups[gid]
+                nsrv = len(servers)
+                futs = srv = None
+                for _ in range(nsrv):
+                    srv = servers[self._leaders[gid] % nsrv]
+                    try:
+                        futs = srv.submit_batch([ops[i] for i in idxs])
+                        break
+                    except RPCError:
+                        self._leaders[gid] += 1
+                if futs is None:
+                    continue
+                for i, fut in zip(idxs, futs):
+                    if fut.wait(max(0.0, deadline - time.monotonic())) \
+                            and fut.value is not _DEAD:
+                        replies[i] = fut.value
+                        todo.remove(i)
+                    else:
+                        try:
+                            srv.abandon(ops[i].cid, ops[i].cseq)
+                        except RPCError:
+                            pass
+            if todo:
+                now = time.monotonic()
+                if now >= deadline:
+                    raise RPCError("frontend: op timeout (no majority?)")
+                for gid in {gids[i] for i in todo}:
+                    self._leaders[gid] += 1
+                bo.sleep(deadline - now)
+        return replies[0] if single else tuple(replies)
+
+    def _fe_batch_blocking(self, ops):
+        return self._serve_blocking(ops, single=False)
+
+    def _get_blocking(self, key, cid, cseq):
+        return self._serve_blocking((("get", key, "", cid, cseq),),
+                                    single=True)
+
+    def _put_append_blocking(self, kind, key, value, cid, cseq):
+        return self._serve_blocking(((kind, key, value, cid, cseq),),
+                                    single=True)
+
+    # --------------------------------------------------------- lifecycle
+
+    @property
+    def rpc_count(self) -> int:
+        return self._srv.rpc_count
+
+    def set_unreliable(self, flag: bool) -> None:
+        self._srv.set_unreliable(flag)
+
+    def deafen(self) -> None:
+        self._srv.deafen()
+
+    def undeafen(self) -> None:
+        self._srv.undeafen()
+
+    def kill(self) -> None:
+        self._dead = True
+        self._wake.set()
+        self._srv.kill()
+        if self._engine is not None:
+            self._engine.join(timeout=5.0)
+
+
+def shardkv_op(kind, key, value, cid, cseq, tc):
+    """Op factory reusing the frontend per shardkv group (extra=None on
+    client ops; the reconf path never travels this wire)."""
+    from tpu6824.services.shardkv import Op as SOp
+
+    return SOp(kind, key, value, cid, cseq, None, tc)
+
+
+# ---------------------------------------------------------------------------
+# Client side
+
+
+class FrontendClerk:
+    """Blocking single-client clerk over the frontend wire — the
+    reference clerk surface (get/put/append, at-most-once via cid/cseq),
+    for harness/history use.  `addrs` lists the frontends (or plain
+    kvpaxos endpoints) to rotate across; a peer that does not speak
+    `fe_batch` is detected once ("no such rpc") and served single-op
+    frames from then on — old↔new interop in one clerk."""
+
+    def __init__(self, addrs, timeout: float = 10.0):
+        self.addrs = list(addrs)
+        self.timeout = timeout
+        self.cid = fresh_cid()
+        self.cseq = 0
+        self._conn: transport.FramedConn | None = None
+        self._conn_addr = None
+        self._legacy: set[str] = set()  # addrs that refused fe_batch
+        self._backoff = Backoff()
+        self._i = 0
+
+    def _connect(self, addr):
+        if self._conn is not None and self._conn_addr == addr:
+            return self._conn
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+        self._conn = transport.FramedConn(addr, timeout=self.timeout)
+        self._conn_addr = addr
+        return self._conn
+
+    def _teardown(self):
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+            self._conn_addr = None
+
+    def _request(self, addr, frame):
+        conn = self._connect(addr)
+        try:
+            ok, payload = conn.request(frame)
+        except RPCError:
+            self._teardown()
+            raise
+        if ok:
+            return payload
+        if isinstance(payload, BaseException):
+            raise payload
+        raise RPCError(f"{addr}: {payload}")
+
+    def _call(self, op_tuple, timeout=None):
+        """One logical op: send (retrying across addrs/reconnects with
+        the SAME cseq — at-most-once rests on the server dup filter)."""
+        deadline = time.monotonic() + timeout if timeout else None
+        self._backoff.reset()
+        sp = _tracing.span("clerk.op", comp="clerk", op=op_tuple[0],
+                           key=op_tuple[1]) if _tracing.enabled() else None
+        try:
+            while True:
+                addr = self.addrs[self._i % len(self.addrs)]
+                try:
+                    if addr in self._legacy:
+                        return self._single_op(addr, op_tuple, sp)
+                    frame = (FE_BATCH, ((op_tuple,),))
+                    if sp is not None:
+                        rsp = _tracing.child("rpc.call", parent=sp.ctx,
+                                             comp="rpc")
+                        frame = frame + ((rsp.trace_id, rsp.span_id),) \
+                            if rsp is not None else frame
+                        try:
+                            replies = self._request(addr, frame)
+                        finally:
+                            if rsp is not None:
+                                rsp.end()
+                    else:
+                        replies = self._request(addr, frame)
+                    return replies[0]
+                except RPCError as e:
+                    if "no such rpc" in str(e):
+                        self._legacy.add(addr)
+                        continue  # same addr, classic frames
+                    self._i += 1
+                now = time.monotonic()
+                if deadline and now >= deadline:
+                    raise RPCError("clerk timeout")
+                self._backoff.sleep(deadline - now if deadline else None)
+        finally:
+            if sp is not None:
+                sp.end()
+
+    def _single_op(self, addr, t, sp):
+        """Classic single-op frame against a legacy (pre-frontend)
+        endpoint — new clerk → old server interop."""
+        kind, key, value, cid, cseq = t
+        if kind == "get":
+            frame = ("get", (key, cid, cseq))
+        else:
+            frame = ("put_append", (kind, key, value, cid, cseq))
+        if sp is not None:
+            rsp = _tracing.child("rpc.call", parent=sp.ctx, comp="rpc")
+            if rsp is not None:
+                frame = frame + ((rsp.trace_id, rsp.span_id),)
+            try:
+                return self._request(addr, frame)
+            finally:
+                if rsp is not None:
+                    rsp.end()
+        return self._request(addr, frame)
+
+    def _next(self) -> int:
+        self.cseq += 1
+        return self.cseq
+
+    def get(self, key: str, timeout=None) -> str:
+        err, val = self._call(("get", key, "", self.cid, self._next()),
+                              timeout=timeout)
+        return val if err == OK else ""
+
+    def put(self, key: str, value: str, timeout=None):
+        return self._call(("put", key, value, self.cid, self._next()),
+                          timeout=timeout)
+
+    def append(self, key: str, value: str, timeout=None):
+        return self._call(("append", key, value, self.cid, self._next()),
+                          timeout=timeout)
+
+    def close(self) -> None:
+        self._teardown()
+
+
+class FrontendStream:
+    """W logical clients × C connections driven from ONE thread — the
+    bench-side of the batched request path.  Each connection owns a
+    disjoint slice of the logical clients, split into `depth` COHORTS
+    that pipeline on the wire: while cohort A's frame is deciding on the
+    fabric, cohort B's frame is already buffered at the server (the
+    epoll loop serves it the moment A's reply flushes), so a connection
+    keeps the inject pipeline full instead of idling a dispatch per
+    round-trip.  Every logical client still has at most ONE op in
+    flight (its cohort's frame), so the per-client sequential invariant
+    (checkAppends) holds exactly.  Reconnects resend the in-flight
+    frames, same cseqs — at-most-once via the dup filter.
+
+    Reply matching relies on the SERVER's per-connection FIFO: both
+    transports serve one frame per connection at a time (the C++ loop's
+    `handed_off` flag / the Python loop's sequential `_serve_conn`), so
+    frame B is not even dispatched until frame A's reply has flushed —
+    replies can never cross on one connection, and the in-flight
+    deque's popleft always names the frame being answered."""
+
+    def __init__(self, addr: str, conns: int, width: int,
+                 op_timeout: float = 10.0, depth: int = STREAM_DEPTH):
+        assert conns >= 1 and width >= conns * depth
+        self.addr = addr
+        self.op_timeout = op_timeout
+        self.depth = depth
+        self.clients = [[fresh_cid(), 0] for _ in range(width)]
+        # conn ci, cohort k owns clients {c : c ≡ ci·depth+k (mod C·D)}.
+        self._cohorts = [
+            [list(range(ci * depth + k, width, conns * depth))
+             for k in range(depth)]
+            for ci in range(conns)
+        ]
+
+    def run_appends(self, key_of, value_of, stop, on_done=None,
+                    lat_sink=None, max_per_client: int | None = None):
+        """Each logical client c appends value_of(c, i) to key_of(c),
+        i = 0, 1, ... until `stop` is set (or `max_per_client` ops).
+        `on_done(n)` fires per reply frame; `lat_sink` collects per-op
+        frame round-trip seconds.  Returns total ops completed."""
+        import select as _select
+
+        nconns = len(self._cohorts)
+        conns: list = [None] * nconns
+        # Per-client next-op index.
+        progress = {c: 0 for c in range(len(self.clients))}
+        # Per-conn FIFO of in-flight cohorts: (k, ops, members, t_sent);
+        # the server answers frames in order, so popleft matches.
+        inflight: list[deque] = [deque() for _ in range(nconns)]
+        total = 0
+        alive = [True] * nconns
+        done_conns = 0
+
+        def build_ops(members):
+            ops, took = [], []
+            for c in members:
+                i = progress[c]
+                if max_per_client is not None and i >= max_per_client:
+                    continue
+                cid, cseq = self.clients[c]
+                ops.append(("append", key_of(c), value_of(c, i), cid,
+                            cseq + 1))
+                took.append(c)
+            return tuple(ops), took
+
+        def send_cohort(ci, k):
+            """Build + send cohort k's next frame; False when the cohort
+            is drained (max_per_client reached for all members)."""
+            ops, took = build_ops(self._cohorts[ci][k])
+            if not ops:
+                return False
+            conns[ci].send((FE_BATCH, (ops,)))
+            inflight[ci].append((k, ops, took, time.monotonic()))
+            return True
+
+        def open_conn(ci):
+            """(Re)dial and (re)send everything in flight, in order —
+            same cseqs, so replays are dup-filtered server-side."""
+            conns[ci] = transport.FramedConn(self.addr,
+                                             timeout=self.op_timeout)
+            requeue = list(inflight[ci])
+            inflight[ci].clear()
+            for k, ops, took, _ in requeue:
+                conns[ci].send((FE_BATCH, (ops,)))
+                inflight[ci].append((k, ops, took, time.monotonic()))
+            if not requeue:
+                started = False
+                for k in range(self.depth):
+                    started = send_cohort(ci, k) or started
+                return started
+            return True
+
+        def conn_done(ci):
+            nonlocal done_conns
+            if alive[ci]:
+                alive[ci] = False
+                done_conns += 1
+                if conns[ci] is not None:
+                    conns[ci].close()
+                    conns[ci] = None
+
+        bo = Backoff()
+        for ci in range(nconns):
+            try:
+                if not open_conn(ci):
+                    conn_done(ci)
+            except RPCError:
+                if conns[ci] is not None:
+                    conns[ci].close()
+                conns[ci] = None
+        try:
+            while done_conns < nconns:
+                if stop is not None and stop.is_set():
+                    break
+                # Redial torn connections (resends in-flight frames).
+                for ci in range(nconns):
+                    if alive[ci] and conns[ci] is None:
+                        try:
+                            if not open_conn(ci):
+                                conn_done(ci)
+                        except RPCError:
+                            if conns[ci] is not None:
+                                conns[ci].close()
+                            conns[ci] = None
+                live_socks = {conns[ci].fileno(): ci
+                              for ci in range(nconns)
+                              if alive[ci] and conns[ci] is not None}
+                if not live_socks:
+                    if all(not alive[ci] or conns[ci] is None
+                           for ci in range(nconns)):
+                        bo.sleep(0.2)  # every dial failing: pace redials
+                    continue
+                r, _, _ = _select.select(list(live_socks), [], [], 0.2)
+                now = time.monotonic()
+                for fd in r:
+                    ci = live_socks[fd]
+                    try:
+                        ok, payload = conns[ci].recv()
+                    except RPCError:
+                        conns[ci].close()
+                        conns[ci] = None  # redial + resend above
+                        continue
+                    if not ok:
+                        # Frontend-side op failure (e.g. no majority
+                        # within its budget): tear + resend — the dup
+                        # filter keeps the replay at-most-once.
+                        conns[ci].close()
+                        conns[ci] = None
+                        continue
+                    k, ops, took, t_sent = inflight[ci].popleft()
+                    n = len(took)
+                    for c in took:  # commit: advance each member once
+                        self.clients[c][1] += 1
+                        progress[c] += 1
+                    total += n
+                    if lat_sink is not None:
+                        lat_sink.extend([now - t_sent] * n)
+                    if on_done is not None and n:
+                        on_done(n)
+                    if stop is not None and stop.is_set():
+                        continue
+                    try:
+                        if not send_cohort(ci, k) and not inflight[ci]:
+                            conn_done(ci)
+                    except RPCError:
+                        if conns[ci] is not None:
+                            conns[ci].close()
+                        conns[ci] = None
+                # Frame-level timeout: tear + resend (dup-filtered).
+                for ci in range(nconns):
+                    q = inflight[ci]
+                    if alive[ci] and q and conns[ci] is not None \
+                            and now - q[0][3] > self.op_timeout:
+                        conns[ci].close()
+                        conns[ci] = None
+        finally:
+            for c in conns:
+                if c is not None:
+                    c.close()
+        return total

@@ -25,7 +25,13 @@ from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.obs import metrics as _metrics
 from tpu6824.obs import tracing as _tracing
-from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
+from tpu6824.services.common import (
+    Backoff,
+    ColumnarDups,
+    DecidedTap,
+    FlakyNet,
+    fresh_cid,
+)
 from tpu6824.utils.errors import OK, ErrNoKey, RPCError
 from tpu6824.utils.profiling import PhaseProfiler
 from tpu6824.utils import crashsink
@@ -65,20 +71,29 @@ class _Fut:
     noticing it (the pipelined clerk parks up to 0.2s between sweeps).
     `tctx` is the tpuscope context of the apply-side span (set BEFORE
     the event fires, so the waiter can parent its reply span to the
-    apply that resolved it); None on untraced ops."""
+    apply that resolved it); None on untraced ops.
+    `sink`, when set, is invoked with the future right after `set()` —
+    the clerk frontend's completion hook, so the driver's one-sweep
+    retire notify delivers straight into the frontend's event loop with
+    no per-op waiter thread parked anywhere.  A sink must be O(1) and
+    non-blocking: it runs on the driver thread, under the server mutex."""
 
-    __slots__ = ("ev", "value", "t_set", "tctx")
+    __slots__ = ("ev", "value", "t_set", "tctx", "sink")
 
     def __init__(self):
         self.ev = threading.Event()
         self.value = None
         self.t_set = None
         self.tctx = None
+        self.sink = None
 
     def set(self, v):
         self.value = v
         self.t_set = time.monotonic()
         self.ev.set()
+        s = self.sink
+        if s is not None:
+            s(self)
 
     def wait(self, timeout):
         return self.ev.wait(timeout)
@@ -116,7 +131,10 @@ class KVPaxosServer:
         self.mu = new_rlock("kvpaxos.mu")
         self.kv: dict[str, str] = {}
         self.applied = -1  # highest paxos seq applied to kv
-        self.dup: dict[int, tuple[int, object]] = {}  # cid -> (max cseq, reply)
+        # At-most-once filter, columnar: cid → (max cseq, reply) with the
+        # cseq column in a C array and reply refs in a parallel list —
+        # batch-updated once per drain (see _apply_batch_locked).
+        self.dup = ColumnarDups()
         self.op_timeout = op_timeout
         self.dead = False
         # TEST-ONLY linearizability fault hook: True disables at-most-once
@@ -135,6 +153,13 @@ class KVPaxosServer:
         self._inflight: dict[int, Op] = {}  # seq -> my undecided proposal
         self._next_seq = 0               # next seq I would propose at
         self._wake = threading.Event()
+        # Done() variant for the driver's per-drain watermark: the
+        # lock-free deferred form when the backend has one (the fabric
+        # folds it at its next dispatch staging), else the locked call.
+        # A hot driver calling the locked form convoys behind the
+        # clock's retire fold at clerk-frontend load.
+        self._done_fn = getattr(self.px, "done_deferred", None) \
+            or self.px.done
         # Decided-delta feed (fabric backends): the fabric computes each
         # retire's newly-decided (seq, value) delta ONCE per group and
         # fans it out, waking this driver — so the P replicas stop
@@ -219,18 +244,24 @@ class KVPaxosServer:
         lookups hoisted and every per-op branch inline.  Futures are
         COLLECTED, not resolved: the caller sets them in one notify sweep
         after the batch, so waiter wakeups never interleave with apply
-        work.  Returns [(fut, reply), ...]."""
+        work.  Dup-filter writes are likewise collected in `pend` (which
+        doubles as the intra-batch read-your-writes overlay) and folded
+        into the columnar store in ONE `apply_batch` pass per drain.
+        Returns [(fut, reply), ...]."""
         dup = self.dup
         kv = self.kv
         kv_get = kv.get
-        dup_get = dup.get
+        dup_seen = dup.seen
         waiters_pop = self._waiters.pop
         nodup = self._test_disable_dup
         notif = []
+        pend: dict = {}  # cid -> (cseq, reply): this batch's dup writes
+        pend_get = pend.get
         for v in vals:
             self.applied += 1
             if isinstance(v, Op):
-                seen, reply = dup_get(v.cid, (-1, None))
+                ent = pend_get(v.cid)
+                seen = ent[0] if ent is not None else dup_seen(v.cid)
                 if v.cseq > seen or nodup:
                     kind = v.kind
                     if kind == "get":
@@ -244,13 +275,17 @@ class KVPaxosServer:
                         reply = (OK, "")
                     else:
                         reply = (OK, "")
-                    dup[v.cid] = (v.cseq, reply)
+                    pend[v.cid] = (v.cseq, reply)
+                else:
+                    reply = ent[1] if ent is not None else dup.reply(v.cid)
                 fut = waiters_pop((v.cid, v.cseq), None)
                 if fut is not None:
                     if v.tc is not None:
                         self._trace_resolve(v, fut)
                     notif.append((fut, reply))
             self._pop_lost_inflight_locked(v)
+        if pend:
+            dup.apply_batch(pend)
         return notif
 
     def _drain_feed_locked(self):
@@ -293,7 +328,7 @@ class KVPaxosServer:
             prof.add("notify", time.perf_counter_ns() - t0)
         self._last_drain = applied_n
         if self.applied >= base0:
-            self.px.done(self.applied)
+            self._done_fn(self.applied)
 
     def _drain_bulk_locked(self, status_many):
         """Apply every already-decided instance in order, in bulk.  On the
@@ -336,7 +371,7 @@ class KVPaxosServer:
                 self._pop_lost_inflight_locked(v)
         self._last_drain = self.applied + 1 - base0
         if self.applied >= base0:
-            self.px.done(self.applied)
+            self._done_fn(self.applied)
 
     def _drain_bulk_scalar_locked(self, status_many):
         """status_many-probe drain for backends without drain_decided."""
@@ -371,7 +406,7 @@ class KVPaxosServer:
             probe = min(2 * probe, 256)  # long decided run: widen the probe
         self._last_drain = self.applied + 1 - base0
         if self.applied >= base0:
-            self.px.done(self.applied)
+            self._done_fn(self.applied)
 
     def _collect_proposals_locked(self):
         """Assign consecutive seqs to everything queued; returns the
@@ -382,8 +417,8 @@ class KVPaxosServer:
             key = (op.cid, op.cseq)
             if key not in self._waiters:
                 continue  # timed out, resolved, or already applied
-            seen, _ = self.dup.get(op.cid, (-1, None))
-            if op.cseq <= seen and not self._test_disable_dup:
+            if op.cseq <= self.dup.seen(op.cid) \
+                    and not self._test_disable_dup:
                 continue  # applied via another replica's proposal
             props.append((nxt, op))
             self._inflight[nxt] = op
@@ -517,11 +552,18 @@ class KVPaxosServer:
 
     # ------------------------------------------------------------ RPC surface
 
-    def submit_batch(self, ops) -> list[_Fut]:
+    def submit_batch(self, ops, sink=None) -> list[_Fut]:
         """Enqueue a block of ops for the group-commit driver under ONE
         lock acquisition; returns their futures (already resolved for
         duplicates).  The in-process seam the pipelined clerk multiplexes
-        on; the blocking RPC surface is _submit = submit_batch + wait."""
+        on; the blocking RPC surface is _submit = submit_batch + wait.
+
+        `sink` (optional) is attached to every returned future BEFORE it
+        can resolve: `fut.set` then invokes `sink(fut)` exactly once —
+        the clerk frontend's event-loop completion hook.  A future that
+        already carries a different sink keeps it (one frontend per
+        server; a frontend re-submitting its own op re-attaches the same
+        hook)."""
         futs = []
         tr = _tracing.enabled()
         cur = _tracing.current() if tr else None
@@ -533,15 +575,19 @@ class KVPaxosServer:
             subq = self._subq
             nodup = self._test_disable_dup
             for op in ops:
-                seen, reply = dup.get(op.cid, (-1, None))
+                seen = dup.seen(op.cid)
                 if op.cseq <= seen and not nodup:
                     fut = _Fut()
-                    fut.set(reply)
+                    if sink is not None:
+                        fut.sink = sink
+                    fut.set(dup.reply(op.cid))
                 else:
                     key = (op.cid, op.cseq)
                     fut = waiters.get(key)
                     if fut is None:
                         fut = _Fut()
+                        if sink is not None:
+                            fut.sink = sink
                         if tr:
                             # tpuscope: stamp the op's trace metadata —
                             # parent is the rpc leg's context (explicit
@@ -559,6 +605,11 @@ class KVPaxosServer:
                                 sp.end()
                         waiters[key] = fut
                         subq.append(op)
+                    elif sink is not None and fut.sink is None:
+                        # A waiter parked by the blocking surface (e.g. a
+                        # frontend op retried through the per-op fallback):
+                        # adopt it so the frontend hears the resolution.
+                        fut.sink = sink
                 futs.append(fut)
         self._wake.set()
         return futs

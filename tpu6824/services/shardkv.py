@@ -41,6 +41,7 @@ from tpu6824.obs import tracing as _tracing
 from tpu6824.ops.hashing import NSHARDS, key2shard
 from tpu6824.services import shardmaster
 from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
+from tpu6824.services.kvpaxos import _DEAD, _Fut
 from tpu6824.services.shardmaster import Config
 from tpu6824.utils import crashsink
 from tpu6824.utils.errors import (
@@ -113,12 +114,28 @@ class ShardKVServer:
         # Decided-delta feed (fabric backends): the tick/catch-up drain
         # consumes the fabric's once-per-group decided fan-out instead of
         # walking status() seq by seq; see kvpaxos for the full rationale.
+        # Batched-submit seam (the clerk frontend reuses one frontend per
+        # group over this): futures + queue + a LAZY group-commit driver —
+        # nothing spawns and the blocking `_serve` path is untouched until
+        # the first submit_batch() call.
+        self._waiters: dict[tuple, _Fut] = {}  # (cid, cseq) -> fut
+        self._subq: list[Op] = []
+        self._inflight: dict[int, Op] = {}     # seq -> my undecided proposal
+        self._next_seq = 0
+        self._wake = threading.Event()
+        self._client_driver = None
         sub_fn = getattr(self.px, "subscribe_decided", None)
-        sub = sub_fn() if sub_fn is not None else None
+        sub = sub_fn(wake=self._wake_submit) if sub_fn is not None else None
         self._tap = DecidedTap(sub) if sub is not None else None
         self._ticker = None
         if start_ticker:
             self._start_ticker()
+
+    def _wake_submit(self):
+        # Decided-feed wake hook: shared by the ticker cadence (which
+        # ignores it) and the lazy submit driver (which parks on it).
+        if not self._wake.is_set():
+            self._wake.set()
 
     def _start_ticker(self):
         self._ticker = threading.Thread(
@@ -147,11 +164,11 @@ class ShardKVServer:
 
         seen, reply = self.dup.get(op.cid, (-1, None))
         if op.cseq <= seen:
-            return reply
+            return self._resolve(op, reply)
         if not self._owns(op.key):
             # NOT recorded in the dup filter: the client will retry at the
             # right group with the same cseq (shardkv/server.go:205-242).
-            return (ErrWrongGroup, "")
+            return self._resolve(op, (ErrWrongGroup, ""))
         if op.kind == "get":
             reply = (OK, self.kv[op.key]) if op.key in self.kv else (ErrNoKey, "")
         elif op.kind == "put":
@@ -165,7 +182,30 @@ class ShardKVServer:
             _tracing.complete("service.apply", op.tc[0], op.tc[1],
                               time.monotonic_ns(), comp="shardkv",
                               gid=self.gid, me=self.me, key=op.key)
+        return self._resolve(op, reply)
+
+    def _resolve(self, op: Op, reply):
+        """Resolve any frontend waiter parked on this (cid, cseq) —
+        including the ErrWrongGroup/dup fast paths, which a frontend op
+        must hear about (its clerk re-queries the config and retries)."""
+        if self._waiters:
+            fut = self._waiters.pop((op.cid, op.cseq), None)
+            if fut is not None:
+                fut.set(reply)
         return reply
+
+    def _requeue_lost_locked(self, v) -> None:
+        """Post-apply at self.applied: if my frontend proposal for this
+        slot lost to `v`, re-queue it (its waiter is still parked) —
+        kvpaxos._pop_lost_inflight_locked, shardkv flavor."""
+        if not self._inflight:
+            return
+        mine = self._inflight.pop(self.applied, None)
+        if (mine is not None
+                and (not isinstance(v, Op)
+                     or (mine.cid, mine.cseq) != (v.cid, v.cseq))
+                and (mine.cid, mine.cseq) in self._waiters):
+            self._subq.append(mine)
 
     def _drain_decided(self):
         tap = self._tap
@@ -191,6 +231,7 @@ class ShardKVServer:
                 for v in run:
                     self._apply(v)
                     self.applied += 1
+                    self._requeue_lost_locked(v)
             if self.applied >= base0:
                 self.px.done(self.applied)
             return
@@ -199,9 +240,11 @@ class ShardKVServer:
             if fate == Fate.DECIDED:
                 self._apply(v)
                 self.applied += 1
+                self._requeue_lost_locked(v)
                 self.px.done(self.applied)
             elif fate == Fate.FORGOTTEN:
                 self.applied += 1
+                self._inflight.pop(self.applied, None)
             else:
                 return
 
@@ -216,6 +259,7 @@ class ShardKVServer:
             if fate == Fate.DECIDED:
                 reply = self._apply(v)
                 self.applied = seq
+                self._requeue_lost_locked(v)
                 self.px.done(seq)
                 if (
                     isinstance(v, Op)
@@ -376,6 +420,124 @@ class ShardKVServer:
         finally:
             self.mu.release()
 
+    # ------------------------------------------------- batched submit seam
+    # The clerk frontend's surface (services/frontend.py, op_factory=
+    # shardkv_op): futures resolved by whichever drain applies the op
+    # (ticker, _sync walk, or the lazy driver below).  The blocking _serve
+    # path and its tests are untouched — nothing here runs until the
+    # first submit_batch.
+
+    def submit_batch(self, ops, sink=None) -> list:
+        """Enqueue client ops under one lock acquisition; returns their
+        futures (dup and wrong-group ops resolve immediately).  Same
+        contract as KVPaxosServer.submit_batch."""
+        futs = []
+        with self.mu:
+            if self.dead:
+                raise RPCError("dead")
+            if self._client_driver is None:
+                self._start_client_driver_locked()
+            for op in ops:
+                seen, reply = self.dup.get(op.cid, (-1, None))
+                if op.cseq <= seen:
+                    fut = _Fut()
+                    if sink is not None:
+                        fut.sink = sink
+                    fut.set(reply)
+                elif not self._owns(op.key):
+                    fut = _Fut()
+                    if sink is not None:
+                        fut.sink = sink
+                    fut.set((ErrWrongGroup, ""))
+                else:
+                    key = (op.cid, op.cseq)
+                    fut = self._waiters.get(key)
+                    if fut is None:
+                        fut = _Fut()
+                        if sink is not None:
+                            fut.sink = sink
+                        self._waiters[key] = fut
+                        self._subq.append(op)
+                    elif sink is not None and fut.sink is None:
+                        fut.sink = sink
+                futs.append(fut)
+        self._wake_submit()
+        return futs
+
+    def abandon(self, cid, cseq) -> None:
+        """Drop the waiter for (cid, cseq): the frontend gave up on this
+        replica (the dup filter keeps any retry at-most-once)."""
+        with self.mu:
+            self._waiters.pop((cid, cseq), None)
+
+    def _start_client_driver_locked(self) -> None:
+        self._client_driver = threading.Thread(
+            target=crashsink.guarded(self._client_drive_loop,
+                                     "shardkv-client-driver"),
+            daemon=True)
+        self._client_driver.start()
+
+    def _collect_client_props_locked(self):
+        props = []
+        nxt = max(self._next_seq, self.applied + 1)
+        for op in self._subq:
+            if (op.cid, op.cseq) not in self._waiters:
+                continue  # abandoned / resolved meanwhile
+            seen, _ = self.dup.get(op.cid, (-1, None))
+            if op.cseq <= seen:
+                continue
+            props.append((nxt, op))
+            self._inflight[nxt] = op
+            nxt += 1
+        self._subq = []
+        self._next_seq = nxt
+        return props
+
+    def _client_drive_loop(self):
+        """Group-commit driver for frontend-submitted ops — the kvpaxos
+        driver's shape on shardkv's RSM: drain the decided feed, propose
+        everything queued as one consecutive seq block, let _apply
+        resolve the waiters.  Reconf ops keep flowing through the
+        ticker's _sync walk concurrently; losing a slot to one simply
+        re-queues the client op."""
+        px = self.px
+        start_many = getattr(px, "start_many", None)
+        bo = Backoff(fixed_sleep=0.02)
+        while True:
+            self._wake.wait(0.05)
+            self._wake.clear()
+            try:
+                with self.mu:
+                    if self.dead:
+                        return
+                    self._drain_decided()
+                    props = self._collect_client_props_locked()
+                if props:
+                    try:
+                        if start_many is not None:
+                            start_many(props)
+                        else:
+                            for i, (s, v) in enumerate(props):
+                                try:
+                                    px.start(s, v)
+                                except WindowFullError as e:
+                                    e.index = i
+                                    raise
+                    except WindowFullError as e:
+                        with self.mu:
+                            idx = len(props) if e.index is None else e.index
+                            for seq, op in props[idx:]:
+                                self._inflight.pop(seq, None)
+                                self._subq.append(op)
+                            if idx < len(props):
+                                self._next_seq = props[idx][0]
+                bo.reset()
+            except RPCError:
+                bo.sleep()
+            except Exception as e:  # noqa: BLE001 — singleton thread
+                crashsink.record("shardkv-client-driver", e, fatal=False)
+                time.sleep(0.02)
+
     # ----------------------------------------------------------- RPC surface
 
     def get(self, key: str, cid: str, cseq: int):
@@ -407,8 +569,12 @@ class ShardKVServer:
     def kill(self):
         with self.mu:
             self.dead = True
+            for fut in self._waiters.values():
+                fut.set(_DEAD)
+            self._waiters.clear()
             if self._tap is not None:
                 self._tap.close()
+        self._wake.set()
         self.px.kill()
 
 
