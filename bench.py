@@ -83,6 +83,50 @@ def _tpuscope_delta(before):
         return {"error": repr(e)[:200]}
 
 
+def _environment_begin():
+    """The run's environment block skeleton: cgroup cpu budget, load
+    averages, cpu count (obs/pulse.py probes).  Captured BEFORE the
+    bench ramps, so `loadavg` reflects what the box was already doing —
+    benchdiff uses this plus the calibration spins to tell a code
+    regression from a degraded box (the r08 lesson)."""
+    try:
+        from tpu6824.obs.pulse import environment_snapshot
+
+        env = environment_snapshot()
+    except Exception as e:  # noqa: BLE001 — environment never costs the line
+        env = {"error": repr(e)[:200]}
+    env["calibration"] = {"unit": "ms", "spins": []}
+    return env
+
+
+def _spin(env, label):
+    """One fixed-work calibration spin at a leg boundary: a leg
+    bracketed by slow spins ran on a degraded box, and its regression
+    verdicts demote to suspect-environment downstream."""
+    try:
+        from tpu6824.obs.pulse import calibration_spin
+
+        env["calibration"]["spins"].append(
+            {"at": label, "ms": calibration_spin()})
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _environment_end(env):
+    try:
+        from tpu6824.obs.pulse import environment_snapshot
+
+        env["loadavg_end"] = environment_snapshot().get("loadavg")
+    except Exception:  # noqa: BLE001
+        pass
+    spins = [s["ms"] for s in env["calibration"]["spins"]]
+    if spins:
+        env["calibration"]["min_ms"] = min(spins)
+        env["calibration"]["max_ms"] = max(spins)
+        env["calibration"]["median_ms"] = sorted(spins)[len(spins) // 2]
+    return env
+
+
 def _fabric_protocol(fab):
     """The kernelscope device-resident protocol counters for a leg's
     BENCH section: totals + derived ratios (rounds-per-decide, fast-path
@@ -136,6 +180,8 @@ def child_main():
 
     def run_all(impl: str) -> dict:
         t_start = time.time()
+        env = _environment_begin()
+        _spin(env, "start")
         if impl == "pallas":
             engine = _lane_engine(jax, jnp, np, G, I, P, link, done, on_cpu)
         else:
@@ -299,16 +345,19 @@ def child_main():
         lossy_mode = (engine["lossy_mode"]["v"]
                       if "lossy_mode" in engine else "xla")
         dist = distribution(P, 0.10, 0.20)
+        _spin(env, "wire")
         leg0 = _tpuscope_begin()
         wire = _wire_rate()
         wire["tpuscope"] = _tpuscope_delta(leg0)
         # API-driven configs (never cost the headline line on failure):
+        _spin(env, "service")
         leg0 = _tpuscope_begin()
         try:
             service = _service_rate()
         except Exception as e:  # noqa: BLE001
             service = {"value": 0.0, "error": repr(e)[:200]}
         service["tpuscope"] = _tpuscope_delta(leg0)
+        _spin(env, "clerk")
         leg0 = _tpuscope_begin()
         try:
             service["clerk"] = _clerk_rate()
@@ -317,6 +366,7 @@ def child_main():
         service["clerk"]["tpuscope"] = _tpuscope_delta(leg0)
         # The batched request path (ISSUE 8): clerk ops through the
         # event-loop frontend over real sockets, conns × batch sweep.
+        _spin(env, "clerk_frontend")
         leg0 = _tpuscope_begin()
         try:
             service["clerk_frontend"] = _clerk_frontend_rate()
@@ -326,12 +376,14 @@ def child_main():
         service["clerk_frontend"]["tpuscope"] = _tpuscope_delta(leg0)
         # Durability leg (durafault): recovery-time percentiles, gated by
         # benchdiff like every throughput leg.
+        _spin(env, "recovery")
         leg0 = _tpuscope_begin()
         try:
             recovery = _recovery_rate()
         except Exception as e:  # noqa: BLE001
             recovery = {"error": repr(e)[:200]}
         recovery["tpuscope"] = _tpuscope_delta(leg0)
+        _spin(env, "end")
 
         # Roofline context: bytes moved per BEST-CASE step.
         #  - pallas: the fused cycle is one kernel — reads 7 state + sa +
@@ -378,6 +430,11 @@ def child_main():
             "wire": wire,
             "service": service,
             "recovery": recovery,
+            # The environment block (pulse, ISSUE 10): cgroup budget,
+            # load averages, and fixed-work calibration spins at every
+            # leg boundary — benchdiff's evidence for telling a code
+            # regression from a degraded box.
+            "environment": _environment_end(env),
             "roofline": _roofline(
                 jax, jnp, on_cpu, impl, state_bytes, STEPS / best_dt,
                 measured_bytes=cost_bytes,
@@ -1513,9 +1570,13 @@ def _attach_benchdiff(result):
         result["benchdiff"] = {
             "baseline": os.path.basename(base),
             "regressions": report["regressions"],
+            "suspect": report.get("suspect", 0),
             "compared": report["compared"],
             "regressed": [r["metric"] for r in report["results"]
                           if r["verdict"] == "REGRESSED"],
+            "suspect_environment": [
+                r["metric"] for r in report["results"]
+                if r["verdict"] == "suspect-environment"],
         }
     except Exception as e:  # noqa: BLE001 — the gate never costs the line
         result["benchdiff"] = {"error": repr(e)[:200]}

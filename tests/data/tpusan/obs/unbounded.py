@@ -1,0 +1,29 @@
+"""Golden: unbounded-obs-buffer — telemetry buffers without a cap.
+
+An obs-layer series that appends forever: the ring deque has no maxlen
+and the raw points list grows for the process lifetime.  Pollers
+serialize these whole, so the leak lands exactly when observability
+matters (long soaks).  3 findings: the uncapped deque construction, the
+list append, and the list extend.
+"""
+
+from collections import deque
+
+
+class LeakySeries:
+    def __init__(self):
+        self.points = []                  # uncapped accumulation target
+        self.ring = deque()               # FINDING: deque without maxlen
+        self.bounded = deque(maxlen=64)   # fine: capped ring
+
+    def sample(self, t, v):
+        self.points.append((t, v))        # FINDING: append, no cap
+        self.bounded.append(v)            # fine: ring is capped
+
+    def backfill(self, more):
+        self.points.extend(more)          # FINDING: extend, no cap
+
+    def snapshot(self):
+        local = []                        # fine: locals are per-call
+        local.extend(self.points)
+        return local

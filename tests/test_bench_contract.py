@@ -108,6 +108,22 @@ def test_one_json_line_with_required_keys():
     assert "benchdiff" in d, d.keys()
     if "error" not in d["benchdiff"]:
         assert "regressions" in d["benchdiff"], d["benchdiff"]
+        assert "suspect" in d["benchdiff"], d["benchdiff"]
+    # Environment provenance (ISSUE 10, pulse): every recorded run must
+    # carry the environment block — cgroup budget, load averages, and a
+    # fixed-work calibration spin at every leg boundary — or benchdiff
+    # cannot tell a code regression from a degraded box (the r08 −55%
+    # "regression" was purely environmental).
+    env = d["environment"]
+    assert env["cpus"] >= 1 and env["effective_cpus"] > 0, env
+    assert isinstance(env["cgroup"], dict), env
+    cal = env["calibration"]
+    assert cal["unit"] == "ms" and len(cal["spins"]) >= 5, cal
+    spin_ats = [s["at"] for s in cal["spins"]]
+    for at in ("start", "wire", "service", "clerk", "recovery", "end"):
+        assert at in spin_ats, (at, spin_ats)
+    assert all(s["ms"] > 0 for s in cal["spins"]), cal
+    assert cal["median_ms"] >= cal["min_ms"] > 0, cal
 
 
 @pytest.mark.slow

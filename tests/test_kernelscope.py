@@ -606,6 +606,36 @@ def test_benchdiff_catches_injected_regression():
     assert any(r["metric"] == "value" for r in bad)
 
 
+def _r08():
+    return benchdiff.load_artifact(os.path.join(REPO, "BENCH_r08.json"))
+
+
+def test_clerk_frontend_leg_gates_from_r08(tmp_path):
+    """Satellite (ISSUE 10): BENCH_r08 recorded the frontend leg, so it
+    is promoted from skipped(no-baseline) to GATED — self-compare
+    verdicts ok (not a skip), and an injected regression on the leg
+    trips exit 1 through the CLI."""
+    old = _r08()
+    rep = benchdiff.compare(old, json.loads(json.dumps(old)))
+    by = {r["metric"]: r["verdict"] for r in rep["results"]}
+    assert by["service/clerk_frontend/value"] == "ok", by
+    assert by["service/clerk_frontend/latency/p50_ms"] == "ok", by
+    new = json.loads(json.dumps(old))
+    new["service"]["clerk_frontend"]["value"] *= 0.25  # −75% >> 65% tol
+    rep2 = benchdiff.compare(old, new)
+    by2 = {r["metric"]: r["verdict"] for r in rep2["results"]}
+    assert by2["service/clerk_frontend/value"] == "REGRESSED", by2
+    assert rep2["regressions"] >= 1
+    po, pn = tmp_path / "r08.json", tmp_path / "fe-regressed.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu6824.obs.benchdiff", str(po), str(pn)],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "service/clerk_frontend/value" in r.stdout
+
+
 def test_benchdiff_vanished_leg_is_a_regression_unless_allowed():
     new = json.loads(json.dumps(_r07()))
     del new["service"]  # a leg that stops reporting hides a perf break

@@ -1,0 +1,845 @@
+"""pulse/watchdog/top/environment tests (ISSUE 10).
+
+Five layers:
+  - pulse unit: counters→rates, gauges, per-interval histogram
+    percentiles, bounded rings, default-off shape;
+  - watchdog rules, each against a seeded synthetic condition, plus the
+    nemesis ACCEPTANCE: a fixed partition+kill schedule is detected
+    within one window, the evidence bundle is timestamp-joinable to the
+    injected faults, and a fault-free control run with the same seed
+    machinery stays silent;
+  - zero-overhead-when-idle: the one-device_get-per-dispatch contract
+    and the jitguard zero-recompile contract both hold WITH pulse
+    sampling enabled;
+  - fleet plumbing: the pulse RPC over the fabric_service wire, the
+    Collector's pulse surface + frontend-process polling
+    (rpc.pool.* / frontend.* metrics, dead-member-as-data), and the
+    `python -m tpu6824.obs.top --once --json` CI smoke (stable keys,
+    no NaN);
+  - environment-aware benchdiff: a contended box demotes host-edge
+    regressions to suspect-environment while real/device regressions
+    still gate hard.
+"""
+
+import copy
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpu6824.obs import metrics as obs_metrics
+from tpu6824.obs import pulse as obs_pulse
+from tpu6824.obs import tracing as obs_tracing
+from tpu6824.obs import watchdog as obs_watchdog
+from tpu6824.obs.collector import Collector, local_handle
+from tpu6824.obs.pulse import Pulse
+from tpu6824.obs.watchdog import (
+    DroppedClimbing,
+    JitRecompile,
+    LatencySpike,
+    QueueGrowth,
+    StalledGroups,
+    ThreadCrashes,
+    ThroughputCollapse,
+    Watchdog,
+)
+from tpu6824.utils import crashsink
+from tpu6824.utils.trace import EventLog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_global_pulse():
+    """The process-global pulse must never leak between tests (the
+    default-off contract other suites assert)."""
+    yield
+    obs_pulse.stop()
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------------ pulse unit
+
+
+def test_pulse_counters_become_rates_and_rings_are_bounded():
+    c = obs_metrics.counter("pulsetest.ops")
+    p = Pulse(interval=0.02, cap=5)
+    p.sample_once()
+    for _ in range(8):
+        c.inc(50)
+        time.sleep(0.02)
+        p.sample_once()
+    pts = p.points("pulsetest.ops.rate")
+    assert 0 < len(pts) <= 5, "ring not bounded at cap"
+    assert all(v > 0 for _, v in pts), pts
+    # rate ≈ 50/interval; sanity-bound generously for a loaded box
+    assert any(v > 100 for _, v in pts), pts
+    s = p.series()
+    assert s["enabled"] and s["cap"] == 5
+    assert s["series"]["pulsetest.ops.rate"]["kind"] == "rate"
+    ts = s["series"]["pulsetest.ops.rate"]["t"]
+    assert ts == sorted(ts)
+
+
+def test_pulse_gauges_and_histogram_interval_percentiles():
+    g = obs_metrics.gauge("pulsetest.depth")
+    h = obs_metrics.histogram("pulsetest.latency_us")
+    p = Pulse(interval=0.02, cap=16)
+    p.sample_once()
+    g.set(7)
+    for _ in range(20):
+        h.observe(100)
+    time.sleep(0.02)
+    p.sample_once()
+    assert p.last("pulsetest.depth") == 7.0
+    # per-INTERVAL percentiles: the second interval observes only 10×
+    # a much larger value, and the p99 series must track it (a lifetime
+    # histogram would still answer ~128).
+    for _ in range(10):
+        h.observe(10000)
+    time.sleep(0.02)
+    p.sample_once()
+    pts = p.points("pulsetest.latency_us.p99")
+    assert len(pts) == 2
+    assert pts[0][1] == 128.0  # 2^ceil(log2(100))
+    assert pts[1][1] == 16384.0  # 2^ceil(log2(10000))
+
+
+def test_pulse_default_off_shape_and_fabric_rpc_shell():
+    """Default-off contract: no global pulse unless started, and the
+    snapshot shell keeps a stable shape either way."""
+    assert obs_pulse.get() is None
+    shell = obs_pulse.series_snapshot()
+    assert shell["enabled"] is False and shell["series"] == {}
+    assert set(shell) == {"schema", "enabled", "interval", "cap",
+                          "samples", "t_mono", "series"}
+    p = obs_pulse.start(interval=0.05)
+    assert obs_pulse.get() is p
+    assert obs_pulse.start() is p  # get-or-start, one per process
+    _wait(lambda: obs_pulse.series_snapshot()["enabled"], 10.0, "pulse on")
+    obs_pulse.stop()
+    assert obs_pulse.series_snapshot()["enabled"] is False
+
+
+def test_replay_artifact_embeds_running_pulse():
+    from tpu6824.harness.nemesis import ReplayArtifact
+
+    art = ReplayArtifact(test="pulse-embed")
+    assert "pulse" not in art.to_dict(), "no pulse -> no pulse section"
+    c = obs_metrics.counter("pulsetest.embed")
+    p = obs_pulse.start(interval=0.02)
+    c.inc()
+    _wait(lambda: p.samples >= 2, 10.0, "pulse samples")
+    d = art.to_dict()
+    assert d["pulse"]["enabled"] is True
+    assert d["pulse"]["schema"] == obs_pulse.SCHEMA_VERSION
+
+
+# ------------------------------------------------------- drop gauges
+
+
+def test_eventlog_overflow_moves_registry_gauge():
+    log = EventLog(capacity=3, registry_prefix="pulsetest.log")
+    for i in range(10):
+        log.record("tick", i=i)
+    assert log.counters()["dropped"] == 7
+    g = obs_metrics.gauge("pulsetest.log.events.dropped")
+    assert g.snapshot()["value"] == 7
+
+
+def test_flight_overflow_moves_registry_gauge():
+    fr = obs_tracing.FlightRecorder(capacity=2)
+    for i in range(7):
+        fr.record({"ph": "i", "name": f"e{i}", "comp": "t", "trace_id": 0,
+                   "span_id": i, "parent_id": 0, "ts": 0, "dur": 0,
+                   "args": {}})
+    assert fr.dropped == 5
+    g = obs_metrics.gauge("obs.flight.dropped")
+    assert g.snapshot()["value"] == 5
+    fr.clear()
+    assert g.snapshot()["value"] == 0
+
+
+# --------------------------------------------------------- watchdog rules
+
+
+def _manual_pulse(**kw):
+    kw.setdefault("interval", 0.02)
+    return Pulse(**kw)
+
+
+def test_watchdog_throughput_collapse(tmp_path):
+    c = obs_metrics.counter("fabric.decided_cells")
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[ThroughputCollapse(frac=0.1, min_rate=50.0)],
+                  window=60.0, cooldown=60.0).start()
+    p.sample_once()
+    for _ in range(4):  # healthy half: well above min_rate
+        c.inc(500)
+        time.sleep(0.02)
+        p.sample_once()
+    for _ in range(4):  # collapse half: nothing decides
+        time.sleep(0.02)
+        p.sample_once()
+    assert wd.incidents, "collapse not detected"
+    inc = wd.incidents[0]
+    assert inc["rule"] == "throughput-collapse"
+    assert "collapsed" in inc["reason"]
+    assert os.path.exists(inc["path"])
+
+
+def test_watchdog_latency_spike(tmp_path):
+    h = obs_metrics.histogram("wdtest.latency_us")
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[LatencySpike(factor=4.0)],
+                  window=60.0, cooldown=60.0).start()
+    p.sample_once()
+    for _ in range(4):  # baseline: ~128us buckets
+        for _ in range(20):
+            h.observe(100)
+        time.sleep(0.02)
+        p.sample_once()
+    assert not wd.incidents
+    for _ in range(20):  # spike: two log2 buckets up and then some
+        h.observe(20000)
+    time.sleep(0.02)
+    p.sample_once()
+    assert wd.incidents and wd.incidents[0]["rule"] == "latency-spike"
+    assert "wdtest.latency_us.p99" in wd.incidents[0]["reason"]
+
+
+def test_watchdog_queue_growth(tmp_path):
+    g = obs_metrics.gauge("fabric.health.feed_depth_max")
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[QueueGrowth(limit=100.0)],
+                  window=60.0, cooldown=60.0).start()
+    for depth in (10, 20, 40):  # growing but under the limit: silent
+        g.set(depth)
+        p.sample_once()
+    assert not wd.incidents
+    for depth in (150, 300, 600):
+        g.set(depth)
+        p.sample_once()
+    assert wd.incidents and wd.incidents[0]["rule"] == "queue-growth"
+
+
+def test_watchdog_thread_crashes_and_cooldown(tmp_path):
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path), rules=[ThreadCrashes()],
+                  window=60.0, cooldown=3600.0).start()
+    p.sample_once()
+    assert not wd.incidents, "armed baseline must include old crashes"
+    crashsink.record("wd-test-thread", RuntimeError("boom"), fatal=False)
+    p.sample_once()
+    p.sample_once()  # cooldown: a sustained condition fires ONCE
+    assert len(wd.incidents) == 1
+    assert wd.incidents[0]["rule"] == "thread-crashes"
+
+
+def test_watchdog_dropped_climbing(tmp_path):
+    log = EventLog(capacity=2, registry_prefix="fabric")
+    for i in range(3):  # prime: the gauge exists once a drop happened
+        log.record("warm", i=i)
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[DroppedClimbing(rate=100.0)],
+                  window=60.0, cooldown=60.0).start()
+    p.sample_once()
+    time.sleep(0.02)
+    p.sample_once()
+    assert not wd.incidents, "a static drop count is not climbing"
+    for i in range(400):
+        log.record("flood", i=i)
+    time.sleep(0.02)
+    p.sample_once()
+    assert wd.incidents and wd.incidents[0]["rule"] == "dropped-climbing"
+    assert "fabric.events.dropped" in wd.incidents[0]["reason"]
+
+
+def test_watchdog_jit_recompile_rule(tmp_path):
+    c = obs_metrics.counter("jitguard.compiles")
+    dec = obs_metrics.counter("fabric.decided_cells")
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[JitRecompile(grace=0.0)],
+                  window=0.5, cooldown=60.0).start()
+    p.sample_once()
+    # Warmup compiles WITH cold traffic: expected, silent (the fabricd
+    # false-positive: traffic can arrive any time after boot).
+    c.inc(3)
+    dec.inc(50)
+    time.sleep(0.02)
+    p.sample_once()
+    assert not wd.incidents, "warmup compiles are not an incident"
+    time.sleep(0.6)  # the warmup compiles age out of the window
+    dec.inc(50)  # a busy, compile-free window: warmed
+    p.sample_once()
+    assert not wd.incidents
+    c.inc()  # NOW a compile is steady-state anomalous
+    dec.inc(50)
+    time.sleep(0.02)
+    p.sample_once()
+    assert wd.incidents and wd.incidents[0]["rule"] == "jit-recompile"
+
+
+def test_watchdog_bundle_is_nemesis_format(tmp_path):
+    """The evidence bundle must read like a nemesis failure artifact:
+    same schema stamps, flight ring, plus the watchdog block with the
+    triggering series window and environment."""
+    c = obs_metrics.counter("fabric.decided_cells")
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[ThroughputCollapse(frac=0.1, min_rate=50.0)],
+                  window=60.0, cooldown=60.0).start()
+    p.sample_once()
+    for _ in range(4):
+        c.inc(500)
+        time.sleep(0.02)
+        p.sample_once()
+    for _ in range(4):
+        time.sleep(0.02)
+        p.sample_once()
+    assert wd.incidents
+    with open(wd.incidents[0]["path"]) as f:
+        bundle = json.load(f)
+    assert bundle["test"] == "watchdog:throughput-collapse"
+    assert "flight_recorder" in bundle and "analyzer" in bundle
+    assert bundle["tpuscope"] == obs_tracing.SCHEMA_VERSION
+    w = bundle["watchdog"]
+    assert w["schema"] == obs_watchdog.SCHEMA_VERSION
+    assert w["rule"] == "throughput-collapse"
+    assert "fabric.decided_cells.rate" in w["series_window"]
+    assert "cpus" in w["environment"]
+    assert wd.status()["incidents"][0]["rule"] == "throughput-collapse"
+
+
+# ------------------------------------------ the nemesis acceptance test
+
+
+@pytest.mark.nemesis
+def test_watchdog_detects_nemesis_stall_and_control_stays_silent(
+        tmp_path, nemesis_report):
+    """ISSUE 10 acceptance: under a fixed partition+kill schedule the
+    watchdog detects the stall within one detection window and emits an
+    evidence bundle whose series window and flight events are
+    timestamp-joinable to the injected faults; the fault-free control
+    run (same machinery, empty schedule) stays silent."""
+    from tpu6824.harness.nemesis import (
+        FabricTarget,
+        FaultSchedule,
+        Nemesis,
+        NemesisEvent,
+        seed_from_env,
+    )
+    from tpu6824.services.kvpaxos import Clerk, make_cluster
+
+    seed = seed_from_env(6824)
+    WINDOW = 2.0
+
+    # The SAME rule set for fault and control runs: default stall
+    # detection (the rule under test), with the host-timing rules'
+    # thresholds set for this box (a cgroup-capped ~1.5-share core
+    # where serial-clerk throughput and per-op latency legitimately
+    # wobble 4×+ under suite load — see TUNING round 14).
+    def rules():
+        return [StalledGroups(),
+                ThroughputCollapse(frac=0.02, min_rate=2000.0),
+                LatencySpike(factor=64.0), QueueGrowth(limit=4096.0),
+                ThreadCrashes(), DroppedClimbing(rate=10000.0),
+                JitRecompile(grace=300.0)]
+
+    def run(events, label):
+        fabric, servers = make_cluster(nservers=3, ninstances=32)
+        # stall_after=1.0: tight enough for one-window detection, wide
+        # enough that a box hiccup in the control run (this box freezes
+        # for hundreds of ms under suite load) is not a false stall.
+        pulse = Pulse(fabric=fabric, interval=0.15, cap=256,
+                      stall_after=1.0).start()
+        wd = Watchdog(pulse, outdir=str(tmp_path), window=WINDOW,
+                      rules=rules(), cooldown=60.0).start()
+        sched = FaultSchedule(events, seed=seed)
+        nem = Nemesis(FabricTarget(fabric), sched).start()
+        nemesis_report.attach(nemesis=nem, seed=seed)
+        ck = Clerk(servers)
+        stop = threading.Event()
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                try:
+                    ck.put(f"k{i % 4}", f"v{i}", timeout=60.0)
+                except Exception:  # noqa: BLE001 — killed-server races
+                    pass
+                i += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        try:
+            if events:
+                _wait(lambda: any(i["rule"] == "stalled-groups"
+                                  for i in wd.incidents),
+                      timeout=15.0, msg=f"{label}: stall detection")
+            else:
+                # Control: same wall time the fault run needs, no fire.
+                time.sleep(4.0)
+            return nem, list(wd.incidents)
+        finally:
+            wd.stop()
+            nem.stop()
+            stop.set()
+            t.join(timeout=30.0)
+            pulse.stop()
+            for s in servers:
+                s.dead = True
+            fabric.stop_clock()
+
+    # Fault run: isolate every peer (no majority anywhere) then kill
+    # one; hold the state long past detection (nem.stop() aborts the
+    # tail heal once the assertion lands, and restore() heals).
+    events = [
+        NemesisEvent(0.3, "partition_isolate",
+                     {"g": 0, "parts": [[0], [1], [2]]}),
+        NemesisEvent(0.5, "kill", {"g": 0, "p": 2}),
+        NemesisEvent(30.0, "heal", {"g": 0}),
+        NemesisEvent(30.1, "revive", {"g": 0, "p": 2}),
+    ]
+    nem, incidents = run(events, "fault")
+    stall = next(i for i in incidents if i["rule"] == "stalled-groups")
+
+    # Detection within one window of the stall becoming reportable
+    # (injection + stall_after aging), with sampling-interval slack.
+    inj = next(r for r in nem.timeline
+               if r["action"] == "partition_isolate")
+    t_inj = nem.t0 + inj["wall"]
+    assert stall["t_mono"] >= t_inj, "detected before the fault?"
+    assert stall["t_mono"] - t_inj <= 1.0 + WINDOW + 1.5, (
+        f"detection took {stall['t_mono'] - t_inj:.2f}s")
+
+    with open(stall["path"]) as f:
+        bundle = json.load(f)
+    w = bundle["watchdog"]
+    # The stall diagnosis names WHY (kernelscope evidence).
+    assert w["stall_diagnosis"], bundle["watchdog"].keys()
+    assert any("stalled" in d for d in w["stall_diagnosis"].values())
+    assert w["stats"]["health"]["stalled_groups"] == [0]
+    # Series window timestamps BRACKET the injection instant: the
+    # series and the fault timeline join on the one monotonic clock.
+    sw = w["series_window"]
+    assert sw, "empty series window"
+    name, s = next(iter(sorted(sw.items())))
+    assert s["t"][0] <= t_inj <= s["t"][-1] + WINDOW, (name, s["t"][:2])
+    # Flight events: the injected faults are IN the bundle's ring, with
+    # ts (ns) landing inside the same window.
+    fl = [r for r in bundle["flight_recorder"]["records"]
+          if r["name"] == "nemesis.partition_isolate"]
+    assert fl, "injected fault missing from the flight ring"
+    assert abs(fl[0]["ts"] / 1e9 - t_inj) < 0.5, (fl[0]["ts"], t_inj)
+
+    # Control run: same seed machinery, zero events, zero incidents.
+    _, control_incidents = run([], "control")
+    assert control_incidents == [], control_incidents
+
+
+# ------------------------------------------------- zero-overhead contract
+
+
+def test_one_device_get_per_dispatch_with_pulse_sampling(monkeypatch):
+    """The kernelscope zero-extra-readback contract must survive pulse:
+    sampling rides stats() (a pure host read), so a warmed fabric still
+    performs exactly ONE jax.device_get per dispatch while the pulse
+    clock runs."""
+    import jax
+
+    from tpu6824.core.fabric import PaxosFabric
+
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=16,
+                      auto_step=False, io_mode="compact")
+    pulse = Pulse(fabric=fab, interval=0.01, cap=64).start()
+    try:
+        for seq in range(3):
+            for p in range(3):
+                fab.start(0, p, seq, f"v{seq}")
+        fab.step(3)  # warm
+        _wait(lambda: pulse.samples >= 3, 10.0, "pulse sampling")
+        calls = {"n": 0}
+        real = jax.device_get
+
+        def counting(x):
+            calls["n"] += 1
+            return real(x)
+
+        monkeypatch.setattr(jax, "device_get", counting)
+        fab.step(5)
+        assert calls["n"] == 5, (
+            f"{calls['n']} device_gets over 5 dispatches with pulse on")
+    finally:
+        pulse.stop()
+        fab.stop_clock()
+
+
+def test_jitguard_zero_recompiles_with_pulse_and_watchdog(tmp_path):
+    """Steady-state contract with the whole pulse stack live: a warmed
+    fabric under pulse sampling + watchdog evaluation performs ZERO
+    backend compiles."""
+    from tpu6824.analysis.jitguard import RecompileGuard
+    from tpu6824.core.fabric import PaxosFabric
+
+    fab = PaxosFabric(ngroups=2, npeers=3, ninstances=16,
+                      io_mode="compact", steps_per_dispatch=2)
+    pulse = Pulse(fabric=fab, interval=0.02, cap=64).start()
+    wd = Watchdog(pulse, outdir=str(tmp_path), window=2.0,
+                  cooldown=60.0).start()
+    try:
+        seq = 0
+        for _ in range(6):  # warm every variant
+            fab.start_many([(g, p, seq + g, f"w{seq}") for g in range(2)
+                            for p in range(3)])
+            seq += 2
+            fab.step(2)
+        _wait(lambda: pulse.samples >= 3, 10.0, "pulse sampling")
+        with RecompileGuard() as g:
+            for _ in range(10):
+                fab.start_many([(gr, p, seq + gr, f"s{seq}")
+                                for gr in range(2) for p in range(3)])
+                seq += 2
+                fab.step(2)
+        assert g.compiles == 0
+        # And the watchdog's jit rule saw nothing (grace aside, the
+        # compile counter never moved during the guarded region).
+        assert not any(i["rule"] == "jit-recompile" for i in wd.incidents)
+    finally:
+        wd.stop()
+        pulse.stop()
+        fab.stop_clock()
+
+
+# ------------------------------------------------------- fleet plumbing
+
+
+def test_pulse_rpc_and_collector_merge_over_fabric_service_wire():
+    import shutil
+
+    from tpu6824.core.fabric import PaxosFabric
+    from tpu6824.core.fabric_service import remote_fabric, serve_fabric
+    from tpu6824.harness import make_sockdir
+
+    d = make_sockdir("pulsesvc")
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=16, auto_step=True)
+    pulse = fab.start_pulse(interval=0.05)
+    srv = serve_fabric(fab, d + "/fab")
+    try:
+        for seq in range(3):
+            for p in range(3):
+                fab.start(0, p, seq, f"w{seq}")
+        _wait(lambda: fab.stats()["decided_cells"] >= 3, msg="decides")
+        # Wait for the SERIES, not a bare sample count: early samples
+        # can all predate the first decided delta (slow first compile),
+        # and the rate series only exists once a delta landed.
+        _wait(lambda: pulse.last("fabric.decided_cells.rate") is not None,
+              15.0, "decided-rate series")
+        rf = remote_fabric(d + "/fab", timeout=10.0)
+        ps = rf.pulse()
+        assert ps["enabled"] is True and ps["series"], ps.keys()
+        assert "fabric.health.decided_cells" in ps["series"]
+        col = Collector().add("fabproc", rf).add_local("harness")
+        snap = col.snapshot()
+        assert not snap["errors"], snap["errors"]
+        assert snap["processes"]["fabproc"]["pulse"]["enabled"] is True
+        # In-process serve: the "harness" member shares the process
+        # pulse (one per process by design) — both members report it.
+        assert snap["processes"]["harness"]["pulse"]["enabled"] is True
+        merged = Collector.merge_pulse(snap)
+        assert merged is not None
+        key = "fabric.decided_cells.rate"
+        assert key in merged and "fabproc" in merged[key]["per_process"]
+        assert "latest_sum" in merged[key]
+    finally:
+        srv.kill()
+        obs_pulse.stop()
+        fab.stop_clock()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def test_collector_treats_missing_pulse_rpc_as_disabled_shell():
+    """Back-compat: a pre-pulse member (no `pulse` RPC / attribute
+    raising) is fully healthy — the snapshot carries the disabled
+    shell, NOT an error entry, so mixed fleets and the top --once
+    smoke stay green."""
+    class OldMember:
+        def stats(self):
+            return {"ok": True}
+
+        def metrics(self):
+            return {"counters": {}, "gauges": {}, "histograms": {}}
+
+        def pulse(self):  # a Proxy synthesizes every method name
+            raise RuntimeError("no such rpc: pulse")
+
+    col = Collector().add("old", OldMember())
+    snap = col.snapshot()
+    assert snap["errors"] == {}, snap["errors"]
+    pu = snap["processes"]["old"]["pulse"]
+    assert pu["enabled"] is False and pu["series"] == {}
+    assert "no such rpc" in pu["unavailable"]
+    assert Collector.merge_pulse(snap) is None
+
+
+def test_pulse_restart_resamples():
+    """stop()/start() on one instance must resume sampling (a stuck
+    _stop event used to freeze the series silently)."""
+    p = Pulse(interval=0.02, cap=8).start()
+    _wait(lambda: p.samples >= 2, 10.0, "first run samples")
+    p.stop()
+    n = p.samples
+    p.start()
+    _wait(lambda: p.samples >= n + 2, 10.0, "post-restart samples")
+    p.stop()
+
+
+def test_collector_polls_live_clerk_frontend_process():
+    """Satellite (ISSUE 10): the fleet snapshot over a live ClerkFrontend
+    includes the frontend.* metrics and the rpc.pool.* counters, the
+    frontend's stats surface rides along, and dead-member-as-data still
+    holds next to it."""
+    import shutil
+
+    from tpu6824.harness import make_sockdir
+    from tpu6824.rpc import connect
+    from tpu6824.services.frontend import ClerkFrontend, FrontendClerk
+    from tpu6824.services.kvpaxos import make_cluster
+
+    d = make_sockdir("fecol")
+    fabric, servers = make_cluster(nservers=3, ninstances=32)
+    fe = ClerkFrontend(servers, addr=d + "/fe")
+    try:
+        ck = FrontendClerk([d + "/fe"])
+        for i in range(8):
+            ck.put(f"k{i % 2}", f"v{i}", timeout=30.0)
+        assert ck.get("k0", timeout=30.0).startswith("v")
+        ck.close()
+        rf = connect(d + "/fe", timeout=10.0)
+        rf.stats()  # prime the pooled transport (rpc.pool.* counters)
+
+        class Dead:
+            def stats(self):
+                raise ConnectionRefusedError("gone")
+
+        col = Collector().add("frontend", rf).add("dead", Dead())
+        snap = col.snapshot()
+        assert "dead.stats" in snap["errors"], snap["errors"]
+        proc = snap["processes"]["frontend"]
+        st = proc["stats"]["frontend"]
+        assert st["groups"] == 1 and st["replicas"] == [3]
+        assert st["pending_frames"] >= 0 and "op_timeout" in st
+        counters = proc["metrics"]["counters"]
+        assert counters["frontend.ops"]["total"] >= 9, (
+            counters.get("frontend.ops"))
+        assert counters["frontend.frames"]["total"] >= 9
+        assert "rpc.pool.hits" in counters or "rpc.pool.misses" in counters
+        assert proc["pulse"]["enabled"] is False  # stable shell
+        assert "records" in proc["flight"]
+        json.dumps(snap)  # artifact-safe
+    finally:
+        fe.kill()
+        for s in servers:
+            s.dead = True
+        fabric.stop_clock()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# ------------------------------------------------------------- top smoke
+
+
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+
+def _assert_no_nonfinite(obj, path="$"):
+    if isinstance(obj, float):
+        assert math.isfinite(obj), f"non-finite at {path}"
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _assert_no_nonfinite(v, f"{path}.{k}")
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _assert_no_nonfinite(v, f"{path}[{i}]")
+
+
+def test_top_once_json_smoke_against_live_fabricd():
+    """CI smoke: `python -m tpu6824.obs.top --once --json` against a
+    live fabricd (with --pulse) emits ONE JSON object with the stable
+    per-process key set and no NaN/Inf anywhere."""
+    import shutil
+    import tempfile
+
+    from tests.test_process_cluster import wait_socket
+    from tpu6824.core.fabric_service import remote_fabric
+
+    d = tempfile.mkdtemp(prefix="topsmoke", dir="/var/tmp")
+    proc = None
+    try:
+        addr = os.path.join(d, "fab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu6824.main.fabricd", "--addr", addr,
+             "--groups", "1", "--peers", "3", "--instances", "16",
+             "--ttl", "120", "--pulse", "0.1"],
+            env=_ENV, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        wait_socket(addr, timeout=90.0)
+        rf = remote_fabric(addr, timeout=30.0)
+        for seq in range(3):
+            for p in range(3):
+                rf.start(0, p, seq, f"op{seq}")
+        _wait(lambda: rf.stats()["decided_cells"] >= 3, 60.0, "decides")
+        _wait(lambda: rf.pulse()["samples"] >= 3, 30.0, "pulse samples")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu6824.obs.top", "--once", "--json",
+             "--addr", addr],
+            env=_ENV, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        assert len(lines) == 1, r.stdout
+        view = json.loads(
+            lines[0],
+            parse_constant=lambda c: pytest.fail(f"non-finite {c} in top"))
+        _assert_no_nonfinite(view)
+        assert view["schema"] == "top-1.0.0"
+        assert view["errors"] == {}
+        (pname, p), = view["processes"].items()
+        from tpu6824.obs.top import _PROC_KEYS
+
+        assert set(p) == set(_PROC_KEYS)
+        assert p["decided_cells"] >= 3
+        assert p["pulse"]["enabled"] is True and p["pulse"]["samples"] >= 3
+        assert p["protocol"]["decides"] is None or \
+            p["protocol"]["decides"] >= 0
+        # The human rendering exercises the same view without crashing.
+        r2 = subprocess.run(
+            [sys.executable, "-m", "tpu6824.obs.top", "--once",
+             "--addr", addr],
+            env=_ENV, capture_output=True, text=True, timeout=120)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        assert "tpu6824 top" in r2.stdout
+    finally:
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=10)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# ------------------------------------------- environment-aware benchdiff
+
+
+def _env_block(ms, spins=("start", "service", "end"), loadavg=None,
+               eff=1.0):
+    return {"cpus": 1, "effective_cpus": eff, "cgroup": {},
+            "loadavg": loadavg or [0.1, 0.1, 0.1],
+            "calibration": {"unit": "ms",
+                            "spins": [{"at": a, "ms": ms} for a in spins]}}
+
+
+def _r08():
+    from tpu6824.obs import benchdiff
+
+    return benchdiff.load_artifact(os.path.join(REPO, "BENCH_r08.json"))
+
+
+def test_environment_snapshot_and_spin_shape():
+    env = obs_pulse.environment_snapshot()
+    assert env["cpus"] >= 1 and env["effective_cpus"] > 0
+    assert isinstance(env["cgroup"], dict)
+    ms = obs_pulse.calibration_spin()
+    assert 0 < ms < 10000
+    _assert_no_nonfinite(env)
+
+
+def test_benchdiff_contended_box_demotes_host_edges_only():
+    """THE environment acceptance: under a demonstrably degraded box
+    (calibration spins 3×+ slower), host-edge regressions report
+    suspect-environment and do not cost exit 1 — while the same-sized
+    drop on a device leg, and any regression between environment-equal
+    artifacts, still gate hard."""
+    from tpu6824.obs import benchdiff
+
+    old = _r08()
+    old["environment"] = _env_block(20.0)
+    # Contended re-run: same tree, box 3.5x slower, host legs halved.
+    new = copy.deepcopy(old)
+    new["environment"] = _env_block(70.0)
+    new["service"]["value"] *= 0.3
+    new["service"]["clerk"]["value"] *= 0.3
+    new["service"]["clerk_frontend"]["value"] *= 0.3
+    new["wire"]["value"] *= 0.3
+    rep = benchdiff.compare(old, new)
+    by = {r["metric"]: r["verdict"] for r in rep["results"]}
+    for m in ("service/value", "service/clerk/value",
+              "service/clerk_frontend/value", "wire/value"):
+        assert by[m] == "suspect-environment", (m, by[m])
+    assert rep["regressions"] == 0 and rep["suspect"] >= 4
+    assert any("calibration spin" in n for n in rep["notes"])
+    # A device-path regression under the SAME contention still gates.
+    new2 = copy.deepcopy(new)
+    new2["value"] = old["value"] * 0.3
+    rep2 = benchdiff.compare(old, new2)
+    by2 = {r["metric"]: r["verdict"] for r in rep2["results"]}
+    assert by2["value"] == "REGRESSED"
+    assert rep2["regressions"] >= 1
+    # Environment-equal artifacts: host-edge regressions stay hard.
+    new3 = copy.deepcopy(old)
+    new3["wire"]["value"] *= 0.3
+    rep3 = benchdiff.compare(old, new3)
+    by3 = {r["metric"]: r["verdict"] for r in rep3["results"]}
+    assert by3["wire/value"] == "REGRESSED"
+    # --strict-env disables the demotion entirely.
+    rep4 = benchdiff.compare(old, new, strict_env=True)
+    assert rep4["regressions"] >= 4 and rep4["suspect"] == 0
+
+
+def test_benchdiff_env_suspicion_signals():
+    from tpu6824.obs.benchdiff import env_suspicion
+
+    base = {"environment": _env_block(20.0)}
+    # No environment on either side: nothing to judge, gate stays hard.
+    assert env_suspicion({}, {}) == []
+    assert env_suspicion(base, {}) == []
+    # Within-run instability: the box degraded mid-bench.
+    wobble = {"environment": _env_block(20.0)}
+    wobble["environment"]["calibration"]["spins"][-1]["ms"] = 55.0
+    assert any("unstable" in r for r in env_suspicion(base, wobble))
+    # Quota shrink.
+    small = {"environment": _env_block(20.0, eff=0.4)}
+    assert any("cpu budget" in r for r in env_suspicion(base, small))
+    # Load spike at run start.
+    busy = {"environment": _env_block(20.0, loadavg=[3.0, 2.0, 1.0])}
+    assert any("load average" in r for r in env_suspicion(base, busy))
+    # Equivalent boxes: silent.
+    assert env_suspicion(base, {"environment": _env_block(22.0)}) == []
+
+
+def test_benchdiff_cli_strict_env_and_exit_codes(tmp_path):
+    from tpu6824.obs import benchdiff
+
+    old = _r08()
+    old["environment"] = _env_block(20.0)
+    new = copy.deepcopy(old)
+    new["environment"] = _env_block(70.0)
+    new["wire"]["value"] *= 0.3
+    po, pn = tmp_path / "old.json", tmp_path / "new.json"
+    po.write_text(json.dumps(old))
+    pn.write_text(json.dumps(new))
+    assert benchdiff.main([str(po), str(pn)]) == 0  # suspect, not fatal
+    assert benchdiff.main([str(po), str(pn), "--strict-env"]) == 1

@@ -23,8 +23,17 @@ importable (and fast) without it.
 
 from __future__ import annotations
 
+from tpu6824.obs import metrics as _metrics
+
 _compile_events = 0
 _listener_registered = False
+
+# Registry mirror of the compile count (module scope per the tpusan
+# metric-unregistered rule): once a listener is registered, every
+# backend compile also bumps `jitguard.compiles`, which the pulse layer
+# turns into a rate series the watchdog's steady-state jit-recompile
+# rule fires on.
+_M_COMPILES = _metrics.counter("jitguard.compiles")
 
 
 def _ensure_listener() -> None:
@@ -41,6 +50,7 @@ def _ensure_listener() -> None:
         global _compile_events
         if event == "/jax/core/compile/backend_compile_duration":
             _compile_events += 1
+            _M_COMPILES.inc()
 
     jax.monitoring.register_event_duration_secs_listener(_on_duration)
     _listener_registered = True

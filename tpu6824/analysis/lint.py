@@ -91,6 +91,13 @@ RULES: dict[str, str] = {
         "data never hit the platter) and the dir fsync (the rename "
         "itself can be lost), and it bypasses the durafault injection "
         "seam; route the write through durafs.atomic_write()",
+    "unbounded-obs-buffer":
+        "unbounded list/deque accumulation in tpu6824/obs/ — telemetry "
+        "buffers live for the process lifetime and are scraped whole by "
+        "pollers, so growth without a cap is a slow leak that lands "
+        "exactly when observability matters most (long soaks); give "
+        "every ring a cap with counted drops (deque(maxlen=...)) like "
+        "the flight recorder does",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -122,6 +129,10 @@ _RENAME_CALLS = {"os.rename", "os.replace"}
 # inline callbacks and the native server's epoll-thread hooks.  Callback
 # convention: `_on_*` / `*_cb` function names inside these modules.
 _EVENTLOOP_SCOPE = ("services/frontend.py", "rpc/native_server.py")
+# Observability-buffer scope (unbounded-obs-buffer): every obs/ module —
+# pulse rings, flight recorder, watchdog incidents all hold process-
+# lifetime state that pollers serialize whole.
+_OBS_BUF_SCOPE = ("obs/",)
 
 # Receivers that denote the tpuscope metrics registry, and the
 # get-or-create constructors the metric-unregistered rule polices.
@@ -262,12 +273,14 @@ class _FileLint(ast.NodeVisitor):
         self.met_home = _in_scope(relpath, (_MET_HOME,))
         self.durafs_home = _in_scope(relpath, (_DURAFS_HOME,))
         self.eventloop_scope = _in_scope(relpath, _EVENTLOOP_SCOPE)
+        self.obs_buf_scope = _in_scope(relpath, _OBS_BUF_SCOPE)
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
         self._jit_defs = self._resolve_jit_defs()
         self._scan_persistence()
         self._scan_eventloop_callbacks()
+        self._scan_obs_buffers()
         self._fn_stack: list[ast.AST] = []
         self._calls_subscribe = False
         self._refs_columnar_consumer = False
@@ -434,6 +447,52 @@ class _FileLint(ast.NodeVisitor):
                         self._flag(n, "blocking-in-eventloop",
                                    f"lock wait (`with` on a lock) inside "
                                    f"event-loop callback {fn.name}()")
+
+    def _scan_obs_buffers(self) -> None:
+        """unbounded-obs-buffer: inside tpu6824/obs/, (a) any deque
+        constructed without an explicit maxlen, and (b) any append/
+        extend onto a `self.<attr>` that the module initializes as a
+        plain list literal — both are accumulation without a cap.
+        Fixed-size list attributes (`[0] * N`) and locals are exempt;
+        a genuinely-bounded registry (e.g. one observer per watchdog)
+        suppresses with a justification."""
+        if not self.obs_buf_scope:
+            return
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                if d in ("deque", "collections.deque") and \
+                        not any(kw.arg == "maxlen" for kw in n.keywords):
+                    self._flag(n, "unbounded-obs-buffer",
+                               "deque without maxlen in an obs module — "
+                               "telemetry rings must be bounded with "
+                               "counted drops")
+        list_attrs: set[str] = set()
+        for n in ast.walk(self.tree):
+            target = value = None
+            if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                target, value = n.targets[0], n.value
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                target, value = n.target, n.value
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self" and \
+                    isinstance(value, ast.List):
+                list_attrs.add(target.attr)
+        for n in ast.walk(self.tree):
+            if not (isinstance(n, ast.Call) and
+                    isinstance(n.func, ast.Attribute) and
+                    n.func.attr in ("append", "extend", "insert")):
+                continue
+            recv = n.func.value
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self" and recv.attr in list_attrs:
+                self._flag(n, "unbounded-obs-buffer",
+                           f"self.{recv.attr}.{n.func.attr}() onto an "
+                           "uncapped list attribute in an obs module — "
+                           "use a deque(maxlen=...) ring with counted "
+                           "drops")
 
     def _resolve_jit_defs(self) -> set[int]:
         """FunctionDefs that are jit-compiled: decorated with jax.jit /

@@ -42,6 +42,7 @@ from tpu6824.core.kernel import (
 )
 from tpu6824.obs import collector as obs_collector
 from tpu6824.obs import metrics as obs_metrics
+from tpu6824.obs import pulse as obs_pulse
 from tpu6824.obs import tracing as obs_tracing
 from tpu6824.utils import crashsink, durafs
 from tpu6824.utils.locks import new_rlock
@@ -2185,6 +2186,25 @@ class PaxosFabric:
         Perfetto timeline (each process's records are namespaced by the
         collector; see obs/collector.py)."""
         return obs_tracing.flight_snapshot()
+
+    def pulse(self) -> dict:
+        """The process-global pulse time-series snapshot (obs/pulse.py)
+        — counters-as-rates, gauges, and per-interval latency
+        percentiles in bounded rings — served over the fabric_service
+        wire so `obs.top` and the fleet collector see throughput OVER
+        TIME, not just the instant's totals.  A stable `enabled: False`
+        shell when no pulse is running in this process."""
+        return obs_pulse.series_snapshot()
+
+    def start_pulse(self, interval: float | None = None,
+                    cap: int | None = None,
+                    stall_after: float | None = None):
+        """Start (or return) the process pulse sampling THIS fabric —
+        the health wiring fabricd's `--pulse` flag uses.  Each tick
+        polls stats() (a pure read), so the registry's health gauges and
+        the watchdog's stall evidence stay one interval fresh."""
+        return obs_pulse.start(fabric=self, interval=interval, cap=cap,
+                               stall_after=stall_after)
 
     def _health_locked(self, stall_after: float) -> dict:
         """Graceful-degradation report: how stale the host mirrors are

@@ -46,8 +46,12 @@ FABRIC_RPCS = [
     # process-global tpuscope registry snapshot — one JSON shape spanning
     # rpc/clerk/service/fabric counters; flight is the process-global
     # flight-recorder dump the kernelscope fleet collector merges into
-    # one cross-process Perfetto timeline)
-    "dims", "stats", "metrics", "flight",
+    # one cross-process Perfetto timeline; pulse is the continuous
+    # time-series snapshot — bounded rings of counter rates / gauges /
+    # per-interval latency percentiles sampled by obs/pulse.py, the
+    # surface `python -m tpu6824.obs.top` and the watchdog read — a
+    # stable `enabled: False` shell when no pulse runs in the process)
+    "dims", "stats", "metrics", "flight", "pulse",
 ]
 
 

@@ -805,6 +805,15 @@ class ReplayArtifact:
         # trace_id — the "what was the system doing at that moment" the
         # verdict alone cannot answer.
         d["flight_recorder"] = _tracing.flight_snapshot()
+        # pulse: when continuous telemetry is running in this process,
+        # the artifact carries the recent time-series window too — the
+        # same evidence a watchdog bundle captures for a live incident,
+        # so an injected failure and a caught-in-production one read
+        # identically (series timestamps join the timeline via t0).
+        from tpu6824.obs import pulse as _pulse
+        ps = _pulse.series_snapshot()
+        if ps.get("enabled"):
+            d["pulse"] = ps
         # kernelscope: when a fleet collector is attached (wire-deployment
         # soaks), the artifact carries the merged multi-process snapshot —
         # every process's metrics/stats/flight under its own namespace,

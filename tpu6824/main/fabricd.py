@@ -42,7 +42,19 @@ def main(argv=None):
                          "shutdown")
     ap.add_argument("--checkpoint-interval", type=float, default=0.0)
     ap.add_argument("--checkpoint-keep", type=int, default=3)
+    ap.add_argument("--pulse", type=float, default=0.0, metavar="SECS",
+                    help="sample continuous time-series telemetry every "
+                         "SECS seconds (obs/pulse.py; served as the "
+                         "`pulse` RPC, rendered by python -m "
+                         "tpu6824.obs.top); 0 = off")
+    ap.add_argument("--watchdog-dir", default=None, metavar="DIR",
+                    help="run the anomaly watchdog over the pulse "
+                         "series (requires --pulse); evidence bundles "
+                         "for stalls/collapses/spikes land in DIR in "
+                         "the nemesis-artifact format")
     args = ap.parse_args(argv)
+    if args.watchdog_dir and not args.pulse:
+        ap.error("--watchdog-dir requires --pulse")
     if args.checkpoint_interval and not (args.checkpoint
                                          or args.checkpoint_dir):
         ap.error("--checkpoint-interval requires --checkpoint or "
@@ -74,6 +86,13 @@ def main(argv=None):
             ninstances=args.instances, seed=args.seed, auto_step=True,
         )
     srv = serve_fabric(fabric, args.addr)
+    if args.pulse:
+        pulse = fabric.start_pulse(interval=args.pulse)
+        if args.watchdog_dir:
+            from tpu6824.obs.watchdog import Watchdog
+
+            os.makedirs(args.watchdog_dir, exist_ok=True)
+            Watchdog(pulse, outdir=args.watchdog_dir).start()
     ckptd = None
     if args.checkpoint_dir:
         ckptd = ContinuousCheckpointer(

@@ -17,19 +17,36 @@ Three parts, threaded through every other layer (ISSUE 5):
 
 kernelscope (ISSUE 6) adds two fleet-level tools on top:
 
-  - `obs.collector` — poll `stats()/metrics()/flight()` from every
-    process of a wire deployment (plus the local process) into ONE
-    namespaced snapshot and ONE merged Perfetto timeline; sums the
+  - `obs.collector` — poll `stats()/metrics()/flight()/pulse()` from
+    every process of a wire deployment (plus the local process) into
+    ONE namespaced snapshot and ONE merged Perfetto timeline; sums the
     device-resident per-group protocol counters fleet-wide.
   - `obs.benchdiff` — `python -m tpu6824.obs.benchdiff OLD NEW`
     compares two BENCH_*.json artifacts per leg/metric with noise
-    thresholds and exits non-zero on regression.
+    thresholds and exits non-zero on regression; artifacts carrying an
+    `environment` block (cgroup quota, loadavg, calibration spins) get
+    host-edge regressions demoted to `suspect-environment` when the
+    box itself demonstrably degraded between the runs.
+
+pulse (ISSUE 10) adds the *over time* layer:
+
+  - `obs.pulse` — continuous bounded-ring time-series over the
+    registry (counters→rates, gauges, per-interval histogram
+    p50/p95/p99), served as the fabric_service `pulse` RPC and merged
+    fleet-wide by the Collector; also owns the environment probes
+    (`environment_snapshot`/`calibration_spin`) bench records.
+  - `obs.watchdog` — rules over those series (stalls with kernelscope
+    diagnosis, throughput collapse, latency spikes, queue growth,
+    thread crashes, drop climb, steady-state recompiles); on trigger it
+    auto-captures an evidence bundle in the nemesis-artifact format.
+  - `python -m tpu6824.obs.top` — live single-process-or-fleet
+    terminal dashboard; `--once --json` for scripting/CI.
 
 Stdlib-only on purpose: importable from the analysis CLI, daemons, and
 clerks without dragging in JAX.
 """
 
-from tpu6824.obs import collector, metrics, tracing  # noqa: F401
+from tpu6824.obs import collector, metrics, pulse, tracing, watchdog  # noqa: F401
 from tpu6824.obs.collector import Collector, local_handle  # noqa: F401
 from tpu6824.obs.tracing import (  # noqa: F401
     FLIGHT,

@@ -26,13 +26,28 @@ p50/p95/p99) come from log2 buckets, so a single bucket-boundary
 wobble reads as exactly 2×: their thresholds sit above 2× and below
 the 4× a real two-bucket regression costs.
 
-Verdicts per metric: ok / improved / REGRESSED / skipped(<why>).  A
-metric the old artifact reported but the new one lost (leg errored or
-vanished) is a regression by default — a leg that stops reporting is
-how a perf break hides — `--allow-missing` demotes that to a skip.
-Artifacts from different platforms (or different headline shapes, for
-the shape-dependent metrics) are not comparable; incomparable metrics
-are skipped loudly, and `--force` compares them anyway.
+Verdicts per metric: ok / improved / REGRESSED / suspect-environment /
+skipped(<why>).  A metric the old artifact reported but the new one
+lost (leg errored or vanished) is a regression by default — a leg that
+stops reporting is how a perf break hides — `--allow-missing` demotes
+that to a skip.  Artifacts from different platforms (or different
+headline shapes, for the shape-dependent metrics) are not comparable;
+incomparable metrics are skipped loudly, and `--force` compares them
+anyway.
+
+Environment awareness (pulse, ISSUE 10): bench artifacts carry an
+`environment` block — cgroup cpu quota/shares, load averages, and
+fixed-work calibration spins taken at every leg boundary
+(obs/pulse.py).  When the NEW run's box demonstrably degraded against
+the baseline's (calibration spins ≥1.5× slower, quota shrunk, or the
+spins unstable within the run — the r08 failure mode, where
+service.value "regressed" −55% with zero code change), a would-be
+REGRESSED verdict on a HOST-BOUND metric is demoted to
+`suspect-environment`: annotated with the evidence, excluded from the
+exit-1 count, and re-judgeable on a quiet box.  Device-path metrics
+are never demoted (the kernel doesn't share the box's Python
+scheduler), so an injected real regression on the headline still exits
+1; `--strict-env` restores hard gating everywhere.
 
 Stdlib-only like the rest of obs/ — runnable on artifacts from any
 machine without JAX installed.
@@ -44,7 +59,8 @@ import argparse
 import json
 import sys
 
-__all__ = ["METRICS", "Metric", "compare", "load_artifact", "main"]
+__all__ = ["METRICS", "Metric", "compare", "env_suspicion",
+           "load_artifact", "main"]
 
 
 class Metric:
@@ -62,15 +78,20 @@ class Metric:
     metric is only comparable when every one matches, so a trimmed
     BENCH_SERVICE_GROUPS run never false-alarms against a full-shape
     recorded artifact.
+    host_bound: the metric's bottleneck is the host Python/socket path,
+    not the device kernel — exactly the legs the box's scheduler share
+    moves 2-5× (r08).  Only host-bound regressions are demotable to
+    `suspect-environment` when the environment blocks disagree.
     """
 
     def __init__(self, path, tol, higher_is_better=True,
-                 shape_dependent=False, leg_shape=()):
+                 shape_dependent=False, leg_shape=(), host_bound=False):
         self.path = tuple(path)
         self.tol = tol
         self.higher_is_better = higher_is_better
         self.shape_dependent = shape_dependent
         self.leg_shape = tuple(tuple(p) for p in leg_shape)
+        self.host_bound = host_bound
 
     @property
     def name(self) -> str:
@@ -96,9 +117,9 @@ METRICS = [
     # r06→r07 with no code regression).  Each gates on its OWN leg
     # shape — env-trimmed runs (BENCH_SERVICE_GROUPS=16 in the bench
     # contract test) must skip, not false-alarm.
-    Metric(("service", "value"), 0.35,
+    Metric(("service", "value"), 0.35, host_bound=True,
            leg_shape=[("service", "shape")]),
-    Metric(("service", "clerk", "value"), 0.45,
+    Metric(("service", "clerk", "value"), 0.45, host_bound=True,
            leg_shape=[("service", "clerk", "groups"),
                       ("service", "clerk", "width")]),
     # Batched frontend leg (ISSUE 8): host-edge noisy like the clerk leg
@@ -107,29 +128,29 @@ METRICS = [
     # contract runs (BENCH_FE_GROUPS=2, 2x32 sweep) skip loudly.  First
     # recorded artifact (r08) baselines it: r07 has no leg → this entry
     # reports skipped(no-baseline) once, then gates every round after.
-    Metric(("service", "clerk_frontend", "value"), 0.65,
+    Metric(("service", "clerk_frontend", "value"), 0.65, host_bound=True,
            leg_shape=[("service", "clerk_frontend", "groups"),
                       ("service", "clerk_frontend", "conns"),
                       ("service", "clerk_frontend", "batch_width")]),
     Metric(("service", "clerk_frontend", "latency", "p50_ms"), 0.65,
-           higher_is_better=False,
+           higher_is_better=False, host_bound=True,
            leg_shape=[("service", "clerk_frontend", "groups"),
                       ("service", "clerk_frontend", "conns"),
                       ("service", "clerk_frontend", "batch_width")]),
     # Host-edge legs: the demonstrated noise floor is −55% (wire
     # −40%/−53%, thread-per-clerk −55% between real artifacts).
-    Metric(("wire", "value"), 0.65),
-    Metric(("wire", "pooled"), 0.65),
+    Metric(("wire", "value"), 0.65, host_bound=True),
+    Metric(("wire", "pooled"), 0.65, host_bound=True),
     Metric(("service", "clerk", "thread_per_clerk", "value"), 0.65,
-           leg_shape=[("service", "clerk", "groups")]),
+           host_bound=True, leg_shape=[("service", "clerk", "groups")]),
     # Clerk op latency (lower is better; ms percentiles from the timed
     # window — host-bound like the throughput above).
     Metric(("service", "clerk", "latency", "p50_ms"), 0.65,
-           higher_is_better=False,
+           higher_is_better=False, host_bound=True,
            leg_shape=[("service", "clerk", "groups"),
                       ("service", "clerk", "width")]),
     Metric(("service", "clerk", "latency", "p95_ms"), 0.65,
-           higher_is_better=False,
+           higher_is_better=False, host_bound=True,
            leg_shape=[("service", "clerk", "groups"),
                       ("service", "clerk", "width")]),
     # Recovery leg (durafault): restore-from-snapshot wall time — host
@@ -137,21 +158,82 @@ METRICS = [
     # own shape like the service legs (a BENCH_RECOVERY_GROUPS-trimmed
     # run must skip, not false-alarm).
     Metric(("recovery", "recovery_time_ms", "p50"), 0.65,
-           higher_is_better=False, leg_shape=[("recovery", "shape")]),
+           higher_is_better=False, host_bound=True,
+           leg_shape=[("recovery", "shape")]),
     Metric(("recovery", "recovery_time_ms", "p95"), 0.65,
-           higher_is_better=False, leg_shape=[("recovery", "shape")]),
+           higher_is_better=False, host_bound=True,
+           leg_shape=[("recovery", "shape")]),
     # Per-leg tpuscope histogram percentiles (new in kernelscope): log2
     # buckets quantize to powers of two, so anything under one bucket
     # (2×) is noise and two buckets (4×) is real — gate between them.
     Metric(("service", "clerk", "tpuscope", "histograms",
             "clerk.op_latency_us", "p95"), 2.0, higher_is_better=False,
+           host_bound=True,
            leg_shape=[("service", "clerk", "groups"),
                       ("service", "clerk", "width")]),
     Metric(("service", "clerk", "tpuscope", "histograms",
             "clerk.op_latency_us", "p99"), 2.0, higher_is_better=False,
+           host_bound=True,
            leg_shape=[("service", "clerk", "groups"),
                       ("service", "clerk", "width")]),
 ]
+
+# ------------------------------------------------- environment judgment
+
+# The new run's calibration spins must be this much slower (median) than
+# the baseline's before the box itself is suspect.  1.5× sits above the
+# spin's own jitter on a quiet box (< ±15% measured) and below the 2-5×
+# degradation the r08 bring-up recorded.
+SPIN_DRIFT = 1.5
+# Within one run, max/min spin beyond this spread means the box changed
+# UNDER the bench (a leg bracketed by a slow spin ran degraded).
+SPIN_SPREAD = 2.0
+
+
+def _median(vals):
+    s = sorted(vals)
+    return s[len(s) // 2]
+
+
+def env_suspicion(old: dict, new: dict) -> list[str]:
+    """Evidence that the NEW run's box degraded vs the baseline's —
+    empty when either artifact lacks an environment block (nothing to
+    judge: the gate stays hard) or the boxes look equivalent.  Each
+    reason is human-readable and lands verbatim in the report."""
+    oe, ne = old.get("environment"), new.get("environment")
+    if not isinstance(oe, dict) or not isinstance(ne, dict):
+        return []
+    reasons = []
+    ocal = (oe.get("calibration") or {}).get("spins") or []
+    ncal = (ne.get("calibration") or {}).get("spins") or []
+    oms = [s["ms"] for s in ocal if isinstance(s.get("ms"), (int, float))]
+    nms = [s["ms"] for s in ncal if isinstance(s.get("ms"), (int, float))]
+    if oms and nms:
+        om, nm = _median(oms), _median(nms)
+        if om > 0 and nm > om * SPIN_DRIFT:
+            reasons.append(
+                f"calibration spin {nm:.1f}ms vs {om:.1f}ms baseline "
+                f"(x{nm / om:.1f} slower: less effective CPU)")
+        if min(nms) > 0 and max(nms) > min(nms) * SPIN_SPREAD:
+            reasons.append(
+                f"calibration unstable within the new run "
+                f"({min(nms):.1f}-{max(nms):.1f}ms across leg "
+                "boundaries: box degraded mid-bench)")
+    oq = oe.get("effective_cpus")
+    nq = ne.get("effective_cpus")
+    if isinstance(oq, (int, float)) and isinstance(nq, (int, float)) \
+            and nq < oq * 0.8:
+        reasons.append(f"cgroup cpu budget shrank {oq:g} -> {nq:g} "
+                       "effective cpus")
+    nl = ne.get("loadavg")
+    if isinstance(nl, list) and nl and isinstance(nq, (int, float)) \
+            and nq > 0 and nl[0] / nq > 1.5:
+        ol = oe.get("loadavg")
+        if not (isinstance(ol, list) and ol) or nl[0] > 2 * ol[0]:
+            reasons.append(
+                f"load average {nl[0]:g} over {nq:g} effective cpus at "
+                "run start (external contention)")
+    return reasons
 
 
 def _get_any(d, path):
@@ -197,13 +279,23 @@ def load_artifact(path: str) -> dict:
 
 
 def compare(old: dict, new: dict, tol_scale: float = 1.0,
-            allow_missing: bool = False, force: bool = False) -> dict:
+            allow_missing: bool = False, force: bool = False,
+            strict_env: bool = False) -> dict:
     """Diff two (unwrapped) artifacts over METRICS.
 
-    Returns {"results": [...], "regressions": n, "compared": n,
-    "notes": [...]}; callers gate on `regressions`."""
+    Returns {"results": [...], "regressions": n, "suspect": n,
+    "compared": n, "notes": [...], "environment": [...reasons]};
+    callers gate on `regressions` — `suspect` entries are host-bound
+    would-be regressions demoted because the environment blocks show
+    the box itself degraded (`strict_env` disables the demotion)."""
     results = []
     notes = []
+    suspicion = [] if strict_env else env_suspicion(old, new)
+    if suspicion:
+        notes.append("environment suspect: " + "; ".join(suspicion) +
+                     " — host-bound regressions demoted to "
+                     "suspect-environment (re-run on a quiet box, or "
+                     "--strict-env to gate hard)")
     same_platform = old.get("platform") == new.get("platform")
     same_shape = old.get("metric") == new.get("metric") \
         and old.get("kernel") == new.get("kernel")
@@ -221,7 +313,7 @@ def compare(old: dict, new: dict, tol_scale: float = 1.0,
     if new.get("provisional"):
         notes.append("new artifact is PROVISIONAL (bench wedged mid-run): "
                      "missing legs are skipped, not regressions")
-    regressions = compared = 0
+    regressions = compared = suspect = 0
     for m in METRICS:
         ov, nv = _get(old, m.path), _get(new, m.path)
         entry = {"metric": m.name, "old": ov, "new": nv, "tol": m.tol}
@@ -261,15 +353,25 @@ def compare(old: dict, new: dict, tol_scale: float = 1.0,
             entry["delta"] = round(delta, 4)
             bad = -delta if m.higher_is_better else delta
             if bad > m.tol * tol_scale:
-                entry["verdict"] = "REGRESSED"
-                regressions += 1
+                if m.host_bound and suspicion:
+                    # The box demonstrably degraded between the runs and
+                    # this leg's bottleneck IS the box: annotate, don't
+                    # alarm.  Device-path legs never take this branch —
+                    # a real kernel regression still exits 1.
+                    entry["verdict"] = "suspect-environment"
+                    entry["why"] = "; ".join(suspicion)
+                    suspect += 1
+                else:
+                    entry["verdict"] = "REGRESSED"
+                    regressions += 1
             elif bad < -0.05:
                 entry["verdict"] = "improved"
             else:
                 entry["verdict"] = "ok"
         results.append(entry)
     return {"results": results, "regressions": regressions,
-            "compared": compared, "notes": notes}
+            "suspect": suspect, "compared": compared, "notes": notes,
+            "environment": suspicion}
 
 
 def render(report: dict) -> str:
@@ -288,7 +390,9 @@ def render(report: dict) -> str:
         lines.append(line)
     lines.append(
         f"benchdiff: {report['compared']} compared, "
-        f"{report['regressions']} regressed")
+        f"{report['regressions']} regressed"
+        + (f", {report['suspect']} suspect-environment"
+           if report.get("suspect") else ""))
     return "\n".join(lines)
 
 
@@ -308,6 +412,10 @@ def main(argv=None) -> int:
                          "regressions")
     ap.add_argument("--force", action="store_true",
                     help="compare across platform/shape mismatches")
+    ap.add_argument("--strict-env", action="store_true",
+                    help="never demote host-bound regressions to "
+                         "suspect-environment (gate hard even when the "
+                         "environment blocks show the box degraded)")
     args = ap.parse_args(argv)
     try:
         old, new = load_artifact(args.old), load_artifact(args.new)
@@ -315,7 +423,8 @@ def main(argv=None) -> int:
         print(f"benchdiff: {e}", file=sys.stderr)
         return 2
     report = compare(old, new, tol_scale=args.tol_scale,
-                     allow_missing=args.allow_missing, force=args.force)
+                     allow_missing=args.allow_missing, force=args.force,
+                     strict_env=args.strict_env)
     print(json.dumps(report, indent=1) if args.as_json else render(report))
     return 1 if report["regressions"] else 0
 

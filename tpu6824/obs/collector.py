@@ -38,6 +38,7 @@ import threading
 import time
 
 from tpu6824.obs import metrics as obs_metrics
+from tpu6824.obs import pulse as obs_pulse
 from tpu6824.obs import tracing as obs_tracing
 from tpu6824.utils import crashsink
 
@@ -80,6 +81,9 @@ class _LocalProcess:
     def flight(self):
         return obs_tracing.flight_snapshot()
 
+    def pulse(self):
+        return obs_pulse.series_snapshot()
+
 
 def local_handle(fabric=None) -> _LocalProcess:
     """A collector handle for THIS process (the harness/driver process is
@@ -91,7 +95,7 @@ def local_handle(fabric=None) -> _LocalProcess:
 class Collector:
     """Named fabric-shaped handles → one merged observability artifact."""
 
-    _SURFACES = ("stats", "metrics", "flight")
+    _SURFACES = ("stats", "metrics", "flight", "pulse")
 
     def __init__(self, poll_timeout: float = 15.0):
         # Per-MEMBER wall budget for one snapshot poll: a hung member
@@ -138,6 +142,21 @@ class Collector:
                 try:
                     val = fn()
                 except Exception as e:  # noqa: BLE001 — a dead member is data
+                    if surface == "pulse":
+                        # Back-compat: a pre-pulse fabricd answers the
+                        # pulse RPC with "no such rpc" while being
+                        # fully healthy — that is the documented
+                        # disabled shell, not an error (a member that
+                        # is actually DEAD still errors on its other
+                        # surfaces).
+                        with mu:
+                            out[surface] = {
+                                "schema": obs_pulse.SCHEMA_VERSION,
+                                "enabled": False, "interval": None,
+                                "cap": None, "samples": 0,
+                                "t_mono": None, "series": {},
+                                "unavailable": repr(e)[:200]}
+                        continue
                     with mu:
                         errors[f"{name}.{surface}"] = repr(e)[:200]
                 else:
@@ -201,6 +220,32 @@ class Collector:
 
     def protocol_totals(self) -> dict | None:
         return self.merge_protocol(self.snapshot())
+
+    @staticmethod
+    def merge_pulse(snapshot: dict) -> dict | None:
+        """Fleet view over every member's pulse series (None when no
+        member runs a pulse): per series, the per-process LATEST value
+        plus, for rate-kind series, their sum — fleet throughput is a
+        sum of rates; summing gauge levels or latency percentiles would
+        be meaningless, so non-rate series carry per-process values
+        only."""
+        out: dict[str, dict] = {}
+        any_enabled = False
+        for name, proc in sorted(snapshot["processes"].items()):
+            pu = proc.get("pulse")
+            if not pu or not pu.get("enabled"):
+                continue
+            any_enabled = True
+            for sname, s in pu.get("series", {}).items():
+                if not s["v"]:
+                    continue
+                e = out.setdefault(sname, {"kind": s["kind"],
+                                           "per_process": {}})
+                e["per_process"][name] = s["v"][-1]
+                if s["kind"] == "rate":
+                    e["latest_sum"] = round(
+                        e.get("latest_sum", 0.0) + s["v"][-1], 6)
+        return out if any_enabled else None
 
     # ------------------------------------------------------------- perfetto
 

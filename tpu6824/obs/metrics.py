@@ -31,8 +31,8 @@ from __future__ import annotations
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
-           "counter", "gauge", "histogram", "inc", "snapshot",
-           "diff_snapshots", "reset"]
+           "counter", "gauge", "histogram", "inc", "set_gauge",
+           "snapshot", "diff_snapshots", "reset"]
 
 _NBUCKETS = 64  # log2 buckets cover any int64-scale observation
 
@@ -208,6 +208,14 @@ class Registry:
         happens HERE, inside the registry, not at the hot call site."""
         self._get(name, Counter).inc(n, key=key)
 
+    def set_gauge(self, name: str, v: float,
+                  key: str | None = None) -> None:
+        """Dynamic-name gauge write — the `inc()` analog for gauges
+        whose names are data (the EventLog overflow mirror, keyed by the
+        log's registry prefix).  Producers with a static name still
+        create the gauge at module scope."""
+        self._get(name, Gauge).set(v, key=key)
+
     def snapshot(self) -> dict:
         """{"counters": {...}, "gauges": {...}, "histograms": {...}} —
         JSON-safe, the one shape every consumer (fabric_service wire,
@@ -248,6 +256,10 @@ def histogram(name: str) -> Histogram:
 
 def inc(name: str, n: int = 1, key: str | None = None) -> None:
     REGISTRY.inc(name, n, key=key)
+
+
+def set_gauge(name: str, v: float, key: str | None = None) -> None:
+    REGISTRY.set_gauge(name, v, key=key)
 
 
 def snapshot() -> dict:

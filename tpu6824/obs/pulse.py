@@ -1,0 +1,359 @@
+"""pulse — continuous time-series telemetry over the metrics registry.
+
+tpuscope (ISSUE 5) and kernelscope (ISSUE 6) answer "what has this
+process done" at a POINT: `metrics.snapshot()` is cumulative totals, and
+`stats()` is the instant's health.  Neither answers the question a
+running fleet asks — "what is it doing *over time*, and when did that
+change" — which is exactly the question a stall, a throughput collapse,
+or a latency spike poses.  pulse closes that gap:
+
+  - a `Pulse` samples the process-global registry on its own clock
+    (`TPU6824_PULSE_INTERVAL`), deriving per-interval signals from the
+    cumulative metrics: counters become RATES (delta/dt), gauges are
+    carried as-is, and histograms yield per-interval p50/p95/p99 (the
+    log2-bucket delta between consecutive snapshots, so the percentile
+    series tracks the LAST interval's latency, not the lifetime
+    average's slow drift);
+  - every signal lands in a bounded ring (`TPU6824_PULSE_CAP` points per
+    series, oldest dropped) — `series()` is the one snapshot shape,
+    served over the fabric_service wire as the `pulse` RPC and merged
+    fleet-wide by the kernelscope `Collector`;
+  - observers (the watchdog) run on the sampling clock, so detection
+    latency is one sampling interval by construction.
+
+Zero-overhead-when-idle contract: nothing here runs unless a Pulse is
+explicitly started — there is no import-time thread, no hot-path hook,
+and no per-op allocation anywhere (sampling cost is registry-snapshot
+granular, on pulse's own thread).  With a fabric attached, each tick
+also polls `fabric.stats()` so the health gauges and stall diagnosis are
+exactly as fresh as the last sample — stats() is a pure read by the
+kernelscope contract, so sampling never perturbs the clock thread.
+
+This module also owns the ENVIRONMENT probes bench.py records per
+artifact (`environment_snapshot`, `calibration_spin`): the r08 bring-up
+proved the box's effective CPU swings 2-5× run-to-run, which benchdiff
+can only discount if every artifact carries its own environment
+evidence.  Stdlib-only like the rest of obs/.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from tpu6824.obs import metrics as _metrics
+from tpu6824.utils import crashsink
+
+__all__ = ["Pulse", "start", "stop", "get", "series_snapshot",
+           "environment_snapshot", "calibration_spin", "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = "pulse-1.0.0"
+
+_DEF_INTERVAL = float(os.environ.get("TPU6824_PULSE_INTERVAL", "1.0"))
+_DEF_CAP = int(os.environ.get("TPU6824_PULSE_CAP", "600"))
+
+
+class Pulse:
+    """Bounded ring time-series over the process-global metrics registry.
+
+    `fabric` (optional): a local PaxosFabric whose `stats()` is polled
+    every tick — refreshing the registry's health gauges and keeping
+    `last_stats` (the watchdog's stall/crash evidence) one interval
+    fresh.  `stall_after` forwards to `stats(stall_after=)` so a
+    watchdog can run a tighter stall window than the fabric default.
+    """
+
+    def __init__(self, fabric=None, interval: float | None = None,
+                 cap: int | None = None, stall_after: float | None = None):
+        self.interval = _DEF_INTERVAL if interval is None else float(interval)
+        self.cap = _DEF_CAP if cap is None else int(cap)
+        self.fabric = fabric
+        self.stall_after = stall_after
+        self._mu = threading.Lock()
+        # name -> {"kind": rate|gauge|quantile, "points": deque[(t, v)]}
+        self._series: dict[str, dict] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._prev: tuple[float, dict] | None = None
+        # Observer registry (the watchdog), called on the sampling
+        # thread after each tick: fn(pulse, now).
+        self._observers: list = []
+        self.samples = 0
+        self.last_stats: dict | None = None
+        self.t_started: float | None = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "Pulse":
+        if self._thread is not None:
+            return self
+        # A restarted instance must sample again: without this, a
+        # stop()/start() cycle leaves _stop set and the new thread
+        # exits after one sample — a silently frozen series.
+        self._stop.clear()
+        self.t_started = time.monotonic()
+        self._thread = threading.Thread(
+            target=crashsink.guarded(self._run, "pulse"), daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def add_observer(self, fn) -> None:
+        with self._mu:
+            if fn not in self._observers:
+                # tpusan: ok(unbounded-obs-buffer) — observer registry:
+                # one callback per attached watchdog, deduplicated
+                # above; it never accumulates samples
+                self._observers.append(fn)
+
+    def remove_observer(self, fn) -> None:
+        with self._mu:
+            if fn in self._observers:
+                self._observers.remove(fn)
+
+    # ----------------------------------------------------------- sampling
+
+    def _run(self) -> None:
+        # First tick immediately: it sets the rate baseline (no points
+        # are recorded until the second tick gives a delta window).
+        self.sample_once()
+        while not self._stop.wait(self.interval):
+            self.sample_once()
+
+    def sample_once(self) -> None:
+        """One sampling tick (public so tests can drive the clock
+        deterministically without the thread)."""
+        now = time.monotonic()
+        if self.fabric is not None:
+            try:
+                self.last_stats = (
+                    self.fabric.stats() if self.stall_after is None
+                    else self.fabric.stats(stall_after=self.stall_after))
+            except Exception as e:  # noqa: BLE001 — a dying fabric is data
+                self.last_stats = {"error": repr(e)[:200]}
+        snap = _metrics.snapshot()
+        prev = self._prev
+        self._prev = (now, snap)
+        if prev is not None:
+            t_prev, snap_prev = prev
+            dt = max(now - t_prev, 1e-9)
+            delta = _metrics.diff_snapshots(snap_prev, snap)
+            with self._mu:
+                updated: set[str] = set()
+                for name, c in delta.get("counters", {}).items():
+                    updated.add(self._record_locked(
+                        f"{name}.rate", "rate", now, c["total"] / dt))
+                for name, g in snap.get("gauges", {}).items():
+                    self._record_locked(name, "gauge", now, g["value"])
+                for name, h in delta.get("histograms", {}).items():
+                    # Per-interval percentiles (delta buckets), top-level
+                    # histograms only — per-key sub-series would make
+                    # series cardinality data-dependent.
+                    updated.add(self._record_locked(
+                        f"{name}.rate", "rate", now, h["count"] / dt))
+                    for q in ("p50", "p95", "p99"):
+                        if h.get(q) is not None:
+                            self._record_locked(f"{name}.{q}", "quantile",
+                                                now, h[q])
+                # diff_snapshots drops zero deltas (right for bench
+                # attribution), but a rate SERIES must record the idle
+                # intervals explicitly — a throughput collapse IS a run
+                # of zeros, and the watchdog can only see what's in the
+                # ring.  Quantile series stay sparse by design (an
+                # interval with no observations has no percentile).
+                for name, s in self._series.items():
+                    if s["kind"] == "rate" and name not in updated:
+                        s["points"].append((round(now, 6), 0.0))
+            self.samples += 1
+        observers = list(self._observers)
+        for fn in observers:
+            try:
+                fn(self, now)
+            except Exception as e:  # noqa: BLE001 — a broken watchdog rule
+                # must not kill the sampling clock; recorded, not fatal.
+                crashsink.record("pulse-observer", e, fatal=False)
+
+    def _record_locked(self, name: str, kind: str, t: float, v) -> str:
+        s = self._series.get(name)
+        if s is None:
+            s = self._series[name] = {
+                "kind": kind, "points": deque(maxlen=self.cap)}
+        s["points"].append((round(t, 6), round(float(v), 6)))
+        return name
+
+    # ----------------------------------------------------------- snapshot
+
+    def series(self, names=None, window: float | None = None) -> dict:
+        """The one snapshot shape: `{"schema", "enabled", "interval",
+        "cap", "samples", "t_mono", "series": {name: {"kind", "t",
+        "v"}}}` — timestamps are `time.monotonic()` seconds, joinable
+        against flight-recorder `ts` (ns) and the nemesis timeline's
+        `t0`.  `window` keeps only points newer than `now - window`;
+        `names` filters to the listed series."""
+        now = time.monotonic()
+        cutoff = None if window is None else now - window
+        out: dict[str, dict] = {}
+        with self._mu:
+            for name, s in self._series.items():
+                if names is not None and name not in names:
+                    continue
+                pts = list(s["points"])
+                if cutoff is not None:
+                    pts = [p for p in pts if p[0] >= cutoff]
+                if not pts:
+                    continue
+                out[name] = {"kind": s["kind"],
+                             "t": [p[0] for p in pts],
+                             "v": [p[1] for p in pts]}
+        return {"schema": SCHEMA_VERSION, "enabled": True,
+                "interval": self.interval, "cap": self.cap,
+                "samples": self.samples, "t_mono": round(now, 6),
+                "series": out}
+
+    # -------------------------------------------------- rule-side helpers
+
+    def points(self, name: str, window: float | None = None) -> list:
+        """[(t, v)] for one series (most-recent last), optionally
+        windowed — the watchdog's read primitive."""
+        cutoff = None if window is None else time.monotonic() - window
+        with self._mu:
+            s = self._series.get(name)
+            if s is None:
+                return []
+            pts = list(s["points"])
+        return pts if cutoff is None else [p for p in pts if p[0] >= cutoff]
+
+    def last(self, name: str):
+        pts = self.points(name)
+        return pts[-1][1] if pts else None
+
+    def names(self) -> list[str]:
+        with self._mu:
+            return list(self._series)
+
+
+# ------------------------------------------------- process-global pulse
+
+_PULSE: Pulse | None = None
+_pulse_mu = threading.Lock()
+
+
+def start(fabric=None, interval: float | None = None,
+          cap: int | None = None, stall_after: float | None = None) -> Pulse:
+    """Start (or return) THE process pulse — the instance the fabric's
+    `pulse` RPC serves and the watchdog rides."""
+    global _PULSE
+    with _pulse_mu:
+        if _PULSE is None:
+            _PULSE = Pulse(fabric=fabric, interval=interval, cap=cap,
+                           stall_after=stall_after).start()
+        return _PULSE
+
+
+def stop() -> None:
+    global _PULSE
+    with _pulse_mu:
+        p, _PULSE = _PULSE, None
+    if p is not None:
+        p.stop()
+
+
+def get() -> Pulse | None:
+    return _PULSE
+
+
+def series_snapshot(window: float | None = None) -> dict:
+    """The wire shape of the process pulse: the running instance's
+    `series()`, or a stable `enabled: False` shell when no pulse runs —
+    pollers and the fleet collector never see a missing surface flip
+    shape."""
+    p = _PULSE
+    if p is None:
+        return {"schema": SCHEMA_VERSION, "enabled": False,
+                "interval": None, "cap": None, "samples": 0,
+                "t_mono": round(time.monotonic(), 6), "series": {}}
+    return p.series(window=window)
+
+
+# ------------------------------------------------- environment probes
+
+
+def _read_first(*paths: str) -> str | None:
+    for p in paths:
+        try:
+            with open(p) as f:
+                return f.read().strip()
+        except OSError:
+            continue
+    return None
+
+
+def environment_snapshot() -> dict:
+    """What the box looks like RIGHT NOW: cgroup cpu quota/shares (v2
+    then v1), load averages, cpu count, and the derived effective-cpu
+    budget.  Every BENCH artifact records one so benchdiff can tell "the
+    code got slower" from "the box got smaller" — the r08 lesson
+    (service.value −55% with zero code change, pristine-reproduced)."""
+    cg: dict = {}
+    eff = None
+    v2 = _read_first("/sys/fs/cgroup/cpu.max")
+    if v2:
+        parts = v2.split()
+        quota = None if parts[0] == "max" else int(parts[0])
+        period = int(parts[1]) if len(parts) > 1 else 100000
+        cg["cpu_max"] = v2
+        if quota:
+            eff = round(quota / period, 3)
+    w = _read_first("/sys/fs/cgroup/cpu.weight")
+    if w:
+        cg["cpu_weight"] = int(w)
+    q1 = _read_first("/sys/fs/cgroup/cpu/cpu.cfs_quota_us",
+                     "/sys/fs/cgroup/cpu,cpuacct/cpu.cfs_quota_us")
+    p1 = _read_first("/sys/fs/cgroup/cpu/cpu.cfs_period_us",
+                     "/sys/fs/cgroup/cpu,cpuacct/cpu.cfs_period_us")
+    if q1 and p1:
+        cg["cfs_quota_us"] = int(q1)
+        cg["cfs_period_us"] = int(p1)
+        if eff is None and int(q1) > 0:
+            eff = round(int(q1) / int(p1), 3)
+    s1 = _read_first("/sys/fs/cgroup/cpu/cpu.shares",
+                     "/sys/fs/cgroup/cpu,cpuacct/cpu.shares")
+    if s1:
+        cg["cpu_shares"] = int(s1)
+    cpus = os.cpu_count() or 1
+    try:
+        loadavg = [round(x, 3) for x in os.getloadavg()]
+    except OSError:
+        loadavg = None
+    return {"cpus": cpus,
+            "effective_cpus": eff if eff is not None else float(cpus),
+            "cgroup": cg, "loadavg": loadavg}
+
+
+# Fixed calibration workload: pure-Python integer LCG churn — no numpy,
+# no allocation growth, identical work every call, so wall time measures
+# the BOX (scheduler share, frequency, contention), not the code under
+# bench.  ~10-30ms on a healthy core.
+_CAL_ITERS = 200_000
+
+
+def calibration_spin(iters: int = _CAL_ITERS) -> float:
+    """Wall milliseconds for the fixed calibration workload.  bench runs
+    one at every leg boundary; a leg bracketed by slow spins ran on a
+    degraded box, and benchdiff discounts its regression verdicts to
+    `suspect-environment` accordingly."""
+    t0 = time.perf_counter()
+    acc = 12345
+    for i in range(iters):
+        acc = (acc * 1103515245 + i) & 0xFFFFFFFF
+    if acc < 0:  # unreachable; keeps `acc` live against optimizers
+        raise AssertionError
+    return round((time.perf_counter() - t0) * 1e3, 3)

@@ -1,0 +1,243 @@
+"""obs.top — the live terminal dashboard over one process or a fleet.
+
+    python -m tpu6824.obs.top --addr /var/tmp/x/fab [--addr ...]
+                              [--interval S] [--once] [--json]
+
+Polls each `--addr` fabric_service socket (stats/metrics/flight/pulse —
+the same surfaces the kernelscope Collector merges) plus, with
+`--local`, the calling process's own registry, and renders one screen
+per interval: decided throughput, protocol ratios, stalled groups with
+their kernelscope diagnosis, feed depth, RPC pool traffic, latency
+percentiles, and drop counters.  `--once --json` emits a single
+machine-readable snapshot instead — the CI smoke contract: STABLE keys
+(every process block always carries the same key set) and NO NaN/Inf
+anywhere (non-finite values are scrubbed to null before serializing).
+
+Imports only stdlib + the socket transport (`tpu6824.rpc`); never JAX —
+runnable against a live fabricd from any box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+
+from tpu6824.obs.collector import Collector
+
+SCHEMA_VERSION = "top-1.0.0"
+
+# Every process block carries EXACTLY these keys (the --json stability
+# contract); absent data is None/empty, never a missing key.
+_PROC_KEYS = ("decided_cells", "decided_per_sec", "steps_per_sec",
+              "stalled_groups", "stall_diagnosis", "feed_depth_max",
+              "thread_crashes", "events_dropped", "flight_dropped",
+              "protocol", "rpc_pool", "latency_us", "pulse", "error")
+
+
+def scrub(obj):
+    """Replace non-finite floats with None, recursively — the JSON smoke
+    gate rejects NaN/Inf (json.dumps(allow_nan=False) downstream)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: scrub(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [scrub(v) for v in obj]
+    return obj
+
+
+_RATE_WINDOW_S = 10.0
+
+
+def _series_rate(pulse_snap: dict, name: str):
+    """LIVE rate from a pulse rate-series: the mean over its trailing
+    ~10s of points, None when the series is absent.  Windowed relative
+    to the series' own last timestamp (producer-side monotonic — a
+    remote process's clock is not ours), never over the whole ring: a
+    600-point ring is 10 minutes of history, and a dashboard averaging
+    it would still read "healthy" minutes into a collapse."""
+    s = (pulse_snap or {}).get("series", {}).get(name)
+    if not s or not s["v"]:
+        return None
+    cutoff = s["t"][-1] - _RATE_WINDOW_S
+    tail = [v for t, v in zip(s["t"], s["v"]) if t >= cutoff]
+    return round(sum(tail) / len(tail), 1)
+
+
+def _proc_view(proc: dict, err: str | None) -> dict:
+    st = proc.get("stats") or {}
+    met = proc.get("metrics") or {}
+    fl = proc.get("flight") or {}
+    pu = proc.get("pulse") or {}
+    health = st.get("health") or {}
+    rates = st.get("rates") or {}
+    proto = st.get("protocol") or {}
+    counters = met.get("counters") or {}
+    hists = met.get("histograms") or {}
+    lat = hists.get("clerk.op_latency_us") or {}
+    view = {
+        "decided_cells": st.get("decided_cells"),
+        "decided_per_sec": (
+            _series_rate(pu, "fabric.decided_cells.rate")
+            if pu.get("enabled")
+            else (round(rates.get("decided_cells", 0.0), 1)
+                  if rates else None)),
+        "steps_per_sec": (round(rates.get("steps", 0.0), 1)
+                          if rates else None),
+        "stalled_groups": health.get("stalled_groups", []),
+        "stall_diagnosis": health.get("stall_diagnosis", {}),
+        "feed_depth_max": health.get("feed_depth_max"),
+        "thread_crashes": (health.get("thread_crashes") or {}).get("count"),
+        "events_dropped": st.get("events_dropped"),
+        "flight_dropped": fl.get("dropped"),
+        "protocol": {
+            "decides": (proto.get("totals") or {}).get("decides"),
+            "rounds_per_decide": proto.get("rounds_per_decide"),
+            "fast_path_fraction": proto.get("fast_path_fraction"),
+        },
+        "rpc_pool": {
+            "hits": (counters.get("rpc.pool.hits") or {}).get("total"),
+            "misses": (counters.get("rpc.pool.misses") or {}).get("total"),
+            "evictions": (counters.get("rpc.pool.evictions")
+                          or {}).get("total"),
+        },
+        "latency_us": {"p50": lat.get("p50"), "p95": lat.get("p95"),
+                       "p99": lat.get("p99")},
+        "pulse": {"enabled": bool(pu.get("enabled")),
+                  "samples": pu.get("samples", 0),
+                  "series": len(pu.get("series") or {})},
+        "error": err,
+    }
+    assert set(view) == set(_PROC_KEYS)
+    return view
+
+
+def build_view(snap: dict) -> dict:
+    procs = {}
+    for name in sorted(snap["processes"]):
+        # Error keys are f"{name}.{surface}" with dot-free surfaces;
+        # member names themselves may contain dots (socket basenames
+        # like fab.sock), so match on the LAST dot, not the first.
+        errs = [v for k, v in snap["errors"].items()
+                if k.rsplit(".", 1)[0] == name]
+        procs[name] = _proc_view(snap["processes"][name],
+                                 errs[0] if errs else None)
+    merged = Collector.merge_protocol(snap)
+    if merged is not None:
+        merged = {k: v for k, v in merged.items() if k != "fields"}
+    decided = [p["decided_cells"] for p in procs.values()
+               if p["decided_cells"] is not None]
+    rates = [p["decided_per_sec"] for p in procs.values()
+             if p["decided_per_sec"] is not None]
+    return scrub({
+        "schema": SCHEMA_VERSION,
+        "t_mono": round(time.monotonic(), 6),
+        "processes": procs,
+        "errors": dict(snap["errors"]),
+        "fleet": {
+            "decided_cells": sum(decided) if decided else None,
+            "decided_per_sec": (round(sum(rates), 1) if rates else None),
+            "protocol": merged,
+            "pulse": Collector.merge_pulse(snap),
+        },
+    })
+
+
+# ------------------------------------------------------------- rendering
+
+
+def _fmt(v, width=10):
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float):
+        return f"{v:,.1f}".rjust(width)
+    return f"{v:,}".rjust(width)
+
+
+def render(view: dict) -> str:
+    lines = [f"tpu6824 top  ({len(view['processes'])} process(es), "
+             f"t={view['t_mono']:.1f})",
+             f"{'process':<12}{'decided':>12}{'dec/s':>10}{'steps/s':>10}"
+             f"{'feed':>6}{'stall':>6}{'crash':>6}{'drop':>6}"
+             f"{'rnds/dec':>9}{'p99us':>9}"]
+    for name, p in view["processes"].items():
+        drops = (p["events_dropped"] or 0) + (p["flight_dropped"] or 0)
+        lines.append(
+            f"{name:<12}{_fmt(p['decided_cells'], 12)}"
+            f"{_fmt(p['decided_per_sec'])}{_fmt(p['steps_per_sec'])}"
+            f"{_fmt(p['feed_depth_max'], 6)}"
+            f"{_fmt(len(p['stalled_groups']), 6)}"
+            f"{_fmt(p['thread_crashes'], 6)}{_fmt(drops, 6)}"
+            f"{_fmt(p['protocol']['rounds_per_decide'], 9)}"
+            f"{_fmt(p['latency_us']['p99'], 9)}")
+        for g, why in sorted(p["stall_diagnosis"].items()):
+            lines.append(f"  !! g{g}: {why}")
+        if p["error"]:
+            lines.append(f"  !! poll: {p['error']}")
+    fleet = view["fleet"]
+    if len(view["processes"]) > 1 and fleet["protocol"]:
+        lines.append(
+            f"{'FLEET':<12}{_fmt(fleet['decided_cells'], 12)}"
+            f"{_fmt(fleet['decided_per_sec'])}"
+            f"{'':>10}{'':>6}{'':>6}{'':>6}{'':>6}"
+            f"{_fmt(fleet['protocol'].get('rounds_per_decide'), 9)}")
+    for k, e in view["errors"].items():
+        lines.append(f"error {k}: {e}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ main
+
+
+def build_collector(addrs, local: bool, timeout: float) -> Collector:
+    col = Collector(poll_timeout=timeout)
+    for i, addr in enumerate(addrs):
+        from tpu6824.rpc import connect  # socket transport only, no JAX
+        col.add(f"proc{i}@{addr.rsplit('/', 1)[-1]}",
+                connect(addr, timeout=timeout))
+    if local or not addrs:
+        col.add_local("local")
+    return col
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu6824.obs.top",
+        description="Live dashboard over fabric_service processes "
+                    "(--once --json for scripting/CI).")
+    ap.add_argument("--addr", action="append", default=[],
+                    help="fabric_service socket (repeatable); with none, "
+                         "the local process registry is shown")
+    ap.add_argument("--local", action="store_true",
+                    help="include the calling process alongside --addr")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="one snapshot, no screen clearing")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the snapshot as one JSON object")
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help="per-member poll budget (seconds)")
+    args = ap.parse_args(argv)
+    col = build_collector(args.addr, args.local, args.timeout)
+    try:
+        while True:
+            view = build_view(col.snapshot())
+            if args.as_json:
+                print(json.dumps(view, allow_nan=False), flush=True)
+            else:
+                if not args.once:
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(render(view), flush=True)
+            if args.once:
+                # Machine gate: any dead/errored member fails the smoke.
+                return 1 if view["errors"] else 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

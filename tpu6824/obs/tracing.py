@@ -46,7 +46,14 @@ import time
 from collections import deque
 from typing import NamedTuple
 
+from tpu6824.obs import metrics as _metrics
+
 SCHEMA_VERSION = "tpuscope-1.0.0"
+
+# Ring-overflow drop count as a registry gauge, so the pulse/watchdog
+# layer can rule on "the flight recorder is eating evidence" without
+# polling flight_snapshot() (module scope per metric-unregistered).
+_G_FLIGHT_DROPPED = _metrics.gauge("obs.flight.dropped")
 
 _ENABLED = os.environ.get("TPU6824_TRACE", "") in ("1", "true", "yes")
 _SAMPLE = float(os.environ.get("TPU6824_TRACE_SAMPLE", "1.0"))
@@ -116,10 +123,17 @@ class FlightRecorder:
         self.dropped = 0
 
     def record(self, rec: dict) -> None:
+        dropped = None
         with self._mu:
             if len(self._ring) == self._ring.maxlen:
                 self.dropped += 1
+                dropped = self.dropped
             self._ring.append(rec)
+        if dropped is not None:
+            # Gauge mirror outside self._mu (the registry takes its own
+            # lock); records are batch/fault granular, and the set only
+            # happens in the overflow regime the gauge exists to expose.
+            _G_FLIGHT_DROPPED.set(dropped)
 
     def snapshot(self) -> list[dict]:
         with self._mu:
@@ -129,6 +143,7 @@ class FlightRecorder:
         with self._mu:
             self._ring.clear()
             self.dropped = 0
+        _G_FLIGHT_DROPPED.set(0)
 
 
 FLIGHT = FlightRecorder()

@@ -1,0 +1,368 @@
+"""watchdog — rule evaluation over pulse series, with evidence capture.
+
+The nemesis harness made INJECTED failures debuggable: every failing
+soak ships a ReplayArtifact with the fault timeline, the flight ring,
+and the fleet snapshot.  A LIVE incident had nothing — by the time a
+human polls stats(), the stall is minutes old and the flight ring has
+rotated past the interesting part.  The watchdog closes that asymmetry:
+it rides the pulse sampling clock (observer, no thread of its own), and
+the moment a rule trips it freezes the evidence — flight-recorder dump,
+`stats()` with the stall diagnosis, the triggering series window, the
+environment — into the SAME artifact format nemesis failures use
+(`ReplayArtifact.to_dict` shell), written under `TPU6824_WATCHDOG_DIR`.
+A live incident replays like an injected one.
+
+Rules (thresholds via env, see TUNING):
+
+  - ``stalled-groups``      — stats()["health"]["stalled_groups"] is
+    non-empty; the bundle carries the kernelscope per-group diagnosis.
+  - ``throughput-collapse`` — the fabric.decided_cells rate fell below
+    `TPU6824_WD_COLLAPSE_FRAC` of its earlier-window rate while that
+    earlier rate was above `TPU6824_WD_MIN_RATE` (an idle fabric is not
+    a collapse).
+  - ``latency-spike``       — any per-interval latency p99 series rose
+    `TPU6824_WD_SPIKE_FACTOR`× (default 4 = two log2 buckets — one
+    bucket is quantization noise) over its window median.
+  - ``queue-growth``        — feed_depth_max grew monotonically across
+    the window and ended above `TPU6824_WD_FEED_DEPTH`.
+  - ``thread-crashes``      — crashsink reported a NEW daemon-thread
+    death since the watchdog armed.
+  - ``dropped-climbing``    — fabric.events.dropped / obs.flight.dropped
+    climbing faster than `TPU6824_WD_DROP_RATE`/s (telemetry is eating
+    its own evidence).
+  - ``jit-recompile``       — jitguard.compiles climbing AFTER the rule
+    observed a warmed state (a busy, compile-free window past the
+    `TPU6824_WD_JIT_GRACE` arming delay): steady state must be
+    zero-compile, but first-touch compiles from traffic arriving at any
+    time are warmup, not an incident.
+
+Default-off like tracing: a watchdog only exists when constructed, and
+evaluation is sampling-clock granular — no per-op cost anywhere.
+Stdlib-only; ReplayArtifact is imported lazily at fire time (harness
+imports obs, not the other way around).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from tpu6824.obs import pulse as _pulse
+from tpu6824.utils import crashsink
+
+__all__ = ["Watchdog", "Rule", "default_rules", "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = "watchdog-1.0.0"
+
+
+def _envf(name: str, default: float) -> float:
+    return float(os.environ.get(name, default))
+
+
+class Rule:
+    """One watchdog rule: `check(wd)` returns a human-readable reason
+    string when triggered, else None.  Subclasses read series through
+    `wd.points/last` and the freshest stats through `wd.stats()`."""
+
+    name = "rule"
+
+    def check(self, wd: "Watchdog") -> str | None:
+        raise NotImplementedError
+
+
+class StalledGroups(Rule):
+    name = "stalled-groups"
+
+    def check(self, wd):
+        h = (wd.stats() or {}).get("health") or {}
+        stalled = h.get("stalled_groups") or []
+        if not stalled:
+            return None
+        diag = h.get("stall_diagnosis") or {}
+        first = diag.get(str(stalled[0]), "no diagnosis")
+        return (f"groups {stalled} stalled "
+                f"(g{stalled[0]}: {first})")
+
+
+class ThroughputCollapse(Rule):
+    name = "throughput-collapse"
+    series = "fabric.decided_cells.rate"
+
+    def __init__(self,
+                 frac: float | None = None, min_rate: float | None = None):
+        self.frac = _envf("TPU6824_WD_COLLAPSE_FRAC", 0.1) \
+            if frac is None else frac
+        self.min_rate = _envf("TPU6824_WD_MIN_RATE", 50.0) \
+            if min_rate is None else min_rate
+
+    def check(self, wd):
+        pts = wd.points(self.series)
+        if len(pts) < 4:
+            return None
+        half = len(pts) // 2
+        before = sum(v for _, v in pts[:half]) / half
+        after = sum(v for _, v in pts[half:]) / (len(pts) - half)
+        if before > self.min_rate and after < before * self.frac:
+            return (f"decided/s collapsed {before:.1f} -> {after:.1f} "
+                    f"(< {self.frac:.0%} of the earlier window)")
+        return None
+
+
+class LatencySpike(Rule):
+    name = "latency-spike"
+
+    def __init__(self, factor: float | None = None):
+        self.factor = _envf("TPU6824_WD_SPIKE_FACTOR", 4.0) \
+            if factor is None else factor
+
+    def check(self, wd):
+        for name in wd.series_names():
+            if "latency" not in name or not name.endswith(".p99"):
+                continue
+            pts = wd.points(name)
+            if len(pts) < 4:
+                continue
+            vals = sorted(v for _, v in pts[:-1])
+            median = vals[len(vals) // 2]
+            last = pts[-1][1]
+            if median > 0 and last >= median * self.factor:
+                return (f"{name} spiked to {last:.0f} "
+                        f"(median {median:.0f}, x{last / median:.1f})")
+        return None
+
+
+class QueueGrowth(Rule):
+    name = "queue-growth"
+    series = "fabric.health.feed_depth_max"
+
+    def __init__(self, limit: float | None = None):
+        self.limit = _envf("TPU6824_WD_FEED_DEPTH", 1024.0) \
+            if limit is None else limit
+
+    def check(self, wd):
+        pts = wd.points(self.series)
+        if len(pts) < 3 or pts[-1][1] < self.limit:
+            return None
+        vs = [v for _, v in pts]
+        if all(b >= a for a, b in zip(vs, vs[1:])) and vs[-1] > vs[0]:
+            return (f"feed depth grew {vs[0]:.0f} -> {vs[-1]:.0f} over "
+                    f"the window (consumer falling behind)")
+        return None
+
+
+class ThreadCrashes(Rule):
+    name = "thread-crashes"
+
+    def check(self, wd):
+        cur = crashsink.summary().get("count", 0)
+        if cur > wd.crash_base:
+            return (f"{cur - wd.crash_base} daemon thread(s) died since "
+                    "the watchdog armed")
+        return None
+
+
+class DroppedClimbing(Rule):
+    name = "dropped-climbing"
+    series = ("fabric.events.dropped", "obs.flight.dropped")
+
+    def __init__(self, rate: float | None = None):
+        self.rate = _envf("TPU6824_WD_DROP_RATE", 100.0) \
+            if rate is None else rate
+
+    def check(self, wd):
+        for name in self.series:
+            pts = wd.points(name)
+            if len(pts) < 2:
+                continue
+            (t0, v0), (t1, v1) = pts[0], pts[-1]
+            dt = max(t1 - t0, 1e-9)
+            r = (v1 - v0) / dt
+            if r > self.rate:
+                return (f"{name} climbing at {r:.0f}/s "
+                        f"(> {self.rate:.0f}/s): the ring is eating "
+                        "evidence faster than it is read")
+        return None
+
+
+class JitRecompile(Rule):
+    name = "jit-recompile"
+    series = "jitguard.compiles.rate"
+    busy_series = "fabric.decided_cells.rate"
+
+    def __init__(self, grace: float | None = None):
+        self.grace = _envf("TPU6824_WD_JIT_GRACE", 10.0) \
+            if grace is None else grace
+        # Steady state is OBSERVED, not assumed: the rule arms only
+        # after a busy (deciding), compile-free window — first-touch
+        # compiles from traffic arriving at any time are warmup, and a
+        # wall-clock grace alone cannot know when warmup happened (a
+        # fabricd idling 30s before its first clerk would false-fire).
+        self._steady = False
+
+    def check(self, wd):
+        if wd.uptime() < self.grace:
+            return None  # early compiles are expected regardless
+        compiles = sum(v for _, v in wd.points(self.series,
+                                               window=wd.window))
+        busy = sum(v for _, v in wd.points(self.busy_series,
+                                           window=wd.window)) > 0
+        if compiles == 0:
+            if busy:
+                self._steady = True  # warmed: busy window, no compiles
+            return None
+        if not self._steady:
+            return None  # still warming (cold shapes arriving)
+        return ("backend recompiles in steady state (jitguard counter "
+                "climbing after a warmed, compile-free busy window) — "
+                "a shape/static-arg is varying per dispatch")
+
+
+def default_rules() -> list[Rule]:
+    return [StalledGroups(), ThroughputCollapse(), LatencySpike(),
+            QueueGrowth(), ThreadCrashes(), DroppedClimbing(),
+            JitRecompile()]
+
+
+class Watchdog:
+    """Evaluates rules after every pulse sample; on trigger writes an
+    evidence bundle and remembers the incident.  Per-rule cooldown
+    (`TPU6824_WD_COOLDOWN`) stops a sustained condition from emitting a
+    bundle per tick; the incident ring is bounded."""
+
+    def __init__(self, pulse, outdir: str | None = None,
+                 rules: list[Rule] | None = None,
+                 window: float | None = None,
+                 cooldown: float | None = None, max_incidents: int = 64):
+        self.pulse = pulse
+        self.outdir = outdir or os.environ.get("TPU6824_WATCHDOG_DIR",
+                                               "/tmp")
+        self.rules = default_rules() if rules is None else list(rules)
+        self.window = (_envf("TPU6824_WD_WINDOW", 0.0)
+                       or max(2.0, 5 * pulse.interval)) \
+            if window is None else float(window)
+        self.cooldown = _envf("TPU6824_WD_COOLDOWN", 30.0) \
+            if cooldown is None else float(cooldown)
+        self.incidents: deque = deque(maxlen=max_incidents)
+        self._mu = threading.Lock()
+        self._last_fire: dict[str, float] = {}
+        self._seq = 0
+        self._armed_at: float | None = None
+        self.crash_base = 0
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "Watchdog":
+        self._armed_at = time.monotonic()
+        self.crash_base = crashsink.summary().get("count", 0)
+        # Best effort: make sure the jitguard compile listener is
+        # counting (needs jax.monitoring; absent on a JAX-less poller,
+        # in which case the jit rule simply never sees a series).
+        try:
+            from tpu6824.analysis import jitguard
+            jitguard._ensure_listener()
+        except Exception:  # noqa: BLE001 — optional evidence source
+            pass
+        self.pulse.add_observer(self._on_sample)
+        return self
+
+    def stop(self) -> None:
+        self.pulse.remove_observer(self._on_sample)
+
+    def uptime(self) -> float:
+        return 0.0 if self._armed_at is None \
+            else time.monotonic() - self._armed_at
+
+    # --------------------------------------------------- rule-side reads
+
+    def points(self, name: str, window: float | None = None) -> list:
+        return self.pulse.points(name,
+                                 window=self.window if window is None
+                                 else window)
+
+    def last(self, name: str):
+        return self.pulse.last(name)
+
+    def series_names(self) -> list[str]:
+        return self.pulse.names()
+
+    def stats(self) -> dict | None:
+        return self.pulse.last_stats
+
+    # ----------------------------------------------------------- evaluate
+
+    def _on_sample(self, pulse, now: float) -> None:
+        for rule in self.rules:
+            last = self._last_fire.get(rule.name)
+            if last is not None and now - last < self.cooldown:
+                continue
+            try:
+                reason = rule.check(self)
+            except Exception as e:  # noqa: BLE001 — one broken rule must
+                # not blind the others; recorded, not fatal.
+                crashsink.record(f"watchdog[{rule.name}]", e, fatal=False)
+                continue
+            if reason:
+                self._last_fire[rule.name] = now
+                self._fire(rule, reason, now)
+
+    def _fire(self, rule: Rule, reason: str, now: float) -> None:
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+        incident = {"rule": rule.name, "reason": reason,
+                    "t_mono": round(now, 6),
+                    "detected_after_s": round(self.uptime(), 3),
+                    "seq": seq, "path": None}
+        try:
+            incident["path"] = self._write_bundle(rule, reason, now, seq)
+        except Exception as e:  # noqa: BLE001 — evidence capture must
+            # never kill the sampling clock; the incident still records.
+            incident["error"] = repr(e)[:200]
+            crashsink.record("watchdog-bundle", e, fatal=False)
+        self.incidents.append(incident)
+
+    def _write_bundle(self, rule: Rule, reason: str, now: float,
+                      seq: int) -> str:
+        # Lazy import: obs stays importable standalone; the artifact
+        # SHELL (flight ring, schema stamps) is the nemesis one, so a
+        # live incident and an injected failure read identically.
+        from tpu6824.harness.nemesis import ReplayArtifact
+
+        art = ReplayArtifact(test=f"watchdog:{rule.name}")
+        art.attach(watchdog_rule=rule.name, reason=reason)
+        d = art.to_dict()
+        stats = self.stats()
+        health = (stats or {}).get("health") or {}
+        d["watchdog"] = {
+            "schema": SCHEMA_VERSION,
+            "rule": rule.name,
+            "reason": reason,
+            "t_mono": round(now, 6),
+            "detected_after_s": round(self.uptime(), 3),
+            "window_s": self.window,
+            # The triggering series window: every series' points over
+            # the detection window, timestamp-joinable to the flight
+            # ring (ts/1e9) and the nemesis timeline (t0 + wall).
+            "series_window": self.pulse.series(
+                window=self.window)["series"],
+            "stats": stats,
+            "stall_diagnosis": health.get("stall_diagnosis") or {},
+            "environment": _pulse.environment_snapshot(),
+        }
+        path = os.path.join(self.outdir,
+                            f"watchdog-{rule.name}-{seq}.json")
+        with open(path, "w") as f:
+            json.dump(d, f, indent=1, default=str)
+        return path
+
+    # ------------------------------------------------------------- status
+
+    def status(self) -> dict:
+        return {"schema": SCHEMA_VERSION,
+                "rules": [r.name for r in self.rules],
+                "window_s": self.window, "cooldown_s": self.cooldown,
+                "uptime_s": round(self.uptime(), 3),
+                "incidents": list(self.incidents)}

@@ -48,6 +48,7 @@ import time
 from collections import deque
 
 from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import pulse as _obs_pulse
 from tpu6824.obs import tracing as _tracing
 from tpu6824.rpc import transport
 from tpu6824.rpc.native_server import NativeServer, make_server
@@ -158,6 +159,15 @@ class ClerkFrontend:
             srv.register(FE_BATCH, self._fe_batch_blocking)
             srv.register("get", self._get_blocking)
             srv.register("put_append", self._put_append_blocking)
+        # Observability plane (regular threaded handlers — pollers are
+        # rare and must never touch the event loop): a fleet Collector
+        # polls a live frontend process like any fabric process — the
+        # registry snapshot (frontend.* plus the clerk pool's
+        # rpc.pool.*), engine-side stats, flight ring, and pulse series.
+        srv.register("stats", self.stats)
+        srv.register("metrics", _metrics.snapshot)
+        srv.register("flight", _tracing.flight_snapshot)
+        srv.register("pulse", _obs_pulse.series_snapshot)
         srv.start()
         self._engine = None
         if self.deferred:
@@ -200,6 +210,25 @@ class ClerkFrontend:
         wake = self._wake
         if not wake.is_set():
             wake.set()
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Engine-side health for fleet pollers (served as the `stats`
+        RPC): queue depths and shape — the frontend analog of the
+        fabric's stats() surface, so `obs.top` and the Collector treat
+        a frontend process like any other fleet member.  Reads are
+        len() on deques (atomic under the GIL), never a lock."""
+        return {
+            "frontend": {
+                "groups": len(self.groups),
+                "replicas": [len(g) for g in self.groups],
+                "pending_frames": len(self._pending),
+                "done_queue": len(self._doneq),
+                "deferred": self.deferred,
+                "op_timeout": self.op_timeout,
+            },
+        }
 
     # ------------------------------------------------------------- engine
 

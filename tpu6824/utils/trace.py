@@ -60,6 +60,13 @@ class EventLog:
             capacity = int(os.environ.get("TPU6824_EVENTLOG_CAP", 4096))
         self._cap = capacity
         self._prefix = registry_prefix
+        # Ring-overflow gauge name (e.g. `fabric.events.dropped`): the
+        # watchdog's dropped-climbing rule reads this, so overflow is
+        # visible as a SERIES, not only a counter buried in stats().
+        # Written via the registry's dynamic-name path (set_gauge) —
+        # the name is data here, like the bump() mirror below.
+        self._g_dropped = (f"{registry_prefix}.events.dropped"
+                           if registry_prefix is not None else None)
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._counters: collections.Counter = collections.Counter()
         self._mu = threading.Lock()
@@ -67,10 +74,16 @@ class EventLog:
         self._rate_snap: tuple[float, dict] = (self._t0, {})
 
     def record(self, tag: str, **payload) -> None:
+        dropped = None
         with self._mu:
             if len(self._ring) == self._cap:
                 self._counters["dropped"] += 1
+                dropped = self._counters["dropped"]
             self._ring.append((time.monotonic(), tag, payload))
+        if dropped is not None and self._g_dropped is not None:
+            # Mirror outside self._mu (registry takes its own lock);
+            # only paid in the overflow regime the gauge exists for.
+            _metrics.set_gauge(self._g_dropped, dropped)
 
     def bump(self, counter: str, n: int = 1) -> None:
         with self._mu:
