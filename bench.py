@@ -1168,7 +1168,10 @@ def _clerk_frontend_rate():
                                    os.environ.get("BENCH_SERVICE_SECONDS",
                                                   4.0)))
     # conns×width sweep: half the window stays as in-flight headroom.
-    sweep_spec = os.environ.get("BENCH_FE_SWEEP", "8x2048,16x4096")
+    # 16x8192 added in r09: with the native ingest path the per-frame
+    # width is the remaining amortization lever (TUNING round 15).
+    sweep_spec = os.environ.get("BENCH_FE_SWEEP",
+                                "8x2048,16x4096,16x8192")
     points = []
     for part in sweep_spec.split(","):
         c, w = part.strip().split("x")
@@ -1186,70 +1189,97 @@ def _clerk_frontend_rate():
                        groups=clusters,
                        route=lambda key: int(key[1:key.index("-")]),
                        op_timeout=30.0)
+    # Wire-format knob (TUNING round 15): the sweep speaks the versioned
+    # fe wire by default (zero-GIL C++ decode); BENCH_FE_WIRE=pickle
+    # A/Bs the Python decode path on the same cluster.
+    wire_fmt = os.environ.get("BENCH_FE_WIRE", "native")
     sweep = []
     best = None
+
+    def run_point(pt, conns, width, fmt):
+        count = [0]
+        primed = [False]
+        lat: list = []
+        stop = _th.Event()
+        go = _th.Event()
+
+        def run():
+            st = FrontendStream(fe.addr, conns=conns, width=width,
+                                op_timeout=60.0, wire_format=fmt)
+
+            def on_done(n):
+                primed[0] = True
+                if go.is_set() and not stop.is_set():
+                    count[0] += n
+
+            # Keys namespaced PER SWEEP POINT: each point's stream is
+            # a fresh set of logical clients (fresh cids), so reusing
+            # a key across points would interleave two independent
+            # streams on it and break the order spot-check below.
+            st.run_appends(lambda c: f"k{c % G}-s{pt}-{c}",
+                           lambda c, i: f"x {c} {i} y",
+                           stop=stop, on_done=on_done, lat_sink=lat)
+
+        th = _th.Thread(target=run, daemon=True)
+        th.start()
+        t_hard = _t.monotonic() + 90.0
+        while not primed[0] and _t.monotonic() < t_hard:
+            _t.sleep(0.1)
+        _t.sleep(0.75)
+        go.set()
+        lat_lo = len(lat)
+        s0 = fab.steps_total
+        t0 = _t.perf_counter()
+        _t.sleep(seconds)
+        stop.set()
+        dt = _t.perf_counter() - t0
+        lat_hi = len(lat)
+        steps = fab.steps_total - s0
+        th.join(timeout=90)
+        point = {"conns": conns, "batch_width": width,
+                 "wire_format": fmt,
+                 "value": round(count[0] / dt, 1),
+                 "steps_per_sec": round(steps / dt, 1)}
+        import numpy as _np
+
+        lats = _np.array(lat[lat_lo:lat_hi])
+        if len(lats):
+            point["latency"] = {
+                "p50_ms": round(float(_np.percentile(lats, 50)) * 1e3, 2),
+                "p95_ms": round(float(_np.percentile(lats, 95)) * 1e3, 2),
+                "p99_ms": round(float(_np.percentile(lats, 99)) * 1e3, 2),
+                "n": int(len(lats)),
+                "note": "per-op frame round-trip over the wire, "
+                        "inside the timed window",
+            }
+        return point
+
     try:
         for pt, (conns, width) in enumerate(points):
-            count = [0]
-            primed = [False]
-            lat: list = []
-            stop = _th.Event()
-            go = _th.Event()
-
-            def run(pt=pt, conns=conns, width=width, count=count,
-                    primed=primed, lat=lat, stop=stop, go=go):
-                st = FrontendStream(fe.addr, conns=conns, width=width,
-                                    op_timeout=60.0)
-
-                def on_done(n):
-                    primed[0] = True
-                    if go.is_set() and not stop.is_set():
-                        count[0] += n
-
-                # Keys namespaced PER SWEEP POINT: each point's stream is
-                # a fresh set of logical clients (fresh cids), so reusing
-                # a key across points would interleave two independent
-                # streams on it and break the order spot-check below.
-                st.run_appends(lambda c: f"k{c % G}-s{pt}-{c}",
-                               lambda c, i: f"x {c} {i} y",
-                               stop=stop, on_done=on_done, lat_sink=lat)
-
-            th = _th.Thread(target=run, daemon=True)
-            th.start()
-            t_hard = _t.monotonic() + 90.0
-            while not primed[0] and _t.monotonic() < t_hard:
-                _t.sleep(0.1)
-            _t.sleep(0.75)
-            go.set()
-            lat_lo = len(lat)
-            s0 = fab.steps_total
-            t0 = _t.perf_counter()
-            _t.sleep(seconds)
-            stop.set()
-            dt = _t.perf_counter() - t0
-            lat_hi = len(lat)
-            steps = fab.steps_total - s0
-            th.join(timeout=90)
-            point = {"conns": conns, "batch_width": width,
-                     "value": round(count[0] / dt, 1),
-                     "steps_per_sec": round(steps / dt, 1)}
-            import numpy as _np
-
-            lats = _np.array(lat[lat_lo:lat_hi])
-            if len(lats):
-                point["latency"] = {
-                    "p50_ms": round(float(_np.percentile(lats, 50)) * 1e3, 2),
-                    "p95_ms": round(float(_np.percentile(lats, 95)) * 1e3, 2),
-                    "p99_ms": round(float(_np.percentile(lats, 99)) * 1e3, 2),
-                    "n": int(len(lats)),
-                    "note": "per-op frame round-trip over the wire, "
-                            "inside the timed window",
-                }
+            point = run_point(pt, conns, width, wire_fmt)
             sweep.append(point)
             if best is None or point["value"] > best["value"]:
                 best = point
         assert best is not None and best["value"] > 0, \
             "no frontend clerk op completed"
+        # native_ingest sub-sweep (ISSUE 11): the SAME shape as the best
+        # point, through the Python decode path — the native/pickle A/B
+        # on one cluster, plus the C++ decode counters for the window.
+        ni_stats = fe.stats()["frontend"]["native_ingest"]
+        control = run_point(len(points), best["conns"],
+                            best["batch_width"],
+                            "pickle" if wire_fmt == "native" else "native")
+        native_ingest = {
+            "wire_format": wire_fmt,
+            "enabled": bool(ni_stats.get("frames", 0)),
+            "counters": ni_stats,
+            "control_pickle": control if wire_fmt == "native" else None,
+            "speedup": (round(best["value"] / control["value"], 2)
+                        if control["value"] > 0 else None),
+            "note": "main sweep decodes fe wire frames in C++ on the "
+                    "epoll loop (zero-GIL ingest); control re-runs the "
+                    "best point through the pickled fe_batch path",
+        }
         # Per-client order + exact-once spot check: a client key holds
         # exactly its consecutive markers from 0 (prefix of its stream).
         from tpu6824.rpc import transport as _tr
@@ -1294,9 +1324,10 @@ def _clerk_frontend_rate():
         "steps_per_sec": best["steps_per_sec"],
         "latency": best.get("latency"),
         "sweep": sweep,
+        "native_ingest": native_ingest,
         "protocol": clerk_protocol,
         "knobs": "TPU6824_FRONTEND_OP_TIMEOUT, TPU6824_FRONTEND_DEPTH; "
-                 "BENCH_FE_GROUPS/INSTANCES/SWEEP/SECONDS",
+                 "BENCH_FE_GROUPS/INSTANCES/SWEEP/SECONDS, BENCH_FE_WIRE",
     }
 
 

@@ -1,0 +1,39 @@
+"""tpusan golden: python-decode-in-native-path — a frontend event-loop
+callback decoding frame bytes per op in Python.  Decode belongs to the
+native ingest layer (rpcserver.cpp); a Python per-op unpack loop on the
+callback thread re-creates the GIL-bound ingest wall (ISSUE 11)."""
+
+import pickle
+import struct
+
+_OP = struct.Struct("<BQqHI")
+
+
+class BadNativeFrontend:
+    def _on_batch(self, conn_id, payload, wctx):
+        off = 8
+        nops = struct.unpack_from("<H", payload, 6)[0]  # header read: ok
+        ops = []
+        for _ in range(nops):
+            kind, cid, cseq, klen, vlen = _OP.unpack_from(payload, off)
+            # finding ^: per-op struct unpack in the callback loop
+            off += _OP.size
+            cseq2 = int.from_bytes(payload[off:off + 8], "little")
+            # finding ^: per-op int.from_bytes
+            ops.append((kind, cid, cseq, cseq2))
+            off += klen + vlen
+        self.pending.append((conn_id, ops))
+
+    def reply_cb(self, conn_id, raw):
+        out = []
+        while raw:
+            rep = pickle.loads(raw)   # finding: per-op pickle in a loop
+            out.append(rep)
+            raw = raw[1:]
+        self.done.append((conn_id, out))
+
+    def _engine_pass(self, payload):
+        # NOT a callback: the engine thread may decode (it is the
+        # fallback decoder's home) — no findings here.
+        for _ in range(4):
+            struct.unpack_from("<H", payload, 0)

@@ -73,6 +73,17 @@ def test_one_json_line_with_required_keys():
     assert all("value" in p and "conns" in p and "batch_width" in p
                for p in few["sweep"]), few["sweep"]
     assert few["latency"] and few["latency"]["p50_ms"] > 0, few
+    # Native zero-GIL ingest provenance (ISSUE 11): the sub-sweep must
+    # record the wire format the sweep spoke, the C++ decode counters,
+    # and the native/pickle A/B control — or the ≥5× claim has no
+    # artifact trail and benchdiff cannot gate the new entries.
+    ni = few["native_ingest"]
+    assert ni["wire_format"] in ("native", "pickle"), ni
+    assert "counters" in ni and "ring_full" in ni["counters"], ni
+    if ni["wire_format"] == "native":
+        assert ni["counters"]["ops"] > 0, ni  # C++ decode actually ran
+        assert ni["control_pickle"] and ni["control_pickle"]["value"] > 0
+        assert ni["speedup"] is not None, ni
     proto = few["protocol"]
     assert "error" not in proto and proto["totals"]["decides"] > 0, proto
     assert "tpuscope" in few and "error" not in few["tpuscope"], few

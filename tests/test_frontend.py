@@ -402,7 +402,8 @@ def test_trace_chain_through_frontend(tmp_path):
 # --------------------------------------------------- nemesis soak
 
 
-def _frontend_nemesis_soak(tmp_path, kernel, seed, duration, nemesis_report):
+def _frontend_nemesis_soak(tmp_path, kernel, seed, duration, nemesis_report,
+                           wire_format="auto"):
     from tpu6824.harness.linearize import History, HistoryClerk, \
         check_history
     from tpu6824.harness.nemesis import FabricTarget, FaultSchedule, Nemesis
@@ -426,7 +427,8 @@ def _frontend_nemesis_soak(tmp_path, kernel, seed, duration, nemesis_report):
 
         def client(idx):
             try:
-                ck = HistoryClerk(FrontendClerk([fe.addr], timeout=8.0),
+                ck = HistoryClerk(FrontendClerk([fe.addr], timeout=8.0,
+                                                wire_format=wire_format),
                                   history)
                 for j in range(6):
                     ck.append("k", f"x {idx} {j} y", timeout=120.0)
@@ -447,7 +449,8 @@ def _frontend_nemesis_soak(tmp_path, kernel, seed, duration, nemesis_report):
         assert nem.signature() == sched.signature()
         assert not errs, errs
         fe.set_unreliable(False)
-        final = HistoryClerk(FrontendClerk([fe.addr], timeout=30.0),
+        final = HistoryClerk(FrontendClerk([fe.addr], timeout=30.0,
+                                           wire_format=wire_format),
                              history)
         value = final.get("k", timeout=60.0)
         check_appends(value, 3, 6)

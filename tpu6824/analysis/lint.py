@@ -98,6 +98,13 @@ RULES: dict[str, str] = {
         "exactly when observability matters most (long soaks); give "
         "every ring a cap with counted drops (deque(maxlen=...)) like "
         "the flight recorder does",
+    "python-decode-in-native-path":
+        "per-op wire decode (struct.unpack / pickle.loads / "
+        "int.from_bytes in a loop) inside a frontend event-loop "
+        "callback — frame decode belongs to the NATIVE layer (ISSUE "
+        "11: the C++ loop parses fe_batch straight into columnar "
+        "buffers); a Python per-op decode loop on the callback path "
+        "re-creates the GIL-bound ingest wall the native path removed",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -133,6 +140,15 @@ _EVENTLOOP_SCOPE = ("services/frontend.py", "rpc/native_server.py")
 # pulse rings, flight recorder, watchdog incidents all hold process-
 # lifetime state that pollers serialize whole.
 _OBS_BUF_SCOPE = ("obs/",)
+# Native-path scope (python-decode-in-native-path): the clerk frontend
+# and the native server wrapper, whose inline callbacks must never decode
+# per-op in Python now that the fe wire decodes in C++ (rpc/wire.py is
+# the schema's Python side and is exempt — it IS the fallback decoder,
+# running outside the event loop).
+_NATIVE_PATH_SCOPE = ("services/frontend.py", "rpc/native_server.py")
+_DECODE_DOTTED = {"struct.unpack", "struct.unpack_from", "pickle.loads",
+                  "pickle.load"}
+_DECODE_TAILS = {"unpack", "unpack_from", "from_bytes"}
 
 # Receivers that denote the tpuscope metrics registry, and the
 # get-or-create constructors the metric-unregistered rule polices.
@@ -274,12 +290,14 @@ class _FileLint(ast.NodeVisitor):
         self.durafs_home = _in_scope(relpath, (_DURAFS_HOME,))
         self.eventloop_scope = _in_scope(relpath, _EVENTLOOP_SCOPE)
         self.obs_buf_scope = _in_scope(relpath, _OBS_BUF_SCOPE)
+        self.native_path_scope = _in_scope(relpath, _NATIVE_PATH_SCOPE)
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
         self._jit_defs = self._resolve_jit_defs()
         self._scan_persistence()
         self._scan_eventloop_callbacks()
+        self._scan_native_decode()
         self._scan_obs_buffers()
         self._fn_stack: list[ast.AST] = []
         self._calls_subscribe = False
@@ -447,6 +465,48 @@ class _FileLint(ast.NodeVisitor):
                         self._flag(n, "blocking-in-eventloop",
                                    f"lock wait (`with` on a lock) inside "
                                    f"event-loop callback {fn.name}()")
+
+    def _scan_native_decode(self) -> None:
+        """python-decode-in-native-path: inside a frontend event-loop
+        callback (`_on_*` / `*_cb`), flag per-op wire-decode calls —
+        struct.unpack(_from), pickle.loads, int.from_bytes — that sit
+        INSIDE a for/while loop.  One-shot header reads outside a loop
+        are tolerated (cheap, bounded); a decode LOOP on the callback
+        thread is the regression the native ingest path exists to
+        prevent.  Nested defs are excluded, as in the blocking rule."""
+        if not self.native_path_scope:
+            return
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (fn.name.startswith("_on_") or fn.name.endswith("_cb")):
+                continue
+            skip: set[int] = set()
+            for n in ast.walk(fn):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n is not fn:
+                    skip.update(id(m) for m in ast.walk(n))
+            flagged: set[int] = set()  # a call under nested loops: once
+            for loop in ast.walk(fn):
+                if id(loop) in skip or \
+                        not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for n in ast.walk(loop):
+                    if id(n) in skip or id(n) in flagged or \
+                            not isinstance(n, ast.Call):
+                        continue
+                    d = _dotted(n.func)
+                    if d is None:
+                        continue
+                    tail = d.rsplit(".", 1)[-1]
+                    if d in _DECODE_DOTTED or (
+                            "." in d and tail in _DECODE_TAILS):
+                        flagged.add(id(n))
+                        self._flag(n, "python-decode-in-native-path",
+                                   f"{d}() in a loop inside event-loop "
+                                   f"callback {fn.name}() — per-op frame "
+                                   "decode belongs to the native ingest "
+                                   "layer (rpcserver.cpp + rpc/wire.py)")
 
     def _scan_obs_buffers(self) -> None:
         """unbounded-obs-buffer: inside tpu6824/obs/, (a) any deque

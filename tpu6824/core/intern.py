@@ -105,6 +105,9 @@ def _load_native():
     lib.intern_incref.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.intern_decref.restype = ctypes.c_int32
     lib.intern_decref.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.intern_get_bytes.restype = ctypes.c_int64
+    lib.intern_get_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                     ctypes.c_char_p, ctypes.c_int64]
     lib.intern_nlive.restype = ctypes.c_int64
     lib.intern_nlive.argtypes = [ctypes.c_void_p]
     lib.intern_bytes.restype = ctypes.c_int64
@@ -159,6 +162,21 @@ class NativeIntern:
 
     def refcount(self, vid: int) -> int:
         return int(self._lib.intern_refcount(self._h, vid))
+
+    def get_bytes(self, vid: int) -> "bytes | None":
+        """The id-LOOKUP surface (ISSUE 11): recover the serialized
+        payload bytes from an id alone, straight from the C++ store —
+        None for a freed id.  The native-ingest path uses the same core
+        call to materialize key/value strings lazily."""
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = int(self._lib.intern_get_bytes(self._h, vid, buf, cap))
+            if n < 0:
+                return None
+            if n <= cap:
+                return buf.raw[:n]
+            cap = n
 
     @property
     def nlive(self) -> int:

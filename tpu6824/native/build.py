@@ -4,41 +4,102 @@ Each component is a single .cpp with a C ABI, compiled on first import into
 `<repo>/build/` and loaded with ctypes; compile-to-temp + atomic rename keeps
 concurrent processes from ever dlopening a half-written library.  Returns
 None when no toolchain is available so callers can fall back to Python.
+
+Provenance (ISSUE 11 satellite): staleness is decided by a CONTENT hash of
+the source closure (the .cpp plus every repo-local ``#include "..."``
+header, plus the compile command), not by mtimes — git checkouts reset
+mtimes, which used to let a checked-in ``build/*.so`` silently shadow an
+edited .cpp.  Each build writes a ``<so>.src.sha256`` sidecar; the tier-1
+provenance test recomputes the hash and fails when the checked-in artifact
+drifts from source.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import re
 import subprocess
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 BUILD_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "build")
 
+CXX = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC"]
+
 _cache: dict[str, "ctypes.CDLL | None"] = {}
 _lock = threading.Lock()
 
+_INCLUDE_RE = re.compile(r'^\s*#\s*include\s+"([^"]+)"', re.MULTILINE)
+
+
+def source_closure(src: str) -> list[str]:
+    """The .cpp plus every repo-local quoted include, transitively —
+    the file set whose content defines the artifact."""
+    seen: list[str] = []
+    todo = [os.path.abspath(src)]
+    while todo:
+        path = todo.pop()
+        if path in seen or not os.path.exists(path):
+            continue
+        seen.append(path)
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        base = os.path.dirname(path)
+        for inc in _INCLUDE_RE.findall(text):
+            todo.append(os.path.normpath(os.path.join(base, inc)))
+    return sorted(seen)
+
+
+def source_hash(src: str) -> str:
+    """sha256 over the compile command + the source closure's contents."""
+    h = hashlib.sha256()
+    h.update(" ".join(CXX).encode())
+    for path in source_closure(src):
+        h.update(b"\x00" + os.path.basename(path).encode() + b"\x00")
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def sidecar_path(so: str) -> str:
+    return so + ".src.sha256"
+
 
 def load(so_name: str, src: str) -> "ctypes.CDLL | None":
-    """Compile `src` (if stale) to BUILD_DIR/so_name and dlopen it."""
+    """Compile `src` (if its source closure's hash drifted) to
+    BUILD_DIR/so_name and dlopen it."""
     with _lock:
         if so_name in _cache:
             return _cache[so_name]
         so = os.path.join(BUILD_DIR, so_name)
         try:
-            if (not os.path.exists(so)) or (
-                os.path.getmtime(so) < os.path.getmtime(src)
-            ):
+            want = source_hash(src)
+            have = None
+            try:
+                with open(sidecar_path(so)) as f:
+                    have = f.read().strip()
+            except OSError:
+                pass
+            if (not os.path.exists(so)) or have != want:
                 os.makedirs(BUILD_DIR, exist_ok=True)
                 tmp = f"{so}.{os.getpid()}.tmp"
                 try:
                     subprocess.run(
-                        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                         "-o", tmp, src],
+                        CXX + ["-o", tmp, src],
                         check=True, capture_output=True,
                     )
                     os.replace(tmp, so)
+                    # tpusan: ok(durable-write-discipline) — build-cache
+                    # sidecar, not durable state: worst case after a crash
+                    # is a spurious rebuild; durafs would drag the obs
+                    # stack into this pre-import bootstrap path.
+                    with open(sidecar_path(so) + f".{os.getpid()}.tmp",
+                              "w") as f:
+                        f.write(want + "\n")
+                    os.replace(sidecar_path(so) + f".{os.getpid()}.tmp",
+                               sidecar_path(so))
                 finally:
                     if os.path.exists(tmp):
                         os.unlink(tmp)
@@ -47,3 +108,11 @@ def load(so_name: str, src: str) -> "ctypes.CDLL | None":
             lib = None  # toolchain unavailable → caller's python fallback
         _cache[so_name] = lib
         return lib
+
+
+# The artifact inventory (so → source), shared with the provenance test.
+COMPONENTS = {
+    "libintern6824.so": os.path.join(_HERE, "intern.cpp"),
+    "liblru6824.so": os.path.join(_HERE, "lru.cpp"),
+    "rpcserver.so": os.path.join(_HERE, "rpcserver.cpp"),
+}

@@ -1,0 +1,58 @@
+// fe wire — C++ mirror of the versioned little-endian frame layout in
+// tpu6824/rpc/wire.py (ISSUE 11).  The two files ARE the schema: any
+// layout change bumps kFeVersion in BOTH, and an unknown version must be
+// refused (error frame), never mis-parsed.
+//
+//   request  'F' 'E' 'B' ver |u16 flags|u16 nops| [u64 tid,u64 sid]
+//            then nops records: u8 kind |u64 cid|i64 cseq|u16 klen|
+//            u32 vlen| key bytes | value bytes
+//   reply    'F' 'E' 'R' ver |u16 flags|u16 nops|
+//            then nops records: u8 err |u32 vlen| value bytes
+//   error    'F' 'E' 'E' ver |u32 mlen| utf-8 message
+//
+// Parsing uses memcpy loads (frames arrive unaligned in the connection
+// read buffer) and assumes a little-endian host — the same assumption the
+// Python struct '<' format encodes.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+
+namespace fewire {
+
+constexpr uint8_t kFeVersion = 1;
+
+// kind codes (closed enum, order is schema): get / put / append.
+constexpr int32_t kKindGet = 0;
+constexpr int32_t kKindPut = 1;
+constexpr int32_t kKindAppend = 2;
+constexpr int32_t kNumKinds = 3;
+
+// err codes: OK / ErrNoKey / ErrWrongGroup; 255 = pickled escape hatch
+// (only the Python encoder emits it).
+constexpr uint8_t kErrOther = 255;
+
+constexpr size_t kHdrSize = 8;       // magic4 + flags u16 + nops u16
+constexpr size_t kTcSize = 16;       // trace_id u64 + span_id u64
+constexpr size_t kOpFixed = 23;      // kind u8 + cid u64 + cseq i64 +
+                                     // klen u16 + vlen u32
+constexpr uint16_t kFlagTrace = 1;
+
+inline bool is_batch(const uint8_t* p, size_t n) {
+  return n >= kHdrSize && p[0] == 'F' && p[1] == 'E' && p[2] == 'B';
+}
+
+template <typename T>
+inline T load(const uint8_t* p) {
+  T v;
+  memcpy(&v, p, sizeof(T));
+  return v;
+}
+
+template <typename T>
+inline void store(uint8_t* p, T v) {
+  memcpy(p, &v, sizeof(T));
+}
+
+}  // namespace fewire

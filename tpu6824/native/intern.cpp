@@ -4,32 +4,25 @@
 // device (the kernel agrees on int32 ids, SURVEY §7); every Start() interns
 // its payload here, and the Done/Min window GC drops references when slots
 // are recycled (the doMemShrink/TestForgetMem semantics of the reference,
-// paxos/paxos.go:362-378, paxos/test_test.go:371-454).  This C++ core owns
-// the dedup index, refcounts and free-list under one mutex; the Python side
-// (intern.py) keeps only an id→value list for O(1) lookup without
-// re-serialization.
+// paxos/paxos.go:362-378, paxos/test_test.go:371-454).
+//
+// The store itself lives in intern_core.h (ISSUE 11): the epoll server
+// (rpcserver.cpp) compiles the same core so its loop thread can intern
+// clerk keys/values with no GIL; this file is the C ABI the Python
+// NativeIntern mirror loads.  New in the shared core: an id-LOOKUP surface
+// (`intern_get_bytes`) so a caller can recover the payload bytes from an
+// id alone — the Python side of the native-ingest path materializes
+// key/value strings lazily through it instead of keeping every payload
+// mirrored eagerly.
 //
 // C ABI for ctypes.  Build: g++ -O2 -std=c++17 -shared -fPIC -o
 // libintern6824.so intern.cpp  (driven by intern.py).
 
 #include <cstdint>
-#include <mutex>
-#include <string>
-#include <unordered_map>
-#include <vector>
 
-namespace {
+#include "intern_core.h"
 
-struct Store {
-  std::mutex mu;
-  std::unordered_map<std::string, int32_t> by_key;
-  std::vector<std::string> keys;    // id → serialized payload key
-  std::vector<int64_t> refs;        // id → refcount (0 = slot free)
-  std::vector<int32_t> free_ids;
-  int64_t live_bytes = 0;
-};
-
-}  // namespace
+using intern_core::Store;
 
 extern "C" {
 
@@ -41,69 +34,35 @@ void intern_destroy(void* h) { delete static_cast<Store*>(h); }
 // id was (re)allocated by this call, telling the caller to (re)bind its
 // id→value mirror.
 int32_t intern_put(void* h, const char* key, int64_t klen, int32_t* is_new) {
-  auto* s = static_cast<Store*>(h);
-  std::string k(key, static_cast<size_t>(klen));
-  std::lock_guard<std::mutex> g(s->mu);
-  auto it = s->by_key.find(k);
-  if (it != s->by_key.end()) {
-    *is_new = 0;
-    s->refs[it->second] += 1;
-    return it->second;
-  }
-  int32_t vid;
-  if (!s->free_ids.empty()) {
-    vid = s->free_ids.back();
-    s->free_ids.pop_back();
-    s->keys[vid] = std::move(k);
-    s->refs[vid] = 1;
-  } else {
-    vid = static_cast<int32_t>(s->keys.size());
-    s->keys.push_back(std::move(k));
-    s->refs.push_back(1);
-  }
-  s->by_key.emplace(s->keys[vid], vid);
-  s->live_bytes += klen;
-  *is_new = 1;
-  return vid;
+  return intern_core::store_put(static_cast<Store*>(h), key, klen, is_new);
 }
 
 void intern_incref(void* h, int32_t vid) {
-  auto* s = static_cast<Store*>(h);
-  std::lock_guard<std::mutex> g(s->mu);
-  s->refs[vid] += 1;
+  intern_core::store_incref(static_cast<Store*>(h), vid);
 }
 
 // Drops one reference; returns 1 iff the payload was freed (caller clears
 // its id→value mirror), 0 otherwise.
 int32_t intern_decref(void* h, int32_t vid) {
-  auto* s = static_cast<Store*>(h);
-  std::lock_guard<std::mutex> g(s->mu);
-  if (s->refs[vid] <= 0) return 0;  // already free — tolerate double-decref
-  if (--s->refs[vid] > 0) return 0;
-  s->by_key.erase(s->keys[vid]);
-  s->live_bytes -= static_cast<int64_t>(s->keys[vid].size());
-  s->keys[vid].clear();
-  s->keys[vid].shrink_to_fit();
-  s->free_ids.push_back(vid);
-  return 1;
+  return intern_core::store_decref(static_cast<Store*>(h), vid);
+}
+
+// Copy a live id's payload bytes into `out` (cap bytes); returns the
+// payload length (> cap: nothing copied, retry bigger), -1 if free.
+int64_t intern_get_bytes(void* h, int32_t vid, char* out, int64_t cap) {
+  return intern_core::store_get_copy(static_cast<Store*>(h), vid, out, cap);
 }
 
 int64_t intern_nlive(void* h) {
-  auto* s = static_cast<Store*>(h);
-  std::lock_guard<std::mutex> g(s->mu);
-  return static_cast<int64_t>(s->keys.size() - s->free_ids.size());
+  return intern_core::store_nlive(static_cast<Store*>(h));
 }
 
 int64_t intern_bytes(void* h) {
-  auto* s = static_cast<Store*>(h);
-  std::lock_guard<std::mutex> g(s->mu);
-  return s->live_bytes;
+  return intern_core::store_bytes(static_cast<Store*>(h));
 }
 
 int64_t intern_refcount(void* h, int32_t vid) {
-  auto* s = static_cast<Store*>(h);
-  std::lock_guard<std::mutex> g(s->mu);
-  return s->refs[vid];
+  return intern_core::store_refcount(static_cast<Store*>(h), vid);
 }
 
 }  // extern "C"

@@ -1,0 +1,120 @@
+// Shared refcounted byte-intern store — the native runtime's "allocator"
+// core, extracted from intern.cpp (ISSUE 11) so the epoll server
+// (rpcserver.cpp) can intern clerk keys/values ON ITS LOOP THREAD with no
+// GIL and no cross-library calls: both .cpp files compile this header into
+// their own .so, and each operates only on stores it created itself.
+//
+// The store maps byte strings to dense int32 ids with refcounts and a
+// free-list; payload bytes live in `keys` (ids index it), `by_key` is the
+// dedup index.  All operations take the store's own mutex — callers never
+// need external locking, and the epoll loop thread and Python (via ctypes,
+// which drops the GIL around C calls) interleave safely.
+//
+// Pointer-stability caveat: `keys` is a std::vector<std::string>, so
+// growth MOVES the string objects (and SSO payloads with them).  Readers
+// therefore COPY bytes out under the mutex (store_get_copy) instead of
+// returning interior pointers.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace intern_core {
+
+struct Store {
+  std::mutex mu;
+  std::unordered_map<std::string, int32_t> by_key;
+  std::vector<std::string> keys;    // id → payload bytes
+  std::vector<int64_t> refs;        // id → refcount (0 = slot free)
+  std::vector<int32_t> free_ids;
+  int64_t live_bytes = 0;
+};
+
+// Intern `data` and take one reference.  *is_new is 1 iff the id was
+// (re)allocated by this call (telling a Python caller to (re)bind its
+// id→value mirror).
+inline int32_t store_put(Store* s, const char* data, int64_t len,
+                         int32_t* is_new) {
+  std::string k(data, static_cast<size_t>(len));
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->by_key.find(k);
+  if (it != s->by_key.end()) {
+    if (is_new) *is_new = 0;
+    s->refs[it->second] += 1;
+    return it->second;
+  }
+  int32_t vid;
+  if (!s->free_ids.empty()) {
+    vid = s->free_ids.back();
+    s->free_ids.pop_back();
+    s->keys[vid] = std::move(k);
+    s->refs[vid] = 1;
+  } else {
+    vid = static_cast<int32_t>(s->keys.size());
+    s->keys.push_back(std::move(k));
+    s->refs.push_back(1);
+  }
+  s->by_key.emplace(s->keys[vid], vid);
+  s->live_bytes += len;
+  if (is_new) *is_new = 1;
+  return vid;
+}
+
+inline void store_incref(Store* s, int32_t vid) {
+  std::lock_guard<std::mutex> g(s->mu);
+  s->refs[vid] += 1;
+}
+
+// Drops one reference; returns 1 iff the payload was freed (caller clears
+// its id→value mirror), 0 otherwise.  Double-decref is tolerated.
+inline int32_t store_decref(Store* s, int32_t vid) {
+  std::lock_guard<std::mutex> g(s->mu);
+  if (vid < 0 || size_t(vid) >= s->refs.size() || s->refs[vid] <= 0)
+    return 0;
+  if (--s->refs[vid] > 0) return 0;
+  s->live_bytes -= static_cast<int64_t>(s->keys[vid].size());
+  s->by_key.erase(s->keys[vid]);
+  s->keys[vid].clear();
+  s->keys[vid].shrink_to_fit();
+  s->free_ids.push_back(vid);
+  return 1;
+}
+
+// Copy the payload bytes for a LIVE id into `out` (cap bytes available);
+// returns the payload length, or -1 for a free/unknown id.  A return
+// value > cap means "buffer too small, call again with a bigger one" —
+// nothing was copied.  This is the id-LOOKUP surface the native ingest
+// path and the Python mirror share.
+inline int64_t store_get_copy(Store* s, int32_t vid, char* out,
+                              int64_t cap) {
+  std::lock_guard<std::mutex> g(s->mu);
+  if (vid < 0 || size_t(vid) >= s->refs.size() || s->refs[vid] <= 0)
+    return -1;
+  const std::string& k = s->keys[vid];
+  int64_t n = static_cast<int64_t>(k.size());
+  if (n <= cap) memcpy(out, k.data(), k.size());
+  return n;
+}
+
+inline int64_t store_nlive(Store* s) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return static_cast<int64_t>(s->keys.size() - s->free_ids.size());
+}
+
+inline int64_t store_bytes(Store* s) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->live_bytes;
+}
+
+inline int64_t store_refcount(Store* s, int32_t vid) {
+  std::lock_guard<std::mutex> g(s->mu);
+  if (vid < 0 || size_t(vid) >= s->refs.size()) return 0;
+  return s->refs[vid];
+}
+
+}  // namespace intern_core

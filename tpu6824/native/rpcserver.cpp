@@ -21,6 +21,18 @@
 // after the op ran — the executed-but-unacked case the at-most-once
 // machinery upstairs is tested against.
 //
+// NATIVE INGEST (ISSUE 11): with ingest enabled, versioned fe_batch
+// frames (fewire.h — the little-endian layout shared with rpc/wire.py)
+// are decoded ON THE LOOP THREAD straight into per-frame int64/int32
+// columnar buffers — op kind, cid, cseq, key-id, value-id — with key and
+// value bytes interned into native stores (intern_core.h), all without
+// the GIL.  The Python engine polls ready frames (one memcpy per column
+// into its own numpy buffers), hands the arrays to submit_columnar, and
+// the reply path mirrors it: the driver's notify sweep pushes (tag, err,
+// value-id) triples into the native reply ring, and THIS loop serializes
+// the completed frame's reply bytes and flushes them — steady-state
+// operation builds no per-op Python objects on either direction.
+//
 // C ABI only; loaded via ctypes (no pybind11 in this image).
 
 #include <atomic>
@@ -42,6 +54,9 @@
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <unistd.h>
+
+#include "fewire.h"
+#include "intern_core.h"
 
 namespace {
 
@@ -75,6 +90,42 @@ struct Reply {
   std::vector<uint8_t> data;
 };
 
+// One ingested fe_batch frame: columnar op buffers (filled by the loop
+// thread, copied out once by the Python engine) plus the reply-side state
+// (err/rep_val per slot) the push path completes against.  err 255 =
+// slot unanswered.
+struct FeFrame {
+  uint64_t id = 0;
+  uint64_t conn_id = 0;
+  uint32_t nops = 0;
+  uint32_t remaining = 0;
+  bool has_tc = false;
+  uint64_t tc[2] = {0, 0};
+  std::vector<int32_t> kind, key_id, val_id;
+  std::vector<int64_t> cid, cseq;
+  std::vector<uint8_t> err;       // reply err code per slot
+  std::vector<uint8_t> answered;  // 1 once a push landed on the slot
+  std::vector<int32_t> rep_val;   // reply value id (vals store), -1 = ""
+};
+
+// Per-server native-ingest state.  `mu` guards the frame table and the
+// fresh/done queues; the intern stores carry their own mutexes (the loop
+// thread and Python threads interleave on them freely).
+struct Ingest {
+  std::mutex mu;
+  intern_core::Store keys, vals;
+  std::unordered_map<uint64_t, FeFrame*> frames;
+  std::deque<uint64_t> fresh;  // ingested, not yet polled by the engine
+  std::deque<uint64_t> done;   // replied/failed, awaiting engine reap
+  uint64_t next_frame = 1;
+  int efd = -1;  // engine wakeup eventfd (loop writes, engine selects)
+  int64_t inflight_ops = 0;
+  int64_t max_ops = 1 << 16;  // backpressure: beyond this, frames bounce
+  // native_ingest counters (mirrored into the Python metrics registry).
+  std::atomic<int64_t> c_frames{0}, c_ops{0}, c_bytes{0}, c_full{0};
+  std::atomic<int64_t> c_done_ops{0};  // ops answered (reply or fail)
+};
+
 struct Server {
   int lfd = -1, epfd = -1, evfd = -1;
   std::string path;
@@ -88,6 +139,7 @@ struct Server {
   std::deque<Reply> pending;
   std::unordered_map<uint64_t, Conn> conns;
   uint64_t next_id = 1;
+  std::atomic<Ingest*> ingest{nullptr};  // set once by rpcsrv_ingest_enable
 };
 
 double next_unit(uint64_t& s) {  // xorshift64*, uniform in [0,1)
@@ -133,6 +185,213 @@ void handle_accept(Server* s) {
   }
 }
 
+// Thread-safe reply enqueue: the loop's pending deque + eventfd wake —
+// usable from the loop thread itself (immediate ingest errors) and from
+// any Python thread (the push path's completed frames).
+void enqueue_reply(Server* s, uint64_t conn_id, std::vector<uint8_t>&& data) {
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->pending.push_back(Reply{conn_id, std::move(data)});
+  }
+  uint64_t one = 1;
+  ssize_t ignored = write(s->evfd, &one, 8);
+  (void)ignored;
+}
+
+std::vector<uint8_t> fe_error_bytes(const char* msg) {
+  size_t mlen = strlen(msg);
+  std::vector<uint8_t> out(8 + mlen);
+  out[0] = 'F';
+  out[1] = 'E';
+  out[2] = 'E';
+  out[3] = fewire::kFeVersion;
+  fewire::store<uint32_t>(out.data() + 4, uint32_t(mlen));
+  memcpy(out.data() + 8, msg, mlen);
+  return out;
+}
+
+void ingest_wake_engine(Ingest* ing) {
+  uint64_t one = 1;
+  ssize_t ignored = write(ing->efd, &one, 8);
+  (void)ignored;
+}
+
+// Assemble the completed frame's FER reply (err + value bytes per slot,
+// values read out of the native store), hand it to the loop, and retire
+// the frame to the reap queue.  Caller holds ing->mu.
+void fe_complete_locked(Server* s, Ingest* ing, FeFrame* f) {
+  std::vector<int64_t> vlens(f->nops, 0);
+  size_t total = fewire::kHdrSize;
+  {
+    std::lock_guard<std::mutex> g(ing->vals.mu);
+    for (uint32_t i = 0; i < f->nops; i++) {
+      int32_t vid = f->rep_val[i];
+      if (vid >= 0 && size_t(vid) < ing->vals.refs.size() &&
+          ing->vals.refs[vid] > 0)
+        vlens[i] = int64_t(ing->vals.keys[vid].size());
+      total += 5 + size_t(vlens[i]);
+    }
+  }
+  if (total > kMaxFrame) {
+    // Reply past the transport frame cap (e.g. a batch of huge gets):
+    // answer with an explicit error instead of a frame the client's
+    // receive cap would reject — a silent oversized reply is a retry
+    // livelock (the dup filter re-serves it forever).
+    for (uint32_t i = 0; i < f->nops; i++)
+      if (f->rep_val[i] >= 0)
+        intern_core::store_decref(&ing->vals, f->rep_val[i]);
+    enqueue_reply(s, f->conn_id,
+                  fe_error_bytes("reply too large for one fe frame"));
+    ing->done.push_back(f->id);
+    ing->inflight_ops -= f->nops;
+    ing->c_done_ops.fetch_add(f->nops, std::memory_order_relaxed);
+    return;
+  }
+  std::vector<uint8_t> out(total);
+  out[0] = 'F';
+  out[1] = 'E';
+  out[2] = 'R';
+  out[3] = fewire::kFeVersion;
+  fewire::store<uint16_t>(out.data() + 4, 0);
+  fewire::store<uint16_t>(out.data() + 6, uint16_t(f->nops));
+  size_t off = fewire::kHdrSize;
+  {
+    std::lock_guard<std::mutex> g(ing->vals.mu);
+    for (uint32_t i = 0; i < f->nops; i++) {
+      out[off] = f->err[i];
+      fewire::store<uint32_t>(out.data() + off + 1, uint32_t(vlens[i]));
+      off += 5;
+      if (vlens[i] > 0) {
+        memcpy(out.data() + off, ing->vals.keys[f->rep_val[i]].data(),
+               size_t(vlens[i]));
+        off += size_t(vlens[i]);
+      }
+    }
+  }
+  for (uint32_t i = 0; i < f->nops; i++)
+    if (f->rep_val[i] >= 0)
+      intern_core::store_decref(&ing->vals, f->rep_val[i]);
+  enqueue_reply(s, f->conn_id, std::move(out));
+  ing->done.push_back(f->id);
+  ing->inflight_ops -= f->nops;
+  ing->c_done_ops.fetch_add(f->nops, std::memory_order_relaxed);
+}
+
+// Decode one fe_batch frame on the LOOP THREAD (no GIL anywhere in here):
+// columnar op buffers + native-interned key/value bytes, then wake the
+// Python engine through the ingest eventfd.  Malformed/overload frames
+// answer with an fe error frame — the client tears and retries, exactly
+// the undecodable-frame economics of the pickle path.
+void ingest_frame(Server* s, Ingest* ing, uint64_t conn_id,
+                  const uint8_t* p, size_t n) {
+  if (p[3] != fewire::kFeVersion) {
+    enqueue_reply(s, conn_id, fe_error_bytes("fe wire version mismatch"));
+    return;
+  }
+  uint16_t flags = fewire::load<uint16_t>(p + 4);
+  uint16_t nops = fewire::load<uint16_t>(p + 6);
+  size_t off = fewire::kHdrSize;
+  uint64_t tc0 = 0, tc1 = 0;
+  bool has_tc = (flags & fewire::kFlagTrace) != 0;
+  if (has_tc) {
+    if (n < off + fewire::kTcSize) {
+      enqueue_reply(s, conn_id, fe_error_bytes("malformed fe_batch frame"));
+      return;
+    }
+    tc0 = fewire::load<uint64_t>(p + off);
+    tc1 = fewire::load<uint64_t>(p + off + 8);
+    off += fewire::kTcSize;
+  }
+  if (nops == 0) {
+    // Degenerate empty batch: answer now so the connection's reply FIFO
+    // stays in sync (mirrors the Python engine's empty-frame handling).
+    std::vector<uint8_t> out(fewire::kHdrSize, 0);
+    out[0] = 'F';
+    out[1] = 'E';
+    out[2] = 'R';
+    out[3] = fewire::kFeVersion;
+    enqueue_reply(s, conn_id, std::move(out));
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> g(ing->mu);
+    if (ing->inflight_ops + nops > ing->max_ops) {
+      ing->c_full.fetch_add(1, std::memory_order_relaxed);
+      enqueue_reply(s, conn_id,
+                    fe_error_bytes("native ingest overloaded (ring full)"));
+      return;
+    }
+  }
+  auto* f = new FeFrame;
+  f->conn_id = conn_id;
+  f->nops = nops;
+  f->remaining = nops;
+  f->has_tc = has_tc;
+  f->tc[0] = tc0;
+  f->tc[1] = tc1;
+  f->kind.reserve(nops);
+  f->cid.reserve(nops);
+  f->cseq.reserve(nops);
+  f->key_id.reserve(nops);
+  f->val_id.reserve(nops);
+  f->err.assign(nops, 0);
+  f->answered.assign(nops, 0);
+  f->rep_val.assign(nops, -1);
+  bool ok = true;
+  for (uint16_t i = 0; i < nops; i++) {
+    if (n < off + fewire::kOpFixed) {
+      ok = false;
+      break;
+    }
+    uint8_t kind = p[off];
+    uint64_t cid = fewire::load<uint64_t>(p + off + 1);
+    int64_t cseq = fewire::load<int64_t>(p + off + 9);
+    uint16_t klen = fewire::load<uint16_t>(p + off + 17);
+    uint32_t vlen = fewire::load<uint32_t>(p + off + 19);
+    off += fewire::kOpFixed;
+    if (kind >= fewire::kNumKinds || n < off + klen + vlen) {
+      ok = false;
+      break;
+    }
+    int32_t kid = intern_core::store_put(
+        &ing->keys, reinterpret_cast<const char*>(p + off), klen, nullptr);
+    off += klen;
+    int32_t vid = -1;
+    if (vlen > 0) {
+      vid = intern_core::store_put(
+          &ing->vals, reinterpret_cast<const char*>(p + off), vlen, nullptr);
+    }
+    off += vlen;
+    f->kind.push_back(int32_t(kind));
+    f->cid.push_back(int64_t(cid));
+    f->cseq.push_back(cseq);
+    f->key_id.push_back(kid);
+    f->val_id.push_back(vid);
+  }
+  if (!ok || off != n) {
+    // Roll back the interns taken so far; the frame never existed.
+    for (size_t i = 0; i < f->key_id.size(); i++) {
+      intern_core::store_decref(&ing->keys, f->key_id[i]);
+      if (f->val_id[i] >= 0)
+        intern_core::store_decref(&ing->vals, f->val_id[i]);
+    }
+    delete f;
+    enqueue_reply(s, conn_id, fe_error_bytes("malformed fe_batch frame"));
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> g(ing->mu);
+    f->id = ing->next_frame++;
+    ing->frames.emplace(f->id, f);
+    ing->fresh.push_back(f->id);
+    ing->inflight_ops += nops;
+  }
+  ing->c_frames.fetch_add(1, std::memory_order_relaxed);
+  ing->c_ops.fetch_add(nops, std::memory_order_relaxed);
+  ing->c_bytes.fetch_add(int64_t(n), std::memory_order_relaxed);
+  ingest_wake_engine(ing);
+}
+
 // Hand the next buffered complete frame (if any) to the callback.  Called
 // from handle_read and after a reply flush (the client may have sent its
 // next pooled request while the previous one was being served).  Per-REQUEST
@@ -160,8 +419,16 @@ bool try_dispatch(Server* s, uint64_t id, Conn& c) {
   c.handed_off = true;  // one request in flight per connection
   c.deadline_ms = now_ms() + kConnTimeoutMs;
   epoll_mod(s, id, c);
-  s->cb(id, c.rbuf.data() + 4, int64_t(len));
-  // The callback copies the payload synchronously; drop the consumed frame.
+  const uint8_t* payload = c.rbuf.data() + 4;
+  Ingest* ing_ = s->ingest.load(std::memory_order_acquire);
+  if (ing_ != nullptr && fewire::is_batch(payload, len)) {
+    // Native fe_batch frame: decode HERE, on the loop thread, into the
+    // columnar ingest buffers — the Python callback never sees it.
+    ingest_frame(s, ing_, id, payload, len);
+  } else {
+    s->cb(id, payload, int64_t(len));
+  }
+  // The callback/decoder consumes the payload synchronously; drop it.
   c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + 4 + len);
   return true;
 }
@@ -382,6 +649,254 @@ void rpcsrv_kill(void* srv) {
   unlink(s->path.c_str());
 }
 
-void rpcsrv_free(void* srv) { delete static_cast<Server*>(srv); }
+void rpcsrv_free(void* srv) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing != nullptr) {
+    for (auto& [id, f] : ing->frames) delete f;
+    if (ing->efd >= 0) close(ing->efd);
+    delete ing;
+    s->ingest.store(nullptr, std::memory_order_release);
+  }
+  delete s;
+}
+
+// ------------------------------------------------------- native ingest
+
+// Enable zero-GIL ingest (right after the server binds, before traffic;
+// the pointer is published atomically, a racing frame just takes the
+// Python callback once): fe_batch frames decode on the loop thread into
+// columnar buffers.  Returns the engine-wakeup eventfd
+// (Python selects on it; the loop writes it per ingested frame), or -1.
+int rpcsrv_ingest_enable(void* srv, int64_t max_ops) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* have = s->ingest.load(std::memory_order_acquire);
+  if (have != nullptr) return have->efd;
+  auto* ing = new Ingest;
+  if (max_ops > 0) ing->max_ops = max_ops;
+  ing->efd = eventfd(0, EFD_NONBLOCK);
+  if (ing->efd < 0) {
+    delete ing;
+    return -1;
+  }
+  s->ingest.store(ing, std::memory_order_release);
+  return ing->efd;
+}
+
+// Pop one ready frame: hdr6 = {frame_id, conn_id, nops, has_tc, tc0, tc1},
+// columns memcpy'd into the caller's buffers (cap ops each).  Returns nops,
+// -1 when no frame is ready, -2 when cap is too small (frame stays
+// queued).  The frame's column storage is released here — the caller's
+// copies are the only ones left; err/answered bookkeeping stays for the
+// reply path.
+int64_t rpcsrv_ingest_poll1(void* srv, uint64_t* hdr, int32_t* kinds,
+                            int64_t* cids, int64_t* cseqs, int32_t* keyids,
+                            int32_t* valids, int64_t cap) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) return -1;
+  std::lock_guard<std::mutex> g(ing->mu);
+  while (!ing->fresh.empty()) {
+    uint64_t fid = ing->fresh.front();
+    auto it = ing->frames.find(fid);
+    if (it == ing->frames.end()) {
+      ing->fresh.pop_front();
+      continue;
+    }
+    FeFrame* f = it->second;
+    if (int64_t(f->nops) > cap) return -2;
+    ing->fresh.pop_front();
+    hdr[0] = f->id;
+    hdr[1] = f->conn_id;
+    hdr[2] = f->nops;
+    hdr[3] = f->has_tc ? 1 : 0;
+    hdr[4] = f->tc[0];
+    hdr[5] = f->tc[1];
+    memcpy(kinds, f->kind.data(), f->nops * sizeof(int32_t));
+    memcpy(cids, f->cid.data(), f->nops * sizeof(int64_t));
+    memcpy(cseqs, f->cseq.data(), f->nops * sizeof(int64_t));
+    memcpy(keyids, f->key_id.data(), f->nops * sizeof(int32_t));
+    memcpy(valids, f->val_id.data(), f->nops * sizeof(int32_t));
+    std::vector<int32_t>().swap(f->kind);
+    std::vector<int64_t>().swap(f->cid);
+    std::vector<int64_t>().swap(f->cseq);
+    std::vector<int32_t>().swap(f->key_id);
+    std::vector<int32_t>().swap(f->val_id);
+    return int64_t(f->nops);
+  }
+  return -1;
+}
+
+// Intern one reply value (get results) into the vals store, ref 1 —
+// ownership passes to the next rpcsrv_ingest_push that places it (or is
+// dropped there if the slot is gone).
+int32_t rpcsrv_ingest_val_intern(void* srv, const char* data, int64_t len) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) return -1;
+  return intern_core::store_put(&ing->vals, data, len, nullptr);
+}
+
+// Batched reply-value intern: `data` is n values concatenated,
+// offs/lens index it, ids land in `out` — ONE FFI transition per notify
+// sweep instead of one per get reply (the sweep runs under the kvpaxos
+// server mutex; per-op lock round-trips there are the round-13 lesson).
+void rpcsrv_ingest_val_intern_many(void* srv, const char* data,
+                                   const int64_t* offs,
+                                   const int64_t* lens, int32_t* out,
+                                   int64_t n) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) {
+    for (int64_t i = 0; i < n; i++) out[i] = -1;
+    return;
+  }
+  for (int64_t i = 0; i < n; i++)
+    out[i] = intern_core::store_put(&ing->vals, data + offs[i], lens[i],
+                                    nullptr);
+}
+
+// The reply ring's write side: (tag, err, rep_val) triples from the
+// driver's notify sweep.  tag = (frame_id << 16) | slot.  Unknown frames
+// and already-answered slots are ignored (a second replica applying the
+// same decided op pushes the same tag); a frame whose last slot lands
+// here is serialized and flushed by the loop.
+void rpcsrv_ingest_push(void* srv, const int64_t* tags, const uint8_t* errs,
+                        const int32_t* repvals, int64_t n) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) return;
+  std::lock_guard<std::mutex> g(ing->mu);
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t fid = uint64_t(tags[i]) >> 16;
+    uint32_t slot = uint32_t(tags[i] & 0xFFFF);
+    auto it = ing->frames.find(fid);
+    FeFrame* f = it == ing->frames.end() ? nullptr : it->second;
+    if (f == nullptr || slot >= f->nops || f->answered[slot] ||
+        f->remaining == 0) {
+      if (repvals[i] >= 0)
+        intern_core::store_decref(&ing->vals, repvals[i]);
+      continue;
+    }
+    f->answered[slot] = 1;
+    f->err[slot] = errs[i];
+    f->rep_val[slot] = repvals[i];
+    if (--f->remaining == 0) fe_complete_locked(s, ing, f);
+  }
+}
+
+// Unanswered slot indices for a live frame (the engine's retry pass);
+// returns the count, or -1 for an unknown frame.  `out` must hold nops.
+int64_t rpcsrv_ingest_pending(void* srv, uint64_t frame_id, int32_t* out) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) return -1;
+  std::lock_guard<std::mutex> g(ing->mu);
+  auto it = ing->frames.find(frame_id);
+  if (it == ing->frames.end()) return -1;
+  FeFrame* f = it->second;
+  int64_t n = 0;
+  for (uint32_t i = 0; i < f->nops; i++)
+    if (!f->answered[i]) out[n++] = int32_t(i);
+  return n;
+}
+
+// Fail a live frame (engine timeout): fe error reply to the client, frame
+// retired to the reap queue.  Late pushes against it are dropped.
+void rpcsrv_ingest_fail(void* srv, uint64_t frame_id, const char* msg) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) return;
+  std::lock_guard<std::mutex> g(ing->mu);
+  auto it = ing->frames.find(frame_id);
+  if (it == ing->frames.end()) return;
+  FeFrame* f = it->second;
+  if (f->remaining == 0) return;  // already completed
+  for (uint32_t i = 0; i < f->nops; i++) {
+    f->answered[i] = 1;
+    if (f->rep_val[i] >= 0) {
+      intern_core::store_decref(&ing->vals, f->rep_val[i]);
+      f->rep_val[i] = -1;
+    }
+  }
+  f->remaining = 0;
+  enqueue_reply(s, f->conn_id, fe_error_bytes(msg));
+  ing->done.push_back(f->id);
+  ing->inflight_ops -= f->nops;
+  ing->c_done_ops.fetch_add(f->nops, std::memory_order_relaxed);
+}
+
+// Pop completed/failed frame ids (the engine's bookkeeping reap); the
+// frame structs are freed here — request-side key/value intern refs are
+// the ENGINE's to drop (it holds the column copies), via
+// rpcsrv_ingest_decref once materialization has provably drained.
+int64_t rpcsrv_ingest_reap(void* srv, uint64_t* out, int64_t cap) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) return 0;
+  std::lock_guard<std::mutex> g(ing->mu);
+  int64_t n = 0;
+  while (n < cap && !ing->done.empty()) {
+    uint64_t fid = ing->done.front();
+    ing->done.pop_front();
+    auto it = ing->frames.find(fid);
+    if (it != ing->frames.end()) {
+      delete it->second;
+      ing->frames.erase(it);
+    }
+    out[n++] = fid;
+  }
+  return n;
+}
+
+// Copy a live interned payload out of the key (which=0) / value (which=1)
+// store: returns length (> cap: nothing copied, retry bigger), -1 freed.
+int64_t rpcsrv_ingest_get(void* srv, int which, int32_t id, char* out,
+                          int64_t cap) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) return -1;
+  return intern_core::store_get_copy(which ? &ing->vals : &ing->keys, id,
+                                     out, cap);
+}
+
+// Columnar decref over the key/value store; ids < 0 are skipped.  Freed
+// ids are written to `freed` (the Python mirror invalidates its cached
+// strings for exactly those), count returned.
+int64_t rpcsrv_ingest_decref(void* srv, int which, const int32_t* ids,
+                             int64_t n, int32_t* freed) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) return 0;
+  intern_core::Store* st = which ? &ing->vals : &ing->keys;
+  int64_t nf = 0;
+  for (int64_t i = 0; i < n; i++)
+    if (ids[i] >= 0 && intern_core::store_decref(st, ids[i]))
+      freed[nf++] = ids[i];
+  return nf;
+}
+
+// {frames, ops, bytes, ring_full, inflight_ops, live_frames, keys_live,
+//  vals_live, done_ops} — the native_ingest counters the registry mirrors.
+void rpcsrv_ingest_stats(void* srv, int64_t* out) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) {
+    memset(out, 0, 9 * sizeof(int64_t));
+    return;
+  }
+  out[0] = ing->c_frames.load(std::memory_order_relaxed);
+  out[1] = ing->c_ops.load(std::memory_order_relaxed);
+  out[2] = ing->c_bytes.load(std::memory_order_relaxed);
+  out[3] = ing->c_full.load(std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> g(ing->mu);
+    out[4] = ing->inflight_ops;
+    out[5] = int64_t(ing->frames.size());
+  }
+  out[6] = intern_core::store_nlive(&ing->keys);
+  out[7] = intern_core::store_nlive(&ing->vals);
+  out[8] = ing->c_done_ops.load(std::memory_order_relaxed);
+}
 
 }  // extern "C"

@@ -132,6 +132,21 @@ METRICS = [
            leg_shape=[("service", "clerk_frontend", "groups"),
                       ("service", "clerk_frontend", "conns"),
                       ("service", "clerk_frontend", "batch_width")]),
+    # Native zero-GIL ingest (ISSUE 11): the pickle-decode control point
+    # and the native/pickle speedup ratio.  Both host-edge; the ratio is
+    # measured on ONE box in ONE window, so it is steadier than either
+    # absolute number but still scheduler-share-sensitive under load.
+    # First recorded artifact (r09) baselines; gates thereafter.
+    Metric(("service", "clerk_frontend", "native_ingest",
+            "control_pickle", "value"), 0.65, host_bound=True,
+           leg_shape=[("service", "clerk_frontend", "groups"),
+                      ("service", "clerk_frontend", "conns"),
+                      ("service", "clerk_frontend", "batch_width")]),
+    Metric(("service", "clerk_frontend", "native_ingest", "speedup"),
+           0.50, host_bound=True,
+           leg_shape=[("service", "clerk_frontend", "groups"),
+                      ("service", "clerk_frontend", "conns"),
+                      ("service", "clerk_frontend", "batch_width")]),
     Metric(("service", "clerk_frontend", "latency", "p50_ms"), 0.65,
            higher_is_better=False, host_bound=True,
            leg_shape=[("service", "clerk_frontend", "groups"),

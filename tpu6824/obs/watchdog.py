@@ -136,20 +136,26 @@ class LatencySpike(Rule):
 
 class QueueGrowth(Rule):
     name = "queue-growth"
-    series = "fabric.health.feed_depth_max"
+    # Consumer-side depth gauges: the fabric's decided-feed depth and the
+    # native ingest path's in-flight op count (ISSUE 11 — a stuck reply
+    # ring shows as inflight_ops climbing monotonically while the engine
+    # keeps mirroring the gauge).
+    series = ("fabric.health.feed_depth_max",
+              "frontend.native_ingest.inflight_ops")
 
     def __init__(self, limit: float | None = None):
         self.limit = _envf("TPU6824_WD_FEED_DEPTH", 1024.0) \
             if limit is None else limit
 
     def check(self, wd):
-        pts = wd.points(self.series)
-        if len(pts) < 3 or pts[-1][1] < self.limit:
-            return None
-        vs = [v for _, v in pts]
-        if all(b >= a for a, b in zip(vs, vs[1:])) and vs[-1] > vs[0]:
-            return (f"feed depth grew {vs[0]:.0f} -> {vs[-1]:.0f} over "
-                    f"the window (consumer falling behind)")
+        for name in self.series:
+            pts = wd.points(name)
+            if len(pts) < 3 or pts[-1][1] < self.limit:
+                continue
+            vs = [v for _, v in pts]
+            if all(b >= a for a, b in zip(vs, vs[1:])) and vs[-1] > vs[0]:
+                return (f"{name} grew {vs[0]:.0f} -> {vs[-1]:.0f} over "
+                        f"the window (consumer falling behind)")
         return None
 
 

@@ -28,7 +28,7 @@ import threading
 
 from tpu6824.native.build import load
 from tpu6824.obs import tracing as _tracing
-from tpu6824.rpc import transport
+from tpu6824.rpc import transport, wire
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils import crashsink
 
@@ -62,6 +62,49 @@ def _get_lib():
                 lib.rpcsrv_deafen.argtypes = [ctypes.c_void_p]
                 lib.rpcsrv_kill.argtypes = [ctypes.c_void_p]
                 lib.rpcsrv_free.argtypes = [ctypes.c_void_p]
+                # Native-ingest surface (ISSUE 11).  An older checked-in
+                # .so lacks these; the hash-staleness rebuild in build.py
+                # makes that unreachable in practice, but probe anyway so
+                # a failed rebuild degrades to the Python inline path.
+                if hasattr(lib, "rpcsrv_ingest_enable"):
+                    # Array arguments travel as RAW addresses (c_void_p
+                    # ints from numpy's .ctypes.data): a typed
+                    # data_as()/cast() per call builds a ctypes
+                    # reference CYCLE (pointer ↔ _objects dict) that
+                    # only gc can reclaim — measured at ~3 objects per
+                    # call by the zero-alloc probe.  The caller keeps
+                    # the arrays alive across the call.
+                    vp = ctypes.c_void_p
+                    lib.rpcsrv_ingest_enable.restype = ctypes.c_int
+                    lib.rpcsrv_ingest_enable.argtypes = [
+                        vp, ctypes.c_int64]
+                    lib.rpcsrv_ingest_poll1.restype = ctypes.c_int64
+                    lib.rpcsrv_ingest_poll1.argtypes = [
+                        vp, vp, vp, vp, vp, vp, vp, ctypes.c_int64]
+                    lib.rpcsrv_ingest_val_intern.restype = ctypes.c_int32
+                    lib.rpcsrv_ingest_val_intern.argtypes = [
+                        vp, ctypes.c_char_p, ctypes.c_int64]
+                    lib.rpcsrv_ingest_val_intern_many.argtypes = [
+                        vp, ctypes.c_char_p, vp, vp, vp,
+                        ctypes.c_int64]
+                    lib.rpcsrv_ingest_push.argtypes = [
+                        vp, vp, vp, vp, ctypes.c_int64]
+                    lib.rpcsrv_ingest_pending.restype = ctypes.c_int64
+                    lib.rpcsrv_ingest_pending.argtypes = [
+                        vp, ctypes.c_uint64, vp]
+                    lib.rpcsrv_ingest_fail.argtypes = [
+                        vp, ctypes.c_uint64, ctypes.c_char_p]
+                    lib.rpcsrv_ingest_reap.restype = ctypes.c_int64
+                    lib.rpcsrv_ingest_reap.argtypes = [
+                        vp, vp, ctypes.c_int64]
+                    lib.rpcsrv_ingest_get.restype = ctypes.c_int64
+                    lib.rpcsrv_ingest_get.argtypes = [
+                        vp, ctypes.c_int, ctypes.c_int32,
+                        ctypes.c_char_p, ctypes.c_int64]
+                    lib.rpcsrv_ingest_decref.restype = ctypes.c_int64
+                    lib.rpcsrv_ingest_decref.argtypes = [
+                        vp, ctypes.c_int, vp, ctypes.c_int64, vp]
+                    lib.rpcsrv_ingest_stats.argtypes = [vp, vp]
             _lib = lib or False
     return _lib or None
 
@@ -84,6 +127,10 @@ class NativeServer:
         os.makedirs(os.path.dirname(addr) or ".", exist_ok=True)
         self._lib = lib
         self._handlers: dict[str, callable] = {}
+        # Python-side handler for VERSIONED fe wire frames (rpc/wire.py)
+        # when C++ ingest is off: decoded here, answered natively.
+        self._native_batch = None
+        self._ingest_fd: int | None = None
         # Event-loop handlers (register_inline): run ON the C++ epoll
         # callback thread, no per-request handler thread, reply deferred
         # via send_reply() from any thread — the clerk-frontend seam.
@@ -125,6 +172,36 @@ class NativeServer:
         self._inline[name] = fn
         return self
 
+    def register_native_batch(self, fn) -> "NativeServer":
+        """Event-loop handler for fe wire frames that reach PYTHON (C++
+        ingest off — custom op factories, or a lib without the ingest
+        surface): `fn(conn_id, ops, tc)` with the frame already decoded
+        by rpc/wire.py.  Same discipline as register_inline; replies go
+        out via send_reply_native/send_error_native."""
+        self._native_batch = fn
+        return self
+
+    def enable_ingest(self, max_ops: int = 1 << 16) -> "NativeIngest | None":
+        """Turn on zero-GIL ingest (call right AFTER start(), before
+        traffic — the C handle must exist; a frame racing the enable
+        just takes the Python decode path once): fe wire frames decode
+        on the C++ loop thread into columnar buffers, and the reply
+        ring serializes responses without re-entering Python.  Returns
+        the NativeIngest handle (poll/push/reap surface), or None when
+        the loaded lib predates the ingest ABI."""
+        if not hasattr(self._lib, "rpcsrv_ingest_enable"):
+            return None
+        with self._lock:
+            if self._dead:
+                return None
+            if self._srv is None:
+                raise RPCError("enable_ingest must run after start()")
+            fd = self._lib.rpcsrv_ingest_enable(self._srv, max_ops)
+            if fd < 0:
+                return None
+            self._ingest_fd = fd
+            return NativeIngest(self)
+
     def send_reply(self, conn_id: int, obj) -> None:
         """Deferred ok-reply for an inline-handled request: pickles
         `(True, obj)` and hands it to the epoll loop (eventfd wake) —
@@ -147,6 +224,15 @@ class NativeServer:
         """Drop the connection without replying (the RPCError-refusal
         path of the threaded handlers)."""
         self._send_reply(conn_id, b"")
+
+    def send_reply_native(self, conn_id: int, replies) -> None:
+        """Deferred reply to an fe wire frame: FER-encoded (err, value)
+        pairs — the versioned-layout twin of send_reply."""
+        self._send_reply(conn_id, wire.encode_replies(replies))
+
+    def send_error_native(self, conn_id: int, msg: str) -> None:
+        """Deferred fe error frame (RPCError(msg) at the caller)."""
+        self._send_reply(conn_id, wire.encode_error(msg))
 
     def start(self) -> "NativeServer":
         with self._lock:
@@ -222,6 +308,13 @@ class NativeServer:
         # inline rpc is served on this thread (decode + enqueue + wake; the
         # event-loop discipline) — zero handler threads on the batched path.
         payload = ctypes.string_at(data, length)
+        if wire.is_fe_frame(payload):
+            # Versioned fe wire frame reaching PYTHON: the C++ ingest is
+            # off (custom op factory, or a pre-ingest lib).  Decode with
+            # the shared schema and serve — same layout, different
+            # decoder, so fallback parity holds.
+            self._serve_native(conn_id, payload)
+            return
         frame = None
         if self._inline:
             try:
@@ -243,6 +336,53 @@ class NativeServer:
         threading.Thread(
             target=crashsink.guarded(self._serve, "native-rpc-serve"),
             args=(conn_id, payload, frame), daemon=True).start()
+
+    def _serve_native(self, conn_id: int, payload: bytes) -> None:
+        """fe wire frame, Python side: inline to the native-batch engine
+        hook when registered, else a worker thread over the blocking
+        fe_batch handler; replies always go back in the fe layout the
+        request arrived in."""
+        try:
+            ops, tc = wire.decode_batch(payload)
+        except RPCError as e:
+            self._send_reply(conn_id, wire.encode_error(str(e)))
+            return
+        nb = self._native_batch
+        if nb is not None:
+            try:
+                nb(conn_id, ops, tc)
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                crashsink.record("native-rpc-inline", e, fatal=False)
+                self._send_reply(conn_id, b"")
+            return
+        fn = self._handlers.get("fe_batch")
+        if fn is None:
+            self._send_reply(
+                conn_id, wire.encode_error("no such rpc: fe_batch"))
+            return
+        threading.Thread(
+            target=crashsink.guarded(self._serve_native_blocking,
+                                     "native-rpc-serve"),
+            args=(conn_id, fn, ops, tc), daemon=True).start()
+
+    def _serve_native_blocking(self, conn_id, fn, ops, tc) -> None:
+        try:
+            if tc is not None:
+                with _tracing.use_ctx(_tracing.TraceContext(*tc)):
+                    replies = fn(ops)
+            else:
+                replies = fn(ops)
+        except RPCError:
+            self._send_reply(conn_id, b"")  # refusal: drop, no reply
+            return
+        except Exception as e:  # app-level error → fe error frame
+            self._send_reply(conn_id, wire.encode_error(f"{e!r:.200}"))
+            return
+        try:
+            raw = wire.encode_replies(replies)
+        except Exception as e:  # noqa: BLE001 — degrade like _serve does
+            raw = wire.encode_error(f"unserializable reply ({e!r:.100})")
+        self._send_reply(conn_id, raw)
 
     def _serve(self, conn_id: int, payload: bytes, frame=None) -> None:
         try:
@@ -290,6 +430,219 @@ class NativeServer:
             if self._dead or self._srv is None:
                 return
             self._lib.rpcsrv_reply(self._srv, conn_id, buf, len(raw))
+
+
+class NativeIngest:
+    """Python handle on a server's zero-GIL ingest state: reusable poll
+    buffers (numpy, pointer-passed — the zero-copy handoff), the reply
+    ring's write side, and the lazy id→str key mirror.
+
+    Single-consumer by design: the frontend ENGINE thread owns poll/
+    pending/fail/reap/decref; push/val_intern are safe from any thread
+    (the driver's notify sweep calls them under the server mutex).  All
+    C calls run with the raw server handle the wrapper captured at
+    enable time — the frontend joins the engine before killing the
+    server, so no call can outlive the handle."""
+
+    REAP_CAP = 1024
+
+    def __init__(self, srv: NativeServer):
+        import numpy as np
+
+        self._np = np
+        self._srv = srv
+        self._lib = srv._lib
+        self._h = srv._srv
+        self._lock = srv._lock  # serializes every C call vs kill/free
+        self.fd = srv._ingest_fd
+        self._cap = 0
+        self._grow(4096)
+        self._hdr = np.zeros(6, dtype=np.uint64)
+        self._hdr_p = self._hdr.ctypes.data
+        self._reap_buf = np.zeros(self.REAP_CAP, dtype=np.uint64)
+        self._reap_p = self._reap_buf.ctypes.data
+        self._scratch = ctypes.create_string_buffer(1 << 16)
+        self._keystr: dict[int, str] = {}  # lazy id→str key mirror
+        self._stats_buf = np.zeros(9, dtype=np.int64)
+        self._stats_p = self._stats_buf.ctypes.data
+
+    def _grow(self, cap: int) -> None:
+        np = self._np
+        self._cap = cap
+        self._kind = np.zeros(cap, dtype=np.int32)
+        self._cid = np.zeros(cap, dtype=np.int64)
+        self._cseq = np.zeros(cap, dtype=np.int64)
+        self._keyid = np.zeros(cap, dtype=np.int32)
+        self._valid = np.zeros(cap, dtype=np.int32)
+        self._pend = np.zeros(cap, dtype=np.int32)
+        self._kind_p = self._kind.ctypes.data
+        self._cid_p = self._cid.ctypes.data
+        self._cseq_p = self._cseq.ctypes.data
+        self._keyid_p = self._keyid.ctypes.data
+        self._valid_p = self._valid.ctypes.data
+        self._pend_p = self._pend.ctypes.data
+
+    # ------------------------------------------------------------- ingest
+
+    def poll1(self):
+        """One ready frame as (frame_id, conn_id, nops, tc, kind, cid,
+        cseq, key_id, val_id) with engine-owned column copies, or None."""
+        while True:
+            with self._lock:
+                if self._srv._dead or self._srv._srv is None:
+                    return None
+                n = self._lib.rpcsrv_ingest_poll1(
+                    self._h, self._hdr_p, self._kind_p, self._cid_p,
+                    self._cseq_p, self._keyid_p, self._valid_p, self._cap)
+            if n == -2:
+                self._grow(self._cap * 2)
+                continue
+            if n < 0:
+                return None
+            n = int(n)
+            h = self._hdr
+            tc = (int(h[4]), int(h[5])) if h[3] else None
+            return (int(h[0]), int(h[1]), n, tc,
+                    self._kind[:n].copy(), self._cid[:n].copy(),
+                    self._cseq[:n].copy(), self._keyid[:n].copy(),
+                    self._valid[:n].copy())
+
+    def push(self, tags, errs, repvals) -> None:
+        """Reply-ring write: int64/uint8/int32 arrays of equal length."""
+        n = len(tags)
+        if not n:
+            return
+        with self._lock:
+            if self._srv._dead or self._srv._srv is None:
+                return
+            self._lib.rpcsrv_ingest_push(
+                self._h, tags.ctypes.data, errs.ctypes.data,
+                repvals.ctypes.data, n)
+
+    def val_intern(self, data: bytes) -> int:
+        with self._lock:
+            if self._srv._dead or self._srv._srv is None:
+                return -1
+            return int(self._lib.rpcsrv_ingest_val_intern(
+                self._h, data, len(data)))
+
+    def val_intern_many(self, values):
+        """Intern a list of byte values in ONE C call (the notify
+        sweep's get replies): returns an np.int32 id array."""
+        np = self._np
+        n = len(values)
+        lens = np.fromiter((len(v) for v in values), dtype=np.int64,
+                           count=n)
+        offs = np.zeros(n, dtype=np.int64)
+        np.cumsum(lens[:-1], out=offs[1:])
+        out = np.empty(n, dtype=np.int32)
+        data = b"".join(values)
+        with self._lock:
+            if self._srv._dead or self._srv._srv is None:
+                out[:] = -1
+                return out
+            self._lib.rpcsrv_ingest_val_intern_many(
+                self._h, data, offs.ctypes.data, lens.ctypes.data,
+                out.ctypes.data, n)
+        return out
+
+    def pending(self, frame_id: int):
+        """Unanswered slot indices (np.int32 copy), or None if unknown."""
+        with self._lock:
+            if self._srv._dead or self._srv._srv is None:
+                return None
+            n = self._lib.rpcsrv_ingest_pending(self._h, frame_id,
+                                                self._pend_p)
+        if n < 0:
+            return None
+        return self._pend[:int(n)].copy()
+
+    def fail(self, frame_id: int, msg: str) -> None:
+        with self._lock:
+            if self._srv._dead or self._srv._srv is None:
+                return
+            self._lib.rpcsrv_ingest_fail(self._h, frame_id,
+                                         msg.encode(errors="replace"))
+
+    def reap(self) -> list:
+        out = []
+        while True:
+            with self._lock:
+                if self._srv._dead or self._srv._srv is None:
+                    return out
+                n = int(self._lib.rpcsrv_ingest_reap(
+                    self._h, self._reap_p, self.REAP_CAP))
+            out.extend(int(x) for x in self._reap_buf[:n])
+            if n < self.REAP_CAP:
+                return out
+
+    # ------------------------------------------------------ intern mirror
+
+    def _get(self, which: int, vid: int):
+        while True:
+            with self._lock:
+                if self._srv._dead or self._srv._srv is None:
+                    return None
+                n = self._lib.rpcsrv_ingest_get(self._h, which, vid,
+                                                self._scratch,
+                                                len(self._scratch))
+            if n < 0:
+                return None
+            if n <= len(self._scratch):
+                return self._scratch.raw[:n]
+            self._scratch = ctypes.create_string_buffer(int(n))
+
+    def key_str(self, kid: int):
+        """id → key string, lazily mirrored (keys repeat; the mirror is
+        invalidated by decref_keys exactly when an id frees)."""
+        s = self._keystr.get(kid)
+        if s is None:
+            b = self._get(0, kid)
+            if b is None:
+                return None
+            s = b.decode()
+            self._keystr[kid] = s
+        return s
+
+    def val_str(self, vid: int):
+        """id → value string; -1 is the empty value, unique values are
+        not cached (one materialization per proposal)."""
+        if vid < 0:
+            return ""
+        b = self._get(1, vid)
+        return None if b is None else b.decode()
+
+    def decref_keys(self, ids) -> None:
+        self._decref(0, ids)
+
+    def decref_vals(self, ids) -> None:
+        self._decref(1, ids, invalidate=False)
+
+    def _decref(self, which: int, ids, invalidate: bool = True) -> None:
+        n = len(ids)
+        if not n:
+            return
+        np = self._np
+        freed = np.zeros(n, dtype=np.int32)
+        with self._lock:
+            if self._srv._dead or self._srv._srv is None:
+                return
+            nf = int(self._lib.rpcsrv_ingest_decref(
+                self._h, which, ids.ctypes.data, n, freed.ctypes.data))
+        if invalidate and nf:
+            pop = self._keystr.pop
+            for vid in freed[:nf].tolist():
+                pop(vid, None)
+
+    def stats(self) -> dict:
+        with self._lock:
+            if not (self._srv._dead or self._srv._srv is None):
+                self._lib.rpcsrv_ingest_stats(self._h, self._stats_p)
+        b = self._stats_buf
+        return {"frames": int(b[0]), "ops": int(b[1]), "bytes": int(b[2]),
+                "ring_full": int(b[3]), "inflight_ops": int(b[4]),
+                "live_frames": int(b[5]), "keys_live": int(b[6]),
+                "vals_live": int(b[7]), "done_ops": int(b[8])}
 
 
 def make_server(addr: str, seed: int | None = None, prefer_native=True):

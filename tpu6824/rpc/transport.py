@@ -53,6 +53,7 @@ import time
 
 from tpu6824.obs import metrics as _metrics
 from tpu6824.obs import tracing as _tracing
+from tpu6824.rpc import wire
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils import crashsink
 from tpu6824.utils.trace import dprintf
@@ -239,11 +240,15 @@ def reset_pool() -> None:
     _pool.close_all()
 
 
-def _send_frame(sock: socket.socket, obj) -> None:
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+def _send_raw_frame(sock: socket.socket, data: bytes) -> None:
     if len(data) > _MAX_FRAME:
         raise RPCError(f"frame too large to send: {len(data)}")
     sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _send_frame(sock: socket.socket, obj) -> None:
+    _send_raw_frame(sock,
+                    pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -256,15 +261,22 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket):
+def _recv_raw_frame(sock: socket.socket) -> bytes:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n > _MAX_FRAME:
         raise RPCError(f"frame too large: {n}")
-    data = _recv_exact(sock, n)
+    return _recv_exact(sock, n)
+
+
+def _unpickle_frame(data: bytes):
     try:
         return pickle.loads(data)
     except Exception as e:  # corrupt frame or a non-round-trippable payload
         raise RPCError(f"undecodable frame: {e!r}") from e
+
+
+def _recv_frame(sock: socket.socket):
+    return _unpickle_frame(_recv_raw_frame(sock))
 
 
 class FramedConn:
@@ -306,6 +318,14 @@ class FramedConn:
         except OSError as e:
             raise RPCError(f"send {self.addr}: {e}") from e
 
+    def send_raw(self, data: bytes) -> None:
+        """Send a pre-encoded frame body (the versioned fe wire layout —
+        rpc/wire.py — travels as raw bytes, not pickle)."""
+        try:
+            _send_raw_frame(self.sock, data)
+        except OSError as e:
+            raise RPCError(f"send {self.addr}: {e}") from e
+
     def _pop_frame(self):
         """Decode one frame from the buffer, or None if incomplete."""
         buf = self._buf
@@ -318,6 +338,10 @@ class FramedConn:
             return None
         data = bytes(buf[_LEN.size:_LEN.size + n])
         del buf[:_LEN.size + n]
+        if wire.is_fe_frame(data):
+            # fe wire reply/error frame: decoded by the shared schema
+            # into the same (ok, payload) shape pickled replies carry.
+            return (wire.decode_any_reply(data),)
         try:
             return (pickle.loads(data),)
         except Exception as e:
@@ -584,12 +608,21 @@ class Server:
             conn.settimeout(30.0)
             while not self._dead.is_set():
                 try:
-                    frame = _recv_frame(conn)
-                    # Optional third element: a tpuscope TraceContext
-                    # from a tracing-enabled peer (untagged 2-tuples are
-                    # the common wire; see call()).
-                    rpcname, args = frame[0], frame[1]
-                    wctx = frame[2] if len(frame) > 2 else None
+                    raw = _recv_raw_frame(conn)
+                    native = wire.is_fe_frame(raw)
+                    if native:
+                        # Versioned fe wire frame (rpc/wire.py): the
+                        # pure-Python server speaks the SAME layout the
+                        # native ingest path does — fallback parity is a
+                        # schema contract, not a degraded dialect.
+                        rpcname, args, wctx = "fe_batch", None, None
+                    else:
+                        frame = _unpickle_frame(raw)
+                        # Optional third element: a tpuscope TraceContext
+                        # from a tracing-enabled peer (untagged 2-tuples
+                        # are the common wire; see call()).
+                        rpcname, args = frame[0], frame[1]
+                        wctx = frame[2] if len(frame) > 2 else None
                 except (RPCError, OSError):
                     return  # client hung up / idled out: connection done
                 with self._lock:
@@ -605,6 +638,11 @@ class Server:
                             self.addr, rpcname)
                     return
                 discard_reply = unrel and r2 < REP_DROP
+                if native:
+                    if not self._serve_native_frame(conn, raw,
+                                                    discard_reply):
+                        return
+                    continue
                 fn = self._handlers.get(rpcname)
                 if fn is None:
                     reply = (False, f"no such rpc: {rpcname}")
@@ -646,6 +684,53 @@ class Server:
             with self._lock:
                 self._live.discard(conn)
             conn.close()
+
+    def _serve_native_frame(self, conn: socket.socket, raw: bytes,
+                            discard_reply: bool) -> bool:
+        """One fe wire frame on the blocking server: decode with the
+        shared schema, run the registered `fe_batch` handler, reply in
+        the SAME layout.  Returns False when the connection is done."""
+        try:
+            ops, tc = wire.decode_batch(raw)
+        except RPCError as e:
+            _send_raw_frame(conn, wire.encode_error(str(e)))
+            return True
+        fn = self._handlers.get("fe_batch")
+        if fn is None:
+            out = wire.encode_error("no such rpc: fe_batch")
+        else:
+            try:
+                if tc is not None:
+                    with _tracing.use_ctx(_tracing.TraceContext(*tc)):
+                        replies = fn(ops)
+                else:
+                    replies = fn(ops)
+                out = wire.encode_replies(replies)
+            except RPCError:
+                return False  # transport-level refusal: drop, no reply
+            except Exception as e:  # app-level error → fe error frame
+                out = wire.encode_error(f"{e!r:.200}")
+        if discard_reply:
+            _M_SRV_DROP_REP.inc(key="fe_batch")
+            dprintf("rpc", "%s: dropped reply fe_batch (unreliable)",
+                    self.addr)
+            conn.shutdown(socket.SHUT_WR)
+            return False
+        try:
+            _send_raw_frame(conn, out)
+        except RPCError:
+            # Reply past the frame cap: the size check fires before any
+            # bytes move, so the stream is clean — degrade to an error
+            # frame (the pickled path's unserializable-reply contract;
+            # a silent drop would retry-livelock the clerk).
+            try:
+                _send_raw_frame(conn, wire.encode_error(
+                    "reply too large for one fe frame"))
+            except OSError:
+                return False
+        except OSError:
+            return False
+        return True
 
 
 class DelayProxy:

@@ -1,0 +1,200 @@
+"""fe wire — the versioned little-endian frame layout for the clerk
+frontend's batched request path (ISSUE 11, ROADMAP item 1).
+
+One schema, two decoders: this module is the PYTHON side (encoder for
+clerks, decoder for the pure-Python fallback servers), and
+`tpu6824/native/fewire.h` is the byte-for-byte C++ mirror the epoll loop
+decodes with — straight into preallocated int64/int32 columnar buffers,
+no GIL, no Python objects (the *Paxos Made Switch-y* dataplane bet with
+our native server playing the P4 switch).  Any layout change bumps
+``VERSION`` **in both files** and must keep the older decoder refusing
+(not mis-parsing) the newer frame.
+
+Frames ride the existing L0 transport framing (4-byte big-endian length
+prefix) and are distinguished from the classic pickled tuples by magic:
+pickle frames begin with ``\\x80`` (PROTO opcode), fe frames with
+``FE``.  Old pickled ``fe_batch`` / ``get`` / ``put_append`` frames stay
+first-class on every server — interop both directions is a contract,
+not a transition state.
+
+Layout v1 (all integers little-endian):
+
+  request   'F' 'E' 'B' ver |u16 flags|u16 nops| [u64 tid,u64 sid]
+            then nops records: u8 kind |u64 cid|i64 cseq|u16 klen|
+            u32 vlen| key bytes | value bytes
+  reply     'F' 'E' 'R' ver |u16 flags|u16 nops|
+            then nops records: u8 err |u32 vlen| value bytes
+  error     'F' 'E' 'E' ver |u32 mlen| utf-8 message
+            (maps to RPCError at the client, like a (False, msg) reply)
+
+flags bit 0 on a request: the optional tpuscope trace context
+(trace_id, span_id) follows the header — the PR-5 third frame element,
+frame-scoped.  kind and err are closed enums below; err 255 is the
+escape hatch (value bytes carry a pickled (err, value) pair) so exotic
+service replies survive the binary path without widening the enum.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+
+from tpu6824.utils.errors import OK, ErrNoKey, ErrWrongGroup, RPCError
+
+VERSION = 1
+
+MAGIC_BATCH = b"FEB" + bytes([VERSION])
+MAGIC_REPLY = b"FER" + bytes([VERSION])
+MAGIC_ERROR = b"FEE" + bytes([VERSION])
+
+FLAG_TRACE = 1  # request flags bit 0: (trace_id, span_id) present
+
+# Closed op-kind enum — the int32 the native decoder writes into the
+# kind column.  Order is part of the schema.
+KINDS = ("get", "put", "append")
+KIND_CODE = {k: i for i, k in enumerate(KINDS)}
+
+# Closed reply-err enum; 255 = pickled escape hatch.
+ERRS = (OK, ErrNoKey, ErrWrongGroup)
+ERR_CODE = {e: i for i, e in enumerate(ERRS)}
+ERR_OTHER = 255
+
+_HDR = struct.Struct("<4sHH")            # magic, flags, nops
+_TC = struct.Struct("<QQ")               # trace_id, span_id
+_OP = struct.Struct("<BQqHI")            # kind, cid, cseq, klen, vlen
+_REP = struct.Struct("<BI")              # err, vlen
+_EHDR = struct.Struct("<4sI")            # magic, mlen
+
+MAX_OPS = 0xFFFF  # u16 nops; also the slot width of the native reply tag
+MAX_KEY = 0xFFFF  # u16 klen
+MAX_VALUE = 0xFFFFFFFF  # u32 vlen
+
+
+class CapacityError(RPCError):
+    """An op does not FIT the fe wire layout (key > u16, value > u32,
+    batch > u16 ops).  Distinct from transport failure so a clerk can
+    fall back to the pickled frame for that request instead of
+    retrying/rotating — the op itself is fine, only the encoding is."""
+
+
+def is_fe_frame(buf: bytes) -> bool:
+    """True for any fe wire frame (request, reply, or error)."""
+    return len(buf) >= 4 and buf[:2] == b"FE"
+
+
+def encode_batch(ops, tc=None) -> bytes:
+    """ops: iterable of (kind, key, value, cid, cseq[, tc]) wire tuples
+    (per-op trailing tc elements are ignored — the fe frame's trace
+    context is frame-scoped, pass it as `tc`)."""
+    ops = tuple(ops)
+    if len(ops) > MAX_OPS:
+        raise CapacityError(f"fe_batch too wide: {len(ops)} > {MAX_OPS}")
+    flags = FLAG_TRACE if tc is not None else 0
+    out = bytearray(_HDR.pack(MAGIC_BATCH, flags, len(ops)))
+    if tc is not None:
+        out += _TC.pack(int(tc[0]) & (2**64 - 1), int(tc[1]) & (2**64 - 1))
+    for t in ops:
+        kind, key, value, cid, cseq = t[:5]
+        kb = key.encode() if isinstance(key, str) else bytes(key)
+        vb = value.encode() if isinstance(value, str) else bytes(value)
+        if len(kb) > MAX_KEY or len(vb) > MAX_VALUE:
+            raise CapacityError(
+                f"op does not fit the fe wire (klen {len(kb)} > {MAX_KEY}"
+                f" or vlen {len(vb)} > {MAX_VALUE})")
+        out += _OP.pack(KIND_CODE[kind], int(cid) & (2**64 - 1), int(cseq),
+                        len(kb), len(vb))
+        out += kb
+        out += vb
+    return bytes(out)
+
+
+def decode_batch(buf: bytes):
+    """-> (ops, tc): ops is a tuple of (kind, key, value, cid, cseq)
+    5-tuples (the classic fe_batch wire shape), tc the optional frame
+    trace context.  This is the PYTHON decoder — the fallback servers'
+    side of the schema; the native server never runs it."""
+    if buf[:4] != MAGIC_BATCH:
+        if buf[:3] == MAGIC_BATCH[:3]:
+            raise RPCError(f"fe_batch version {buf[3]} != {VERSION}")
+        raise RPCError("not an fe_batch frame")
+    _, flags, nops = _HDR.unpack_from(buf, 0)
+    off = _HDR.size
+    tc = None
+    if flags & FLAG_TRACE:
+        tc = _TC.unpack_from(buf, off)
+        off += _TC.size
+    ops = []
+    try:
+        for _ in range(nops):
+            kind, cid, cseq, klen, vlen = _OP.unpack_from(buf, off)
+            off += _OP.size
+            key = buf[off:off + klen].decode()
+            off += klen
+            value = buf[off:off + vlen].decode()
+            off += vlen
+            ops.append((KINDS[kind], key, value, cid, cseq))
+    except (struct.error, IndexError, UnicodeDecodeError) as e:
+        raise RPCError(f"malformed fe_batch frame: {e!r}") from e
+    if off != len(buf):
+        raise RPCError("trailing garbage in fe_batch frame")
+    return tuple(ops), tc
+
+
+def encode_replies(replies) -> bytes:
+    """replies: iterable of (err, value) pairs (the kv reply shape).
+    Non-enum errs or non-str values take the pickled escape hatch."""
+    replies = tuple(replies)
+    out = bytearray(_HDR.pack(MAGIC_REPLY, 0, len(replies)))
+    for rep in replies:
+        code = None
+        if isinstance(rep, tuple) and len(rep) == 2 and \
+                isinstance(rep[1], str):
+            code = ERR_CODE.get(rep[0])
+        if code is not None:
+            vb = rep[1].encode()
+        else:
+            code = ERR_OTHER
+            vb = pickle.dumps(rep, protocol=pickle.HIGHEST_PROTOCOL)
+        out += _REP.pack(code, len(vb))
+        out += vb
+    return bytes(out)
+
+
+def decode_replies(buf: bytes):
+    """-> tuple of (err, value) reply pairs."""
+    if buf[:4] != MAGIC_REPLY:
+        raise RPCError("not an fe reply frame")
+    _, _, nops = _HDR.unpack_from(buf, 0)
+    off = _HDR.size
+    reps = []
+    try:
+        for _ in range(nops):
+            err, vlen = _REP.unpack_from(buf, off)
+            off += _REP.size
+            vb = buf[off:off + vlen]
+            off += vlen
+            if err == ERR_OTHER:
+                reps.append(pickle.loads(vb))
+            else:
+                reps.append((ERRS[err], vb.decode()))
+    except (struct.error, IndexError, pickle.UnpicklingError,
+            UnicodeDecodeError) as e:
+        raise RPCError(f"malformed fe reply frame: {e!r}") from e
+    return tuple(reps)
+
+
+def encode_error(msg: str) -> bytes:
+    mb = msg.encode()
+    return _EHDR.pack(MAGIC_ERROR, len(mb)) + mb
+
+
+def decode_any_reply(buf: bytes):
+    """Decode a reply-direction fe frame -> (ok, payload), the transport
+    reply shape: (True, replies-tuple) or (False, message)."""
+    if buf[:4] == MAGIC_REPLY:
+        return True, decode_replies(buf)
+    if buf[:4] == MAGIC_ERROR:
+        _, mlen = _EHDR.unpack_from(buf, 0)
+        return False, buf[_EHDR.size:_EHDR.size + mlen].decode(
+            errors="replace")
+    raise RPCError(f"unknown fe reply frame {buf[:4]!r}")

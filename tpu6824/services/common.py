@@ -133,6 +133,18 @@ class ColumnarDups:
         i = self._slot.get(cid)
         return -1 if i is None else self._cseqs[i]
 
+    def seen_many(self, cids) -> list:
+        """Columnar dedup probe over a native cid column (ISSUE 11):
+        `cids` is a sequence of client ids (a numpy int64 array's
+        .tolist(), or any iterable of ints); returns the parallel list
+        of highest-applied cseqs (-1 for new clients).  One tight pass,
+        no per-op tuple — the submit_columnar side of the at-most-once
+        filter."""
+        slot_get = self._slot.get
+        cseqs = self._cseqs
+        return [-1 if i is None else cseqs[i]
+                for i in map(slot_get, cids)]
+
     def get(self, cid, default=(-1, None)):
         """Dict-compatible read: (max cseq, reply) or `default`."""
         i = self._slot.get(cid)

@@ -43,6 +43,8 @@ many clerks connect.
 from __future__ import annotations
 
 import os
+import pickle
+import select
 import threading
 import time
 from collections import deque
@@ -50,7 +52,7 @@ from collections import deque
 from tpu6824.obs import metrics as _metrics
 from tpu6824.obs import pulse as _obs_pulse
 from tpu6824.obs import tracing as _tracing
-from tpu6824.rpc import transport
+from tpu6824.rpc import transport, wire
 from tpu6824.rpc.native_server import NativeServer, make_server
 from tpu6824.services.common import Backoff, fresh_cid
 from tpu6824.services.kvpaxos import _DEAD, Op
@@ -75,6 +77,15 @@ _M_WIDTH = _metrics.histogram("frontend.frame_width")
 _M_SUBMIT = _metrics.histogram("frontend.submit_ops")  # columnar batch size
 _M_RETRIES = _metrics.counter("frontend.retries")
 _M_TIMEOUTS = _metrics.counter("frontend.timeouts")
+# Native zero-GIL ingest (ISSUE 11): the C++ loop's decode counters,
+# mirrored into the registry each engine pass so pulse/top/watchdog see
+# the native path (the inflight gauge is what queue-growth watches).
+_M_NI_FRAMES = _metrics.counter("frontend.native_ingest.frames")
+_M_NI_OPS = _metrics.counter("frontend.native_ingest.ops")
+_M_NI_BYTES = _metrics.counter("frontend.native_ingest.bytes")
+_M_NI_FULL = _metrics.counter("frontend.native_ingest.ring_full")
+
+_ONE8 = (1).to_bytes(8, "little")  # eventfd wake payload (preallocated)
 
 _UNSET = object()  # reply slot not yet resolved
 
@@ -90,11 +101,13 @@ class _Frame:
 
     __slots__ = ("conn_id", "single", "ops", "gids", "futs", "replies",
                  "remaining", "deadline", "retry_at", "interval", "srv",
-                 "last_remaining")
+                 "last_remaining", "native")
 
-    def __init__(self, conn_id, single, nops, now, op_timeout):
+    def __init__(self, conn_id, single, nops, now, op_timeout,
+                 native=False):
         self.conn_id = conn_id
         self.single = single
+        self.native = native  # arrived in the fe wire layout: reply in it
         self.ops = None
         self.gids = None            # per-slot target group index
         self.futs = [None] * nops
@@ -112,6 +125,115 @@ class _Frame:
         self.retry_at = now + self.interval
         self.srv = {}               # gid → replica idx last submitted to
         self.last_remaining = nops
+
+
+class _NFrame:
+    """One in-flight NATIVE-INGEST frame: the engine's bookkeeping for a
+    frame whose ops live as int columns (decoded by the C++ loop) and
+    whose replies flow through the native reply ring.  Columns are plain
+    int lists (one tolist per frame at poll); `kid_arr`/`vid_arr` keep
+    the numpy copies for the columnar intern decref at reap."""
+
+    __slots__ = ("fid", "conn_id", "nops", "tc", "kinds", "cids", "cseqs",
+                 "key_ids", "val_ids", "kid_arr", "vid_arr", "gids", "tcs",
+                 "deadline", "retry_at", "interval", "srv", "cur_srv",
+                 "tickets", "last_pending")
+
+    def __init__(self, fid, conn_id, nops, tc, now, op_timeout):
+        self.fid = fid
+        self.conn_id = conn_id
+        self.nops = nops
+        self.tc = tc
+        self.gids = None
+        self.tcs = None
+        self.deadline = now + op_timeout
+        self.interval = max(1.0, op_timeout / 4.0)  # the _Frame curve
+        self.retry_at = now + self.interval
+        self.srv = {}       # gid → leader index last submitted to
+        self.cur_srv = {}   # gid → server object last submitted to
+        self.tickets = []   # (server, drain ticket) per submission
+        self.last_pending = nops
+
+
+class _CBlock:
+    """One columnar submission: concatenated frame columns + the id→str
+    resolver (the native intern mirror).  The exact shape
+    KVPaxosServer.submit_columnar consumes."""
+
+    __slots__ = ("kinds", "cids", "cseqs", "key_ids", "val_ids", "tags",
+                 "tcs", "resolver")
+
+    def __init__(self, resolver):
+        self.kinds = []
+        self.cids = []
+        self.cseqs = []
+        self.key_ids = []
+        self.val_ids = []
+        self.tags = []
+        self.tcs = None
+        self.resolver = resolver
+
+
+class _NativeSink:
+    """The columnar reply sink handed to submit_columnar: `push` runs on
+    the group-commit driver's notify sweep (under the server mutex — one
+    call per drain, arrays only, no locks taken here) and writes straight
+    into the C++ reply ring; `server_dead` is the columnar twin of the
+    _DEAD future (O(1) enqueue + engine wake)."""
+
+    __slots__ = ("_ing", "_np", "_deadq", "_wake")
+
+    def __init__(self, ing, deadq, wake):
+        import numpy as np
+
+        self._np = np
+        self._ing = ing
+        self._deadq = deadq
+        self._wake = wake
+
+    def push(self, tags, replies, tctxs=None) -> None:
+        np = self._np
+        ing = self._ing
+        n = len(tags)
+        t = np.array(tags, dtype=np.int64)
+        errs = np.empty(n, dtype=np.uint8)
+        reps = np.full(n, -1, dtype=np.int32)
+        code_of = wire.ERR_CODE.get
+        vidx = vbytes = None  # slots whose reply carries value bytes
+        for i, rep in enumerate(replies):
+            code = None
+            if type(rep) is tuple and len(rep) == 2 \
+                    and isinstance(rep[1], str):
+                code = code_of(rep[0])
+            if code is None:
+                errs[i] = wire.ERR_OTHER
+                vb = pickle.dumps(rep, protocol=pickle.HIGHEST_PROTOCOL)
+            else:
+                errs[i] = code
+                val = rep[1]
+                if not val:
+                    continue  # (OK, "")-class reply: no value bytes
+                vb = val.encode()
+            if vidx is None:
+                vidx, vbytes = [], []
+            vidx.append(i)
+            vbytes.append(vb)
+        if vidx is not None:
+            # ONE C call for the whole sweep's get replies (review
+            # finding: per-op val_intern under the server mutex).
+            reps[vidx] = ing.val_intern_many(vbytes)
+        ing.push(t, errs, reps)
+        if tctxs is not None:
+            for ctx in tctxs:
+                if ctx is not None:
+                    sp = _tracing.child("frontend.reply", parent=ctx,
+                                        comp="frontend")
+                    if sp is not None:
+                        sp.end()
+
+    def server_dead(self, server) -> None:
+        self._deadq.append(server)
+        self._wake()
 
 
 class ClerkFrontend:
@@ -132,7 +254,8 @@ class ClerkFrontend:
     def __init__(self, servers=None, addr: str = "", *,
                  op_timeout: float = OP_TIMEOUT, seed: int | None = None,
                  prefer_native: bool = True, op_factory=_kv_op,
-                 groups=None, route=None):
+                 groups=None, route=None,
+                 ingest_max_ops: int = 1 << 16):
         if groups is None:
             groups = [list(servers)]
         self.groups = [list(g) for g in groups]
@@ -152,23 +275,50 @@ class ClerkFrontend:
             srv.register_inline(FE_BATCH, self._on_batch)
             srv.register_inline("get", self._on_get)
             srv.register_inline("put_append", self._on_put_append)
+            # fe wire frames that reach Python (C++ ingest off): decoded
+            # by the shared schema, served by the same engine, answered
+            # in the layout they arrived in.
+            srv.register_native_batch(self._on_native_batch)
         else:
             # Python accept-loop fallback (no C++ toolchain): blocking
             # handlers, one thread per CONNECTION — the batch still
             # amortizes per-frame, only the thread economics degrade.
+            # fe wire frames land on the SAME fe_batch handler through
+            # transport.Server's native-frame branch (fallback parity).
             srv.register(FE_BATCH, self._fe_batch_blocking)
             srv.register("get", self._get_blocking)
             srv.register("put_append", self._put_append_blocking)
+        # Capability probe: clerks ask once per endpoint whether the
+        # versioned fe wire is spoken here ("no such rpc" = old peer).
+        srv.register("fe_caps", lambda: {"fe_wire": wire.VERSION})
         # Observability plane (regular threaded handlers — pollers are
         # rare and must never touch the event loop): a fleet Collector
         # polls a live frontend process like any fabric process — the
         # registry snapshot (frontend.* plus the clerk pool's
         # rpc.pool.*), engine-side stats, flight ring, and pulse series.
         srv.register("stats", self.stats)
-        srv.register("metrics", _metrics.snapshot)
+        srv.register("metrics", self._metrics_rpc)
         srv.register("flight", _tracing.flight_snapshot)
         srv.register("pulse", _obs_pulse.series_snapshot)
         srv.start()
+        # Zero-GIL ingest (ISSUE 11): only the kvpaxos submit_columnar
+        # seam can consume the columnar frames, so custom op factories
+        # (shardkv) keep the Python decode path.
+        self._ing = None
+        self._deadq: deque = deque()
+        self._csink = None
+        self._wake_armed = False
+        self._ing_last = None  # previous counter snapshot (mirror deltas)
+        self._mirror_mu = threading.Lock()  # engine pass vs metrics RPC
+        if self.deferred and op_factory is _kv_op and all(
+                hasattr(s, "submit_columnar")
+                for g in self.groups for s in g):
+            self._ing = srv.enable_ingest(ingest_max_ops)
+            if self._ing is not None:
+                self._csink = _NativeSink(self._ing, self._deadq,
+                                          self._wake_native)
+                self._ing_last = {"frames": 0, "ops": 0, "bytes": 0,
+                                  "ring_full": 0, "done_ops": 0}
         self._engine = None
         if self.deferred:
             self._engine = threading.Thread(
@@ -181,35 +331,55 @@ class ClerkFrontend:
     # tpusan blocking-in-eventloop scope: decode + enqueue + wake ONLY.
 
     def _on_batch(self, conn_id, args, wctx) -> None:
-        self._pending.append((conn_id, args[0], wctx, False))
-        if not self._wake.is_set():
-            self._wake.set()
+        self._pending.append((conn_id, args[0], wctx, False, False))
+        self._wake_engine()
+
+    def _on_native_batch(self, conn_id, ops, tc) -> None:
+        # fe wire frame decoded in Python (C++ ingest off): same queue,
+        # native reply flag set so the answer leaves in the fe layout.
+        self._pending.append((conn_id, ops, tc, False, True))
+        self._wake_engine()
 
     def _on_get(self, conn_id, args, wctx) -> None:
         key, cid, cseq = args
         self._pending.append(
-            (conn_id, (("get", key, "", cid, cseq),), wctx, True))
-        if not self._wake.is_set():
-            self._wake.set()
+            (conn_id, (("get", key, "", cid, cseq),), wctx, True, False))
+        self._wake_engine()
 
     def _on_put_append(self, conn_id, args, wctx) -> None:
         kind, key, value, cid, cseq = args
         self._pending.append(
-            (conn_id, ((kind, key, value, cid, cseq),), wctx, True))
-        if not self._wake.is_set():
-            self._wake.set()
+            (conn_id, ((kind, key, value, cid, cseq),), wctx, True,
+             False))
+        self._wake_engine()
 
     def _on_fut_done(self, fut) -> None:
         # The future sink: runs on the group-commit driver's notify
         # sweep, under the server mutex — O(1), no locks, no blocking.
-        # The is_set guard matters: a notify sweep delivers THOUSANDS of
+        # The guards matter: a notify sweep delivers THOUSANDS of
         # futures back-to-back, and Event.set() takes the event's
         # condition lock every call — sampled at 14% of busy time before
-        # the guard; is_set() is a lock-free flag read.
+        # the guard; is_set()/_wake_armed are lock-free flag reads.
         self._doneq.append(fut)
-        wake = self._wake
-        if not wake.is_set():
-            wake.set()
+        self._wake_engine()
+
+    # ------------------------------------------------------ engine wakes
+
+    def _wake_native(self) -> None:
+        """Wake the engine's eventfd wait (native-ingest mode) — armed
+        flag keeps it one syscall per sleep, not one per event."""
+        if not self._wake_armed:
+            self._wake_armed = True
+            try:
+                os.write(self._ing.fd, _ONE8)
+            except OSError:
+                pass  # engine torn down under us
+
+    def _wake_engine(self) -> None:
+        if self._ing is not None:
+            self._wake_native()
+        elif not self._wake.is_set():
+            self._wake.set()
 
     # ------------------------------------------------------------- stats
 
@@ -219,6 +389,7 @@ class ClerkFrontend:
         fabric's stats() surface, so `obs.top` and the Collector treat
         a frontend process like any other fleet member.  Reads are
         len() on deques (atomic under the GIL), never a lock."""
+        ing = self._ing
         return {
             "frontend": {
                 "groups": len(self.groups),
@@ -227,6 +398,8 @@ class ClerkFrontend:
                 "done_queue": len(self._doneq),
                 "deferred": self.deferred,
                 "op_timeout": self.op_timeout,
+                "native_ingest": (ing.stats() if ing is not None
+                                  else {"enabled": False}),
             },
         }
 
@@ -313,8 +486,11 @@ class ClerkFrontend:
         live.pop(id(fr), None)
         for fut in fr.futs:
             self._unlink(futmap, fut, fr)
-        payload = fr.replies[0] if fr.single else tuple(fr.replies)
-        self._srv.send_reply(fr.conn_id, payload)
+        if fr.native:
+            self._srv.send_reply_native(fr.conn_id, tuple(fr.replies))
+        else:
+            payload = fr.replies[0] if fr.single else tuple(fr.replies)
+            self._srv.send_reply(fr.conn_id, payload)
         _M_OPS.inc(len(fr.replies))
 
     @staticmethod
@@ -348,7 +524,10 @@ class ClerkFrontend:
             self._unlink(futmap, fut, fr)
             if fr.replies[slot] is _UNSET:
                 self._abandon(fr, slot)
-        self._srv.send_error(fr.conn_id, msg)
+        if fr.native:
+            self._srv.send_error_native(fr.conn_id, msg)
+        else:
+            self._srv.send_error(fr.conn_id, msg)
         _M_TIMEOUTS.inc()
 
     def _retry_frame(self, fr, now, futmap) -> None:
@@ -374,18 +553,283 @@ class ClerkFrontend:
         fr.retry_at = now + fr.interval
         self._submit(ops, owners, gids, futmap)
 
+    # ---------------------------------------------- native ingest engine
+
+    def _mirror_ingest(self, ing) -> None:
+        """Mirror the C++ decode counters into the registry (delta-inc,
+        once per engine pass — and on demand when a fleet poller asks
+        for `metrics`, so a quiet frontend's counters are never a pass
+        stale) + the inflight gauge queue-growth watches."""
+        with self._mirror_mu:
+            st = ing.stats()
+            last = self._ing_last
+            d = st["frames"] - last["frames"]
+            if d:
+                _M_NI_FRAMES.inc(d)
+            d = st["ops"] - last["ops"]
+            if d:
+                _M_NI_OPS.inc(d)
+            d = st["bytes"] - last["bytes"]
+            if d:
+                _M_NI_BYTES.inc(d)
+            d = st["ring_full"] - last["ring_full"]
+            if d:
+                _M_NI_FULL.inc(d)
+            d = st["done_ops"] - last["done_ops"]
+            if d:
+                _M_OPS.inc(d)  # answered via the native reply ring
+            self._ing_last = st
+            _metrics.set_gauge("frontend.native_ingest.inflight_ops",
+                               st["inflight_ops"])
+
+    def _metrics_rpc(self):
+        """The `metrics` RPC: registry snapshot, with the native-ingest
+        counters mirrored FIRST (pollers must not read a pass stale)."""
+        if self._ing is not None:
+            self._mirror_ingest(self._ing)
+        return _metrics.snapshot()
+
+    def _native_pass(self, ing, nframes, defer, now) -> None:
+        """One engine pass over the zero-GIL ingest path: reap completed
+        frames, drop intern refs behind the drain fence, rotate frames
+        off dead servers, poll freshly decoded frames into columnar
+        submissions, and run the event-loop retry/timeout curve — all
+        without building a single per-op Python container."""
+        for fid in ing.reap():
+            nf = nframes.pop(fid, None)
+            if nf is not None:
+                defer.append(nf)
+        if defer:
+            # The decref fence: a frame's key/value interns drop only
+            # once every server it was submitted to has materialized (or
+            # provably never will) — columnar_drained is the per-server
+            # ticket high-water the driver advances at proposal time.
+            kept = []
+            for nf in defer:
+                if all(s.columnar_drained >= t or s.dead
+                       for s, t in nf.tickets):
+                    ing.decref_keys(nf.kid_arr)
+                    ing.decref_vals(nf.vid_arr)
+                else:
+                    kept.append(nf)
+            defer[:] = kept
+        while True:  # killed servers: rotate their frames NOW
+            try:
+                srv = self._deadq.popleft()
+            except IndexError:
+                break
+            for nf in nframes.values():
+                if srv in nf.cur_srv.values():
+                    nf.retry_at = 0.0
+        new = None
+        multi = len(self.groups) > 1
+        route = self._route
+        key_str = ing.key_str
+        tr = _tracing.enabled()
+        while True:
+            got = ing.poll1()
+            if got is None:
+                break
+            fid, conn_id, nops, tc, ka, ca, sa, kia, via = got
+            nf = _NFrame(fid, conn_id, nops, tc, now, self.op_timeout)
+            nf.kinds = ka.tolist()
+            nf.cids = ca.tolist()
+            nf.cseqs = sa.tolist()
+            nf.key_ids = kia.tolist()
+            nf.val_ids = via.tolist()
+            nf.kid_arr = kia
+            nf.vid_arr = via
+            if multi:
+                try:
+                    ng = len(self.groups)
+                    gids = [route(key_str(k)) for k in nf.key_ids]
+                    for gid in gids:
+                        if not 0 <= gid < ng:
+                            raise ValueError(
+                                f"route() -> {gid} outside [0, {ng})")
+                except Exception as e:  # noqa: BLE001 — bad frame ≠ dead loop
+                    ing.fail(fid,
+                             f"frontend: unroutable frame ({e!r:.100})")
+                    defer.append(nf)  # no tickets: decref next pass
+                    continue
+                nf.gids = gids
+            if tr and tc is not None:
+                # The frame-scoped wire context fans out per op, same
+                # span names as the Python decode path (tracing is the
+                # sampled diagnostic mode — it may allocate).
+                parent = _tracing.TraceContext(*tc)
+                tcs = []
+                for k in nf.key_ids:
+                    sp = _tracing.child("frontend.submit", parent=parent,
+                                        comp="frontend", key=key_str(k))
+                    if sp is not None:
+                        tcs.append((sp.trace_id, sp.span_id))
+                        sp.end()
+                    else:
+                        tcs.append(None)
+                nf.tcs = tcs
+            nframes[fid] = nf
+            _M_FRAMES.inc()
+            _M_WIDTH.observe(nops)
+            if new is None:
+                new = []
+            new.append((nf, None))
+        if new:
+            self._submit_native(ing, new, now)
+        if nframes:
+            now = time.monotonic()
+            for nf in list(nframes.values()):
+                if now < nf.retry_at and now < nf.deadline:
+                    continue
+                pend = ing.pending(nf.fid)
+                npend = 0 if pend is None else len(pend)
+                if npend == 0:
+                    nf.retry_at = now + nf.interval  # completing: re-arm
+                    continue
+                idxs = pend.tolist()
+                if now >= nf.deadline:
+                    self._abandon_native(nf, idxs)
+                    ing.fail(nf.fid,
+                             "frontend: op timeout (no majority?)")
+                    _M_TIMEOUTS.inc()
+                    continue
+                if nf.retry_at > 0.0 and npend < nf.last_pending:
+                    # Actively draining: never fail over mid-drain (the
+                    # _Frame rule); retry_at == 0.0 is the dead-server
+                    # override — rotate now regardless of progress.
+                    nf.last_pending = npend
+                    nf.retry_at = now + nf.interval
+                    continue
+                nf.last_pending = npend
+                self._abandon_native(nf, idxs)
+                _M_RETRIES.inc(npend)
+                gset = {0} if not multi else {nf.gids[i] for i in idxs}
+                for gid in gset:
+                    self._leaders[gid] = \
+                        nf.srv.get(gid, self._leaders[gid]) + 1
+                nf.interval = min(nf.interval * 2.0, self.op_timeout / 2.0)
+                nf.retry_at = now + nf.interval
+                self._submit_native(ing, [(nf, idxs)], now)
+        self._mirror_ingest(ing)
+
+    def _submit_native(self, ing, parts, now) -> None:
+        """parts: [(nframe, slot idxs | None=all)] — ONE columnar
+        submit_batch per target group, concatenated across frames; dup
+        hits answer straight back through the reply ring."""
+        multi = len(self.groups) > 1
+        buckets: dict[int, list] = {}
+        for nf, idxs in parts:
+            if idxs is None:
+                idxs = range(nf.nops)
+            if not multi:
+                buckets.setdefault(0, []).append((nf, idxs))
+            else:
+                per: dict[int, list] = {}
+                gids = nf.gids
+                for i in idxs:
+                    per.setdefault(gids[i], []).append(i)
+                for gid, ii in per.items():
+                    buckets.setdefault(gid, []).append((nf, ii))
+        sink = self._csink
+        for gid, bucket in buckets.items():
+            block = _CBlock(ing)
+            kinds, cids, cseqs = block.kinds, block.cids, block.cseqs
+            kids, vids, tags = block.key_ids, block.val_ids, block.tags
+            tcs = None
+            if any(nf.tcs is not None for nf, _ in bucket):
+                block.tcs = tcs = []
+            for nf, ii in bucket:
+                base = nf.fid << 16
+                fk, fc, fs = nf.kinds, nf.cids, nf.cseqs
+                fki, fvi, ftc = nf.key_ids, nf.val_ids, nf.tcs
+                for i in ii:
+                    kinds.append(fk[i])
+                    cids.append(fc[i])
+                    cseqs.append(fs[i])
+                    kids.append(fki[i])
+                    vids.append(fvi[i])
+                    tags.append(base + i)
+                    if tcs is not None:
+                        tcs.append(ftc[i] if ftc is not None else None)
+            servers = self.groups[gid]
+            nsrv = len(servers)
+            got = srv = None
+            for _ in range(nsrv):
+                srv = servers[self._leaders[gid] % nsrv]
+                try:
+                    got = srv.submit_columnar(block, range(len(tags)),
+                                              sink)
+                    break
+                except RPCError:
+                    self._leaders[gid] += 1
+            if got is None:
+                later = now + 0.05  # group dead right now: retry soon
+                for nf, _ in bucket:
+                    nf.retry_at = min(nf.retry_at, later)
+                continue
+            ticket, dup_tags, dup_reps = got
+            _M_SUBMIT.observe(len(tags))
+            for nf, _ in bucket:
+                nf.srv[gid] = self._leaders[gid]
+                nf.cur_srv[gid] = srv
+                if ticket:
+                    nf.tickets.append((srv, ticket))
+            if dup_tags:
+                sink.push(dup_tags, dup_reps)
+
+    def _abandon_native(self, nf, idxs) -> None:
+        """Drop the slots' columnar waiters on their last submit target
+        (the failover/timeout prelude — same contract as _abandon)."""
+        multi = len(self.groups) > 1
+        per: dict[int, list] = {}
+        for i in idxs:
+            per.setdefault(nf.gids[i] if multi else 0, []).append(i)
+        for gid, ii in per.items():
+            srv = nf.cur_srv.get(gid)
+            if srv is None:
+                continue
+            srv.abandon_columnar([nf.cids[i] for i in ii],
+                                 [nf.cseqs[i] for i in ii])
+
     def _engine_loop(self) -> None:
         live: dict[int, _Frame] = {}
         futmap: dict[int, list] = {}
+        nframes: dict[int, _NFrame] = {}  # native-ingest frames by fid
+        defer: list = []                  # (nf) awaiting the decref fence
+        ing = self._ing
         pending = self._pending
         doneq = self._doneq
         wake = self._wake
         while True:
-            wake.wait(0.05 if live else None)
-            wake.clear()
+            if ing is not None:
+                # Native mode: ONE wait primitive — the ingest eventfd.
+                # The C++ loop writes it per decoded frame; Python-side
+                # producers (done sink, pickle frames, kill) write it via
+                # _wake_native.  A short tick while work is in flight
+                # drives the retry/reap/decref passes.
+                busy = live or nframes or defer
+                try:
+                    r, _, _ = select.select([ing.fd], [], [],
+                                            0.05 if busy else 2.0)
+                    if r:
+                        os.read(ing.fd, 8)
+                        # Disarm AFTER the read: clearing first lets a
+                        # producer's arm+write land between the two and
+                        # be consumed with the flag still set — its next
+                        # event would then wait out the whole idle
+                        # timeout (a 2s latency spike, caught in review).
+                        self._wake_armed = False
+                except (OSError, ValueError):
+                    self._wake_armed = False  # fd gone: kill in progress
+            else:
+                wake.wait(0.05 if live else None)
+                wake.clear()
             if self._dead:
                 for fr in list(live.values()):
                     self._drop_frame(fr, live, futmap, "frontend killed")
+                if ing is not None:
+                    for nf in list(nframes.values()):
+                        ing.fail(nf.fid, "frontend killed")
                 return
             now = time.monotonic()
             # ---- ingest: everything queued since the last pass becomes
@@ -398,7 +842,8 @@ class ClerkFrontend:
                 ngroups = len(self.groups)
                 while True:
                     try:
-                        conn_id, ops_wire, wctx, single = pending.popleft()
+                        conn_id, ops_wire, wctx, single, native = \
+                            pending.popleft()
                     except IndexError:
                         break
                     # EVERYTHING frame-derived stays inside the guard: a
@@ -412,10 +857,13 @@ class ClerkFrontend:
                             # frame with no ops would otherwise park in
                             # `live` forever (nothing ever resolves it)
                             # and desync the connection's reply FIFO.
-                            self._srv.send_reply(conn_id, ())
+                            if native:
+                                self._srv.send_reply_native(conn_id, ())
+                            else:
+                                self._srv.send_reply(conn_id, ())
                             continue
                         fr = _Frame(conn_id, single, nops, now,
-                                    self.op_timeout)
+                                    self.op_timeout, native=native)
                         fr.ops = [self._make_op(t, wctx) for t in ops_wire]
                         if multi:
                             fr.gids = [route(op.key) for op in fr.ops]
@@ -427,9 +875,11 @@ class ClerkFrontend:
                         else:
                             fr.gids = [0] * nops
                     except Exception as e:  # noqa: BLE001 — bad frame ≠ dead loop
-                        self._srv.send_error(
-                            conn_id,
-                            f"frontend: undecodable op tuple ({e!r:.100})")
+                        msg = f"frontend: undecodable op tuple ({e!r:.100})"
+                        if native:
+                            self._srv.send_error_native(conn_id, msg)
+                        else:
+                            self._srv.send_error(conn_id, msg)
                         continue
                     _M_FRAMES.inc()
                     _M_WIDTH.observe(len(ops_wire))
@@ -449,6 +899,9 @@ class ClerkFrontend:
                     break
                 for fr, slot in futmap.pop(id(fut), ()):
                     self._complete(fr, slot, fut, live, futmap)
+            # ---- native ingest: reap / decref / poll / submit / retry
+            if ing is not None:
+                self._native_pass(ing, nframes, defer, now)
             # ---- retry/timeout pass (event-loop backoff, no sleeps)
             if live:
                 now = time.monotonic()
@@ -552,9 +1005,17 @@ class ClerkFrontend:
     def kill(self) -> None:
         self._dead = True
         self._wake.set()
-        self._srv.kill()
+        if self._ing is not None:
+            self._wake_armed = False
+            self._wake_native()
+        # Join the engine BEFORE tearing the server down: the engine's
+        # last pass fails its native frames through the still-live ingest
+        # handle (every NativeIngest call is also guarded on the server
+        # lock, so late driver pushes after kill() are no-ops, never
+        # use-after-free).
         if self._engine is not None:
             self._engine.join(timeout=5.0)
+        self._srv.kill()
 
 
 def shardkv_op(kind, key, value, cid, cseq, tc):
@@ -577,7 +1038,7 @@ class FrontendClerk:
     `fe_batch` is detected once ("no such rpc") and served single-op
     frames from then on — old↔new interop in one clerk."""
 
-    def __init__(self, addrs, timeout: float = 10.0):
+    def __init__(self, addrs, timeout: float = 10.0, wire_format="auto"):
         self.addrs = list(addrs)
         self.timeout = timeout
         self.cid = fresh_cid()
@@ -585,6 +1046,13 @@ class FrontendClerk:
         self._conn: transport.FramedConn | None = None
         self._conn_addr = None
         self._legacy: set[str] = set()  # addrs that refused fe_batch
+        # Versioned fe wire negotiation: "auto" probes each endpoint ONCE
+        # via the fe_caps rpc ("no such rpc" = pickle peer); "native" /
+        # "pickle" pin the format (tests, benches).  A probe that fails
+        # on transport error is NOT cached — unreliable wire must not
+        # permanently demote an endpoint.
+        self.wire_format = wire_format
+        self._fmt: dict[str, str] = {}
         self._backoff = Backoff()
         self._i = 0
 
@@ -617,6 +1085,37 @@ class FrontendClerk:
             raise payload
         raise RPCError(f"{addr}: {payload}")
 
+    def _request_native(self, addr, ops, tc=None):
+        conn = self._connect(addr)
+        try:
+            conn.send_raw(wire.encode_batch(ops, tc=tc))
+            ok, payload = conn.recv()
+        except RPCError:
+            self._teardown()
+            raise
+        if ok:
+            return payload
+        raise RPCError(f"{addr}: {payload}")
+
+    def _format_for(self, addr) -> str:
+        """The frame format this endpoint speaks: pinned, cached, or
+        probed once via fe_caps (one extra round-trip per endpoint)."""
+        if self.wire_format != "auto":
+            return self.wire_format
+        fmt = self._fmt.get(addr)
+        if fmt is not None:
+            return fmt
+        try:
+            caps = self._request(addr, ("fe_caps", ()))
+            fmt = "native" if isinstance(caps, dict) \
+                and caps.get("fe_wire") == wire.VERSION else "pickle"
+        except RPCError as e:
+            if "no such rpc" not in str(e):
+                raise  # transport failure: do NOT cache a demotion
+            fmt = "pickle"
+        self._fmt[addr] = fmt
+        return fmt
+
     def _call(self, op_tuple, timeout=None):
         """One logical op: send (retrying across addrs/reconnects with
         the SAME cseq — at-most-once rests on the server dup filter)."""
@@ -630,19 +1129,43 @@ class FrontendClerk:
                 try:
                     if addr in self._legacy:
                         return self._single_op(addr, op_tuple, sp)
-                    frame = (FE_BATCH, ((op_tuple,),))
+                    fmt = self._format_for(addr)
                     if sp is not None:
                         rsp = _tracing.child("rpc.call", parent=sp.ctx,
                                              comp="rpc")
-                        frame = frame + ((rsp.trace_id, rsp.span_id),) \
-                            if rsp is not None else frame
+                        ctx = (rsp.trace_id, rsp.span_id) \
+                            if rsp is not None else None
                         try:
-                            replies = self._request(addr, frame)
+                            if fmt == "native":
+                                try:
+                                    replies = self._request_native(
+                                        addr, (op_tuple,), tc=ctx)
+                                except wire.CapacityError:
+                                    # Op too big for the fe layout
+                                    # (key > u16): this one request
+                                    # rides the pickled frame instead.
+                                    frame = (FE_BATCH, ((op_tuple,),))
+                                    if ctx is not None:
+                                        frame = frame + (ctx,)
+                                    replies = self._request(addr, frame)
+                            else:
+                                frame = (FE_BATCH, ((op_tuple,),))
+                                if ctx is not None:
+                                    frame = frame + (ctx,)
+                                replies = self._request(addr, frame)
                         finally:
                             if rsp is not None:
                                 rsp.end()
+                    elif fmt == "native":
+                        try:
+                            replies = self._request_native(addr,
+                                                           (op_tuple,))
+                        except wire.CapacityError:
+                            replies = self._request(
+                                addr, (FE_BATCH, ((op_tuple,),)))
                     else:
-                        replies = self._request(addr, frame)
+                        replies = self._request(addr,
+                                                (FE_BATCH, ((op_tuple,),)))
                     return replies[0]
                 except RPCError as e:
                     if "no such rpc" in str(e):
@@ -718,11 +1241,17 @@ class FrontendStream:
     deque's popleft always names the frame being answered."""
 
     def __init__(self, addr: str, conns: int, width: int,
-                 op_timeout: float = 10.0, depth: int = STREAM_DEPTH):
+                 op_timeout: float = 10.0, depth: int = STREAM_DEPTH,
+                 wire_format: str = "auto"):
         assert conns >= 1 and width >= conns * depth
         self.addr = addr
         self.op_timeout = op_timeout
         self.depth = depth
+        # "auto": one fe_caps probe on the first dial decides whether
+        # frames go out in the versioned fe wire layout (zero-GIL server
+        # decode) or as classic pickled fe_batch tuples.
+        self._native = {"native": True, "pickle": False,
+                        "auto": None}[wire_format]
         self.clients = [[fresh_cid(), 0] for _ in range(width)]
         # conn ci, cohort k owns clients {c : c ≡ ci·depth+k (mod C·D)}.
         self._cohorts = [
@@ -762,13 +1291,19 @@ class FrontendStream:
                 took.append(c)
             return tuple(ops), took
 
+        def send_frame(ci, ops):
+            if self._native:
+                conns[ci].send_raw(wire.encode_batch(ops))
+            else:
+                conns[ci].send((FE_BATCH, (ops,)))
+
         def send_cohort(ci, k):
             """Build + send cohort k's next frame; False when the cohort
             is drained (max_per_client reached for all members)."""
             ops, took = build_ops(self._cohorts[ci][k])
             if not ops:
                 return False
-            conns[ci].send((FE_BATCH, (ops,)))
+            send_frame(ci, ops)
             inflight[ci].append((k, ops, took, time.monotonic()))
             return True
 
@@ -777,10 +1312,16 @@ class FrontendStream:
             same cseqs, so replays are dup-filtered server-side."""
             conns[ci] = transport.FramedConn(self.addr,
                                              timeout=self.op_timeout)
+            if self._native is None:
+                # One fe_caps probe decides the stream's wire format.
+                ok, caps = conns[ci].request(("fe_caps", ()))
+                self._native = bool(ok and isinstance(caps, dict)
+                                    and caps.get("fe_wire")
+                                    == wire.VERSION)
             requeue = list(inflight[ci])
             inflight[ci].clear()
             for k, ops, took, _ in requeue:
-                conns[ci].send((FE_BATCH, (ops,)))
+                send_frame(ci, ops)
                 inflight[ci].append((k, ops, took, time.monotonic()))
             if not requeue:
                 started = False

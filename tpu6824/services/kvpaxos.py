@@ -25,6 +25,7 @@ from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.obs import metrics as _metrics
 from tpu6824.obs import tracing as _tracing
+from tpu6824.rpc import wire as _wire
 from tpu6824.services.common import (
     Backoff,
     ColumnarDups,
@@ -152,6 +153,19 @@ class KVPaxosServer:
         self._subq: list[Op] = []        # submitted, not yet proposed
         self._inflight: dict[int, Op] = {}  # seq -> my undecided proposal
         self._next_seq = 0               # next seq I would propose at
+        # Columnar waiters (ISSUE 11, the native-ingest seam): ops arrive
+        # as int columns, not Op objects — the waiter state is two int→int
+        # dicts (cid → awaited cseq, cid → reply-ring tag) instead of a
+        # per-op future, and materialization into log entries is deferred
+        # to the driver's proposal pass (`_collect_proposals_locked`).
+        # One frontend sink per server; `columnar_drained` is the ticket
+        # fence the engine's deferred intern-decref waits on.
+        self._csink = None
+        self._ccseq: dict[int, int] = {}
+        self._ctag: dict[int, int] = {}
+        self._cblocks: list = []         # (ticket, block, accepted idxs)
+        self._cblocks_submitted = 0
+        self.columnar_drained = 0
         self._wake = threading.Event()
         # Done() variant for the driver's per-drain watermark: the
         # lock-free deferred form when the backend has one (the fabric
@@ -184,15 +198,15 @@ class KVPaxosServer:
 
     # ------------------------------------------------------------ RSM core
 
-    def _trace_resolve(self, v: Op, fut: _Fut) -> None:
+    def _trace_apply(self, v: Op):
         """tpuscope: the apply side of a traced op — emit the
         `fabric.dispatch` span (propose→decide window, parented to the
         proposer's service-submit span carried in `v.tc`) and the
-        `service.apply` span, then park the apply context on the future
-        so the waiter's reply span chains off it.  Only ever called for
-        ops whose value carries trace metadata (tracing was on at
-        submit), and only on the replica resolving a waiter — passive
-        replicas applying the same decided op emit nothing."""
+        `service.apply` span; returns the apply-side TraceContext the
+        reply span chains off.  Only ever called for ops whose value
+        carries trace metadata (tracing was on at submit), and only on
+        the replica resolving a waiter — passive replicas applying the
+        same decided op emit nothing."""
         now = time.monotonic_ns()
         t_prop = self._trace_prop.pop((v.cid, v.cseq), now)
         tid, submit_sid = v.tc
@@ -201,7 +215,12 @@ class KVPaxosServer:
         aid = _tracing.complete("service.apply", tid, did, now, now,
                                 comp="kvpaxos", me=self.me, key=v.key,
                                 cid=v.cid, cseq=v.cseq)
-        fut.tctx = _tracing.TraceContext(tid, aid)
+        return _tracing.TraceContext(tid, aid)
+
+    def _trace_resolve(self, v: Op, fut: _Fut) -> None:
+        """Park the apply-side trace context on the future so the
+        waiter's reply span chains off the apply that resolved it."""
+        fut.tctx = self._trace_apply(v)
 
     def _apply(self, op: Op):
         """Apply one decided op (doGet/doPutAppend, kvpaxos/server.go:115-162)
@@ -226,6 +245,14 @@ class KVPaxosServer:
             if op.tc is not None:
                 self._trace_resolve(op, fut)
             fut.set(reply)
+        elif self._ccseq.get(op.cid) == op.cseq:
+            # Columnar waiter on the scalar-drain path (feedless
+            # backends): resolve straight into the native reply ring.
+            del self._ccseq[op.cid]
+            tag = self._ctag.pop(op.cid)
+            tctx = self._trace_apply(op) if op.tc is not None else None
+            if self._csink is not None:
+                self._csink.push([tag], [reply], [tctx])
         return reply
 
     def _pop_lost_inflight_locked(self, v):
@@ -235,10 +262,11 @@ class KVPaxosServer:
         if (mine is not None
                 and (not isinstance(v, Op)
                      or (mine.cid, mine.cseq) != (v.cid, v.cseq))
-                and (mine.cid, mine.cseq) in self._waiters):
+                and ((mine.cid, mine.cseq) in self._waiters
+                     or self._ccseq.get(mine.cid) == mine.cseq)):
             self._subq.append(mine)
 
-    def _apply_batch_locked(self, vals) -> list:
+    def _apply_batch_locked(self, vals, cnotif=None) -> list:
         """Apply one contiguous decided run as a tight batch — the batched
         doGet/doPutAppend (kvpaxos/server.go:115-162) with the dict
         lookups hoisted and every per-op branch inline.  Futures are
@@ -247,12 +275,20 @@ class KVPaxosServer:
         work.  Dup-filter writes are likewise collected in `pend` (which
         doubles as the intra-batch read-your-writes overlay) and folded
         into the columnar store in ONE `apply_batch` pass per drain.
-        Returns [(fut, reply), ...]."""
+        Columnar waiters (native ingest) collect into `cnotif` — three
+        parallel lists (tags, replies, trace ctxs; int/ref appends only,
+        no per-op tuples) the caller pushes into the reply ring once per
+        drain.  Returns [(fut, reply), ...]."""
         dup = self.dup
         kv = self.kv
         kv_get = kv.get
         dup_seen = dup.seen
         waiters_pop = self._waiters.pop
+        ccseq = self._ccseq
+        ccseq_get = ccseq.get
+        ctag_pop = self._ctag.pop
+        if cnotif is not None:
+            ctags, creps, ctctx = cnotif
         nodup = self._test_disable_dup
         notif = []
         pend: dict = {}  # cid -> (cseq, reply): this batch's dup writes
@@ -283,6 +319,12 @@ class KVPaxosServer:
                     if v.tc is not None:
                         self._trace_resolve(v, fut)
                     notif.append((fut, reply))
+                elif cnotif is not None and ccseq_get(v.cid) == v.cseq:
+                    del ccseq[v.cid]
+                    ctags.append(ctag_pop(v.cid))
+                    creps.append(reply)
+                    ctctx.append(self._trace_apply(v)
+                                 if v.tc is not None else None)
             self._pop_lost_inflight_locked(v)
         if pend:
             dup.apply_batch(pend)
@@ -302,6 +344,7 @@ class KVPaxosServer:
         prof = self._prof
         base0 = self.applied + 1
         notif = []
+        cnotif = ([], [], []) if self._csink is not None else None
         apply_ns = 0
         while True:
             run = tap.pop_ready(self.applied)
@@ -316,7 +359,7 @@ class KVPaxosServer:
                         continue
                 break
             t0 = time.perf_counter_ns()
-            notif.extend(self._apply_batch_locked(run))
+            notif.extend(self._apply_batch_locked(run, cnotif))
             apply_ns += time.perf_counter_ns() - t0
         applied_n = self.applied + 1 - base0
         if applied_n > 0:
@@ -325,6 +368,10 @@ class KVPaxosServer:
             t0 = time.perf_counter_ns()
             for fut, reply in notif:
                 fut.set(reply)
+            if cnotif is not None and cnotif[0]:
+                # Columnar waiters: ONE reply-ring push per drain — the
+                # native loop thread serializes and flushes the frames.
+                self._csink.push(*cnotif)
             prof.add("notify", time.perf_counter_ns() - t0)
         self._last_drain = applied_n
         if self.applied >= base0:
@@ -410,12 +457,19 @@ class KVPaxosServer:
 
     def _collect_proposals_locked(self):
         """Assign consecutive seqs to everything queued; returns the
-        (seq, op) block to propose."""
+        (seq, op) block to propose.  Columnar blocks (native ingest)
+        MATERIALIZE here — kind/key/value strings resolved from the
+        frontend's native intern stores only now, on the driver thread,
+        at proposal time: the frame→submit path never built a Python
+        object per op, and an op answered or abandoned before this pass
+        is skipped without ever materializing."""
         props = []
         nxt = max(self._next_seq, self.applied + 1)
+        ccseq_get = self._ccseq.get
         for op in self._subq:
             key = (op.cid, op.cseq)
-            if key not in self._waiters:
+            if key not in self._waiters \
+                    and ccseq_get(op.cid) != op.cseq:
                 continue  # timed out, resolved, or already applied
             if op.cseq <= self.dup.seen(op.cid) \
                     and not self._test_disable_dup:
@@ -426,6 +480,58 @@ class KVPaxosServer:
                 self._trace_prop[(op.cid, op.cseq)] = time.monotonic_ns()
             nxt += 1
         self._subq = []
+        if self._cblocks:
+            cblocks, self._cblocks = self._cblocks, []
+            dup_seen = self.dup.seen
+            nodup = self._test_disable_dup
+            tr = _tracing.enabled()
+            kinds = _wire.KINDS
+            for ticket, block, idxs in cblocks:
+                res = block.resolver
+                key_str = res.key_str
+                val_str = res.val_str
+                bk, bc, bs = block.kinds, block.cids, block.cseqs
+                bkid, bvid = block.key_ids, block.val_ids
+                tcs = block.tcs
+                # tpusan: ok(lock-nested-loop) — one flat pass over the
+                # submitted ops: the outer loop is per-BLOCK bookkeeping,
+                # this is the same per-op proposal collection the classic
+                # _subq loop runs under mu; the body is dict probes and
+                # intern lookups, no device or socket work.
+                for i in idxs:
+                    cid = bc[i]
+                    cseq = bs[i]
+                    if ccseq_get(cid) != cseq:
+                        continue  # answered / abandoned / superseded
+                    if cseq <= dup_seen(cid) and not nodup:
+                        continue  # applied via another replica
+                    key = key_str(bkid[i])
+                    value = val_str(bvid[i])
+                    if key is None or value is None:
+                        # Intern freed under us: only possible once the
+                        # op decided elsewhere and its frame completed —
+                        # the decided instance precedes anything we could
+                        # propose now, so skipping is safe.
+                        continue
+                    tc = None
+                    if tr and tcs is not None and tcs[i] is not None:
+                        sp = _tracing.child(
+                            "service.submit",
+                            parent=_tracing.TraceContext(*tcs[i]),
+                            comp="kvpaxos", key=key)
+                        if sp is not None:
+                            tc = (sp.trace_id, sp.span_id)
+                            sp.end()
+                    op = Op(kinds[bk[i]], key, value, cid, cseq, tc)
+                    props.append((nxt, op))
+                    self._inflight[nxt] = op
+                    if tc is not None:
+                        self._trace_prop[(cid, cseq)] = \
+                            time.monotonic_ns()
+                    nxt += 1
+                # The ticket fence: the engine's deferred decref of this
+                # block's interns is legal from here on.
+                self.columnar_drained = ticket
         self._next_seq = nxt
         return props
 
@@ -470,6 +576,10 @@ class KVPaxosServer:
                     if self.dead:
                         if self._tap is not None:
                             self._tap.close()  # idempotent; stops fan-out
+                        # Queued columnar blocks will never materialize:
+                        # release the engine's decref fence.
+                        self._cblocks.clear()
+                        self.columnar_drained = self._cblocks_submitted
                         return
                     self._wake.clear()
                     self._drain_bulk_locked(status_many)
@@ -614,6 +724,67 @@ class KVPaxosServer:
         self._wake.set()
         return futs
 
+    def submit_columnar(self, block, idxs, sink):
+        """The native-ingest submit seam (ISSUE 11): `block` carries the
+        decoded frame columns as plain int lists (kinds, cids, cseqs,
+        key_ids, val_ids, tags, optional per-op tcs) plus a `resolver`
+        (id → string, lazily, against the frontend's native intern
+        stores); `idxs` selects the slots to submit.  Under ONE lock
+        acquisition each op either dedups (already applied — its tag and
+        cached reply return immediately for the engine to push) or parks
+        as a columnar waiter: two int→int dict entries, NO per-op Python
+        object.  Materialization into Op log entries happens on the
+        driver at proposal time.
+
+        Returns (ticket, dup_tags, dup_replies).  The ticket is the
+        block's drain fence: once `columnar_drained >= ticket`, every
+        accepted slot has been materialized or skipped and the engine
+        may drop its intern references."""
+        with self.mu:
+            if self.dead:
+                raise RPCError("dead")
+            dup = self.dup
+            ccseq = self._ccseq
+            ctag = self._ctag
+            nodup = self._test_disable_dup
+            cids = block.cids
+            cseqs = block.cseqs
+            tags = block.tags
+            seen = dup.seen_many([cids[i] for i in idxs])
+            accepted = []
+            dup_tags = []
+            dup_replies = []
+            for j, i in enumerate(idxs):
+                cid = cids[i]
+                if cseqs[i] <= seen[j] and not nodup:
+                    dup_tags.append(tags[i])
+                    dup_replies.append(dup.reply(cid))
+                else:
+                    ccseq[cid] = cseqs[i]
+                    ctag[cid] = tags[i]
+                    accepted.append(i)
+            self._csink = sink
+            if accepted:
+                self._cblocks_submitted += 1
+                ticket = self._cblocks_submitted
+                self._cblocks.append((ticket, block, accepted))
+            else:
+                ticket = 0  # nothing to drain: fence trivially satisfied
+        self._wake.set()
+        return ticket, dup_tags, dup_replies
+
+    def abandon_columnar(self, cids, cseqs) -> None:
+        """Drop columnar waiters (the engine's failover/timeout path) —
+        the ops may still decide here, dup-filtered as ever, but this
+        server stops re-proposing them and will not answer their tags."""
+        with self.mu:
+            ccseq = self._ccseq
+            ctag = self._ctag
+            for i, cid in enumerate(cids):
+                if ccseq.get(cid) == cseqs[i]:
+                    del ccseq[cid]
+                    ctag.pop(cid, None)
+
     def submit_nowait(self, op: Op) -> _Fut:
         return self.submit_batch((op,))[0]
 
@@ -656,6 +827,16 @@ class KVPaxosServer:
             for fut in self._waiters.values():
                 fut.set(_DEAD)
             self._waiters.clear()
+            self._ccseq.clear()
+            self._ctag.clear()
+            self._cblocks.clear()
+            # Dropped blocks will never materialize: release the fence so
+            # the engine's deferred intern decrefs are not stranded.
+            self.columnar_drained = self._cblocks_submitted
+            if self._csink is not None:
+                # The columnar twin of the _DEAD future: tell the engine
+                # to rotate this server's frames NOW (O(1) enqueue+wake).
+                self._csink.server_dead(self)
             self._trace_prop.clear()
             if self._tap is not None:
                 self._tap.close()  # stop the fabric fanning into a corpse
