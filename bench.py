@@ -374,6 +374,16 @@ def child_main():
             service["clerk_frontend"] = {"value": 0.0,
                                          "error": repr(e)[:200]}
         service["clerk_frontend"]["tpuscope"] = _tpuscope_delta(leg0)
+        # Overload leg (ISSUE 12, netfault): goodput/shed/p99 under
+        # offered load at 1x/2x/4x of this box's measured capacity —
+        # the admission-control acceptance surface, gated by benchdiff.
+        _spin(env, "overload")
+        leg0 = _tpuscope_begin()
+        try:
+            service["overload"] = _overload_rate()
+        except Exception as e:  # noqa: BLE001
+            service["overload"] = {"value": 0.0, "error": repr(e)[:200]}
+        service["overload"]["tpuscope"] = _tpuscope_delta(leg0)
         # Durability leg (durafault): recovery-time percentiles, gated by
         # benchdiff like every throughput leg.
         _spin(env, "recovery")
@@ -1329,6 +1339,226 @@ def _clerk_frontend_rate():
         "knobs": "TPU6824_FRONTEND_OP_TIMEOUT, TPU6824_FRONTEND_DEPTH; "
                  "BENCH_FE_GROUPS/INSTANCES/SWEEP/SECONDS, BENCH_FE_WIRE",
     }
+
+
+def _overload_rate():
+    """service.overload (ISSUE 12): end-to-end overload protection on
+    the clerk path.  Measures this box's closed-loop capacity through
+    one ClerkFrontend, then drives OPEN-LOOP offered load at 1×/2×/4×
+    of it (frames sent on a pacing clock, never gated on replies) and
+    records, per leg: offered vs goodput ops/s, the fraction of offered
+    ops shed with the EXPLICIT retryable admission error (the defense —
+    overload must answer fast, not convert into timeouts), and the p99
+    frame round-trip of the ops that were served.  The headline `value`
+    is goodput at 4× — the "degrades gracefully" number benchdiff
+    gates; `goodput_4x_frac` relates it to measured capacity (the
+    acceptance bar is ≥ 0.7)."""
+    import threading as _th
+    import time as _t
+    from collections import deque as _deque
+
+    import numpy as _np
+
+    from tpu6824.core.fabric import PaxosFabric
+    from tpu6824.rpc import transport as _tr
+    from tpu6824.rpc import wire as _wire
+    from tpu6824.services.common import fresh_cid
+    from tpu6824.services.frontend import ClerkFrontend, FrontendStream
+    from tpu6824.services.kvpaxos import KVPaxosServer
+
+    G = int(os.environ.get("BENCH_OVERLOAD_GROUPS", 2))
+    I = int(os.environ.get("BENCH_OVERLOAD_INSTANCES", 512))
+    P = 3
+    seconds = float(os.environ.get("BENCH_OVERLOAD_SECONDS", 2.0))
+    width = int(os.environ.get("BENCH_OVERLOAD_WIDTH", 64))
+    nconns = int(os.environ.get("BENCH_OVERLOAD_CONNS", 4))
+    max_inflight = int(os.environ.get("BENCH_OVERLOAD_INFLIGHT", 2048))
+    fab = PaxosFabric(ngroups=G, npeers=P, ninstances=I, auto_step=True,
+                      io_mode="compact", steps_per_dispatch=1,
+                      pipeline_depth=2,
+                      summary_k=max(16384, (G * I * 3) // 2))
+    clusters = [[KVPaxosServer(fab, g, p, op_timeout=10.0)
+                 for p in range(P)] for g in range(G)]
+    fe = ClerkFrontend(addr=f"/tmp/bench-ov-{os.getpid()}.sock",
+                       groups=clusters,
+                       route=lambda key: int(key[1:key.index("-")]),
+                       op_timeout=6.0, max_inflight=max_inflight)
+
+    def measure_capacity():
+        """Closed-loop burst (FrontendStream) — the 1× reference."""
+        count = [0]
+        primed = [False]
+        stop = _th.Event()
+        go = _th.Event()
+
+        def run():
+            st = FrontendStream(fe.addr, conns=nconns,
+                                width=nconns * width, op_timeout=30.0)
+
+            def on_done(n):
+                primed[0] = True
+                if go.is_set() and not stop.is_set():
+                    count[0] += n
+
+            st.run_appends(lambda c: f"k{c % G}-cap-{c}",
+                           lambda c, i: f"x {c} {i} y",
+                           stop=stop, on_done=on_done)
+
+        th = _th.Thread(target=run, daemon=True)
+        th.start()
+        t_hard = _t.monotonic() + 60.0
+        while not primed[0] and _t.monotonic() < t_hard:
+            _t.sleep(0.05)
+        _t.sleep(0.5)
+        go.set()
+        t0 = _t.perf_counter()
+        _t.sleep(max(1.0, seconds * 0.75))
+        stop.set()
+        dt = _t.perf_counter() - t0
+        th.join(timeout=60)
+        return count[0] / dt
+
+    def drive_leg(mult, capacity):
+        """Open-loop: frames of `width` puts at mult×capacity ops/s
+        across `nconns` paced connections; replies classified as
+        goodput / explicit shed / other error / lost (torn conn) /
+        unanswered (still in flight after the drain grace)."""
+        target = max(width * nconns, capacity * mult)  # ops/s
+        interval = width * nconns / target  # s between sends PER CONN
+        conns = []
+        for ci in range(nconns):
+            conns.append(_tr.FramedConn(fe.addr, timeout=6.0))
+        inflight = [_deque() for _ in range(nconns)]
+        next_at = [None] * nconns
+        sent = good = shed = other = lost = 0
+        rtts = []
+        t0 = _t.monotonic()
+        stop_at = t0 + seconds
+        for ci in range(nconns):
+            next_at[ci] = t0 + interval * ci / nconns
+
+        def build(ci):
+            # One FRESH logical client per op: open-loop frames overlap
+            # arbitrarily deep on one conn, and the columnar waiter
+            # table (like any clerk protocol here) allows ONE op in
+            # flight per client — reusing a cid across in-flight frames
+            # would overwrite waiters and manufacture timeouts that are
+            # the generator's fault, not the server's.
+            return tuple(
+                ("put", f"k{(ci + j) % G}-ov{mult}-{ci}", "v",
+                 fresh_cid(), 1)
+                for j in range(width))
+
+        drain_until = stop_at + 4.0
+        while True:
+            now = _t.monotonic()
+            sending = now < stop_at
+            have_inflight = any(q for q in inflight)
+            if not sending and not have_inflight:
+                break
+            if not sending and now >= drain_until:
+                break
+            rd = [c.sock for ci, c in enumerate(conns)
+                  if c is not None and inflight[ci]]
+            import select as _select
+
+            r, _, _ = _select.select(rd, [], [], 0.01 if sending else 0.1)
+            ready = {c.fileno() for c in r}
+            for ci, c in enumerate(conns):
+                if c is None or not inflight[ci] \
+                        or c.fileno() not in ready:
+                    continue
+                try:
+                    ok, payload = c.recv()
+                except _tr.RPCError:
+                    lost += sum(n for n, _ in inflight[ci])
+                    inflight[ci].clear()
+                    c.close()
+                    conns[ci] = None
+                    continue
+                n, t_sent = inflight[ci].popleft()
+                if ok:
+                    good += n
+                    rtts.append(_t.monotonic() - t_sent)
+                elif "overloaded" in str(payload) \
+                        or "ring full" in str(payload):
+                    shed += n  # the EXPLICIT retryable admission answer
+                else:
+                    other += n
+            now = _t.monotonic()
+            for ci in range(nconns):
+                if now >= stop_at or now < next_at[ci]:
+                    continue
+                if conns[ci] is None:  # torn by backpressure: redial
+                    try:
+                        conns[ci] = _tr.FramedConn(fe.addr, timeout=6.0)
+                    except _tr.RPCError:
+                        next_at[ci] = now + interval
+                        continue
+                ops = build(ci)
+                try:
+                    conns[ci].send_raw(_wire.encode_batch(ops))
+                except _tr.RPCError:
+                    lost += sum(n for n, _ in inflight[ci])
+                    inflight[ci].clear()
+                    conns[ci].close()
+                    conns[ci] = None
+                    continue
+                inflight[ci].append((len(ops), now))
+                sent += len(ops)
+                next_at[ci] += interval
+                if next_at[ci] < now - 5 * interval:
+                    next_at[ci] = now  # fell behind: don't burst-catch-up
+        unanswered = sum(n for q in inflight for n, _ in q)
+        for c in conns:
+            if c is not None:
+                c.close()
+        dt = max(seconds, 1e-9)
+        leg = {
+            "multiplier": mult,
+            "offered_ops_s": round(sent / dt, 1),
+            "goodput_ops_s": round(good / dt, 1),
+            "shed_frac": round(shed / sent, 4) if sent else 0.0,
+            "explicit_shed_ops": shed,
+            "other_error_ops": other,
+            "lost_ops": lost,
+            "unanswered_ops": unanswered,
+        }
+        if rtts:
+            arr = _np.array(rtts)
+            leg["p99_ms"] = round(float(_np.percentile(arr, 99)) * 1e3, 2)
+            leg["p50_ms"] = round(float(_np.percentile(arr, 50)) * 1e3, 2)
+        return leg
+
+    try:
+        capacity = measure_capacity()
+        assert capacity > 0, "no closed-loop op completed"
+        legs = [drive_leg(m, capacity) for m in (1, 2, 4)]
+        at4 = legs[-1]
+        inflight_gauge = fe.stats()["frontend"]
+        return {
+            "value": at4["goodput_ops_s"],
+            "capacity_ops_s": round(capacity, 1),
+            "goodput_4x_frac": round(at4["goodput_ops_s"] / capacity, 3),
+            "legs": legs,
+            "shape": {"G": G, "I": I, "conns": nconns, "width": width,
+                      "max_inflight": max_inflight},
+            "inflight_end": inflight_gauge["inflight_ops"],
+            "native_inflight_end": inflight_gauge["native_ingest"].get(
+                "inflight_ops", 0),
+            "note": ("open-loop offered load at 1x/2x/4x of measured "
+                     "closed-loop capacity through ONE frontend; value "
+                     "= goodput at 4x; shed_frac counts the explicit "
+                     "retryable admission errors (never timeouts)"),
+            "knobs": "TPU6824_FE_MAX_INFLIGHT; BENCH_OVERLOAD_GROUPS/"
+                     "SECONDS/WIDTH/CONNS/INFLIGHT",
+        }
+    finally:
+        fe.kill()
+        for cl in clusters:
+            for s in cl:
+                s.dead = True
+        fab.stop_clock()
 
 
 def _recovery_rate():

@@ -1,0 +1,63 @@
+"""Golden fixture for the unbounded-retry rule: retry loops with no
+deadline/budget/backoff/timeout bound and no pacing sleep (2 findings),
+plus bounded shapes that must stay quiet."""
+
+import time
+
+from tpu6824.services.common import Backoff
+from tpu6824.utils.errors import RPCError
+
+
+def call(addr, name, *args):
+    raise RPCError("stub")
+
+
+def spin_retry_no_bound(addr):
+    # FINDING: while-True catching RPCError, nothing bounds or paces it.
+    while True:
+        try:
+            return call(addr, "get", "k")
+        except RPCError:
+            continue
+
+
+def rotate_retry_no_bound(addrs):
+    # FINDING: rotation is not a bound — every endpoint refusing spins
+    # this loop at CPU speed.
+    i = 0
+    while True:
+        addr = addrs[i % len(addrs)]
+        i += 1
+        try:
+            return call(addr, "put", "k", "v")
+        except RPCError:
+            pass
+
+
+def retry_with_deadline(addr, deadline):
+    # quiet: bounded by a deadline check.
+    while True:
+        try:
+            return call(addr, "get", "k")
+        except RPCError:
+            if time.monotonic() >= deadline:
+                raise
+
+
+def retry_with_backoff(addr):
+    # quiet: paced by the budgeted Backoff.
+    bo = Backoff()
+    while True:
+        try:
+            return call(addr, "get", "k")
+        except RPCError:
+            bo.sleep()
+
+
+def serve_loop(conn):
+    # quiet: catches-and-re-raises is not a retry loop.
+    while True:
+        try:
+            conn.recv()
+        except RPCError:
+            raise

@@ -31,7 +31,10 @@ def test_one_json_line_with_required_keys():
                    "BENCH_SERVICE_GROUPS": "16", "BENCH_SERVICE_SECONDS": "1",
                    "BENCH_CLERK_GROUPS": "4",
                    "BENCH_FE_GROUPS": "2", "BENCH_FE_INSTANCES": "128",
-                   "BENCH_FE_SWEEP": "2x32", "BENCH_FE_SECONDS": "1"})
+                   "BENCH_FE_SWEEP": "2x32", "BENCH_FE_SECONDS": "1",
+                   "BENCH_OVERLOAD_SECONDS": "1",
+                   "BENCH_OVERLOAD_WIDTH": "32",
+                   "BENCH_OVERLOAD_CONNS": "2"})
     assert r.returncode == 0, r.stderr[-500:]
     lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, r.stdout
@@ -87,6 +90,20 @@ def test_one_json_line_with_required_keys():
     proto = few["protocol"]
     assert "error" not in proto and proto["totals"]["decides"] > 0, proto
     assert "tpuscope" in few and "error" not in few["tpuscope"], few
+    # Overload provenance (ISSUE 12, netfault): every recorded run must
+    # carry the overload leg — measured capacity, the 1×/2×/4× offered-
+    # load table (goodput, explicit-shed fraction, p99), and the leg's
+    # own shape — or the admission-control claims have no artifact
+    # trail and benchdiff cannot gate the new entries.
+    ov = d["service"]["overload"]
+    assert "error" not in ov, ov
+    assert ov["capacity_ops_s"] > 0 and ov["value"] > 0, ov
+    assert [leg["multiplier"] for leg in ov["legs"]] == [1, 2, 4], ov
+    for leg in ov["legs"]:
+        assert leg["offered_ops_s"] > 0, leg
+        assert 0.0 <= leg["shed_frac"] <= 1.0, leg
+    assert ov["goodput_4x_frac"] > 0, ov
+    assert ov["shape"]["max_inflight"] >= 1, ov
     # Durability provenance (ISSUE 7, durafault): every recorded run
     # must carry the recovery leg — restore-from-snapshot wall-time
     # percentiles + snapshot footprint — or recovery-time regressions

@@ -546,7 +546,7 @@ def test_new_vocabulary_schedules_are_stamped_and_round_trip(tmp_path):
             "scopes": ["a", "b"], "actions": [
                 "crash_process", "reboot_process", "disk_fault"]}
     sched = FaultSchedule.generate(99, 4.0, spec)
-    assert sched.schema == FaultSchedule.SCHEMA == 2
+    assert sched.schema == FaultSchedule.SCHEMA == 3
     acts = [e.action for e in sched]
     assert "crash_process" in acts and "disk_fault" in acts
     # Every crash ends rebooted (the revival guarantee).
@@ -562,7 +562,25 @@ def test_new_vocabulary_schedules_are_stamped_and_round_trip(tmp_path):
     with open(p, "w") as f:
         json.dump(sched.to_dict(), f)
     again = FaultSchedule.from_json(p)
-    assert again == sched and again.schema == 2
+    assert again == sched and again.schema == 3
     assert again.signature() == sched.signature()
     # Determinism across the new vocabulary.
     assert FaultSchedule.generate(99, 4.0, spec) == sched
+
+
+def test_v2_artifact_still_loads_byte_exact():
+    """Replay compatibility one schema further back (ISSUE 12): a
+    STAMPED schema-2 capture (durafault vocabulary, pre-netfault)
+    loads cleanly, keeps its exact event list, and round-trips with
+    its original stamp — identity, not upgrade."""
+    sched = FaultSchedule.from_json(os.path.join(DATA, "nemesis_v2.json"))
+    assert sched.schema == 2
+    assert sched.seed == 4242
+    assert [e.action for e in sched] == [
+        "partition_minority", "crash_process", "disk_fault",
+        "reboot_process", "kill", "revive", "heal"]
+    assert sched.events[1].args == {"name": "kv-1", "disk": "dirty"}
+    assert sched.events[2].args["frac"] == 0.731502
+    again = FaultSchedule.from_dict(sched.to_dict())
+    assert again.schema == 2 and again == sched
+    assert again.signature() == sched.signature()

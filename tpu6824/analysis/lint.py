@@ -105,6 +105,15 @@ RULES: dict[str, str] = {
         "11: the C++ loop parses fe_batch straight into columnar "
         "buffers); a Python per-op decode loop on the callback path "
         "re-creates the GIL-bound ingest wall the native path removed",
+    "unbounded-retry":
+        "retry loop (while True catching RPCError and continuing) in "
+        "rpc/services scope with no visible bound — no deadline, retry "
+        "budget, backoff, timeout, or sleep/wait pacing in the loop "
+        "body.  An unbounded retry loop is the raw material of a retry "
+        "storm: under overload every such clerk amplifies the load "
+        "that is failing it (ISSUE 12's retry-budget Backoff and "
+        "deadline propagation exist to bound exactly this); pace the "
+        "loop with services.common.Backoff or bound it by deadline",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -149,6 +158,13 @@ _NATIVE_PATH_SCOPE = ("services/frontend.py", "rpc/native_server.py")
 _DECODE_DOTTED = {"struct.unpack", "struct.unpack_from", "pickle.loads",
                   "pickle.load"}
 _DECODE_TAILS = {"unpack", "unpack_from", "from_bytes"}
+# Retry-loop scope (unbounded-retry): anywhere clerks/transports retry
+# RPCs.  A loop counts as BOUNDED when its body references any of these
+# identifier substrings (deadlines, budgets, backoffs, timeouts) or
+# paces itself with a sleep/wait call.
+_RETRY_SCOPE = ("rpc/", "services/")
+_RETRY_BOUND_SUBSTR = ("deadline", "budget", "backoff", "timeout")
+_RETRY_PACE_TAILS = {"sleep", "wait"}
 
 # Receivers that denote the tpuscope metrics registry, and the
 # get-or-create constructors the metric-unregistered rule polices.
@@ -291,6 +307,7 @@ class _FileLint(ast.NodeVisitor):
         self.eventloop_scope = _in_scope(relpath, _EVENTLOOP_SCOPE)
         self.obs_buf_scope = _in_scope(relpath, _OBS_BUF_SCOPE)
         self.native_path_scope = _in_scope(relpath, _NATIVE_PATH_SCOPE)
+        self.retry_scope = _in_scope(relpath, _RETRY_SCOPE)
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
@@ -299,6 +316,7 @@ class _FileLint(ast.NodeVisitor):
         self._scan_eventloop_callbacks()
         self._scan_native_decode()
         self._scan_obs_buffers()
+        self._scan_retry_loops()
         self._fn_stack: list[ast.AST] = []
         self._calls_subscribe = False
         self._refs_columnar_consumer = False
@@ -553,6 +571,65 @@ class _FileLint(ast.NodeVisitor):
                            "uncapped list attribute in an obs module — "
                            "use a deque(maxlen=...) ring with counted "
                            "drops")
+
+    def _scan_retry_loops(self) -> None:
+        """unbounded-retry: a `while True:` loop in rpc/services scope
+        that catches RPCError without re-raising (the retry shape) and
+        whose body shows NO bound — no identifier mentioning a
+        deadline/budget/backoff/timeout, no sleep/wait pacing call.
+        Nested defs are excluded both ways (their loops are their own
+        scope; their bounds don't bound this loop)."""
+        if not self.retry_scope:
+            return
+        for loop in ast.walk(self.tree):
+            if not (isinstance(loop, ast.While)
+                    and isinstance(loop.test, ast.Constant)
+                    and loop.test.value is True):
+                continue
+            skip: set[int] = set()
+            for n in ast.walk(loop):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    skip.update(id(m) for m in ast.walk(n))
+            retries = False
+            for n in ast.walk(loop):
+                if id(n) in skip or not isinstance(n, ast.ExceptHandler) \
+                        or n.type is None:
+                    continue
+                names = {x.id for x in ast.walk(n.type)
+                         if isinstance(x, ast.Name)}
+                if "RPCError" in names and not any(
+                        isinstance(m, ast.Raise) for m in ast.walk(n)):
+                    retries = True
+                    break
+            if not retries:
+                continue
+            bound = False
+            for n in ast.walk(loop):
+                if id(n) in skip:
+                    continue
+                name = None
+                if isinstance(n, ast.Name):
+                    name = n.id
+                elif isinstance(n, ast.Attribute):
+                    name = n.attr
+                if name is not None and any(
+                        s in name.lower() for s in _RETRY_BOUND_SUBSTR):
+                    bound = True
+                    break
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    tail = f.attr if isinstance(f, ast.Attribute) else (
+                        f.id if isinstance(f, ast.Name) else None)
+                    if tail in _RETRY_PACE_TAILS:
+                        bound = True
+                        break
+            if not bound:
+                self._flag(loop, "unbounded-retry",
+                           "while-True RPC retry loop with no deadline/"
+                           "budget/backoff/timeout bound and no pacing "
+                           "sleep — a retry storm amplifier; pace it "
+                           "with services.common.Backoff or bound it "
+                           "by deadline")
 
     def _resolve_jit_defs(self) -> set[int]:
         """FunctionDefs that are jit-compiled: decorated with jax.jit /

@@ -70,6 +70,8 @@ DEFAULT_WEIGHTS = {
     "crash_process": 1.2,
     "reboot_process": 3.0,
     "disk_fault": 1.5,
+    # netfault (ISSUE 12): byte-level wire faults
+    "net_fault": 1.5,
 }
 EXTRA_WEIGHT = 1.5
 
@@ -79,6 +81,12 @@ EXTRA_WEIGHT = 1.5
 #: lose it entirely.
 DISK_FAULT_KINDS = ("torn", "fsync_lie", "enospc", "crash_rename")
 CRASH_DISK_MODES = ("keep", "dirty", "lose")
+
+#: Wire-fault kinds a `net_fault` event may arm on a netfault scope
+#: (rpc/netfault.py — corrupt/truncate/split/coalesce/stall/dup_frame/
+#: reset, the byte-level fault vocabulary of ISSUE 12).
+NET_FAULT_KINDS = ("corrupt", "truncate", "split", "coalesce", "stall",
+                   "dup_frame", "reset")
 
 
 def seed_from_env(default: int) -> int:
@@ -105,12 +113,14 @@ class FaultSchedule:
 
     #: Artifact schema version.  1 = the original (implicit) vocabulary;
     #: 2 adds the durafault actions (crash_process/reboot_process/
-    #: disk_fault) and stamps artifacts explicitly.  `from_dict` accepts
-    #: unstamped v1 artifacts — old /tmp/nemesis-*.json captures keep
-    #: replaying — and never rejects a NEWER stamp (events are plain
-    #: (t, action, args) rows; unknown actions fail loudly at apply
-    #: time, which is the right place).
-    SCHEMA = 2
+    #: disk_fault) and stamps artifacts explicitly; 3 adds the netfault
+    #: action (`net_fault {scope, kind, frac}` — byte-level wire
+    #: faults, ISSUE 12).  `from_dict` accepts unstamped v1 artifacts —
+    #: old /tmp/nemesis-*.json captures keep replaying — loads stamped
+    #: v2 captures byte-exact, and never rejects a NEWER stamp (events
+    #: are plain (t, action, args) rows; unknown actions fail loudly at
+    #: apply time, which is the right place).
+    SCHEMA = 3
 
     def __init__(self, events: list[NemesisEvent], seed: int | None = None,
                  params: dict | None = None, schema: int | None = None):
@@ -220,6 +230,9 @@ class _GenState:
         self.scopes = list(spec.get("scopes", []))
         self.disk_kinds = list(spec.get("disk_kinds", DISK_FAULT_KINDS))
         self.crashed: set = set()
+        # netfault: byte-level wire-fault scopes (NetTarget).
+        self.net_scopes = list(spec.get("net_scopes", []))
+        self.net_kinds = list(spec.get("net_kinds", NET_FAULT_KINDS))
 
     def _max_killed(self) -> int:
         return max(0, (self.P - 1) // 2)
@@ -260,6 +273,8 @@ class _GenState:
             return bool(self.crashed)
         if a == "disk_fault":
             return bool(self.scopes)
+        if a == "net_fault":
+            return bool(self.net_scopes)
         return True
 
     def _quiet_names(self):
@@ -364,6 +379,10 @@ class _GenState:
         if action == "disk_fault":
             return {"scope": rng.choice(sorted(self.scopes)),
                     "kind": rng.choice(self.disk_kinds),
+                    "frac": round(rng.random(), 6)}
+        if action == "net_fault":
+            return {"scope": rng.choice(sorted(self.net_scopes)),
+                    "kind": rng.choice(self.net_kinds),
                     "frac": round(rng.random(), 6)}
         return {}  # extra action: no args
 
@@ -538,6 +557,54 @@ class DiskTarget:
     def restore(self) -> None:
         for disk in self.disks.values():
             disk.disarm()  # armed-but-unfired faults must not leak
+
+
+class NetTarget:
+    """Byte-level wire faults as a nemesis dimension (netfault, ISSUE
+    12): each `net_fault {scope, kind, frac}` event arms ONE
+    deterministic fault on a named injector — a `netfault.WireFault`
+    over a transport scope (client-side FramedConn sends and/or the
+    pure-Python server's reply path), or a `NativeServer` (its C++
+    reply-path hook; `netfault_arm` has the same arm shape).  Because
+    arming is a pure function of the schedule and firing is a pure
+    function of the scope's framed-send sequence, replaying a seed
+    re-arms the identical faults — the byte-level analog of
+    `DiskTarget`.
+
+    `scopes` maps scope name → injector; an injector is anything with
+    `arm(kind, frac)` + a disarm surface (`disarm()` for WireFault,
+    `netfault_clear()` for NativeServer)."""
+
+    ACTIONS = ["net_fault"]
+
+    def __init__(self, scopes: dict, kinds: tuple = NET_FAULT_KINDS):
+        self.scopes = dict(scopes)
+        self.kinds = tuple(kinds)
+
+    @staticmethod
+    def _arm(inj, kind: str, frac: float) -> None:
+        if hasattr(inj, "arm"):
+            inj.arm(kind, frac=frac)
+        else:
+            inj.netfault_arm(kind, frac)
+
+    def spec(self) -> dict:
+        return {"kind": "net", "net_scopes": sorted(self.scopes),
+                "net_kinds": list(self.kinds),
+                "actions": list(self.ACTIONS)}
+
+    def apply(self, action: str, args: dict) -> None:
+        if action != "net_fault":
+            raise ValueError(f"unknown net nemesis action {action!r}")
+        self._arm(self.scopes[args["scope"]], args["kind"],
+                  args.get("frac", 0.5))
+
+    def restore(self) -> None:
+        for inj in self.scopes.values():
+            if hasattr(inj, "disarm"):
+                inj.disarm()  # armed-but-unfired faults must not leak
+            else:
+                inj.netfault_clear()
 
 
 class CompositeTarget:

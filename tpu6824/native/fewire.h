@@ -38,6 +38,37 @@ constexpr size_t kTcSize = 16;       // trace_id u64 + span_id u64
 constexpr size_t kOpFixed = 23;      // kind u8 + cid u64 + cseq i64 +
                                      // klen u16 + vlen u32
 constexpr uint16_t kFlagTrace = 1;
+// Caps-gated v1 extensions (ISSUE 12, netfault — mirrored from
+// rpc/wire.py): u32 op-budget ms / u32 frame crc32 follow the trace
+// context, in flag-bit order.  Only clerks that saw the matching
+// fe_caps advertisement send them, so a flag-less frame stays
+// byte-identical to the original v1 layout.
+constexpr uint16_t kFlagDeadline = 2;
+constexpr uint16_t kFlagCrc = 4;
+
+// crc32 (IEEE / zlib polynomial, bitwise-reflected) — matches Python's
+// zlib.crc32 so the two decoders verify the same stamp.  The table is
+// a C++11 magic static (thread-safe one-time init — the epoll loop and
+// Python reply threads both compute CRCs).
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+
+inline uint32_t crc32(const uint8_t* p, size_t n, uint32_t seed = 0) {
+  static const Crc32Table table;
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    c = table.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
 
 inline bool is_batch(const uint8_t* p, size_t n) {
   return n >= kHdrSize && p[0] == 'F' && p[1] == 'E' && p[2] == 'B';

@@ -35,6 +35,7 @@
 //
 // C ABI only; loaded via ctypes (no pybind11 in this image).
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
@@ -65,6 +66,14 @@ constexpr double kReqDrop = 0.10;  // paxos/paxos.go:528-531
 constexpr double kRepDrop = 0.20;  // paxos/paxos.go:535-538
 constexpr int64_t kConnTimeoutMs = 30'000;  // transport.py settimeout(30.0)
 
+// netfault (ISSUE 12): reply-path byte-fault kinds, indices matching
+// rpc/netfault.py NET_FAULT_KINDS.  coalesce has no event-loop meaning
+// on a deferred-reply server (replies already batch per drain) and is
+// applied as split — the frame still arrives re-chunked.
+constexpr int kNfCorrupt = 0, kNfTruncate = 1, kNfSplit = 2,
+              kNfCoalesce = 3, kNfStall = 4, kNfDup = 5, kNfReset = 6;
+constexpr int kNumNetFaults = 7;
+
 int64_t now_ms() {
   return std::chrono::duration_cast<std::chrono::milliseconds>(
              std::chrono::steady_clock::now().time_since_epoch())
@@ -80,6 +89,11 @@ struct Conn {
   bool handed_off = false;     // one request in flight per connection
   bool want_write = false;
   int64_t deadline_ms = 0;   // absolute steady-clock ms; 30s per I/O phase
+  // netfault reply-path state (ISSUE 12): injected write shaping.
+  bool close_after_write = false;  // truncate/dup: tear once flushed
+  size_t write_cap = 0;            // split/stall: max bytes per write()
+  int64_t pace_ms = 0;             // stall: min gap between writes
+  int64_t next_write_ms = 0;
   std::vector<uint8_t> rbuf;
   std::vector<uint8_t> wbuf;
   size_t woff = 0;
@@ -100,6 +114,8 @@ struct FeFrame {
   uint32_t nops = 0;
   uint32_t remaining = 0;
   bool has_tc = false;
+  bool want_crc = false;     // request carried kFlagCrc: echo it back
+  uint32_t deadline_ms = 0;  // propagated clerk op budget (0 = none)
   uint64_t tc[2] = {0, 0};
   std::vector<int32_t> kind, key_id, val_id;
   std::vector<int64_t> cid, cseq;
@@ -132,6 +148,13 @@ struct Server {
   std::atomic<bool> dead{false};
   std::atomic<bool> unreliable{false};
   std::atomic<int64_t> rpc_count{0};
+  // Malformed/oversized input rejected at the decode state machine —
+  // connection-scoped, counted, never a crash (mirrored into the
+  // registry as rpc.wire.rejected by the Python wrapper).
+  std::atomic<int64_t> wire_rejected{0};
+  // Per-conn I/O-phase deadline (ms); settable so slow-loris defense
+  // tests run in finite time.
+  std::atomic<int64_t> io_deadline_ms{kConnTimeoutMs};
   uint64_t rng;
   Callback cb;
   std::thread loop;
@@ -140,6 +163,16 @@ struct Server {
   std::unordered_map<uint64_t, Conn> conns;
   uint64_t next_id = 1;
   std::atomic<Ingest*> ingest{nullptr};  // set once by rpcsrv_ingest_enable
+  // netfault reply-path injector (ISSUE 12): one-shot FIFO + optional
+  // seeded per-reply plan, drawn in drain_replies under nf_mu.
+  std::mutex nf_mu;
+  std::deque<std::pair<int, double>> nf_armed;  // (kind, frac)
+  bool nf_plan = false;
+  uint64_t nf_rng = 1;
+  double nf_rates[kNumNetFaults] = {0};
+  uint64_t nf_index = 0;                  // reply send index
+  std::atomic<int64_t> nf_injected{0};
+  std::atomic<int> paced{0};              // conns mid-stall (loop tick)
 };
 
 double next_unit(uint64_t& s) {  // xorshift64*, uniform in [0,1)
@@ -156,8 +189,11 @@ void set_nonblock(int fd) {
 
 void epoll_mod(Server* s, uint64_t id, Conn& c) {
   epoll_event ev{};
+  // A paced (netfault-stalled) reply must NOT arm EPOLLOUT: the socket
+  // stays writable, so level-triggered EPOLLOUT would hot-spin the
+  // loop; the loop's timeout tick resumes the trickle instead.
   ev.events = (c.handed_off ? 0u : unsigned(EPOLLIN)) |
-              (c.want_write ? unsigned(EPOLLOUT) : 0u);
+              (c.want_write && !c.pace_ms ? unsigned(EPOLLOUT) : 0u);
   ev.data.u64 = id;
   epoll_ctl(s->epfd, EPOLL_CTL_MOD, c.fd, &ev);
 }
@@ -165,6 +201,8 @@ void epoll_mod(Server* s, uint64_t id, Conn& c) {
 void close_conn(Server* s, uint64_t id) {
   auto it = s->conns.find(id);
   if (it == s->conns.end()) return;
+  if (it->second.pace_ms)
+    s->paced.fetch_sub(1, std::memory_order_relaxed);
   epoll_ctl(s->epfd, EPOLL_CTL_DEL, it->second.fd, nullptr);
   close(it->second.fd);
   s->conns.erase(it);
@@ -177,7 +215,7 @@ void handle_accept(Server* s) {
     uint64_t id = s->next_id++;
     Conn& c = s->conns[id];
     c.fd = fd;
-    c.deadline_ms = now_ms() + kConnTimeoutMs;
+    c.deadline_ms = now_ms() + s->io_deadline_ms.load(std::memory_order_relaxed);
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.u64 = id;
@@ -221,7 +259,7 @@ void ingest_wake_engine(Ingest* ing) {
 // the frame to the reap queue.  Caller holds ing->mu.
 void fe_complete_locked(Server* s, Ingest* ing, FeFrame* f) {
   std::vector<int64_t> vlens(f->nops, 0);
-  size_t total = fewire::kHdrSize;
+  size_t total = fewire::kHdrSize + (f->want_crc ? 4 : 0);
   {
     std::lock_guard<std::mutex> g(ing->vals.mu);
     for (uint32_t i = 0; i < f->nops; i++) {
@@ -252,9 +290,16 @@ void fe_complete_locked(Server* s, Ingest* ing, FeFrame* f) {
   out[1] = 'E';
   out[2] = 'R';
   out[3] = fewire::kFeVersion;
-  fewire::store<uint16_t>(out.data() + 4, 0);
+  fewire::store<uint16_t>(out.data() + 4,
+                          f->want_crc ? fewire::kFlagCrc : 0);
   fewire::store<uint16_t>(out.data() + 6, uint16_t(f->nops));
   size_t off = fewire::kHdrSize;
+  size_t crc_off = 0;
+  if (f->want_crc) {  // 4 reserved bytes, stamped after serialization
+    crc_off = off;
+    fewire::store<uint32_t>(out.data() + off, 0);
+    off += 4;
+  }
   {
     std::lock_guard<std::mutex> g(ing->vals.mu);
     for (uint32_t i = 0; i < f->nops; i++) {
@@ -271,6 +316,12 @@ void fe_complete_locked(Server* s, Ingest* ing, FeFrame* f) {
   for (uint32_t i = 0; i < f->nops; i++)
     if (f->rep_val[i] >= 0)
       intern_core::store_decref(&ing->vals, f->rep_val[i]);
+  if (f->want_crc) {
+    uint32_t c = fewire::crc32(out.data(), crc_off);
+    c = fewire::crc32(out.data() + crc_off + 4, out.size() - crc_off - 4,
+                      c);
+    fewire::store<uint32_t>(out.data() + crc_off, c);
+  }
   enqueue_reply(s, f->conn_id, std::move(out));
   ing->done.push_back(f->id);
   ing->inflight_ops -= f->nops;
@@ -285,6 +336,7 @@ void fe_complete_locked(Server* s, Ingest* ing, FeFrame* f) {
 void ingest_frame(Server* s, Ingest* ing, uint64_t conn_id,
                   const uint8_t* p, size_t n) {
   if (p[3] != fewire::kFeVersion) {
+    s->wire_rejected.fetch_add(1, std::memory_order_relaxed);
     enqueue_reply(s, conn_id, fe_error_bytes("fe wire version mismatch"));
     return;
   }
@@ -292,15 +344,47 @@ void ingest_frame(Server* s, Ingest* ing, uint64_t conn_id,
   uint16_t nops = fewire::load<uint16_t>(p + 6);
   size_t off = fewire::kHdrSize;
   uint64_t tc0 = 0, tc1 = 0;
+  uint32_t deadline_ms = 0;
   bool has_tc = (flags & fewire::kFlagTrace) != 0;
+  bool want_crc = (flags & fewire::kFlagCrc) != 0;
   if (has_tc) {
     if (n < off + fewire::kTcSize) {
+      s->wire_rejected.fetch_add(1, std::memory_order_relaxed);
       enqueue_reply(s, conn_id, fe_error_bytes("malformed fe_batch frame"));
       return;
     }
     tc0 = fewire::load<uint64_t>(p + off);
     tc1 = fewire::load<uint64_t>(p + off + 8);
     off += fewire::kTcSize;
+  }
+  if (flags & fewire::kFlagDeadline) {
+    if (n < off + 4) {
+      s->wire_rejected.fetch_add(1, std::memory_order_relaxed);
+      enqueue_reply(s, conn_id, fe_error_bytes("malformed fe_batch frame"));
+      return;
+    }
+    deadline_ms = fewire::load<uint32_t>(p + off);
+    off += 4;
+  }
+  if (want_crc) {
+    // Frame integrity (the netfault corrupt defense): crc32 over every
+    // byte except the 4-byte crc field itself; a mismatch is a
+    // connection-scoped reject, NEVER a silently-altered op.
+    if (n < off + 4) {
+      s->wire_rejected.fetch_add(1, std::memory_order_relaxed);
+      enqueue_reply(s, conn_id, fe_error_bytes("malformed fe_batch frame"));
+      return;
+    }
+    uint32_t want = fewire::load<uint32_t>(p + off);
+    uint32_t got = fewire::crc32(p, off);
+    got = fewire::crc32(p + off + 4, n - off - 4, got);
+    if (got != want) {
+      s->wire_rejected.fetch_add(1, std::memory_order_relaxed);
+      enqueue_reply(s, conn_id,
+                    fe_error_bytes("fe_batch frame CRC mismatch"));
+      return;
+    }
+    off += 4;
   }
   if (nops == 0) {
     // Degenerate empty batch: answer now so the connection's reply FIFO
@@ -327,6 +411,8 @@ void ingest_frame(Server* s, Ingest* ing, uint64_t conn_id,
   f->nops = nops;
   f->remaining = nops;
   f->has_tc = has_tc;
+  f->want_crc = want_crc;
+  f->deadline_ms = deadline_ms;
   f->tc[0] = tc0;
   f->tc[1] = tc1;
   f->kind.reserve(nops);
@@ -376,6 +462,7 @@ void ingest_frame(Server* s, Ingest* ing, uint64_t conn_id,
         intern_core::store_decref(&ing->vals, f->val_id[i]);
     }
     delete f;
+    s->wire_rejected.fetch_add(1, std::memory_order_relaxed);
     enqueue_reply(s, conn_id, fe_error_bytes("malformed fe_batch frame"));
     return;
   }
@@ -404,6 +491,9 @@ bool try_dispatch(Server* s, uint64_t id, Conn& c) {
   size_t len = (size_t(c.rbuf[0]) << 24) | (size_t(c.rbuf[1]) << 16) |
                (size_t(c.rbuf[2]) << 8) | size_t(c.rbuf[3]);
   if (len > kMaxFrame) {
+    // Oversized frame claim (or a corrupted length prefix): reject the
+    // CONNECTION, count it, keep serving everyone else.
+    s->wire_rejected.fetch_add(1, std::memory_order_relaxed);
     close_conn(s, id);
     return false;
   }
@@ -417,7 +507,7 @@ bool try_dispatch(Server* s, uint64_t id, Conn& c) {
   }
   c.discard_reply = unrel && r2 < kRepDrop;
   c.handed_off = true;  // one request in flight per connection
-  c.deadline_ms = now_ms() + kConnTimeoutMs;
+  c.deadline_ms = now_ms() + s->io_deadline_ms.load(std::memory_order_relaxed);
   epoll_mod(s, id, c);
   const uint8_t* payload = c.rbuf.data() + 4;
   Ingest* ing_ = s->ingest.load(std::memory_order_acquire);
@@ -463,12 +553,28 @@ void handle_write(Server* s, uint64_t id) {
   if (it == s->conns.end()) return;
   Conn& c = it->second;
   while (c.woff < c.wbuf.size()) {
-    ssize_t n = write(c.fd, c.wbuf.data() + c.woff, c.wbuf.size() - c.woff);
+    if (c.pace_ms && now_ms() < c.next_write_ms)
+      return;  // stalled reply: the loop tick resumes the trickle
+    size_t want = c.wbuf.size() - c.woff;
+    if (c.write_cap && want > c.write_cap) want = c.write_cap;
+    ssize_t n = write(c.fd, c.wbuf.data() + c.woff, want);
     if (n > 0) {
       c.woff += size_t(n);
+      if (c.pace_ms) {
+        c.next_write_ms = now_ms() + c.pace_ms;
+        if (c.woff < c.wbuf.size()) return;
+      }
       continue;
     }
     if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+    close_conn(s, id);
+    return;
+  }
+  if (c.pace_ms) {
+    s->paced.fetch_sub(1, std::memory_order_relaxed);
+    c.pace_ms = 0;  // cleared BEFORE close_conn so it never re-counts
+  }
+  if (c.close_after_write) {  // netfault truncate/dup: tear once flushed
     close_conn(s, id);
     return;
   }
@@ -478,11 +584,39 @@ void handle_write(Server* s, uint64_t id) {
   c.wbuf.clear();
   c.woff = 0;
   c.want_write = false;
+  c.write_cap = 0;
+  c.pace_ms = 0;
   c.handed_off = false;
   c.discard_reply = false;
-  c.deadline_ms = now_ms() + kConnTimeoutMs;
+  c.deadline_ms = now_ms() + s->io_deadline_ms.load(std::memory_order_relaxed);
   epoll_mod(s, id, c);
   try_dispatch(s, id, c);  // next request may already be buffered
+}
+
+// Draw the next netfault reply fault for this server: armed FIFO first,
+// then the seeded plan (two rng draws per reply, like durafs.FaultPlan,
+// so placement is a pure function of the reply index).  Returns kind or
+// -1, with frac in *frac_out.
+int nf_draw(Server* s, double* frac_out) {
+  std::lock_guard<std::mutex> g(s->nf_mu);
+  s->nf_index++;
+  if (!s->nf_armed.empty()) {
+    auto [kind, frac] = s->nf_armed.front();
+    s->nf_armed.pop_front();
+    *frac_out = frac;
+    return kind;
+  }
+  if (!s->nf_plan) return -1;
+  double u = next_unit(s->nf_rng), frac = next_unit(s->nf_rng);
+  double acc = 0.0;
+  for (int k = 0; k < kNumNetFaults; k++) {
+    acc += s->nf_rates[k];
+    if (u < acc) {
+      *frac_out = frac;
+      return k;
+    }
+  }
+  return -1;
 }
 
 void drain_replies(Server* s) {
@@ -513,10 +647,68 @@ void drain_replies(Server* s) {
     c.wbuf[2] = uint8_t(len >> 8);
     c.wbuf[3] = uint8_t(len);
     memcpy(c.wbuf.data() + 4, r.data.data(), r.data.size());
+    // netfault (ISSUE 12): byte-level reply faults — the hook that
+    // makes NATIVE-INGEST connections injectable (their request path
+    // never re-enters Python, so the Python seam cannot see them).
+    double frac = 0.5;
+    int nf = nf_draw(s, &frac);
+    if (nf >= 0) {
+      s->nf_injected.fetch_add(1, std::memory_order_relaxed);
+      size_t total = c.wbuf.size();
+      switch (nf) {
+        case kNfCorrupt: {
+          // 1-3 flips at offsets that are a PURE function of (reply
+          // index, frac, length) — the Python corrupt_offsets rule —
+          // anywhere in the framed bytes, length prefix included (the
+          // client decode state machine owes safety everywhere).
+          // NEVER seed from s->rng: it advances with the unreliable
+          // coins per request, which would break seed replay.
+          uint64_t rr = (s->nf_index << 20) ^ uint64_t(frac * 1e6) ^
+                        uint64_t(total);
+          if (rr == 0) rr = 1;  // xorshift state must be nonzero
+          int nflips = 1 + int(next_unit(rr) * 3);
+          for (int k = 0; k < nflips; k++)
+            c.wbuf[size_t(next_unit(rr) * total)] ^= 0xFF;
+          break;
+        }
+        case kNfTruncate: {
+          size_t keep = total * std::min(std::max(frac, 0.01), 0.95);
+          c.wbuf.resize(std::max<size_t>(1, keep));
+          c.close_after_write = true;
+          break;
+        }
+        case kNfSplit:
+        case kNfCoalesce:
+          c.write_cap = std::max<size_t>(1, std::min<size_t>(512,
+                            total * std::min(std::max(frac, 0.02), 0.5)));
+          break;
+        case kNfStall:
+          c.write_cap = std::max<size_t>(128, total / 8);
+          c.pace_ms = 40 + int64_t(frac * 80);
+          c.next_write_ms = 0;
+          s->paced.fetch_add(1, std::memory_order_relaxed);
+          break;
+        case kNfDup:
+          // Reply-direction "duplicate": the fe reply wire has no
+          // request ids, so a literally-doubled reply would be
+          // UNDETECTABLE by any client (the next request would read
+          // the stale copy) — that would manufacture violations no
+          // server code could prevent.  Model the delivered-once half
+          // instead: reply flushed, then the conn torn, forcing the
+          // client through redial + resend, where the REQUEST-side dup
+          // filter (exercised by the Python injector's true dup_frame)
+          // absorbs the replay.
+          c.close_after_write = true;
+          break;
+        case kNfReset:
+          close_conn(s, r.conn_id);
+          continue;
+      }
+    }
     c.want_write = true;
     // Re-arm the I/O deadline for the reply-write phase: a client that
     // stops reading must not pin the fd + buffered reply forever.
-    c.deadline_ms = now_ms() + kConnTimeoutMs;
+    c.deadline_ms = now_ms() + s->io_deadline_ms.load(std::memory_order_relaxed);
     epoll_mod(s, r.conn_id, c);
     handle_write(s, r.conn_id);  // opportunistic immediate flush
   }
@@ -540,7 +732,18 @@ void loop_body(Server* s) {
   epoll_event evs[64];
   int64_t next_sweep = now_ms() + 1000;
   while (!s->dead.load(std::memory_order_acquire)) {
-    int n = epoll_wait(s->epfd, evs, 64, 200);
+    // Stalled (netfault-paced) replies are resumed by the loop tick,
+    // not EPOLLOUT (see epoll_mod) — shorten the tick while any exist.
+    int tmo = s->paced.load(std::memory_order_relaxed) > 0 ? 20 : 200;
+    int n = epoll_wait(s->epfd, evs, 64, tmo);
+    if (s->paced.load(std::memory_order_relaxed) > 0) {
+      int64_t now = now_ms();
+      std::vector<uint64_t> due;
+      for (auto& [id, c] : s->conns)
+        if (c.pace_ms && c.woff < c.wbuf.size() && now >= c.next_write_ms)
+          due.push_back(id);
+      for (uint64_t id : due) handle_write(s, id);
+    }
     if (now_ms() >= next_sweep) {
       sweep_stale(s);
       next_sweep = now_ms() + 1000;
@@ -622,6 +825,49 @@ void rpcsrv_set_unreliable(void* srv, int flag) {
                                               std::memory_order_relaxed);
 }
 
+// ---------------------------------------------------------- netfault
+// Reply-path byte-fault injection (ISSUE 12).  kind indexes
+// rpc/netfault.py NET_FAULT_KINDS; armed faults fire FIFO against the
+// server's reply sequence, a seeded plan draws per reply (two xorshift
+// draws each, durafs.FaultPlan style).
+
+void rpcsrv_netfault_arm(void* srv, int kind, double frac) {
+  auto* s = static_cast<Server*>(srv);
+  if (kind < 0 || kind >= kNumNetFaults) return;
+  std::lock_guard<std::mutex> g(s->nf_mu);
+  s->nf_armed.emplace_back(kind, frac);
+}
+
+void rpcsrv_netfault_plan(void* srv, uint64_t seed, const double* rates) {
+  auto* s = static_cast<Server*>(srv);
+  std::lock_guard<std::mutex> g(s->nf_mu);
+  s->nf_rng = seed ? seed : 1;
+  for (int k = 0; k < kNumNetFaults; k++) s->nf_rates[k] = rates[k];
+  s->nf_plan = true;
+}
+
+void rpcsrv_netfault_clear(void* srv) {
+  auto* s = static_cast<Server*>(srv);
+  std::lock_guard<std::mutex> g(s->nf_mu);
+  s->nf_armed.clear();
+  s->nf_plan = false;
+}
+
+int64_t rpcsrv_netfault_injected(void* srv) {
+  return static_cast<Server*>(srv)->nf_injected.load(
+      std::memory_order_relaxed);
+}
+
+int64_t rpcsrv_wire_rejected(void* srv) {
+  return static_cast<Server*>(srv)->wire_rejected.load(
+      std::memory_order_relaxed);
+}
+
+void rpcsrv_set_io_deadline_ms(void* srv, int64_t ms) {
+  static_cast<Server*>(srv)->io_deadline_ms.store(
+      ms > 0 ? ms : kConnTimeoutMs, std::memory_order_relaxed);
+}
+
 int64_t rpcsrv_rpc_count(void* srv) {
   return static_cast<Server*>(srv)->rpc_count.load(
       std::memory_order_relaxed);
@@ -683,7 +929,8 @@ int rpcsrv_ingest_enable(void* srv, int64_t max_ops) {
   return ing->efd;
 }
 
-// Pop one ready frame: hdr6 = {frame_id, conn_id, nops, has_tc, tc0, tc1},
+// Pop one ready frame: hdr7 = {frame_id, conn_id, nops, has_tc, tc0, tc1,
+// deadline_ms (0 = none — the propagated clerk op budget)},
 // columns memcpy'd into the caller's buffers (cap ops each).  Returns nops,
 // -1 when no frame is ready, -2 when cap is too small (frame stays
 // queued).  The frame's column storage is released here — the caller's
@@ -712,6 +959,7 @@ int64_t rpcsrv_ingest_poll1(void* srv, uint64_t* hdr, int32_t* kinds,
     hdr[3] = f->has_tc ? 1 : 0;
     hdr[4] = f->tc[0];
     hdr[5] = f->tc[1];
+    hdr[6] = f->deadline_ms;
     memcpy(kinds, f->kind.data(), f->nops * sizeof(int32_t));
     memcpy(cids, f->cid.data(), f->nops * sizeof(int64_t));
     memcpy(cseqs, f->cseq.data(), f->nops * sizeof(int64_t));

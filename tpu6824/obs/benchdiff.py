@@ -152,6 +152,16 @@ METRICS = [
            leg_shape=[("service", "clerk_frontend", "groups"),
                       ("service", "clerk_frontend", "conns"),
                       ("service", "clerk_frontend", "batch_width")]),
+    # Overload leg (ISSUE 12, netfault): goodput under 4× offered load
+    # and the measured closed-loop capacity it is relative to.  Both
+    # host-edge noisy like every clerk-path leg; gated on the leg's OWN
+    # shape (env-trimmed contract runs skip loudly).  First recorded
+    # artifact baselines them; gated thereafter.
+    Metric(("service", "overload", "value"), 0.65, host_bound=True,
+           leg_shape=[("service", "overload", "shape")]),
+    Metric(("service", "overload", "capacity_ops_s"), 0.65,
+           host_bound=True,
+           leg_shape=[("service", "overload", "shape")]),
     # Host-edge legs: the demonstrated noise floor is −55% (wire
     # −40%/−53%, thread-per-clerk −55% between real artifacts).
     Metric(("wire", "value"), 0.65, host_bound=True),

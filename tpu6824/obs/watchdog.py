@@ -35,6 +35,11 @@ Rules (thresholds via env, see TUNING):
     `TPU6824_WD_JIT_GRACE` arming delay): steady state must be
     zero-compile, but first-touch compiles from traffic arriving at any
     time are warmup, not an incident.
+  - ``retry-storm``         — frontend retries/timeouts climbing while
+    goodput (frontend.ops rate) falls: the self-amplifying overload
+    signature netfault's overload protection exists to prevent
+    (`TPU6824_WD_RETRY_RATE` floor keeps ordinary failover retries
+    quiet).
 
 Default-off like tracing: a watchdog only exists when constructed, and
 evaluation is sampling-clock granular — no per-op cost anywhere.
@@ -226,10 +231,59 @@ class JitRecompile(Rule):
                 "a shape/static-arg is varying per dispatch")
 
 
+class RetryStorm(Rule):
+    """Retry amplification on the clerk path (ISSUE 12): the retry (or
+    timeout) rate climbing across the window while goodput falls.  Both
+    halves matter — retries alone spike benignly on any failover, and
+    falling goodput alone is throughput-collapse's job; the STORM
+    signature is work shifting from serving ops to re-proposing them."""
+
+    name = "retry-storm"
+    retries = "frontend.retries.rate"
+    timeouts = "frontend.timeouts.rate"
+    goodput = "frontend.ops.rate"
+
+    def __init__(self, min_rate: float | None = None,
+                 climb: float = 1.5, fall: float = 0.5):
+        # Floor on the late-window retry+timeout rate: ordinary
+        # failover retries (a killed replica, one partition) stay quiet.
+        self.min_rate = _envf("TPU6824_WD_RETRY_RATE", 50.0) \
+            if min_rate is None else min_rate
+        self.climb = climb
+        self.fall = fall
+
+    @staticmethod
+    def _halves(pts):
+        half = len(pts) // 2
+        before = sum(v for _, v in pts[:half]) / max(half, 1)
+        after = sum(v for _, v in pts[half:]) / max(len(pts) - half, 1)
+        return before, after
+
+    def check(self, wd):
+        good = wd.points(self.goodput)
+        if len(good) < 4:
+            return None
+        g_before, g_after = self._halves(good)
+        if g_before <= 0 or g_after >= g_before * self.fall:
+            return None  # goodput holding: churn, not a storm
+        for name in (self.retries, self.timeouts):
+            pts = wd.points(name)
+            if len(pts) < 4:
+                continue
+            r_before, r_after = self._halves(pts)
+            if r_after >= self.min_rate and \
+                    r_after >= max(r_before, 1e-9) * self.climb:
+                return (f"{name} climbed {r_before:.1f} -> "
+                        f"{r_after:.1f}/s while goodput fell "
+                        f"{g_before:.1f} -> {g_after:.1f}/s "
+                        "(retries amplifying, not recovering)")
+        return None
+
+
 def default_rules() -> list[Rule]:
     return [StalledGroups(), ThroughputCollapse(), LatencySpike(),
             QueueGrowth(), ThreadCrashes(), DroppedClimbing(),
-            JitRecompile()]
+            JitRecompile(), RetryStorm()]
 
 
 class Watchdog:

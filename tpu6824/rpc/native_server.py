@@ -105,6 +105,24 @@ def _get_lib():
                     lib.rpcsrv_ingest_decref.argtypes = [
                         vp, ctypes.c_int, vp, ctypes.c_int64, vp]
                     lib.rpcsrv_ingest_stats.argtypes = [vp, vp]
+                # netfault reply-path hook + decode-reject counter
+                # (ISSUE 12).  Probed like the ingest surface: absent
+                # on a stale .so, in which case injection/counting
+                # degrade to unavailable rather than crashing.
+                if hasattr(lib, "rpcsrv_netfault_arm"):
+                    lib.rpcsrv_netfault_arm.argtypes = [
+                        ctypes.c_void_p, ctypes.c_int, ctypes.c_double]
+                    lib.rpcsrv_netfault_plan.argtypes = [
+                        ctypes.c_void_p, ctypes.c_uint64,
+                        ctypes.POINTER(ctypes.c_double)]
+                    lib.rpcsrv_netfault_clear.argtypes = [ctypes.c_void_p]
+                    lib.rpcsrv_netfault_injected.restype = ctypes.c_int64
+                    lib.rpcsrv_netfault_injected.argtypes = [
+                        ctypes.c_void_p]
+                    lib.rpcsrv_wire_rejected.restype = ctypes.c_int64
+                    lib.rpcsrv_wire_rejected.argtypes = [ctypes.c_void_p]
+                    lib.rpcsrv_set_io_deadline_ms.argtypes = [
+                        ctypes.c_void_p, ctypes.c_int64]
             _lib = lib or False
     return _lib or None
 
@@ -175,11 +193,73 @@ class NativeServer:
     def register_native_batch(self, fn) -> "NativeServer":
         """Event-loop handler for fe wire frames that reach PYTHON (C++
         ingest off — custom op factories, or a lib without the ingest
-        surface): `fn(conn_id, ops, tc)` with the frame already decoded
-        by rpc/wire.py.  Same discipline as register_inline; replies go
-        out via send_reply_native/send_error_native."""
+        surface): `fn(conn_id, ops, tc, meta)` with the frame already
+        decoded by rpc/wire.py (meta = the decode_batch_meta dict:
+        propagated deadline + crc echo).  Same discipline as
+        register_inline; replies go out via send_reply_native/
+        send_error_native."""
         self._native_batch = fn
         return self
+
+    # ------------------------------------------------- netfault surface
+    # Reply-path byte-fault injection (ISSUE 12): the C++-side hook that
+    # makes native-ingest connections injectable (their request path
+    # never re-enters Python).  Uniform arm/disarm shape with
+    # netfault.WireFault so the nemesis NetTarget drives both.
+
+    def netfault_arm(self, kind: str, frac: float = 0.5) -> None:
+        from tpu6824.rpc.netfault import NET_FAULT_KINDS
+
+        with self._lock:
+            if self._srv is not None and not self._dead and \
+                    hasattr(self._lib, "rpcsrv_netfault_arm"):
+                self._lib.rpcsrv_netfault_arm(
+                    self._srv, NET_FAULT_KINDS.index(kind), float(frac))
+
+    def netfault_plan(self, seed: int, rates: dict) -> None:
+        from tpu6824.rpc.netfault import NET_FAULT_KINDS
+
+        arr = (ctypes.c_double * len(NET_FAULT_KINDS))(
+            *[float(rates.get(k, 0.0)) for k in NET_FAULT_KINDS])
+        with self._lock:
+            if self._srv is not None and not self._dead and \
+                    hasattr(self._lib, "rpcsrv_netfault_plan"):
+                self._lib.rpcsrv_netfault_plan(self._srv, seed, arr)
+
+    def netfault_clear(self) -> None:
+        with self._lock:
+            if self._srv is not None and not self._dead and \
+                    hasattr(self._lib, "rpcsrv_netfault_clear"):
+                self._lib.rpcsrv_netfault_clear(self._srv)
+
+    @property
+    def netfault_injected(self) -> int:
+        with self._lock:
+            if self._srv is not None and not self._dead and \
+                    hasattr(self._lib, "rpcsrv_netfault_injected"):
+                return int(self._lib.rpcsrv_netfault_injected(self._srv))
+        return 0
+
+    @property
+    def wire_rejected(self) -> int:
+        """Malformed/oversized frames the C++ decode state machine
+        rejected (connection-scoped) — the Python-side rejects are
+        counted straight into rpc.wire.rejected as they happen."""
+        with self._lock:
+            if self._srv is not None and not self._dead and \
+                    hasattr(self._lib, "rpcsrv_wire_rejected"):
+                return int(self._lib.rpcsrv_wire_rejected(self._srv))
+        return 0
+
+    def set_io_deadline(self, seconds: float) -> None:
+        """Per-conn I/O-phase deadline (slow-loris bound): a conn that
+        cannot finish a frame read or a reply write within this window
+        is closed.  Default 30s (the transport contract)."""
+        with self._lock:
+            if self._srv is not None and not self._dead and \
+                    hasattr(self._lib, "rpcsrv_set_io_deadline_ms"):
+                self._lib.rpcsrv_set_io_deadline_ms(
+                    self._srv, int(seconds * 1000))
 
     def enable_ingest(self, max_ops: int = 1 << 16) -> "NativeIngest | None":
         """Turn on zero-GIL ingest (call right AFTER start(), before
@@ -225,10 +305,18 @@ class NativeServer:
         path of the threaded handlers)."""
         self._send_reply(conn_id, b"")
 
-    def send_reply_native(self, conn_id: int, replies) -> None:
+    def send_reply_native(self, conn_id: int, replies,
+                          crc: bool = False) -> None:
         """Deferred reply to an fe wire frame: FER-encoded (err, value)
-        pairs — the versioned-layout twin of send_reply."""
-        self._send_reply(conn_id, wire.encode_replies(replies))
+        pairs — the versioned-layout twin of send_reply.  `crc` echoes
+        a request's FLAG_CRC.  An encoded reply past the transport
+        frame cap answers with an explicit fe error instead (parity
+        with the C++ reply ring and transport.Server — a silently
+        oversized frame the client cap rejects is a retry livelock)."""
+        raw = wire.encode_replies(replies, crc=crc)
+        if len(raw) > transport._MAX_FRAME:
+            raw = wire.encode_error("reply too large for one fe frame")
+        self._send_reply(conn_id, raw)
 
     def send_error_native(self, conn_id: int, msg: str) -> None:
         """Deferred fe error frame (RPCError(msg) at the caller)."""
@@ -321,6 +409,7 @@ class NativeServer:
                 frame = pickle.loads(payload)
                 fn = self._inline.get(frame[0])
             except Exception:  # undecodable frame: drop (cf. _serve)
+                transport._M_WIRE_REJ.inc(key="undecodable")
                 self._send_reply(conn_id, b"")
                 return
             if fn is not None:
@@ -343,14 +432,17 @@ class NativeServer:
         fe_batch handler; replies always go back in the fe layout the
         request arrived in."""
         try:
-            ops, tc = wire.decode_batch(payload)
+            ops, tc, meta = wire.decode_batch_meta(payload)
         except RPCError as e:
+            # Malformed (incl. CRC mismatch): connection-scoped error,
+            # counted, never a crash or a mis-applied op.
+            transport._M_WIRE_REJ.inc(key="malformed_fe")
             self._send_reply(conn_id, wire.encode_error(str(e)))
             return
         nb = self._native_batch
         if nb is not None:
             try:
-                nb(conn_id, ops, tc)
+                nb(conn_id, ops, tc, meta)
             except Exception as e:  # noqa: BLE001 — loop must survive
                 crashsink.record("native-rpc-inline", e, fatal=False)
                 self._send_reply(conn_id, b"")
@@ -363,9 +455,9 @@ class NativeServer:
         threading.Thread(
             target=crashsink.guarded(self._serve_native_blocking,
                                      "native-rpc-serve"),
-            args=(conn_id, fn, ops, tc), daemon=True).start()
+            args=(conn_id, fn, ops, tc, meta), daemon=True).start()
 
-    def _serve_native_blocking(self, conn_id, fn, ops, tc) -> None:
+    def _serve_native_blocking(self, conn_id, fn, ops, tc, meta) -> None:
         try:
             if tc is not None:
                 with _tracing.use_ctx(_tracing.TraceContext(*tc)):
@@ -379,7 +471,11 @@ class NativeServer:
             self._send_reply(conn_id, wire.encode_error(f"{e!r:.200}"))
             return
         try:
-            raw = wire.encode_replies(replies)
+            raw = wire.encode_replies(replies, crc=meta.get("crc", False))
+            if len(raw) > transport._MAX_FRAME:
+                # Cap parity with the reply ring: explicit error, never
+                # an oversized frame the client cap would reject.
+                raw = wire.encode_error("reply too large for one fe frame")
         except Exception as e:  # noqa: BLE001 — degrade like _serve does
             raw = wire.encode_error(f"unserializable reply ({e!r:.100})")
         self._send_reply(conn_id, raw)
@@ -457,7 +553,7 @@ class NativeIngest:
         self.fd = srv._ingest_fd
         self._cap = 0
         self._grow(4096)
-        self._hdr = np.zeros(6, dtype=np.uint64)
+        self._hdr = np.zeros(7, dtype=np.uint64)
         self._hdr_p = self._hdr.ctypes.data
         self._reap_buf = np.zeros(self.REAP_CAP, dtype=np.uint64)
         self._reap_p = self._reap_buf.ctypes.data
@@ -485,8 +581,10 @@ class NativeIngest:
     # ------------------------------------------------------------- ingest
 
     def poll1(self):
-        """One ready frame as (frame_id, conn_id, nops, tc, kind, cid,
-        cseq, key_id, val_id) with engine-owned column copies, or None."""
+        """One ready frame as (frame_id, conn_id, nops, tc, deadline_ms,
+        kind, cid, cseq, key_id, val_id) with engine-owned column
+        copies, or None.  deadline_ms is the clerk op budget the frame
+        header propagated (0 = none)."""
         while True:
             with self._lock:
                 if self._srv._dead or self._srv._srv is None:
@@ -502,7 +600,7 @@ class NativeIngest:
             n = int(n)
             h = self._hdr
             tc = (int(h[4]), int(h[5])) if h[3] else None
-            return (int(h[0]), int(h[1]), n, tc,
+            return (int(h[0]), int(h[1]), n, tc, int(h[6]),
                     self._kind[:n].copy(), self._cid[:n].copy(),
                     self._cseq[:n].copy(), self._keyid[:n].copy(),
                     self._valid[:n].copy())

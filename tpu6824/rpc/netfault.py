@@ -1,0 +1,290 @@
+"""netfault — deterministic byte-level wire fault injection (ISSUE 12).
+
+durafault (utils/durafs.py) made the DISK a first-class fault domain by
+owning the one durable-write seam; this module does the same for the
+WIRE.  PR 10 moved the request hot path onto a versioned binary layout
+decoded by a C++ epoll loop — which makes the byte stream itself a
+thing that can fail, yet nemesis could only drop or delay whole calls.
+A `WireFault` registered over a transport scope (a server socket path)
+intercepts every framed send through that scope and injects faults at
+the BYTE level:
+
+    corrupt    flip bytes at deterministically-derived offsets — the
+               receiver's decode state machine must reject the frame as
+               a connection-scoped error (and the fe wire's CRC makes
+               even payload-region flips detectable: corruption may
+               never silently alter an op);
+    truncate   send only the first ``frac`` of the framed bytes, then
+               close — the peer sees a mid-frame EOF;
+    split      re-chunk the send across many small syscalls (the frame
+               arrives intact but never in one read) — exercises
+               reassembly across syscall boundaries;
+    coalesce   hold the frame and flush it glued to the FRONT of the
+               next send on the same connection — two frames in one
+               segment (the inverse re-chunking);
+    stall      slow-loris: trickle the frame below a byte-rate floor —
+               the receiver's per-conn read deadline is the defense;
+    dup_frame  send the framed bytes TWICE, then close the connection
+               (a duplicated delivery; the close keeps the sender's
+               reply FIFO coherent, and the receiver's dup filter must
+               absorb the byte-identical replay);
+    reset      close the connection without sending anything — the op
+               was never delivered.
+
+Arming mirrors `DuraDisk` exactly: a FIFO of one-shot faults (`arm()`,
+the nemesis `NetTarget`'s injection point — `net_fault {scope, kind,
+frac}` events re-arm identically on replay) plus an optional seeded
+per-send `NetFaultPlan` drawing at fixed per-kind rates.  Every
+injection is recorded in `timeline` as `(send_index, kind, detail)` —
+a pure function of (plan/armed sequence, send sizes), so the same seed
+over the same send sequence replays the identical byte-level timeline.
+
+The Python seam is `transport.FramedConn` (client→server bytes) and
+`transport.Server`'s reply path (server→client bytes); native-ingest
+connections are injectable through the C++ reply-path hook
+(`rpcserver.cpp rpcsrv_netfault_*`, surfaced as
+`NativeServer.set_netfault`).  Registration is by scope string (the
+socket path): `register(addr, wf)` makes every *subsequently dialed*
+`FramedConn` to that address consult `wf` — the harness registers
+scopes before the clerks dial.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from tpu6824.obs import metrics as _metrics
+
+#: Closed fault-kind vocabulary, order is part of the C ABI (the native
+#: reply-path hook receives the kind as an index into this tuple).
+NET_FAULT_KINDS = ("corrupt", "truncate", "split", "coalesce", "stall",
+                   "dup_frame", "reset")
+
+#: stall pacing: bytes per trickle chunk and the inter-chunk sleep
+#: ceiling.  The whole stall is bounded (chunks are sized so a frame
+#: takes at most ~MAX_STALL_S) — the injector models a slow peer, not a
+#: hung one; the receiver's read deadline is what unbounded slowness
+#: would test, and that is covered by lowering the deadline in tests.
+STALL_CHUNK = 64
+MAX_STALL_S = 1.5
+
+_M_INJECTED = _metrics.counter("netfault.injected")
+
+
+class NetFaultPlan:
+    """Seeded per-send fault sampler — `durafs.FaultPlan` for the wire.
+    `rates` maps kind → probability; draws come off a private
+    Random(seed) and ALWAYS consume exactly two draws per send, so
+    fault placement is a pure function of the send index."""
+
+    def __init__(self, seed: int, rates: dict[str, float] | None = None):
+        bad = set(rates or ()) - set(NET_FAULT_KINDS)
+        if bad:
+            raise ValueError(f"unknown net fault kinds: {sorted(bad)}")
+        self.seed = seed
+        self.rates = dict(rates or {})
+        self._rng = random.Random(seed)
+
+    def draw(self) -> dict | None:
+        u, frac = self._rng.random(), self._rng.random()
+        acc = 0.0
+        for kind in NET_FAULT_KINDS:
+            acc += self.rates.get(kind, 0.0)
+            if u < acc:
+                return {"kind": kind, "frac": frac}
+        return None
+
+
+def corrupt_offsets(n: int, frac: float, index: int) -> list[int]:
+    """The deterministic corrupt-placement function: byte offsets to
+    flip in an n-byte framed send, derived purely from (n, frac,
+    send index) — shared by tests asserting byte-level replay
+    identity.  1–3 flips, anywhere in the frame (length prefix,
+    header, payload: the decoder owes safety everywhere)."""
+    rng = random.Random((index << 20) ^ int(frac * 1e6) ^ n)
+    nflips = 1 + rng.randrange(3)
+    return sorted({rng.randrange(n) for _ in range(nflips)})
+
+
+class WireFault:
+    """One injectable wire scope.  Thread-safe: many connections may
+    send through one scope; the armed FIFO / plan draw / send index are
+    taken under the lock, the (slow) byte-pushing itself is not."""
+
+    def __init__(self, scope: str = "", plan: NetFaultPlan | None = None,
+                 kinds: tuple = NET_FAULT_KINDS):
+        bad = set(kinds) - set(NET_FAULT_KINDS)
+        if bad:
+            raise ValueError(f"unknown net fault kinds: {sorted(bad)}")
+        self.scope = scope
+        self.plan = plan
+        self.kinds = tuple(kinds)
+        self._mu = threading.Lock()
+        self._armed: list[dict] = []      # FIFO of one-shot faults
+        self.send_index = 0               # framed sends THROUGH the scope
+        self.timeline: list[tuple] = []   # (send_index, kind, detail)
+        self.counts: dict[str, int] = {}
+
+    # ------------------------------------------------------------ arming
+
+    def arm(self, kind: str, frac: float = 0.5) -> None:
+        if kind not in NET_FAULT_KINDS:
+            raise ValueError(f"unknown net fault kind {kind!r}")
+        with self._mu:
+            self._armed.append({"kind": kind, "frac": frac})
+
+    def disarm(self) -> None:
+        """Drop armed-but-unfired faults (the nemesis restore tail)."""
+        with self._mu:
+            self._armed.clear()
+
+    # ----------------------------------------------------------- drawing
+
+    def _next_fault(self, nbytes: int):
+        """(send_index, fault|None) for the next framed send.  One
+        timeline row per INJECTED fault; the index advances per send
+        either way so placement replays identically."""
+        with self._mu:
+            idx = self.send_index
+            self.send_index += 1
+            fault = self._armed.pop(0) if self._armed else (
+                self.plan.draw() if self.plan is not None else None)
+            if fault is not None and fault["kind"] not in self.kinds:
+                fault = None
+            if fault is not None:
+                kind = fault["kind"]
+                self.counts[kind] = self.counts.get(kind, 0) + 1
+                self.timeline.append((idx, kind,
+                                      round(fault.get("frac", 0.5), 6),
+                                      nbytes))
+        if fault is not None:
+            _M_INJECTED.inc(key=fault["kind"])
+        return idx, fault
+
+    # ---------------------------------------------------------- injection
+
+    def send(self, sock, data: bytes, hold: bytearray | None = None,
+             dup_literal: bool = True):
+        """Push one fully-framed byte string (length prefix included)
+        through `sock`, applying at most one injected fault.
+
+        `hold` is the CONNECTION's coalesce buffer (the conn owns it;
+        a scope is shared across conns).  `dup_literal=False` is the
+        REPLY-direction mode: a literally-doubled reply would be
+        undetectable by any client (the fe reply wire has no request
+        ids — the next request would read the stale copy), so reply
+        paths send once and tear instead; request-direction dups stay
+        byte-identical replays the server dup filter must absorb.
+        Returns the action applied: None (clean), or the fault kind.
+        Raises ConnectionError after faults that tear the stream
+        (truncate/dup_frame/reset) so the caller treats the connection
+        as garbage — exactly the transport contract (the op may or may
+        not have been delivered)."""
+        if hold is not None and hold:
+            # Flush held bytes glued to the front of this send — the
+            # second half of a coalesce.
+            data = bytes(hold) + data
+            del hold[:]
+        idx, fault = self._next_fault(len(data))
+        if fault is None:
+            sock.sendall(data)
+            return None
+        kind = fault["kind"]
+        frac = fault.get("frac", 0.5)
+        if kind == "corrupt":
+            buf = bytearray(data)
+            for off in corrupt_offsets(len(buf), frac, idx):
+                buf[off] ^= 0xFF
+            sock.sendall(bytes(buf))
+            return kind
+        if kind == "truncate":
+            k = max(1, int(len(data) * min(max(frac, 0.01), 0.95)))
+            try:
+                sock.sendall(data[:k])
+            finally:
+                _close_quietly(sock)
+            raise ConnectionError(
+                f"netfault: truncated frame at byte {k}")
+        if kind == "split":
+            # Re-chunk across syscalls; frac picks the chunk size in
+            # [1, len/2] so at least two segments always result.
+            chunk = max(1, int(len(data) * min(max(frac, 0.02), 0.5)))
+            for i in range(0, len(data), chunk):
+                sock.sendall(data[i:i + chunk])
+            return kind
+        if kind == "coalesce":
+            if hold is None:
+                # No per-conn hold buffer (server reply path): degrade
+                # to a split so the recorded injection still has a real
+                # wire effect — the frame arrives re-chunked.
+                chunk = max(1, int(len(data)
+                                   * min(max(frac, 0.02), 0.5)))
+                for i in range(0, len(data), chunk):
+                    sock.sendall(data[i:i + chunk])
+                return kind
+            hold.extend(data)
+            return kind
+        if kind == "stall":
+            delay = min(0.3, 0.02 + frac * 0.08)
+            nchunks = max(2, min(len(data) // STALL_CHUNK + 1,
+                                 int(MAX_STALL_S / delay)))
+            chunk = max(STALL_CHUNK, len(data) // nchunks + 1)
+            for i in range(0, len(data), chunk):
+                sock.sendall(data[i:i + chunk])
+                if i + chunk < len(data):
+                    time.sleep(delay)
+            return kind
+        if kind == "dup_frame":
+            try:
+                sock.sendall(data)
+                if dup_literal:
+                    sock.sendall(data)
+            finally:
+                _close_quietly(sock)
+            raise ConnectionError("netfault: frame duplicated, conn torn")
+        if kind == "reset":
+            _close_quietly(sock)
+            raise ConnectionError("netfault: connection reset")
+        raise AssertionError(kind)  # unreachable: closed vocabulary
+
+
+def _close_quietly(sock) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+# ------------------------------------------------------------- registry
+#
+# Scope registry: the harness registers a WireFault per socket path
+# BEFORE clerks dial; FramedConn consults it at dial time, the servers
+# via set_netfault().  Process-local, test-scoped — reset() between
+# tests like transport.reset_pool().
+
+_reg_mu = threading.Lock()
+_registry: dict[str, WireFault] = {}
+
+
+def register(scope: str, wf: WireFault) -> WireFault:
+    with _reg_mu:
+        _registry[scope] = wf
+    return wf
+
+
+def unregister(scope: str) -> None:
+    with _reg_mu:
+        _registry.pop(scope, None)
+
+
+def for_addr(addr: str) -> WireFault | None:
+    with _reg_mu:
+        return _registry.get(addr)
+
+
+def reset() -> None:
+    """Drop every registered scope (test isolation helper)."""
+    with _reg_mu:
+        _registry.clear()

@@ -53,6 +53,7 @@ import time
 
 from tpu6824.obs import metrics as _metrics
 from tpu6824.obs import tracing as _tracing
+from tpu6824.rpc import netfault as _netfault
 from tpu6824.rpc import wire
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils import crashsink
@@ -79,9 +80,21 @@ _M_SRV_DROP_REP = _metrics.counter("rpc.server.dropped_replies")
 _M_POOL_HITS = _metrics.counter("rpc.pool.hits")
 _M_POOL_MISSES = _metrics.counter("rpc.pool.misses")
 _M_POOL_EVICT = _metrics.counter("rpc.pool.evictions")
+# Decode state-machine rejects (ISSUE 12, netfault): malformed,
+# truncated, oversized, or CRC-failed input handled as a CONNECTION-
+# scoped error — counted by reason, never a crash, a livelock, or a
+# wire-format demotion.  Shared by both transports' Python paths; the
+# C++ loop keeps its own counter (NativeServer.wire_rejected).
+_M_WIRE_REJ = _metrics.counter("rpc.wire.rejected")
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 64 << 20
+
+# Slow-loris bound (netfault `stall` defense): one frame must finish
+# arriving within this window or the connection is closed — per FRAME,
+# not per recv(), so a trickling peer cannot pin a serving thread and
+# its buffer indefinitely by staying just under the socket timeout.
+READ_DEADLINE = float(os.environ.get("TPU6824_WIRE_READ_DEADLINE", 30.0))
 
 # Pooled persistent connections are the default (see module docstring);
 # TPU6824_DIAL_PER_CALL=1 restores the reference's dial-per-call discipline
@@ -251,10 +264,22 @@ def _send_frame(sock: socket.socket, obj) -> None:
                     pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: float | None = None) -> bytes:
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                _M_WIRE_REJ.inc(key="read_deadline")
+                raise RPCError("frame read deadline exceeded (slow peer)")
+            sock.settimeout(min(30.0, remaining))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if deadline is None:
+                raise
+            continue  # re-check the frame deadline at the loop top
         if not chunk:
             raise RPCError("connection closed mid-frame")
         buf += chunk
@@ -266,6 +291,29 @@ def _recv_raw_frame(sock: socket.socket) -> bytes:
     if n > _MAX_FRAME:
         raise RPCError(f"frame too large: {n}")
     return _recv_exact(sock, n)
+
+
+def _recv_raw_frame_server(sock: socket.socket) -> bytes:
+    """The SERVER's framed read: the idle wait for a next request is
+    bounded by the socket timeout as before, but once the first byte of
+    a frame arrives the whole frame must complete within READ_DEADLINE
+    (netfault `stall` defense — a slow-loris trickling bytes just under
+    the socket timeout used to pin the serving thread indefinitely);
+    the rolling buffer stays bounded by the frame cap either way."""
+    sock.settimeout(30.0)
+    first = _recv_exact(sock, 1)
+    deadline = time.monotonic() + READ_DEADLINE
+    (n,) = _LEN.unpack(first + _recv_exact(sock, _LEN.size - 1, deadline))
+    if n > _MAX_FRAME:
+        _M_WIRE_REJ.inc(key="oversized")
+        raise RPCError(f"frame too large: {n}")
+    body = _recv_exact(sock, n, deadline)
+    # Restore the serving timeout NOW, not at the next read: the frame
+    # may have completed with only milliseconds of deadline left, and
+    # the handler's reply sendall runs on this same socket — it must
+    # not inherit a near-expired recv clamp.
+    sock.settimeout(30.0)
+    return body
 
 
 def _unpickle_frame(data: bytes):
@@ -293,10 +341,16 @@ class FramedConn:
     IO failure raises RPCError and the connection is garbage — redial,
     exactly the transport contract (the op may or may not have run)."""
 
-    __slots__ = ("addr", "sock", "_buf")
+    __slots__ = ("addr", "sock", "_buf", "_nf", "_nf_hold")
 
     def __init__(self, addr: str, timeout: float = 10.0):
         self.addr = addr
+        # netfault (ISSUE 12): a WireFault registered over this address
+        # intercepts every framed send — byte-level fault injection at
+        # the one client-side transport seam.  Looked up at dial time
+        # (the harness registers scopes before clerks dial).
+        self._nf = _netfault.for_addr(addr)
+        self._nf_hold = bytearray() if self._nf is not None else None
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
             self.sock.settimeout(timeout)
@@ -313,17 +367,20 @@ class FramedConn:
         self.sock.settimeout(t)
 
     def send(self, obj) -> None:
-        try:
-            _send_frame(self.sock, obj)
-        except OSError as e:
-            raise RPCError(f"send {self.addr}: {e}") from e
+        self.send_raw(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
 
     def send_raw(self, data: bytes) -> None:
         """Send a pre-encoded frame body (the versioned fe wire layout —
         rpc/wire.py — travels as raw bytes, not pickle)."""
+        if len(data) > _MAX_FRAME:
+            raise RPCError(f"frame too large to send: {len(data)}")
+        framed = _LEN.pack(len(data)) + data
         try:
-            _send_raw_frame(self.sock, data)
-        except OSError as e:
+            if self._nf is not None:
+                self._nf.send(self.sock, framed, hold=self._nf_hold)
+            else:
+                self.sock.sendall(framed)
+        except OSError as e:  # ConnectionError from an injected tear too
             raise RPCError(f"send {self.addr}: {e}") from e
 
     def _pop_frame(self):
@@ -333,6 +390,7 @@ class FramedConn:
             return None
         (n,) = _LEN.unpack_from(buf)
         if n > _MAX_FRAME:
+            _M_WIRE_REJ.inc(key="oversized")
             raise RPCError(f"frame too large: {n}")
         if len(buf) < _LEN.size + n:
             return None
@@ -341,10 +399,17 @@ class FramedConn:
         if wire.is_fe_frame(data):
             # fe wire reply/error frame: decoded by the shared schema
             # into the same (ok, payload) shape pickled replies carry.
-            return (wire.decode_any_reply(data),)
+            # A malformed/CRC-failed reply is a CONNECTION-scoped
+            # reject: counted, the caller tears and redials.
+            try:
+                return (wire.decode_any_reply(data),)
+            except RPCError:
+                _M_WIRE_REJ.inc(key="malformed_fe")
+                raise
         try:
             return (pickle.loads(data),)
         except Exception as e:
+            _M_WIRE_REJ.inc(key="undecodable")
             raise RPCError(f"undecodable frame: {e!r}") from e
 
     def recv(self):
@@ -482,6 +547,7 @@ class Server:
         self._handlers: dict[str, callable] = {}
         self._dead = threading.Event()
         self._unreliable = False
+        self._netfault = None  # WireFault over the reply path (ISSUE 12)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         # Requests served (paxos/paxos.go:539-542 rpccount; under
@@ -550,6 +616,31 @@ class Server:
         with self._lock:
             self._unreliable = flag
 
+    def set_netfault(self, wf) -> None:
+        """Attach a netfault.WireFault over this server's REPLY path:
+        every outbound reply frame consults it (byte-level injection on
+        the server→client direction; the client side injects through
+        FramedConn's registry lookup).  None detaches."""
+        self._netfault = wf
+
+    def _send_raw_reply(self, conn: socket.socket, data: bytes) -> None:
+        """One framed reply, through the netfault seam when armed.
+        Raises RPCError for an oversized frame BEFORE any bytes move
+        (the stream stays clean); injected tears raise ConnectionError
+        (an OSError), which callers already treat as a dead peer."""
+        if len(data) > _MAX_FRAME:
+            raise RPCError(f"frame too large to send: {len(data)}")
+        framed = _LEN.pack(len(data)) + data
+        wf = self._netfault
+        if wf is not None:
+            wf.send(conn, framed, dup_literal=False)
+        else:
+            conn.sendall(framed)
+
+    def _send_obj_reply(self, conn: socket.socket, obj) -> None:
+        self._send_raw_reply(
+            conn, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
     def deafen(self) -> None:
         """Remove the socket path out from under the live server: existing
         inode keeps listening but nobody can dial it
@@ -608,7 +699,7 @@ class Server:
             conn.settimeout(30.0)
             while not self._dead.is_set():
                 try:
-                    raw = _recv_raw_frame(conn)
+                    raw = _recv_raw_frame_server(conn)
                     native = wire.is_fe_frame(raw)
                     if native:
                         # Versioned fe wire frame (rpc/wire.py): the
@@ -617,7 +708,14 @@ class Server:
                         # schema contract, not a degraded dialect.
                         rpcname, args, wctx = "fe_batch", None, None
                     else:
-                        frame = _unpickle_frame(raw)
+                        try:
+                            frame = _unpickle_frame(raw)
+                        except RPCError:
+                            # Corrupt/garbled frame: connection-scoped
+                            # reject — counted, conn closed, the server
+                            # keeps serving everyone else.
+                            _M_WIRE_REJ.inc(key="undecodable")
+                            return
                         # Optional third element: a tpuscope TraceContext
                         # from a tracing-enabled peer (untagged 2-tuples
                         # are the common wire; see call()).
@@ -667,14 +765,14 @@ class Server:
                     conn.shutdown(socket.SHUT_WR)
                     return
                 try:
-                    _send_frame(conn, reply)
+                    self._send_obj_reply(conn, reply)
                 except OSError:
                     return  # peer gone / stream broken — nothing to salvage
                 except Exception as e:
                     # Unpicklable or oversized reply: dumps/size-check fail
                     # before any bytes move, so the stream is still clean —
                     # degrade to a string error instead of a silent hang.
-                    _send_frame(
+                    self._send_obj_reply(
                         conn, (False, f"unserializable reply ({e!r:.100}): "
                                       f"{reply[1]!r:.200}")
                     )
@@ -691,9 +789,12 @@ class Server:
         shared schema, run the registered `fe_batch` handler, reply in
         the SAME layout.  Returns False when the connection is done."""
         try:
-            ops, tc = wire.decode_batch(raw)
+            ops, tc, meta = wire.decode_batch_meta(raw)
         except RPCError as e:
-            _send_raw_frame(conn, wire.encode_error(str(e)))
+            # Malformed (incl. CRC mismatch): counted, answered with an
+            # explicit error — never a crash or a mis-applied op.
+            _M_WIRE_REJ.inc(key="malformed_fe")
+            self._send_raw_reply(conn, wire.encode_error(str(e)))
             return True
         fn = self._handlers.get("fe_batch")
         if fn is None:
@@ -705,7 +806,8 @@ class Server:
                         replies = fn(ops)
                 else:
                     replies = fn(ops)
-                out = wire.encode_replies(replies)
+                out = wire.encode_replies(replies,
+                                          crc=meta.get("crc", False))
             except RPCError:
                 return False  # transport-level refusal: drop, no reply
             except Exception as e:  # app-level error → fe error frame
@@ -717,14 +819,14 @@ class Server:
             conn.shutdown(socket.SHUT_WR)
             return False
         try:
-            _send_raw_frame(conn, out)
+            self._send_raw_reply(conn, out)
         except RPCError:
             # Reply past the frame cap: the size check fires before any
             # bytes move, so the stream is clean — degrade to an error
             # frame (the pickled path's unserializable-reply contract;
             # a silent drop would retry-livelock the clerk).
             try:
-                _send_raw_frame(conn, wire.encode_error(
+                self._send_raw_reply(conn, wire.encode_error(
                     "reply too large for one fe frame"))
             except OSError:
                 return False

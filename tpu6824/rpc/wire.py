@@ -32,12 +32,31 @@ flags bit 0 on a request: the optional tpuscope trace context
 frame-scoped.  kind and err are closed enums below; err 255 is the
 escape hatch (value bytes carry a pickled (err, value) pair) so exotic
 service replies survive the binary path without widening the enum.
+
+Capability-gated v1 extensions (ISSUE 12, netfault): two further flag
+bits add OPTIONAL header fields — bit 1 (`FLAG_DEADLINE`): a u32
+remaining-op-budget in milliseconds follows the trace context, so the
+server stops working on ops the clerk has already abandoned; bit 2
+(`FLAG_CRC`): a u32 crc32 (zlib) of the whole frame EXCLUDING the crc
+field itself follows, and reply frames echo the flag + their own crc.
+A v1 decoder that predates these bits would MIS-parse a frame carrying
+them, so a clerk only sets them when the endpoint's `fe_caps` probe
+advertised `fe_deadline` / `fe_crc` — a frame with neither flag is
+byte-identical to the original v1 layout, which is what keeps this a
+compatible extension rather than a version bump.  The CRC is the
+corruption DEFENSE the netfault injector exposes the need for: a byte
+flip landing in the cid/cseq/key/value region of an otherwise
+well-formed frame would silently alter an op (or poison the dup
+filter); with the flag on, both decoders reject the frame as a
+connection-scoped error instead — corruption never silently applies
+and never demotes the wire format.
 """
 
 from __future__ import annotations
 
 import pickle
 import struct
+import zlib
 
 from tpu6824.utils.errors import OK, ErrNoKey, ErrWrongGroup, RPCError
 
@@ -47,7 +66,10 @@ MAGIC_BATCH = b"FEB" + bytes([VERSION])
 MAGIC_REPLY = b"FER" + bytes([VERSION])
 MAGIC_ERROR = b"FEE" + bytes([VERSION])
 
-FLAG_TRACE = 1  # request flags bit 0: (trace_id, span_id) present
+FLAG_TRACE = 1     # request flags bit 0: (trace_id, span_id) present
+FLAG_DEADLINE = 2  # bit 1: u32 op-budget ms present (caps-gated)
+FLAG_CRC = 4       # bit 2: u32 frame crc32 present (caps-gated);
+#                    replies echo the flag + carry their own crc
 
 # Closed op-kind enum — the int32 the native decoder writes into the
 # kind column.  Order is part of the schema.
@@ -61,6 +83,7 @@ ERR_OTHER = 255
 
 _HDR = struct.Struct("<4sHH")            # magic, flags, nops
 _TC = struct.Struct("<QQ")               # trace_id, span_id
+_U32 = struct.Struct("<I")               # deadline_ms / crc32 fields
 _OP = struct.Struct("<BQqHI")            # kind, cid, cseq, klen, vlen
 _REP = struct.Struct("<BI")              # err, vlen
 _EHDR = struct.Struct("<4sI")            # magic, mlen
@@ -82,17 +105,46 @@ def is_fe_frame(buf: bytes) -> bool:
     return len(buf) >= 4 and buf[:2] == b"FE"
 
 
-def encode_batch(ops, tc=None) -> bytes:
+def _seal_crc(out: bytearray, crc_off: int) -> bytes:
+    """Stamp the frame's crc32 into the 4 reserved bytes at `crc_off`
+    (computed over every OTHER byte of the frame)."""
+    c = zlib.crc32(out[:crc_off])
+    c = zlib.crc32(out[crc_off + 4:], c)
+    out[crc_off:crc_off + 4] = _U32.pack(c & 0xFFFFFFFF)
+    return bytes(out)
+
+
+def _check_crc(buf: bytes, crc_off: int) -> bool:
+    (want,) = _U32.unpack_from(buf, crc_off)
+    c = zlib.crc32(buf[:crc_off])
+    c = zlib.crc32(buf[crc_off + 4:], c)
+    return (c & 0xFFFFFFFF) == want
+
+
+def encode_batch(ops, tc=None, deadline_ms=None, crc=False) -> bytes:
     """ops: iterable of (kind, key, value, cid, cseq[, tc]) wire tuples
     (per-op trailing tc elements are ignored — the fe frame's trace
-    context is frame-scoped, pass it as `tc`)."""
+    context is frame-scoped, pass it as `tc`).  `deadline_ms` / `crc`
+    add the caps-gated v1 extension fields — only pass them for an
+    endpoint whose fe_caps advertised `fe_deadline` / `fe_crc` (an old
+    decoder would mis-parse the extended header)."""
     ops = tuple(ops)
     if len(ops) > MAX_OPS:
         raise CapacityError(f"fe_batch too wide: {len(ops)} > {MAX_OPS}")
     flags = FLAG_TRACE if tc is not None else 0
+    if deadline_ms is not None:
+        flags |= FLAG_DEADLINE
+    if crc:
+        flags |= FLAG_CRC
     out = bytearray(_HDR.pack(MAGIC_BATCH, flags, len(ops)))
     if tc is not None:
         out += _TC.pack(int(tc[0]) & (2**64 - 1), int(tc[1]) & (2**64 - 1))
+    if deadline_ms is not None:
+        out += _U32.pack(max(0, min(int(deadline_ms), 0xFFFFFFFF)))
+    crc_off = None
+    if crc:
+        crc_off = len(out)
+        out += b"\x00\x00\x00\x00"
     for t in ops:
         kind, key, value, cid, cseq = t[:5]
         kb = key.encode() if isinstance(key, str) else bytes(key)
@@ -105,6 +157,8 @@ def encode_batch(ops, tc=None) -> bytes:
                         len(kb), len(vb))
         out += kb
         out += vb
+    if crc_off is not None:
+        return _seal_crc(out, crc_off)
     return bytes(out)
 
 
@@ -113,6 +167,16 @@ def decode_batch(buf: bytes):
     5-tuples (the classic fe_batch wire shape), tc the optional frame
     trace context.  This is the PYTHON decoder — the fallback servers'
     side of the schema; the native server never runs it."""
+    ops, tc, _meta = decode_batch_meta(buf)
+    return ops, tc
+
+
+def decode_batch_meta(buf: bytes):
+    """-> (ops, tc, meta) with meta = {"deadline_ms": int|None, "crc":
+    bool} — the server-side decoder: verifies the frame CRC when
+    present (mismatch is a malformed frame — a connection-scoped
+    reject, never a crash or a mis-applied op) and surfaces the
+    propagated op budget."""
     if buf[:4] != MAGIC_BATCH:
         if buf[:3] == MAGIC_BATCH[:3]:
             raise RPCError(f"fe_batch version {buf[3]} != {VERSION}")
@@ -120,11 +184,20 @@ def decode_batch(buf: bytes):
     _, flags, nops = _HDR.unpack_from(buf, 0)
     off = _HDR.size
     tc = None
-    if flags & FLAG_TRACE:
-        tc = _TC.unpack_from(buf, off)
-        off += _TC.size
-    ops = []
+    deadline_ms = None
+    has_crc = bool(flags & FLAG_CRC)
     try:
+        if flags & FLAG_TRACE:
+            tc = _TC.unpack_from(buf, off)
+            off += _TC.size
+        if flags & FLAG_DEADLINE:
+            (deadline_ms,) = _U32.unpack_from(buf, off)
+            off += _U32.size
+        if has_crc:
+            if len(buf) < off + 4 or not _check_crc(buf, off):
+                raise RPCError("fe_batch frame CRC mismatch")
+            off += _U32.size
+        ops = []
         for _ in range(nops):
             kind, cid, cseq, klen, vlen = _OP.unpack_from(buf, off)
             off += _OP.size
@@ -137,14 +210,21 @@ def decode_batch(buf: bytes):
         raise RPCError(f"malformed fe_batch frame: {e!r}") from e
     if off != len(buf):
         raise RPCError("trailing garbage in fe_batch frame")
-    return tuple(ops), tc
+    return tuple(ops), tc, {"deadline_ms": deadline_ms, "crc": has_crc}
 
 
-def encode_replies(replies) -> bytes:
+def encode_replies(replies, crc=False) -> bytes:
     """replies: iterable of (err, value) pairs (the kv reply shape).
-    Non-enum errs or non-str values take the pickled escape hatch."""
+    Non-enum errs or non-str values take the pickled escape hatch.
+    `crc=True` (echoing a request's FLAG_CRC) stamps the reply with
+    its own crc32 so reply-direction corruption is detectable too."""
     replies = tuple(replies)
-    out = bytearray(_HDR.pack(MAGIC_REPLY, 0, len(replies)))
+    out = bytearray(_HDR.pack(MAGIC_REPLY, FLAG_CRC if crc else 0,
+                              len(replies)))
+    crc_off = None
+    if crc:
+        crc_off = len(out)
+        out += b"\x00\x00\x00\x00"
     for rep in replies:
         code = None
         if isinstance(rep, tuple) and len(rep) == 2 and \
@@ -157,17 +237,26 @@ def encode_replies(replies) -> bytes:
             vb = pickle.dumps(rep, protocol=pickle.HIGHEST_PROTOCOL)
         out += _REP.pack(code, len(vb))
         out += vb
+    if crc_off is not None:
+        return _seal_crc(out, crc_off)
     return bytes(out)
 
 
 def decode_replies(buf: bytes):
-    """-> tuple of (err, value) reply pairs."""
+    """-> tuple of (err, value) reply pairs.  A reply carrying FLAG_CRC
+    is verified; a mismatch raises (the clerk tears the connection and
+    retries — at-most-once rests on the dup filter, as for any torn
+    reply)."""
     if buf[:4] != MAGIC_REPLY:
         raise RPCError("not an fe reply frame")
-    _, _, nops = _HDR.unpack_from(buf, 0)
+    _, flags, nops = _HDR.unpack_from(buf, 0)
     off = _HDR.size
     reps = []
     try:
+        if flags & FLAG_CRC:
+            if len(buf) < off + 4 or not _check_crc(buf, off):
+                raise RPCError("fe reply frame CRC mismatch")
+            off += _U32.size
         for _ in range(nops):
             err, vlen = _REP.unpack_from(buf, off)
             off += _REP.size
@@ -180,6 +269,11 @@ def decode_replies(buf: bytes):
     except (struct.error, IndexError, pickle.UnpicklingError,
             UnicodeDecodeError) as e:
         raise RPCError(f"malformed fe reply frame: {e!r}") from e
+    if off != len(buf):
+        # Exact-length discipline doubles as corruption armor: a flip
+        # that clears FLAG_CRC leaves the 4 crc bytes stranded in the
+        # record region, so the parse cannot land on the frame end.
+        raise RPCError("trailing garbage in fe reply frame")
     return tuple(reps)
 
 

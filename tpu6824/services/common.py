@@ -31,8 +31,17 @@ _sysrand = random.SystemRandom()
 # the in-process clerk↔server leg's fault-coin outcomes.
 _M_BACKOFFS = _metrics.counter("clerk.backoff.sleeps")
 _M_BACKOFF_US = _metrics.histogram("clerk.backoff.sleep_us")
+_M_BUDGET_WAITS = _metrics.counter("clerk.backoff.budget_waits")
 _M_FLAKY_DROP_REQ = _metrics.counter("clerk.flaky.dropped_requests")
 _M_FLAKY_DROP_REP = _metrics.counter("clerk.flaky.dropped_replies")
+
+# Retry BUDGET (ISSUE 12): sustained retries/sec a clerk may spend and
+# the burst it may front-load.  Generous enough that healthy traffic
+# and short blips never touch it (the jitter curve tops out near
+# 10/s–500/s only in pathological storms); a clerk stuck in a retry
+# storm decays to the sustained rate instead of amplifying.  0 disables.
+RETRY_BUDGET_RATE = float(os.environ.get("TPU6824_RETRY_BUDGET", 50.0))
+RETRY_BUDGET_BURST = float(os.environ.get("TPU6824_RETRY_BURST", 100.0))
 
 
 class Backoff:
@@ -51,15 +60,29 @@ class Backoff:
     the base again.
 
     Mode resolution: explicit `mode` arg > $TPU6824_CLERK_BACKOFF >
-    jitter.  `fixed` keeps the 10ms cadence (fidelity tests pin this);
-    unknown values fall back to jitter.  Each Backoff owns a seeded RNG,
-    so a seeded clerk's retry pattern is reproducible."""
+    jitter.  `fixed` keeps the 10ms cadence (fidelity tests pin this —
+    and skips the budget, reference fidelity being the point of the
+    mode); unknown values fall back to jitter.  Each Backoff owns a
+    seeded RNG, so a seeded clerk's retry pattern is reproducible.
+
+    Retry budget (ISSUE 12): each `sleep()` spends one token from a
+    per-clerk bucket (RETRY_BUDGET_BURST capacity, refilled at
+    RETRY_BUDGET_RATE/s).  An exhausted bucket stretches the sleep to
+    the token-accrual time, so a clerk's sustained retry rate can
+    never exceed the budget no matter what the backoff curve or the
+    failure pattern does — retry storms decay by construction instead
+    of amplifying (the 3× retry-collapse PR 8 fixed by schedule
+    becomes structurally impossible).  `reset()` resets the
+    exponential, NOT the bucket: the budget is a sustained-rate bound,
+    not a per-outage one."""
 
     FIXED_SLEEP = 0.01  # the reference cadence (fixed mode)
 
     def __init__(self, base: float = 0.002, cap: float = 0.1,
                  mode: str | None = None, seed: int | None = None,
-                 fixed_sleep: float = FIXED_SLEEP):
+                 fixed_sleep: float = FIXED_SLEEP,
+                 budget_rate: float | None = None,
+                 budget_burst: float | None = None):
         self.base = base
         self.cap = cap
         self.mode = mode or os.environ.get("TPU6824_CLERK_BACKOFF", "jitter")
@@ -67,6 +90,12 @@ class Backoff:
         self._rng = random.Random(seed) if seed is not None \
             else random.Random(_sysrand.getrandbits(62))
         self._sleep = base
+        self.budget_rate = RETRY_BUDGET_RATE if budget_rate is None \
+            else float(budget_rate)
+        self.budget_burst = RETRY_BUDGET_BURST if budget_burst is None \
+            else float(budget_burst)
+        self._tokens = self.budget_burst
+        self._refill_at = time.monotonic()
 
     def next_interval(self) -> float:
         if self.mode == "fixed":
@@ -75,11 +104,40 @@ class Backoff:
         self._sleep = s
         return s
 
+    def _budget_extend(self, dt: float) -> float:
+        """Spend one retry token (borrowing allowed); when the bucket
+        went dry, stretch `dt` to the accrual time of the debt — the
+        sleep itself refills the bucket (accounted by elapsed time at
+        the next call), so the sustained retry rate is exactly
+        budget_rate."""
+        if self.budget_rate <= 0 or self.mode == "fixed":
+            return dt
+        now = time.monotonic()
+        self._tokens = min(self.budget_burst,
+                           self._tokens
+                           + (now - self._refill_at) * self.budget_rate)
+        self._refill_at = now
+        self._tokens -= 1.0
+        # Debt floor: callers clamp sleeps to their remaining deadline
+        # (max_s), so the stretched interval may never actually be
+        # slept — without a floor, a long storm of clamped sleeps
+        # accrues unbounded debt and a later UNclamped sleep would
+        # block for all of it at once.  One burst of debt is the cap.
+        if self._tokens < -self.budget_burst:
+            self._tokens = -self.budget_burst
+        if self._tokens < 0.0:
+            need = -self._tokens / self.budget_rate
+            if need > dt:
+                _M_BUDGET_WAITS.inc()
+                dt = need
+        return dt
+
     def sleep(self, max_s: float | None = None) -> float:
-        """Sleep the next interval, clamped to `max_s` (callers pass their
-        remaining deadline so a capped 100ms backoff can never overshoot a
+        """Sleep the next interval — budget-extended when the retry
+        bucket is dry — clamped to `max_s` (callers pass their
+        remaining deadline so a stretched backoff can never overshoot a
         short op timeout)."""
-        dt = self.next_interval()
+        dt = self._budget_extend(self.next_interval())
         if max_s is not None:
             dt = max(0.0, min(dt, max_s))
         _M_BACKOFFS.inc()

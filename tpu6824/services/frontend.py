@@ -69,6 +69,15 @@ FE_BATCH = "fe_batch"
 # default wire-pipelining depth (cohorts per connection).
 OP_TIMEOUT = float(os.environ.get("TPU6824_FRONTEND_OP_TIMEOUT", 8.0))
 STREAM_DEPTH = int(os.environ.get("TPU6824_FRONTEND_DEPTH", 2))
+# Overload protection (ISSUE 12, TUNING round 16): the admission
+# watermark — total ops the frontend will hold in flight before it
+# SHEDS new frames with an explicit retryable error.  Shedding beats
+# the alternatives it replaces: an unbounded queue turns overload into
+# timeouts (the clerk can't tell shed from dead and burns its whole
+# budget), and the native ring's hard bounce fires only when the ring
+# is literally full.  The watermark is deliberately below the default
+# ring cap so the explicit shed answers first.
+MAX_INFLIGHT = int(os.environ.get("TPU6824_FE_MAX_INFLIGHT", 1 << 15))
 
 # tpuscope metrics (module scope per the metric-unregistered rule).
 _M_FRAMES = _metrics.counter("frontend.frames")
@@ -77,6 +86,13 @@ _M_WIDTH = _metrics.histogram("frontend.frame_width")
 _M_SUBMIT = _metrics.histogram("frontend.submit_ops")  # columnar batch size
 _M_RETRIES = _metrics.counter("frontend.retries")
 _M_TIMEOUTS = _metrics.counter("frontend.timeouts")
+# Overload protection (ISSUE 12): frames shed at the admission
+# watermark (explicit retryable error, not a timeout) and the live
+# inflight gauge the watchdog watches.  A propagated deadline needs no
+# counter of its own: it tightens the frame deadline, so expiry shows
+# up as frontend.timeouts — reached sooner, which is the point.
+_M_SHED = _metrics.counter("frontend.shed")
+_M_INFLIGHT = _metrics.gauge("frontend.inflight_ops")
 # Native zero-GIL ingest (ISSUE 11): the C++ loop's decode counters,
 # mirrored into the registry each engine pass so pulse/top/watchdog see
 # the native path (the inflight gauge is what queue-growth watches).
@@ -101,18 +117,25 @@ class _Frame:
 
     __slots__ = ("conn_id", "single", "ops", "gids", "futs", "replies",
                  "remaining", "deadline", "retry_at", "interval", "srv",
-                 "last_remaining", "native")
+                 "last_remaining", "native", "crc")
 
     def __init__(self, conn_id, single, nops, now, op_timeout,
-                 native=False):
+                 native=False, deadline_ms=None, crc=False):
         self.conn_id = conn_id
         self.single = single
         self.native = native  # arrived in the fe wire layout: reply in it
+        self.crc = crc        # request carried FLAG_CRC: echo it back
         self.ops = None
         self.gids = None            # per-slot target group index
         self.futs = [None] * nops
         self.replies = [_UNSET] * nops
         self.remaining = nops
+        # Deadline propagation (ISSUE 12): when the clerk's remaining op
+        # budget rode the frame header, the server works to THAT bound —
+        # never longer than its own op_timeout — so ops the clerk has
+        # already abandoned stop consuming proposals.
+        if deadline_ms:
+            op_timeout = min(op_timeout, deadline_ms / 1000.0)
         self.deadline = now + op_timeout
         # First failover attempt after a good slice of the op budget
         # (the pipelined clerk waits the WHOLE budget before failing
@@ -139,13 +162,16 @@ class _NFrame:
                  "deadline", "retry_at", "interval", "srv", "cur_srv",
                  "tickets", "last_pending")
 
-    def __init__(self, fid, conn_id, nops, tc, now, op_timeout):
+    def __init__(self, fid, conn_id, nops, tc, now, op_timeout,
+                 deadline_ms=0):
         self.fid = fid
         self.conn_id = conn_id
         self.nops = nops
         self.tc = tc
         self.gids = None
         self.tcs = None
+        if deadline_ms:  # propagated clerk budget (the _Frame rule)
+            op_timeout = min(op_timeout, deadline_ms / 1000.0)
         self.deadline = now + op_timeout
         self.interval = max(1.0, op_timeout / 4.0)  # the _Frame curve
         self.retry_at = now + self.interval
@@ -255,7 +281,8 @@ class ClerkFrontend:
                  op_timeout: float = OP_TIMEOUT, seed: int | None = None,
                  prefer_native: bool = True, op_factory=_kv_op,
                  groups=None, route=None,
-                 ingest_max_ops: int = 1 << 16):
+                 ingest_max_ops: int = 1 << 16,
+                 max_inflight: int | None = None):
         if groups is None:
             groups = [list(servers)]
         self.groups = [list(g) for g in groups]
@@ -264,6 +291,12 @@ class ClerkFrontend:
         self.addr = addr
         self.op_timeout = op_timeout
         self.op_factory = op_factory
+        # Admission control (ISSUE 12): total ops held in flight before
+        # new frames are shed with an explicit retryable error.
+        self.max_inflight = MAX_INFLIGHT if max_inflight is None \
+            else int(max_inflight)
+        self._inflight = 0  # Python-path ops admitted, engine-owned
+        self._rej_last = 0  # last-mirrored native wire_rejected count
         self._pending: deque = deque()   # (conn_id, ops_wire, wctx, single)
         self._doneq: deque = deque()     # resolved futures (sink hook)
         self._wake = threading.Event()
@@ -289,8 +322,20 @@ class ClerkFrontend:
             srv.register("get", self._get_blocking)
             srv.register("put_append", self._put_append_blocking)
         # Capability probe: clerks ask once per endpoint whether the
-        # versioned fe wire is spoken here ("no such rpc" = old peer).
-        srv.register("fe_caps", lambda: {"fe_wire": wire.VERSION})
+        # versioned fe wire is spoken here ("no such rpc" = old peer),
+        # and which caps-gated v1 extensions are safe to send: deadline
+        # propagation and frame CRC (ISSUE 12).  An old clerk ignores
+        # the extra keys; an old server's caps lack them, so a new
+        # clerk never sends a flag this endpoint cannot parse.
+        # `_ext_ok` gates the advertisement on the actual decoder: with
+        # C++ ingest enabled on a STALE .so that predates the extension
+        # flags, advertising them would make every extended frame
+        # "malformed" — a retry loop, not an interop path (set after
+        # enable_ingest below; the lambda reads it per probe).
+        self._ext_ok = True
+        srv.register("fe_caps", lambda: {"fe_wire": wire.VERSION,
+                                         "fe_deadline": self._ext_ok,
+                                         "fe_crc": self._ext_ok})
         # Observability plane (regular threaded handlers — pollers are
         # rare and must never touch the event loop): a fleet Collector
         # polls a live frontend process like any fabric process — the
@@ -319,6 +364,10 @@ class ClerkFrontend:
                                           self._wake_native)
                 self._ing_last = {"frames": 0, "ops": 0, "bytes": 0,
                                   "ring_full": 0, "done_ops": 0}
+                # The extension flags are parsed by the C++ decoder
+                # now; the netfault ABI ships in the same compilation
+                # unit, so its presence proves the lib is new enough.
+                self._ext_ok = hasattr(srv._lib, "rpcsrv_netfault_arm")
         self._engine = None
         if self.deferred:
             self._engine = threading.Thread(
@@ -331,26 +380,28 @@ class ClerkFrontend:
     # tpusan blocking-in-eventloop scope: decode + enqueue + wake ONLY.
 
     def _on_batch(self, conn_id, args, wctx) -> None:
-        self._pending.append((conn_id, args[0], wctx, False, False))
+        self._pending.append((conn_id, args[0], wctx, False, False, None))
         self._wake_engine()
 
-    def _on_native_batch(self, conn_id, ops, tc) -> None:
+    def _on_native_batch(self, conn_id, ops, tc, meta) -> None:
         # fe wire frame decoded in Python (C++ ingest off): same queue,
-        # native reply flag set so the answer leaves in the fe layout.
-        self._pending.append((conn_id, ops, tc, False, True))
+        # native reply flag set so the answer leaves in the fe layout
+        # (meta: propagated deadline + crc echo).
+        self._pending.append((conn_id, ops, tc, False, True, meta))
         self._wake_engine()
 
     def _on_get(self, conn_id, args, wctx) -> None:
         key, cid, cseq = args
         self._pending.append(
-            (conn_id, (("get", key, "", cid, cseq),), wctx, True, False))
+            (conn_id, (("get", key, "", cid, cseq),), wctx, True, False,
+             None))
         self._wake_engine()
 
     def _on_put_append(self, conn_id, args, wctx) -> None:
         kind, key, value, cid, cseq = args
         self._pending.append(
             (conn_id, ((kind, key, value, cid, cseq),), wctx, True,
-             False))
+             False, None))
         self._wake_engine()
 
     def _on_fut_done(self, fut) -> None:
@@ -398,6 +449,9 @@ class ClerkFrontend:
                 "done_queue": len(self._doneq),
                 "deferred": self.deferred,
                 "op_timeout": self.op_timeout,
+                "inflight_ops": self._inflight,
+                "max_inflight": self.max_inflight,
+                "wire_rejected": getattr(self._srv, "wire_rejected", 0),
                 "native_ingest": (ing.stats() if ing is not None
                                   else {"enabled": False}),
             },
@@ -484,10 +538,12 @@ class ClerkFrontend:
 
     def _finish(self, fr, live, futmap) -> None:
         live.pop(id(fr), None)
+        self._inflight -= len(fr.replies)
         for fut in fr.futs:
             self._unlink(futmap, fut, fr)
         if fr.native:
-            self._srv.send_reply_native(fr.conn_id, tuple(fr.replies))
+            self._srv.send_reply_native(fr.conn_id, tuple(fr.replies),
+                                        crc=fr.crc)
         else:
             payload = fr.replies[0] if fr.single else tuple(fr.replies)
             self._srv.send_reply(fr.conn_id, payload)
@@ -518,6 +574,7 @@ class ClerkFrontend:
 
     def _drop_frame(self, fr, live, futmap, msg) -> None:
         live.pop(id(fr), None)
+        self._inflight -= len(fr.replies)
         for slot, fut in enumerate(fr.futs):
             if fut is None:
                 continue
@@ -626,12 +683,18 @@ class ClerkFrontend:
         route = self._route
         key_str = ing.key_str
         tr = _tracing.enabled()
+        # Admission watermark over the native path: ops already held by
+        # live native frames, sampled once per pass (C++ tracks the
+        # authoritative count; the engine's view is one pass stale,
+        # which the watermark's headroom below the ring cap absorbs).
+        admitted = sum(nf.nops for nf in nframes.values())
         while True:
             got = ing.poll1()
             if got is None:
                 break
-            fid, conn_id, nops, tc, ka, ca, sa, kia, via = got
-            nf = _NFrame(fid, conn_id, nops, tc, now, self.op_timeout)
+            fid, conn_id, nops, tc, dl_ms, ka, ca, sa, kia, via = got
+            nf = _NFrame(fid, conn_id, nops, tc, now, self.op_timeout,
+                         deadline_ms=dl_ms)
             nf.kinds = ka.tolist()
             nf.cids = ca.tolist()
             nf.cseqs = sa.tolist()
@@ -639,6 +702,15 @@ class ClerkFrontend:
             nf.val_ids = via.tolist()
             nf.kid_arr = kia
             nf.vid_arr = via
+            if admitted + nops > self.max_inflight:
+                # Shed at the watermark (explicit retryable error) —
+                # BEFORE the ring's hard bounce; the frame's interns
+                # drop through the usual decref fence (no tickets).
+                _M_SHED.inc(nops)
+                ing.fail(fid, "frontend overloaded (shed): retry")
+                defer.append(nf)
+                continue
+            admitted += nops
             if multi:
                 try:
                     ng = len(self.groups)
@@ -842,7 +914,7 @@ class ClerkFrontend:
                 ngroups = len(self.groups)
                 while True:
                     try:
-                        conn_id, ops_wire, wctx, single, native = \
+                        conn_id, ops_wire, wctx, single, native, meta = \
                             pending.popleft()
                     except IndexError:
                         break
@@ -852,18 +924,30 @@ class ClerkFrontend:
                     # with an error, never kill the engine thread.
                     try:
                         nops = len(ops_wire)
+                        crc = bool(meta and meta.get("crc"))
                         if not single and nops == 0:
                             # Degenerate empty batch: answer now — a
                             # frame with no ops would otherwise park in
                             # `live` forever (nothing ever resolves it)
                             # and desync the connection's reply FIFO.
                             if native:
-                                self._srv.send_reply_native(conn_id, ())
+                                self._srv.send_reply_native(conn_id, (),
+                                                            crc=crc)
                             else:
                                 self._srv.send_reply(conn_id, ())
                             continue
+                        dl_ms = meta.get("deadline_ms") if meta else None
+                        if self._inflight + nops > self.max_inflight:
+                            # ADMISSION CONTROL (ISSUE 12): shed with an
+                            # explicit retryable error BEFORE anything
+                            # is proposed — overload must answer fast,
+                            # not convert into timeouts.
+                            _M_SHED.inc(nops)
+                            raise RPCError(
+                                "frontend overloaded (shed): retry")
                         fr = _Frame(conn_id, single, nops, now,
-                                    self.op_timeout, native=native)
+                                    self.op_timeout, native=native,
+                                    deadline_ms=dl_ms, crc=crc)
                         fr.ops = [self._make_op(t, wctx) for t in ops_wire]
                         if multi:
                             fr.gids = [route(op.key) for op in fr.ops]
@@ -875,7 +959,12 @@ class ClerkFrontend:
                         else:
                             fr.gids = [0] * nops
                     except Exception as e:  # noqa: BLE001 — bad frame ≠ dead loop
-                        msg = f"frontend: undecodable op tuple ({e!r:.100})"
+                        # RPCError carries an intentional, client-facing
+                        # message (shed / expired budget); anything else
+                        # is a genuinely undecodable frame.
+                        msg = str(e) if isinstance(e, RPCError) \
+                            else f"frontend: undecodable op tuple " \
+                                 f"({e!r:.100})"
                         if native:
                             self._srv.send_error_native(conn_id, msg)
                         else:
@@ -883,6 +972,7 @@ class ClerkFrontend:
                         continue
                     _M_FRAMES.inc()
                     _M_WIDTH.observe(len(ops_wire))
+                    self._inflight += nops
                     live[id(fr)] = fr
                     for i, op in enumerate(fr.ops):
                         batch_ops.append(op)
@@ -926,6 +1016,16 @@ class ClerkFrontend:
                     else:
                         fr.last_remaining = fr.remaining
                         self._retry_frame(fr, now, futmap)
+            # Overload visibility: the Python-path inflight gauge (the
+            # native path mirrors its own through _mirror_ingest), and
+            # the C++ decode state machine's reject counter mirrored
+            # into rpc.wire.rejected (delta-inc, one lock per pass).
+            _M_INFLIGHT.set(self._inflight)
+            rej = getattr(self._srv, "wire_rejected", 0)
+            if rej > self._rej_last:
+                transport._M_WIRE_REJ.inc(rej - self._rej_last,
+                                          key="native")
+                self._rej_last = rej
 
     # ------------------------------------------- blocking fallback path
 
@@ -1053,6 +1153,14 @@ class FrontendClerk:
         # permanently demote an endpoint.
         self.wire_format = wire_format
         self._fmt: dict[str, str] = {}
+        # Per-endpoint capability dict from the fe_caps probe: which
+        # caps-gated v1 extensions (deadline propagation, frame CRC)
+        # are safe to send to this address (ISSUE 12).
+        self._caps: dict[str, dict] = {}
+        # The retry BUDGET rides the Backoff (services/common.py): a
+        # clerk in a retry storm decays to the sustained token rate
+        # instead of amplifying — 3×-collapse-by-retry is impossible by
+        # construction, not by schedule tuning.
         self._backoff = Backoff()
         self._i = 0
 
@@ -1085,10 +1193,19 @@ class FrontendClerk:
             raise payload
         raise RPCError(f"{addr}: {payload}")
 
-    def _request_native(self, addr, ops, tc=None):
+    def _request_native(self, addr, ops, tc=None, budget_s=None):
         conn = self._connect(addr)
+        caps = self._caps.get(addr) or {}
+        deadline_ms = None
+        if budget_s is not None and caps.get("fe_deadline"):
+            # Deadline propagation: the server stops working on this
+            # frame once OUR remaining budget is gone (floored at 1ms —
+            # 0 is the expired-on-arrival sentinel).
+            deadline_ms = max(1, int(budget_s * 1000))
         try:
-            conn.send_raw(wire.encode_batch(ops, tc=tc))
+            conn.send_raw(wire.encode_batch(
+                ops, tc=tc, deadline_ms=deadline_ms,
+                crc=bool(caps.get("fe_crc"))))
             ok, payload = conn.recv()
         except RPCError:
             self._teardown()
@@ -1099,7 +1216,10 @@ class FrontendClerk:
 
     def _format_for(self, addr) -> str:
         """The frame format this endpoint speaks: pinned, cached, or
-        probed once via fe_caps (one extra round-trip per endpoint)."""
+        probed once via fe_caps (one extra round-trip per endpoint).
+        The caps dict also gates the v1 extension flags (deadline /
+        crc) — "native"-pinned clerks that never probed simply send
+        plain v1 frames."""
         if self.wire_format != "auto":
             return self.wire_format
         fmt = self._fmt.get(addr)
@@ -1107,8 +1227,12 @@ class FrontendClerk:
             return fmt
         try:
             caps = self._request(addr, ("fe_caps", ()))
-            fmt = "native" if isinstance(caps, dict) \
-                and caps.get("fe_wire") == wire.VERSION else "pickle"
+            if isinstance(caps, dict) \
+                    and caps.get("fe_wire") == wire.VERSION:
+                fmt = "native"
+                self._caps[addr] = caps
+            else:
+                fmt = "pickle"
         except RPCError as e:
             if "no such rpc" not in str(e):
                 raise  # transport failure: do NOT cache a demotion
@@ -1126,6 +1250,11 @@ class FrontendClerk:
         try:
             while True:
                 addr = self.addrs[self._i % len(self.addrs)]
+                # The budget that rides the frame header (deadline
+                # propagation): our remaining deadline, else the
+                # per-request socket budget.
+                budget_s = (deadline - time.monotonic()) if deadline \
+                    else self.timeout
                 try:
                     if addr in self._legacy:
                         return self._single_op(addr, op_tuple, sp)
@@ -1139,7 +1268,8 @@ class FrontendClerk:
                             if fmt == "native":
                                 try:
                                     replies = self._request_native(
-                                        addr, (op_tuple,), tc=ctx)
+                                        addr, (op_tuple,), tc=ctx,
+                                        budget_s=budget_s)
                                 except wire.CapacityError:
                                     # Op too big for the fe layout
                                     # (key > u16): this one request
@@ -1158,8 +1288,8 @@ class FrontendClerk:
                                 rsp.end()
                     elif fmt == "native":
                         try:
-                            replies = self._request_native(addr,
-                                                           (op_tuple,))
+                            replies = self._request_native(
+                                addr, (op_tuple,), budget_s=budget_s)
                         except wire.CapacityError:
                             replies = self._request(
                                 addr, (FE_BATCH, ((op_tuple,),)))
@@ -1249,9 +1379,13 @@ class FrontendStream:
         self.depth = depth
         # "auto": one fe_caps probe on the first dial decides whether
         # frames go out in the versioned fe wire layout (zero-GIL server
-        # decode) or as classic pickled fe_batch tuples.
+        # decode) or as classic pickled fe_batch tuples.  The probe's
+        # caps dict also gates the v1 extension flags (deadline
+        # propagation + frame CRC, ISSUE 12); pinned "native" sends
+        # plain v1 frames (no probe ran, so no extension is known-safe).
         self._native = {"native": True, "pickle": False,
                         "auto": None}[wire_format]
+        self._caps: dict = {}
         self.clients = [[fresh_cid(), 0] for _ in range(width)]
         # conn ci, cohort k owns clients {c : c ≡ ci·depth+k (mod C·D)}.
         self._cohorts = [
@@ -1293,7 +1427,11 @@ class FrontendStream:
 
         def send_frame(ci, ops):
             if self._native:
-                conns[ci].send_raw(wire.encode_batch(ops))
+                caps = self._caps
+                dl = max(1, int(self.op_timeout * 1000)) \
+                    if caps.get("fe_deadline") else None
+                conns[ci].send_raw(wire.encode_batch(
+                    ops, deadline_ms=dl, crc=bool(caps.get("fe_crc"))))
             else:
                 conns[ci].send((FE_BATCH, (ops,)))
 
@@ -1318,6 +1456,8 @@ class FrontendStream:
                 self._native = bool(ok and isinstance(caps, dict)
                                     and caps.get("fe_wire")
                                     == wire.VERSION)
+                if self._native:
+                    self._caps = caps
             requeue = list(inflight[ci])
             inflight[ci].clear()
             for k, ops, took, _ in requeue:

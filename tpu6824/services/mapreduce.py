@@ -161,6 +161,11 @@ class Master:
         ndone = 0
 
         def dispatch():
+            # tpusan: ok(unbounded-retry) — paced by the blocking
+            # workers.get(): a failed worker is NOT returned to the
+            # pool, so each retry waits for a DIFFERENT idle worker to
+            # register — the pool, not a clock, is the bound (the
+            # reference's master semantics, mapreduce/master.go).
             while True:
                 try:
                     i = task_q.get_nowait()
