@@ -384,6 +384,16 @@ def child_main():
         except Exception as e:  # noqa: BLE001
             service["overload"] = {"value": 0.0, "error": repr(e)[:200]}
         service["overload"]["tpuscope"] = _tpuscope_delta(leg0)
+        # Transaction leg (ISSUE 13, txnkv): cross-shard 2PC transfer
+        # mix at configurable contention — commits/s, abort fraction,
+        # p99 commit latency, conserved-sum asserted.
+        _spin(env, "txn")
+        leg0 = _tpuscope_begin()
+        try:
+            service["txn"] = _txn_rate()
+        except Exception as e:  # noqa: BLE001
+            service["txn"] = {"value": 0.0, "error": repr(e)[:200]}
+        service["txn"]["tpuscope"] = _tpuscope_delta(leg0)
         # Durability leg (durafault): recovery-time percentiles, gated by
         # benchdiff like every throughput leg.
         _spin(env, "recovery")
@@ -1559,6 +1569,119 @@ def _overload_rate():
             for s in cl:
                 s.dead = True
         fab.stop_clock()
+
+
+def _txn_rate():
+    """service.txn (ISSUE 13): cross-shard transfer throughput through
+    the 2PC-over-Paxos transaction layer at CONFIGURABLE contention.
+    `BENCH_TXN_ACCOUNTS` accounts spread across `BENCH_TXN_GROUPS`
+    shardkv groups; `BENCH_TXN_CLIENTS` clerks run optimistic-CAS
+    transfers between random account pairs for `BENCH_TXN_SECONDS`.
+    Reports commits/s (the headline), the abort fraction (optimistic
+    retries + lock conflicts — rises as accounts shrink), p50/p99
+    commit latency, and the conserved transfer-sum invariant check (a
+    bench run that lost money is an ERROR, not a number)."""
+    import random as _random
+    import threading as _th
+
+    import numpy as _np
+
+    from tpu6824.core.fabric import PaxosFabric  # noqa: F401 (env guard)
+    from tpu6824.services import txnkv
+    from tpu6824.services.shardkv import ShardSystem
+
+    G = int(os.environ.get("BENCH_TXN_GROUPS", 2))
+    naccounts = int(os.environ.get("BENCH_TXN_ACCOUNTS", 16))
+    nclients = int(os.environ.get("BENCH_TXN_CLIENTS", 4))
+    seconds = float(os.environ.get("BENCH_TXN_SECONDS", 2.0))
+    system = ShardSystem(ngroups=G, nreplicas=3, ninstances=256,
+                         fabric_kw=dict(io_mode="compact",
+                                        steps_per_dispatch=1,
+                                        pipeline_depth=2))
+    try:
+        for gid in system.gids:
+            system.join(gid)
+        system.clerk().put("warm", "1")
+        # Account keys spread over the shard space by first byte.
+        accounts = [chr(ord("a") + (i % 26)) + f"cct{i}"
+                    for i in range(naccounts)]
+        init = txnkv.TxnClerk(system.sm_servers, system.directory)
+        for a in accounts:
+            assert init.multi_cas([(a, "", "1000")]), a
+        total0 = naccounts * 1000
+        stop = _th.Event()
+        commits = [0] * nclients
+        aborts = [0] * nclients
+        lats: list[list[float]] = [[] for _ in range(nclients)]
+        errs: list = []
+
+        def run(ci):
+            rng = _random.Random(1000 + ci)
+            ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+            try:
+                while not stop.is_set():
+                    src, dst = rng.sample(accounts, 2)
+                    t0 = time.perf_counter()
+                    try:
+                        snap = ck.read([src, dst], timeout=10.0)
+                        a = int(snap.get(src) or 0)
+                        b = int(snap.get(dst) or 0)
+                        amt = rng.randint(1, 10)
+                        ok = ck.multi_cas(
+                            [(src, snap.get(src, ""), str(a - amt)),
+                             (dst, snap.get(dst, ""), str(b + amt))],
+                            timeout=10.0)
+                    except Exception as e:  # noqa: BLE001 — counted
+                        errs.append(repr(e)[:120])
+                        continue
+                    if ok:
+                        commits[ci] += 1
+                        lats[ci].append(time.perf_counter() - t0)
+                    else:
+                        aborts[ci] += 1
+            except Exception as e:  # noqa: BLE001 — surface, don't hang
+                errs.append(repr(e)[:200])
+
+        ts = [_th.Thread(target=run, args=(ci,), daemon=True)
+              for ci in range(nclients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in ts:
+            t.join(timeout=60)
+        dt = time.perf_counter() - t0
+        ncommit = sum(commits)
+        nabort = sum(aborts)
+        final = txnkv.TxnClerk(system.sm_servers, system.directory)
+        total1 = 0
+        for a in accounts:
+            total1 += int(final.read([a], timeout=15.0).get(a) or 0)
+        assert total1 == total0, \
+            f"transfer sum NOT conserved: {total0} -> {total1}"
+        all_lats = sorted(x for sub in lats for x in sub)
+        lat = {}
+        if all_lats:
+            arr = _np.array(all_lats)
+            lat = {"p50_ms": round(float(_np.percentile(arr, 50)) * 1e3, 2),
+                   "p99_ms": round(float(_np.percentile(arr, 99)) * 1e3, 2)}
+        return {
+            "value": round(ncommit / dt, 1),
+            "commits": ncommit,
+            "abort_frac": round(nabort / max(1, ncommit + nabort), 4),
+            "latency": lat,
+            "sum_conserved": True,
+            "client_errors": len(errs),
+            "shape": {"groups": G, "accounts": naccounts,
+                      "clients": nclients},
+            "note": ("cross-shard 2PC transfers (optimistic CAS); value "
+                     "= commits/s; abort_frac counts CAS/lock retries; "
+                     "the transfer-sum invariant is ASSERTED"),
+            "knobs": "BENCH_TXN_GROUPS/ACCOUNTS/CLIENTS/SECONDS",
+        }
+    finally:
+        system.shutdown()
 
 
 def _recovery_rate():

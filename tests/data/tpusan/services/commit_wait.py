@@ -1,0 +1,27 @@
+"""Golden: blocking-commit-wait — waiting on a cross-group RPC/future
+while holding the server mutex or inside the apply path (the classic
+2PC deadlock shape: A's apply blocks on B, B's on A, both logs jam)."""
+
+import threading
+
+
+class TwoPCServer:
+    def __init__(self, peers):
+        self.mu = threading.Lock()
+        self.peers = peers
+        self.prepared = {}
+
+    def _apply_commit(self, op):
+        # FINDING: consulting the coordinator group from INSIDE the
+        # apply path — the replica can't drain its log past this op
+        # until another group answers.
+        peer = self.peers[0]
+        decision = peer.txn_status(op.tid)
+        return decision
+
+    def commit(self, fut, op):
+        with self.mu:
+            # FINDING: parking on a cross-group future under mu — every
+            # clerk op on this server now queues behind a remote group.
+            fut.wait(1.0)
+            self.prepared.pop(op.tid, None)

@@ -34,7 +34,10 @@ def test_one_json_line_with_required_keys():
                    "BENCH_FE_SWEEP": "2x32", "BENCH_FE_SECONDS": "1",
                    "BENCH_OVERLOAD_SECONDS": "1",
                    "BENCH_OVERLOAD_WIDTH": "32",
-                   "BENCH_OVERLOAD_CONNS": "2"})
+                   "BENCH_OVERLOAD_CONNS": "2",
+                   "BENCH_TXN_SECONDS": "1",
+                   "BENCH_TXN_ACCOUNTS": "6",
+                   "BENCH_TXN_CLIENTS": "2"})
     assert r.returncode == 0, r.stderr[-500:]
     lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, r.stdout
@@ -104,6 +107,19 @@ def test_one_json_line_with_required_keys():
         assert 0.0 <= leg["shed_frac"] <= 1.0, leg
     assert ov["goodput_4x_frac"] > 0, ov
     assert ov["shape"]["max_inflight"] >= 1, ov
+    # Transaction provenance (ISSUE 13, txnkv): every recorded run must
+    # carry the txn leg — cross-shard 2PC commit throughput, the abort
+    # fraction at the recorded contention, commit-latency percentiles,
+    # the leg's own shape, and the ASSERTED conserved-sum invariant —
+    # or the atomicity layer's cost has no artifact trail and benchdiff
+    # cannot gate the new entries.
+    tx = d["service"]["txn"]
+    assert "error" not in tx, tx
+    assert tx["value"] > 0 and tx["commits"] > 0, tx
+    assert 0.0 <= tx["abort_frac"] <= 1.0, tx
+    assert tx["sum_conserved"] is True, tx
+    assert tx["latency"]["p99_ms"] >= tx["latency"]["p50_ms"] > 0, tx
+    assert tx["shape"]["accounts"] >= 2 and tx["shape"]["clients"] >= 1
     # Durability provenance (ISSUE 7, durafault): every recorded run
     # must carry the recovery leg — restore-from-snapshot wall-time
     # percentiles + snapshot footprint — or recovery-time regressions

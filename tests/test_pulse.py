@@ -39,6 +39,7 @@ from tpu6824.obs import watchdog as obs_watchdog
 from tpu6824.obs.collector import Collector, local_handle
 from tpu6824.obs.pulse import Pulse
 from tpu6824.obs.watchdog import (
+    AbortStorm,
     DroppedClimbing,
     JitRecompile,
     LatencySpike,
@@ -288,6 +289,78 @@ def test_watchdog_retry_storm_control_stays_silent(tmp_path):
         time.sleep(0.02)
         p.sample_once()
     assert not wd.incidents, wd.incidents
+
+
+def test_watchdog_abort_storm(tmp_path):
+    """ISSUE 13 satellite: txn aborts climbing while commits fall fires
+    the abort-storm rule against a seeded synthetic condition (the 2PC
+    layer burning its work on lock conflicts instead of committing)."""
+    commits = obs_metrics.counter("txn.commit")
+    aborts = obs_metrics.counter("txn.abort")
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[AbortStorm(min_rate=10.0)],
+                  window=60.0, cooldown=60.0).start()
+    p.sample_once()
+    for _ in range(4):  # healthy half: commits flow, trickle of aborts
+        commits.inc(200)
+        aborts.inc(2)
+        time.sleep(0.02)
+        p.sample_once()
+    assert not wd.incidents
+    for _ in range(4):  # the storm: aborts amplify, commits collapse
+        commits.inc(5)
+        aborts.inc(150)
+        time.sleep(0.02)
+        p.sample_once()
+    assert wd.incidents, "abort storm not detected"
+    inc = wd.incidents[0]
+    assert inc["rule"] == "abort-storm"
+    assert "aborts climbed" in inc["reason"]
+    assert os.path.exists(inc["path"])
+
+
+def test_watchdog_abort_storm_control_stays_silent(tmp_path):
+    """The fault-free control: healthy commit flow with the ordinary
+    optimistic-CAS abort trickle — and even a commit dip WITHOUT an
+    abort climb — must not fire (the storm needs both halves)."""
+    commits = obs_metrics.counter("txn.commit")
+    aborts = obs_metrics.counter("txn.abort")
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[AbortStorm(min_rate=10.0)],
+                  window=60.0, cooldown=0.0).start()
+    p.sample_once()
+    for _ in range(8):  # healthy contention: commits dominate
+        commits.inc(200)
+        aborts.inc(4)
+        time.sleep(0.02)
+        p.sample_once()
+    for _ in range(4):  # quiet tail: both rates fall together
+        time.sleep(0.02)
+        p.sample_once()
+    assert not wd.incidents, wd.incidents
+
+
+def test_queue_growth_watches_txn_inflight(tmp_path):
+    """ISSUE 13 satellite: the txn.inflight gauge is wired into the
+    existing queue-growth rule — transactions piling up (prepares
+    outliving their resolvers) trips the same consumer-falling-behind
+    watchdog as a stuck feed or reply ring."""
+    g = obs_metrics.gauge("txn.inflight")
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[QueueGrowth(limit=50.0)],
+                  window=60.0, cooldown=60.0).start()
+    for depth in (2, 4, 8):  # growing but under the limit: silent
+        g.set(depth)
+        p.sample_once()
+    assert not wd.incidents
+    for depth in (80, 160, 320):
+        g.set(depth)
+        p.sample_once()
+    assert wd.incidents and wd.incidents[0]["rule"] == "queue-growth"
+    assert "txn.inflight" in wd.incidents[0]["reason"]
 
 
 def test_watchdog_thread_crashes_and_cooldown(tmp_path):

@@ -1,0 +1,1146 @@
+"""txnkv acceptance (ISSUE 13): cross-group atomic transactions — 2PC
+over Paxos groups, safe under live reconfiguration.
+
+Covers:
+  - the protocol (commit, CAS abort, lock conflicts, idempotency);
+  - recovery — kill-mid-commit (locks held, no decision) resolved by
+    the participant resolvers + first-writer-wins coordinator log, on
+    BOTH sides of the commit point;
+  - reconfiguration safety — a shard migrating mid-commit carries its
+    prepared-lock table in XState.txn; the new owner blocks the keys
+    (ErrTxnLocked, never a dirty read) until the coordinator record
+    resolves them; the pre-reconfig donor answers ErrWrongGroup (the
+    fix-en-route semantics) and inherited prepares survive
+    requeue/abandon;
+  - the transactional Wing–Gong checker, proven BOTH ways (passes
+    correct histories; catches a synthetic partial commit, a dirty
+    read, and a LIVE injected half-applied transaction via the
+    `_test_partial_commit` hook, PR 3 style);
+  - the ClerkFrontend WIRE path (caps-gated txn frame kinds; pre-txn
+    endpoints refuse loudly; plain ops interop unchanged);
+  - trace chain begin→prepare→commit→reply + jitguard zero
+    steady-state recompiles under txn traffic;
+  - the fixed-seed composite nemesis smoke (partition + kill/revive +
+    unreliable + reconfiguration + kill_mid_commit under ONE
+    CompositeTarget schedule) with the checker green, the transfer sum
+    conserved, and replay identity — and the slow full-matrix soaks on
+    both kernel engines adding byte-level wire faults on the frontend
+    path.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpu6824.harness.nemesis import (
+    CompositeTarget,
+    FabricTarget,
+    FaultSchedule,
+    Nemesis,
+    NetTarget,
+    TxnKillTarget,
+    seed_from_env,
+)
+from tpu6824.harness.txn_check import (
+    TxnRecord,
+    check_txn_history,
+    kv_record,
+)
+from tpu6824.ops.hashing import key2shard
+from tpu6824.services import txnkv
+from tpu6824.services.shardkv import ShardSystem
+from tpu6824.utils.errors import (
+    OK,
+    ErrTxnAbort,
+    ErrTxnLocked,
+    ErrWrongGroup,
+    RPCError,
+)
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+# ----------------------------------------------------------- helpers
+
+
+def _system(ngroups=2, **kw):
+    system = ShardSystem(ngroups=ngroups, nreplicas=3,
+                         ninstances=kw.pop("ninstances", 48), **kw)
+    for gid in system.gids:
+        system.join(gid)
+    system.clerk().put("warm", "1")
+    return system
+
+
+def _cross_keys(system, suffix="k"):
+    """One key owned by each of the system's first two groups (shard =
+    first byte % NSHARDS, so vary the first character)."""
+    cfg = system.sm_clerk().query(-1)
+    g0, g1 = system.gids[0], system.gids[1]
+    keyA = keyB = None
+    for i in range(26):
+        k = chr(ord("a") + i) + suffix
+        if cfg.shards[key2shard(k)] == g0 and keyA is None:
+            keyA = k
+        if cfg.shards[key2shard(k)] == g1 and keyB is None:
+            keyB = k
+    assert keyA and keyB, (keyA, keyB, cfg.shards)
+    return keyA, keyB
+
+
+def _set_resolver_pace(system, resolve=0.2, inherited=0.05, abort=0.6):
+    for grp in system.groups.values():
+        for s in grp:
+            s.txn_resolve_after = resolve
+            s.txn_resolve_inherited = inherited
+            s.txn_abort_after = abort
+
+
+def _all_servers(system):
+    return [s for grp in system.groups.values() for s in grp]
+
+
+def _wait_no_locks(system, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not any(s.txn_prepared for s in _all_servers(system)):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ------------------------------------------------------------ payloads
+
+
+def test_payload_roundtrip():
+    p = txnkv.encode_prepare("t1", 101, ("g101-0", "g101-1"),
+                             [("k", "cas", "new", "old")])
+    d = txnkv.decode_payload(p)
+    assert d["tid"] == "t1" and d["coord"] == 101
+    assert d["coord_srv"] == ["g101-0", "g101-1"]
+    assert d["ops"] == [["k", "cas", "new", "old"]]
+    assert txnkv.decode_payload(txnkv.encode_coord("t2", "abort")) == \
+        {"tid": "t2", "decision": "abort"}
+    assert txnkv.decode_payload(txnkv.encode_finish("t3")) == {"tid": "t3"}
+
+
+# ------------------------------------------------------- the protocol
+
+
+def test_txn_commit_transfer_and_atomic_read():
+    system = _system()
+    try:
+        keyA, keyB = _cross_keys(system)
+        hist = txnkv.TxnHistory()
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory,
+                            history=hist)
+        assert ck.multi_cas([(keyA, "", "100"), (keyB, "", "100")])
+        assert ck.transfer(keyA, keyB, 30)
+        snap = ck.read([keyA, keyB])
+        assert snap == {keyA: "70", keyB: "130"}, snap
+        res = check_txn_history(hist)
+        assert res.ok, res.describe()
+    finally:
+        system.shutdown()
+
+
+def test_cas_mismatch_aborts_atomically():
+    system = _system()
+    try:
+        keyA, keyB = _cross_keys(system)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyA, "", "5"), (keyB, "", "5")])
+        # Wrong expectation on keyB: NOTHING may change, incl. keyA.
+        assert not ck.multi_cas([(keyA, "5", "6"), (keyB, "99", "7")])
+        snap = ck.read([keyA, keyB])
+        assert snap == {keyA: "5", keyB: "5"}, snap
+        assert _wait_no_locks(system), "abort left locks behind"
+    finally:
+        system.shutdown()
+
+
+def test_lock_conflict_blocks_and_releases():
+    """A prepared transaction's keys answer ErrTxnLocked to ordinary
+    ops (NOT recorded — the same cseq succeeds after release), and the
+    ordinary clerk rides its Backoff budget straight through the
+    window."""
+    system = _system()
+    try:
+        keyA, keyB = _cross_keys(system)
+        _set_resolver_pace(system, resolve=0.3, abort=0.9)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyA, "", "1"), (keyB, "", "1")])
+        killer = txnkv.MidCommitKiller()
+        ck.mid_commit_hook = killer
+        killer.arm("keep")
+        with pytest.raises(txnkv.TxnAbandoned):
+            ck.multi_cas([(keyA, "1", "2"), (keyB, "1", "2")])
+        ck.mid_commit_hook = None
+        # Direct probe: the lock error surface, not recorded.
+        srv = next(s for s in _all_servers(system)
+                   if s.txn_locks.get(keyA))
+        err, _ = srv.get(keyA, "lockprobe", 1)
+        assert err == ErrTxnLocked
+        # The ordinary clerk blocks through the lock window and then
+        # serves — the resolver aborts the abandoned txn underneath.
+        val = system.clerk().get(keyA, timeout=30.0)
+        assert val == "1", val
+        # Same (cid, cseq) retried post-release must SERVE (the locked
+        # reply was never recorded in the dup filter).
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            err, val = srv.get(keyA, "lockprobe", 1)
+            if err == OK:
+                break
+            time.sleep(0.05)
+        assert err == OK and val == "1", (err, val)
+    finally:
+        system.shutdown()
+
+
+def test_kill_mid_commit_resolver_aborts():
+    """No coordinator decision + dead clerk → the resolvers race an
+    ABORT into the coordinator log and release every lock; the balances
+    stay untouched and traffic resumes."""
+    system = _system()
+    try:
+        keyA, keyB = _cross_keys(system)
+        _set_resolver_pace(system)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyA, "", "100"), (keyB, "", "100")])
+        killer = txnkv.MidCommitKiller()
+        ck.mid_commit_hook = killer
+        killer.arm("dirty")
+        with pytest.raises(txnkv.TxnAbandoned):
+            ck.transfer(keyA, keyB, 10)
+        ck.mid_commit_hook = None
+        assert killer.fired and killer.fired[0][1] == "dirty"
+        snap = ck.read([keyA, keyB], timeout=30.0)
+        assert snap == {keyA: "100", keyB: "100"}, snap
+        assert ck.transfer(keyA, keyB, 25)
+        assert ck.read([keyA, keyB]) == {keyA: "75", keyB: "125"}
+    finally:
+        system.shutdown()
+
+
+def test_commit_record_wins_over_recovery_abort():
+    """The coordinator record is the single commit point: when the
+    decision COMMIT is already in the coordinator log (clerk died right
+    after writing it, before any finish op), the resolvers must COMMIT
+    the prepared writes at every group — a recovery abort may not win,
+    and the outcome is atomic."""
+    system = _system()
+    try:
+        keyA, keyB = _cross_keys(system)
+        # Slow resolvers: WE place the decision first.
+        _set_resolver_pace(system, resolve=30.0, inherited=30.0,
+                           abort=60.0)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyA, "", "50"), (keyB, "", "50")])
+        killer = txnkv.MidCommitKiller()
+        ck.mid_commit_hook = killer
+        killer.arm("keep")
+        with pytest.raises(txnkv.TxnAbandoned):
+            ck.multi_cas([(keyA, "50", "10"), (keyB, "50", "90")])
+        ck.mid_commit_hook = None
+        tid = killer.fired[0][0]
+        srv = next(s for s in _all_servers(system)
+                   if tid in s.txn_prepared)
+        # "The clerk's commit barely landed": the decision enters the
+        # coordinator group's log...
+        d = txnkv.decide_at_coordinator(srv, srv.txn_prepared[tid],
+                                        tid, "commit")
+        assert d == "commit", d
+        # ...and a late recovery-ABORT attempt must read COMMIT back.
+        d2 = txnkv.decide_at_coordinator(srv, srv.txn_prepared[tid],
+                                         tid, "abort")
+        assert d2 == "commit", d2
+        _set_resolver_pace(system, resolve=0.0, inherited=0.0, abort=60.0)
+        deadline = time.monotonic() + 30.0
+        snap = None
+        while time.monotonic() < deadline:
+            try:
+                snap = ck.read([keyA, keyB], timeout=5.0)
+                break
+            except Exception:
+                time.sleep(0.1)
+        assert snap == {keyA: "10", keyB: "90"}, snap
+    finally:
+        system.shutdown()
+
+
+# ---------------------------------------------- reconfiguration safety
+
+
+def test_reconfig_mid_commit_inherited_prepare_commits():
+    """A shard migrating MID-COMMIT carries its prepared-lock rows in
+    XState.txn: the new owner re-locks the keys (ErrTxnLocked — never a
+    stale serve), the donor answers ErrWrongGroup (fix-en-route
+    semantics pinned), and the coordinator record resolves the
+    inherited prepare atomically."""
+    system = _system()
+    try:
+        g0, g1 = system.gids
+        keyA, keyB = _cross_keys(system)
+        _set_resolver_pace(system, resolve=30.0, inherited=30.0,
+                           abort=60.0)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyA, "", "100"), (keyB, "", "100")])
+        killer = txnkv.MidCommitKiller()
+        ck.mid_commit_hook = killer
+        killer.arm("dirty")
+        with pytest.raises(txnkv.TxnAbandoned):
+            ck.multi_cas([(keyA, "100", "60"), (keyB, "100", "140")])
+        ck.mid_commit_hook = None
+        tid = killer.fired[0][0]
+        # Reconfigure MID-COMMIT: g1 leaves; its shards (incl. the
+        # locked keyB) migrate to g0 with the prepared rows aboard.
+        system.leave(g1)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if any(s.txn_locks.get(keyB) == tid
+                   for s in system.groups[g0]):
+                break
+            time.sleep(0.05)
+        s0 = next(s for s in system.groups[g0]
+                  if s.txn_locks.get(keyB) == tid)
+        # New owner: locked, not wrong-group; donor: wrong-group.
+        err, _ = s0.get(keyB, "rprobe", 1)
+        assert err == ErrTxnLocked, err
+        err, _ = system.groups[g1][0].get(keyB, "rprobe2", 1)
+        assert err == ErrWrongGroup, err
+        ent = s0.txn_prepared[tid]
+        assert any(t[0] == keyB for t in ent["ops"])
+        d = txnkv.decide_at_coordinator(s0, ent, tid, "commit")
+        assert d == "commit", d
+        _set_resolver_pace(system, resolve=0.0, inherited=0.0)
+        deadline = time.monotonic() + 30.0
+        snap = None
+        while time.monotonic() < deadline:
+            try:
+                snap = ck.read([keyA, keyB], timeout=5.0)
+                break
+            except Exception:
+                time.sleep(0.1)
+        assert snap == {keyA: "60", keyB: "140"}, snap
+    finally:
+        system.shutdown()
+
+
+def test_reconfig_inherited_flag_when_recipient_not_participant():
+    """A single-group transaction whose keys migrate to a group that
+    had NO part in it installs a fresh inherited entry (inherited=True,
+    counted) — and the resolver aborts it when no decision exists."""
+    from tpu6824.obs import metrics as obs_metrics
+
+    system = _system()
+    try:
+        g0, g1 = system.gids
+        _, keyB = _cross_keys(system)
+        _set_resolver_pace(system, resolve=30.0, inherited=30.0,
+                           abort=60.0)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyB, "", "7")])
+        killer = txnkv.MidCommitKiller()
+        ck.mid_commit_hook = killer
+        killer.arm("keep")
+        with pytest.raises(txnkv.TxnAbandoned):
+            ck.multi_cas([(keyB, "7", "8")])
+        ck.mid_commit_hook = None
+        tid = killer.fired[0][0]
+        base = obs_metrics.counter("txn.inherited_prepares").total
+        system.leave(g1)
+        deadline = time.monotonic() + 20.0
+        ent = None
+        while time.monotonic() < deadline:
+            for s in system.groups[g0]:
+                got = s.txn_prepared.get(tid)
+                if got is not None:
+                    ent = got
+                    break
+            if ent is not None:
+                break
+            time.sleep(0.05)
+        assert ent is not None and ent["inherited"] is True, ent
+        assert obs_metrics.counter("txn.inherited_prepares").total > base
+        # No decision anywhere → the inheritor's resolver aborts it and
+        # the key serves its pre-txn value.
+        _set_resolver_pace(system, resolve=0.1, inherited=0.05,
+                           abort=0.3)
+        deadline = time.monotonic() + 30.0
+        val = None
+        while time.monotonic() < deadline:
+            err, val = system.groups[g0][0].get(keyB, "iprobe", 1)
+            if err == OK:
+                break
+            time.sleep(0.05)
+        assert (err, val) == (OK, "7"), (err, val)
+    finally:
+        system.shutdown()
+
+
+def test_inherited_prepare_survives_requeue_and_abandon():
+    """Fix-en-route regression (ISSUE 13): the prepared-lock table is
+    RSM state — dropping a parked waiter (`abandon`) or losing a
+    proposal slot must never release a lock or forget a prepare; and a
+    finish op routed by a migrated key applies by tid, never answering
+    ErrWrongGroup from the submit fast-path."""
+    system = _system()
+    try:
+        keyA, keyB = _cross_keys(system)
+        _set_resolver_pace(system, resolve=30.0, inherited=30.0,
+                           abort=60.0)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyA, "", "3"), (keyB, "", "3")])
+        killer = txnkv.MidCommitKiller()
+        ck.mid_commit_hook = killer
+        killer.arm("keep")
+        with pytest.raises(txnkv.TxnAbandoned):
+            ck.multi_cas([(keyA, "3", "4"), (keyB, "3", "4")])
+        ck.mid_commit_hook = None
+        tid = killer.fired[0][0]
+        srv = next(s for s in _all_servers(system)
+                   if tid in s.txn_prepared)
+        # Abandoning every conceivable waiter leaves the RSM state
+        # (locks + prepared entry) fully intact.
+        srv.abandon(ck.cid, 999999)
+        srv.abandon(f"txr-{tid}", 1)
+        assert tid in srv.txn_prepared
+        assert srv.txn_locks, "abandon released a prepared lock"
+        # A finish op with a routing key this group does NOT own must
+        # still apply (tid-keyed, no ownership fast-path).
+        foreign = keyA if not srv._owns(keyA) else keyB
+        assert not srv._owns(foreign)
+        d = txnkv.decide_at_coordinator(srv, srv.txn_prepared[tid],
+                                        tid, "abort")
+        assert d == "abort"
+        err, val = srv.txn_op("txn_abort", foreign,
+                              txnkv.encode_finish(tid), "fin-probe", 1)
+        assert err == OK and val == "abort", (err, val)
+        assert tid not in srv.txn_prepared
+        assert not srv.txn_locks
+    finally:
+        system.shutdown()
+
+
+def test_migrate_back_prunes_stale_prepared_entry():
+    """Review regression (ISSUE 13): a shard that migrates AWAY (its
+    2PC state resolving at the new owner), takes further committed
+    writes, and migrates BACK must not let the original owner's stale
+    prepared entry re-apply old buffered writes over the newer state —
+    the reconf import treats the incoming XState.txn as the
+    authoritative surviving set and prunes local leftovers for the
+    imported shards."""
+    system = _system()
+    try:
+        g0, g1 = system.gids
+        _, keyB = _cross_keys(system, suffix="mb")  # owned by g1
+        _set_resolver_pace(system, resolve=30.0, inherited=30.0,
+                           abort=60.0)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyB, "", "old")])
+        killer = txnkv.MidCommitKiller()
+        ck.mid_commit_hook = killer
+        killer.arm("keep")
+        with pytest.raises(txnkv.TxnAbandoned):
+            ck.multi_cas([(keyB, "old", "TXN")])
+        ck.mid_commit_hook = None
+        tid = killer.fired[0][0]
+        srv1 = next(s for s in system.groups[g1]
+                    if tid in s.txn_prepared)
+        # The decision is COMMIT — eternal in the coordinator log.
+        assert txnkv.decide_at_coordinator(
+            srv1, srv1.txn_prepared[tid], tid, "commit") == "commit"
+        # Shard migrates AWAY: g0 inherits T; let ONLY g0 resolve it.
+        system.leave(g1)
+        for s in system.groups[g0]:
+            s.txn_resolve_after = 0.0
+            s.txn_resolve_inherited = 0.0
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(tid not in s.txn_prepared
+                   for s in system.groups[g0]) \
+                    and system.groups[g0][0].kv.get(keyB) == "TXN":
+                break
+            time.sleep(0.05)
+        assert system.groups[g0][0].kv.get(keyB) == "TXN"
+        # A NEWER committed write lands while g0 owns the shard...
+        ck2 = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck2.multi_cas([(keyB, "TXN", "NEWER")])
+        # ...and the shard migrates BACK to g1, which still holds the
+        # stale prepared entry for T (its resolvers were slowed).
+        assert any(tid in s.txn_prepared for s in system.groups[g1])
+        system.join(g1)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(s.config.num >= 4 for s in system.groups[g1]):
+                break
+            time.sleep(0.05)
+        # The import PRUNED the stale entry — no resolver can ever
+        # re-apply T's buffered write over NEWER.
+        assert all(tid not in s.txn_prepared
+                   for s in system.groups[g1]), [
+            (s.name, list(s.txn_prepared)) for s in system.groups[g1]]
+        assert all(s.txn_locks.get(keyB) is None
+                   for s in system.groups[g1])
+        _set_resolver_pace(system, resolve=0.0, inherited=0.0)
+        time.sleep(0.5)  # any stale resolver pass gets its chance
+        assert ck.read([keyB], timeout=30.0) == {keyB: "NEWER"}
+    finally:
+        system.shutdown()
+
+
+def test_same_tid_prepare_portions_never_alias():
+    """Fix-en-route regression (ISSUE 13, caught by the pallas soak):
+    a same-tid prepare carrying DIFFERENT sub-ops is not a replay.  A
+    stale route can land group B's portion on group A — answering
+    group A's recorded reads for group B's keys committed reads of the
+    WRONG keys (the partial-read hole).  The mis-routed portion must
+    run the ownership gauntlet (ErrWrongGroup here); a portion the
+    group genuinely owns merges instead."""
+    system = _system()
+    try:
+        keyA, keyB = _cross_keys(system, suffix="z")
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyA, "", "11"), (keyB, "", "22")])
+        srv = next(s for s in _all_servers(system) if s._owns(keyA))
+        coord_srv = [s.name for s in _all_servers(system)
+                     if s.gid == srv.gid]
+        tid = "t-alias-test"
+        # Portion 1: keyA (owned) — votes OK with keyA's read.
+        err, val = srv.txn_op(
+            "txn_prepare", keyA,
+            txnkv.encode_prepare(tid, srv.gid, coord_srv,
+                                 [(keyA, "read", "", "")]),
+            "alias-cid", 1)
+        assert err == OK and json.loads(val) == {keyA: "11"}
+        # Portion 2, same tid, keyB (NOT owned here): must answer
+        # ErrWrongGroup — NEVER portion 1's reads.
+        err, val = srv.txn_op(
+            "txn_prepare", keyB,
+            txnkv.encode_prepare(tid, srv.gid, coord_srv,
+                                 [(keyB, "read", "", "")]),
+            "alias-cid", 2)
+        assert err == ErrWrongGroup, (err, val)
+        # A second portion the group DOES own merges (reads for the
+        # incoming keys only), and the entry covers both.
+        keyA2 = next(chr(ord("a") + i) + "z2" for i in range(26)
+                     if srv._owns(chr(ord("a") + i) + "z2"))
+        srv.put_append(keyA2, "put", "33", "alias-seed", 1)
+        err, val = srv.txn_op(
+            "txn_prepare", keyA2,
+            txnkv.encode_prepare(tid, srv.gid, coord_srv,
+                                 [(keyA2, "read", "", "")]),
+            "alias-cid", 3)
+        assert err == OK and json.loads(val) == {keyA2: "33"}, (err, val)
+        ent = srv.txn_prepared[tid]
+        assert {t[0] for t in ent["ops"]} == {keyA, keyA2}
+        assert ent["reads"] == {keyA: "11", keyA2: "33"}
+        # Exact replay of portion 1 (fresh cseq, identical ops... the
+        # entry is merged now, so the dup filter no longer answers) —
+        # the merged entry still answers idempotently for owned keys.
+        err, _ = srv.txn_op("txn_abort", keyA,
+                            txnkv.encode_finish(tid), "alias-cid", 4)
+        assert err == OK
+        assert tid not in srv.txn_prepared and not srv.txn_locks
+    finally:
+        system.shutdown()
+
+
+def test_reconfig_with_mixed_cid_dup_table():
+    """Fix-en-route regression (ISSUE 13): frontend-submitted ops carry
+    INT cids while this wire's native clerks use strings; the first
+    reconfiguration over such a mixed dup table used to kill the
+    shardkv ticker (TypeError in the XState sort) and wedge the config
+    walk forever.  A reconfig over mixed-type cids must complete and
+    carry the dup rows across."""
+    import tempfile
+
+    from tpu6824.services.frontend import ClerkFrontend, FrontendClerk, \
+        shardkv_op
+    from tpu6824.utils import crashsink
+
+    tmp = tempfile.mkdtemp(prefix="mixcid")
+    system = _system()
+    fe = router = None
+    try:
+        g0, g1 = system.gids
+        router = txnkv.ConfigRouter(system.sm_servers, system.gids)
+        fe = ClerkFrontend(groups=[system.groups[g0], system.groups[g1]],
+                           addr=os.path.join(tmp, "fe.sock"),
+                           op_factory=shardkv_op, route=router.route)
+        keyA, keyB = _cross_keys(system, suffix="m")
+        fc = FrontendClerk([fe.addr])   # INT cid into the dup table
+        fc.put(keyB, "mixed")
+        sck = system.clerk()            # STRING cid into the same table
+        sck.put(keyA, "native")
+        crashes0 = crashsink.summary().get("count", 0)
+        system.leave(g1)                # reconfig must sort the mix
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if all(s.config.num >= 3 for s in system.groups[g0]):
+                break
+            time.sleep(0.05)
+        assert all(s.config.num >= 3 for s in system.groups[g0]), \
+            "reconfiguration never completed over a mixed-cid dup table"
+        assert crashsink.summary().get("count", 0) == crashes0, \
+            crashsink.summary()
+        assert sck.get(keyB, timeout=30.0) == "mixed"
+        fc.close()
+    finally:
+        if router is not None:
+            router.stop()
+        if fe is not None:
+            fe.kill()
+        system.shutdown()
+
+
+# ------------------------------------------------------- the checker
+
+
+def _t(client, ops, call, ret, status="committed"):
+    return TxnRecord(client=client, ops=tuple(ops), call=call, ret=ret,
+                     status=status)
+
+
+def test_checker_passes_correct_concurrent_transfers():
+    h = [
+        _t(0, [("w", "a", "100"), ("w", "b", "100")], 0.0, 1.0),
+        _t(1, [("r", "a", "100"), ("r", "b", "100"),
+               ("w", "a", "70"), ("w", "b", "130")], 1.5, 2.5),
+        _t(2, [("r", "a", "70"), ("r", "b", "130"),
+               ("w", "a", "90"), ("w", "b", "110")], 2.0, 3.5),
+        _t(0, [("r", "a", "90"), ("r", "b", "110")], 4.0, 5.0),
+    ]
+    res = check_txn_history(h)
+    assert res.ok, res.describe()
+
+
+def test_checker_catches_partial_commit():
+    """T1 atomically writes a=70/b=130 — a later read seeing a=70 with
+    b STILL 100 is a half-applied transaction: no serial order of
+    atomic transactions produces it."""
+    h = [
+        _t(0, [("w", "a", "100"), ("w", "b", "100")], 0.0, 1.0),
+        _t(1, [("w", "a", "70"), ("w", "b", "130")], 1.5, 2.5),
+        _t(2, [("r", "a", "70"), ("r", "b", "100")], 3.0, 4.0),
+    ]
+    res = check_txn_history(h)
+    assert not res.ok
+    assert res.violations, res.describe()
+
+
+def test_checker_catches_dirty_read():
+    """A value only an ABORTED transaction wrote can never be observed
+    — aborted transactions have no effect by definition."""
+    h = [
+        _t(0, [("w", "a", "1")], 0.0, 1.0),
+        _t(1, [("w", "a", "666")], 1.5, 2.5, status="aborted"),
+        _t(2, [("r", "a", "666")], 3.0, 4.0),
+    ]
+    res = check_txn_history(h)
+    assert not res.ok and res.violations, res.describe()
+
+
+def test_checker_unknown_fate_both_ways():
+    """An unknown-fate transaction may have applied or not — BOTH
+    subsequent observations are legal."""
+    base = [
+        _t(0, [("w", "a", "1")], 0.0, 1.0),
+        _t(1, [("w", "a", "2")], 1.5, None, status="unknown"),
+    ]
+    applied = base + [_t(2, [("r", "a", "2")], 3.0, 4.0)]
+    dropped = base + [_t(2, [("r", "a", "1")], 3.0, 4.0)]
+    assert check_txn_history(applied).ok
+    assert check_txn_history(dropped).ok
+    # ...but an observation NEITHER fate explains still fails.
+    neither = base + [_t(2, [("r", "a", "3")], 3.0, 4.0)]
+    assert not check_txn_history(neither).ok
+
+
+def test_checker_components_are_independent():
+    """Key-disjoint transactions partition into separate components
+    (the generalized P-compositionality): a violation in one names
+    only that component."""
+    h = [
+        _t(0, [("w", "a", "1"), ("w", "b", "1")], 0.0, 1.0),
+        _t(1, [("w", "x", "1")], 0.0, 1.0),
+        _t(2, [("r", "x", "WRONG")], 2.0, 3.0),
+    ]
+    res = check_txn_history(h)
+    assert not res.ok
+    assert len(res.results) == 2
+    bad = res.violations
+    assert len(bad) == 1 and "x" in bad[0].keys
+    good = [r for r in res.results if r.ok]
+    assert len(good) == 1 and set(good[0].keys) == {"a", "b"}
+
+
+def test_checker_adapts_plain_kv_records():
+    from tpu6824.harness.linearize import OpRecord
+
+    recs = [
+        kv_record(OpRecord(0, "put", "k", "v1", None, 0.0, 1.0)),
+        kv_record(OpRecord(1, "append", "k", "+2", None, 1.5, 2.5)),
+        kv_record(OpRecord(2, "get", "k", "", "v1+2", 3.0, 4.0)),
+    ]
+    assert check_txn_history(recs).ok
+    bad = recs[:2] + [
+        kv_record(OpRecord(2, "get", "k", "", "nope", 3.0, 4.0))]
+    assert not check_txn_history(bad).ok
+
+
+def test_checker_catches_live_injected_partial_commit():
+    """PR 3-style acceptance: the `_test_partial_commit` hook makes ONE
+    group drop its committed writes — a real half-applied transaction.
+    The recorded history + final reads must FAIL the transactional
+    checker (and the conserved-sum invariant breaks), proving the
+    checker catches the violation class this subsystem exists to
+    prevent."""
+    system = _system()
+    try:
+        g0, g1 = system.gids
+        keyA, keyB = _cross_keys(system)
+        hist = txnkv.TxnHistory()
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory,
+                            history=hist)
+        assert ck.multi_cas([(keyA, "", "100"), (keyB, "", "100")])
+        # Break atomicity on g1 only: its commits release locks but
+        # drop the writes.
+        for s in system.groups[g1]:
+            s._test_partial_commit = True
+        assert ck.transfer(keyA, keyB, 40)  # "commits"...
+        snap = ck.read([keyA, keyB])
+        # ...but the money vanished on the broken group.
+        total = int(snap[keyA]) + int(snap[keyB])
+        assert total != 200, "hook failed to break atomicity"
+        res = check_txn_history(hist)
+        assert not res.ok, (
+            "transactional checker MISSED an injected partial commit:\n"
+            + res.describe())
+        assert res.violations, res.describe()
+    finally:
+        system.shutdown()
+
+
+# ------------------------------------------------------- the wire path
+
+
+def test_txn_through_frontend_wire():
+    """Acceptance: transactions flow through the ClerkFrontend's
+    multi-group route= machinery as caps-gated txn frame kinds, plain
+    clerk traffic rides the same socket unchanged, and a txn-less
+    endpoint (kvpaxos frontend: no fe_txn cap) refuses transactions
+    loudly while serving everything else."""
+    import tempfile
+
+    from tpu6824.core.fabric import PaxosFabric
+    from tpu6824.services.frontend import (
+        ClerkFrontend,
+        FrontendClerk,
+        shardkv_op,
+    )
+    from tpu6824.services.kvpaxos import KVPaxosServer
+
+    tmp = tempfile.mkdtemp(prefix="txnfe")
+    system = _system()
+    fe = router = kvfab = kvfe = None
+    kvsrv = []
+    try:
+        g0, g1 = system.gids
+        router = txnkv.ConfigRouter(system.sm_servers, system.gids)
+        fe = ClerkFrontend(groups=[system.groups[g0], system.groups[g1]],
+                           addr=os.path.join(tmp, "fe.sock"),
+                           op_factory=shardkv_op, route=router.route)
+        keyA, keyB = _cross_keys(system, suffix="w")
+        hist = txnkv.TxnHistory()
+        tc = txnkv.TxnFrontendClerk([fe.addr], system.sm_servers,
+                                    system.gids, history=hist)
+        assert tc.multi_cas([(keyA, "", "500"), (keyB, "", "500")])
+        assert tc.transfer(keyA, keyB, 123)
+        assert tc.read([keyA, keyB]) == {keyA: "377", keyB: "623"}
+        # Plain clerk ops interop on the SAME endpoint, unchanged.
+        fc = FrontendClerk([fe.addr])
+        fc.put(keyA + "p", "v")
+        assert fc.get(keyA + "p") == "v"
+        caps = fc._txn_caps(fe.addr)
+        assert caps.get("fe_txn") is True and caps["fe_wire"] == 1
+        fc.close()
+        res = check_txn_history(hist)
+        assert res.ok, res.describe()
+        # A kvpaxos frontend never advertises fe_txn: transactions are
+        # refused LOUDLY (old/txn-less endpoints never see a txn
+        # frame), plain ops serve as ever.
+        kvfab = PaxosFabric(ngroups=1, npeers=3, ninstances=32,
+                            auto_step=True)
+        kvsrv = [KVPaxosServer(kvfab, 0, p) for p in range(3)]
+        kvfe = ClerkFrontend(kvsrv, os.path.join(tmp, "kv.sock"))
+        kfc = FrontendClerk([kvfe.addr])
+        assert kfc._txn_caps(kvfe.addr).get("fe_txn") is False
+        with pytest.raises(RPCError, match="no transaction support"):
+            kfc.txn_call(("txn_prepare", "k",
+                          txnkv.encode_prepare("t", 0, (), ()), 1, 1))
+        kfc.put("plain", "ok")
+        assert kfc.get("plain") == "ok"
+        kfc.close()
+        tc.close()
+    finally:
+        if kvfe is not None:
+            kvfe.kill()
+        for s in kvsrv:
+            s.kill()
+        if kvfab is not None:
+            kvfab.stop_clock()
+        if router is not None:
+            router.stop()
+        if fe is not None:
+            fe.kill()
+        system.shutdown()
+
+
+def test_txn_wire_pickled_fallback():
+    """wire_format='pickle' pins the pickled fe_batch form — txn kinds
+    ride it too (still caps-gated on fe_txn), so the binary layout is
+    an optimization, not a requirement."""
+    import tempfile
+
+    from tpu6824.services.frontend import ClerkFrontend, shardkv_op
+
+    tmp = tempfile.mkdtemp(prefix="txnpk")
+    system = _system()
+    fe = router = None
+    try:
+        g0, g1 = system.gids
+        router = txnkv.ConfigRouter(system.sm_servers, system.gids)
+        fe = ClerkFrontend(groups=[system.groups[g0], system.groups[g1]],
+                           addr=os.path.join(tmp, "fe.sock"),
+                           op_factory=shardkv_op, route=router.route)
+        keyA, keyB = _cross_keys(system, suffix="q")
+        tc = txnkv.TxnFrontendClerk([fe.addr], system.sm_servers,
+                                    system.gids, wire_format="pickle")
+        assert tc.multi_cas([(keyA, "", "10"), (keyB, "", "10")])
+        assert tc.transfer(keyA, keyB, 3)
+        assert tc.read([keyA, keyB]) == {keyA: "7", keyB: "13"}
+        tc.close()
+    finally:
+        if router is not None:
+            router.stop()
+        if fe is not None:
+            fe.kill()
+        system.shutdown()
+
+
+def test_txn_wire_kinds_encode_roundtrip():
+    from tpu6824.rpc import wire
+
+    ops = (("txn_prepare", "akey",
+            txnkv.encode_prepare("t9", 100, ("g100-0",),
+                                 [("akey", "cas", "2", "1")]),
+            12345, 7),)
+    buf = wire.encode_batch(ops)
+    got, tc = wire.decode_batch(buf)
+    assert tc is None and got == ops
+    assert wire.TXN_KINDS == frozenset(
+        ("txn_prepare", "txn_commit", "txn_abort", "txn_coord"))
+    # The kind codes sit ABOVE the C++ decoder's kNumKinds on purpose.
+    assert all(wire.KIND_CODE[k] >= 3 for k in wire.TXN_KINDS)
+
+
+def test_coord_token_never_collides_with_user_keys():
+    """Review regression (ISSUE 13): the coordinator routing token is
+    NUL-prefixed so no printable user key can collide with it — keys
+    that merely LOOK tokenish ("@g2!order", "\\x00gamma") fall through
+    to the shard map instead of being pinned or rejected."""
+    from tpu6824.services.shardmaster import Config
+    from tpu6824.services.txnkv import (
+        _coord_token,
+        _parse_coord_token,
+        frontend_route,
+    )
+
+    assert _parse_coord_token(_coord_token(2)) == 2
+    for not_a_token in ("@g2!order", "@gamma", "plain", "\x00gamma",
+                        "\x00g!", "\x00gx!y", ""):
+        assert _parse_coord_token(not_a_token) is None, not_a_token
+    route = frontend_route([100, 101], [Config.initial()])
+    assert route(_coord_token(1)) == 1
+    # Tokenish USER keys route by shard map (index 0 on the initial
+    # all-unassigned config), never raise, never pin to a group.
+    for k in ("@g2!order", "@gamma", "\x00gamma"):
+        assert route(k) == 0, k
+    # An out-of-range token index also falls through instead of
+    # crashing the engine's route call.
+    assert route(_coord_token(7)) == 0
+
+
+# ----------------------------------------------- trace chain / jitguard
+
+
+def test_trace_chain_begin_prepare_commit_reply():
+    from tpu6824.obs import tracing as obs
+    from tpu6824.obs.tracing import FLIGHT
+
+    FLIGHT.clear()
+    obs.enable(sample=1.0)
+    system = _system()
+    try:
+        keyA, keyB = _cross_keys(system)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyA, "", "1"), (keyB, "", "1")])
+    finally:
+        system.shutdown()
+        obs.disable()
+    spans = [r for r in FLIGHT.snapshot()
+             if r.get("trace_id") and r.get("name", "").startswith("txn.")]
+    FLIGHT.clear()
+    by_name: dict = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    for want in ("txn.op", "txn.begin", "txn.prepare", "txn.commit",
+                 "txn.reply"):
+        assert want in by_name, (want, sorted(by_name))
+    # One committing chain: reply → commit → op(root), with begin and
+    # the per-group prepares parented to the same root.
+    by_id = {e["span_id"]: e for e in spans}
+    chained = 0
+    for reply in by_name["txn.reply"]:
+        commit = by_id.get(reply["parent_id"])
+        if commit is None or commit["name"] != "txn.commit":
+            continue
+        root = by_id.get(commit["parent_id"])
+        if root is None or root["name"] != "txn.op":
+            continue
+        tid = root["trace_id"]
+        kids = {e["name"] for e in spans
+                if e["trace_id"] == tid and e["parent_id"]
+                == root["span_id"]}
+        if {"txn.begin", "txn.prepare", "txn.commit"} <= kids:
+            chained += 1
+    assert chained, "no trace chains txn begin→prepare→commit→reply"
+
+
+def test_zero_steady_state_recompiles_under_txn_traffic():
+    from tpu6824.analysis.jitguard import RecompileGuard
+
+    system = _system()
+    try:
+        keyA, keyB = _cross_keys(system)
+        ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert ck.multi_cas([(keyA, "", "100"), (keyB, "", "100")])
+        assert ck.transfer(keyA, keyB, 1)  # warm every variant
+        time.sleep(0.3)
+        with RecompileGuard() as g:
+            for _ in range(3):
+                assert ck.transfer(keyA, keyB, 2)
+        assert g.compiles == 0
+    finally:
+        system.shutdown()
+
+
+# -------------------------------------------------- schedule artifacts
+
+
+def test_pre_txn_schema3_capture():
+    """Replay compatibility (ISSUE 13 satellite): a schema-3 stamped
+    capture carrying the txn-era vocabulary (kill_mid_commit +
+    net_fault + a reconfigure extra) loads byte-exact through the
+    schema-3 loader path — identity, not upgrade — and the CURRENT
+    generator stamps schema 4."""
+    sched = FaultSchedule.from_json(os.path.join(DATA, "nemesis_txn.json"))
+    assert sched.schema == 3
+    assert sched.seed == 1313
+    acts = [e.action for e in sched]
+    assert acts.count("kill_mid_commit") == 2
+    assert "net_fault" in acts and "reconfigure" in acts
+    assert sched.events[1].args == {"disk": "dirty"}
+    again = FaultSchedule.from_dict(sched.to_dict())
+    assert again == sched and again.schema == 3
+    assert again.signature() == sched.signature()
+    assert FaultSchedule.SCHEMA == 4
+
+
+def test_kill_mid_commit_schedule_generation_deterministic():
+    spec = CompositeTarget(
+        TxnKillTarget(lambda disk: None),
+    ).spec()
+    s1 = FaultSchedule.generate(77, 3.0, spec)
+    s2 = FaultSchedule.generate(77, 3.0, spec)
+    assert s1 == s2 and s1.schema == 4
+    assert all(e.action == "kill_mid_commit" and
+               e.args["disk"] in ("keep", "dirty") for e in s1)
+    assert len(s1) > 0
+
+
+# ------------------------------------------------- composite nemesis
+
+
+def _txn_soak(system, seed, duration, nemesis_report, extra_targets=(),
+              nclients=2, ntransfers=5, accounts=None, clerk_factory=None,
+              weights=None):
+    """Shared composite-soak body: concurrent cross-shard transfers
+    under ONE CompositeTarget schedule (fabric faults + reconfiguration
+    + kill_mid_commit [+ wire faults]), then convergence, conserved-sum
+    check, transactional-checker verdict, and replay identity."""
+    g0, g1 = system.gids
+    _set_resolver_pace(system, resolve=0.3, inherited=0.05, abort=0.8)
+    hist = txnkv.TxnHistory()
+    if accounts is None:
+        accounts = [chr(ord("a") + i) + "ct" for i in range(6)]
+    if clerk_factory is None:
+        def clerk_factory(h):
+            return txnkv.TxnClerk(system.sm_servers, system.directory,
+                                  history=h)
+    init = clerk_factory(hist)
+    for a in accounts:
+        assert init.multi_cas([(a, "", "100")], timeout=60.0), a
+    total0 = len(accounts) * 100
+
+    killer = txnkv.MidCommitKiller()
+    state = {"joined": True}
+
+    def reconfigure():
+        (system.leave if state["joined"] else system.join)(g1)
+        state["joined"] = not state["joined"]
+
+    target = CompositeTarget(
+        FabricTarget(system.fabric, groups=[1, 2],
+                     extra={"reconfigure": reconfigure}),
+        TxnKillTarget(killer.arm, disarm_fn=killer.disarm),
+        *extra_targets,
+    )
+    w = {"reconfigure": 2.5, "clock_pause": 0.0, "kill_mid_commit": 2.0}
+    w.update(weights or {})
+    sched = FaultSchedule.generate(seed, duration, target.spec(),
+                                   weights=w)
+    nem = Nemesis(target, sched).start()
+    nemesis_report.attach(nemesis=nem, seed=seed)
+
+    errs: list = []
+
+    def client(idx):
+        ck = clerk_factory(hist)
+        ck.mid_commit_hook = killer
+        rngpairs = [(accounts[(idx + j) % len(accounts)],
+                     accounts[(idx + j + 1) % len(accounts)])
+                    for j in range(ntransfers)]
+        for src, dst in rngpairs:
+            try:
+                ck.transfer(src, dst, 5, timeout=90.0)
+            except (txnkv.TxnAbandoned, RPCError):
+                continue  # fate unknown: recorded, resolvers own it
+            except Exception as e:  # pragma: no cover
+                errs.append((idx, repr(e)))
+        if hasattr(ck, "close"):
+            ck.close()
+
+    ts = [threading.Thread(target=client, args=(i,), daemon=True)
+          for i in range(nclients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300.0)
+    assert not any(t.is_alive() for t in ts), "client stuck past 300s"
+    nem.join(60.0)
+    assert nem.done
+    assert nem.signature() == sched.signature()  # replay identity
+    assert not errs, errs
+    # Post-restore: ensure g1 is joined (the schedule may end either
+    # way), then wait for every prepared transaction to resolve.
+    if not state["joined"]:
+        system.join(g1)
+        state["joined"] = True
+    assert _wait_no_locks(system, timeout=60.0), (
+        "prepared transactions never resolved: "
+        + repr([(s.name, dict(s.txn_prepared)) for s in
+                _all_servers(system) if s.txn_prepared]))
+    # Conserved sum + final atomic observation (recorded, so the
+    # checker judges the final state too).
+    final = clerk_factory(hist)
+    snap = {}
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        try:
+            snap = final.read(accounts, timeout=30.0)
+            break
+        except Exception:
+            time.sleep(0.2)
+    assert snap, "final read never served"
+    total1 = sum(int(v or 0) for v in snap.values())
+    assert total1 == total0, f"transfer sum broke: {total0} -> {total1}"
+    res = check_txn_history(hist)
+    assert res.ok, res.describe()
+    if hasattr(final, "close"):
+        final.close()
+    return hist
+
+
+@pytest.mark.nemesis
+def test_txn_composite_nemesis_smoke(nemesis_report):
+    """Tier-1 acceptance smoke: fixed-seed composite schedule —
+    partitions (incl. majority-less), kill/revive, unreliable,
+    schedule-driven RECONFIGURATION, and kill_mid_commit — against
+    concurrent cross-shard transfers; transactional checker green,
+    transfer sum conserved, replay identity."""
+    system = _system(ninstances=64)
+    try:
+        _txn_soak(system, seed_from_env(1306), 2.0, nemesis_report,
+                  nclients=2, ntransfers=4)
+    finally:
+        system.shutdown()
+
+
+@pytest.mark.nemesis
+@pytest.mark.slow
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_txn_full_matrix_soak(kernel, tmp_path, nemesis_report):
+    """The FULL composite fault matrix on both kernel engines
+    (acceptance): partition + reconfiguration + coordinator/participant
+    kill-revive + kill-mid-commit (keep/dirty disk disposition) + BYTE-
+    LEVEL WIRE FAULTS on the frontend path, against concurrent
+    cross-shard transfers flowing through the ClerkFrontend wire as
+    caps-gated txn frames — checker green, conserved sum, replay
+    identity."""
+    from tpu6824.rpc import netfault
+    from tpu6824.rpc.netfault import WireFault
+    from tpu6824.services.frontend import ClerkFrontend, shardkv_op
+
+    heavy = kernel == "xla"
+    system = _system(ninstances=64, fabric_kw={"kernel": kernel})
+    fe = router = None
+    wf_scope = None
+    try:
+        g0, g1 = system.gids
+        router = txnkv.ConfigRouter(system.sm_servers, system.gids)
+        fe = ClerkFrontend(groups=[system.groups[g0], system.groups[g1]],
+                           addr=str(tmp_path / "soakfe.sock"),
+                           op_factory=shardkv_op, route=router.route,
+                           op_timeout=6.0)
+        # Byte-level wire faults on every subsequently-dialed clerk
+        # conn to the frontend socket (the ISSUE 12 injection seam).
+        wf = netfault.register(fe.addr, WireFault(scope=fe.addr))
+        wf_scope = fe.addr
+
+        def clerk_factory(h):
+            return txnkv.TxnFrontendClerk(
+                [fe.addr], system.sm_servers, system.gids, history=h,
+                timeout=8.0)
+
+        _txn_soak(
+            system, seed_from_env(2607), 3.0 if heavy else 1.5,
+            nemesis_report,
+            extra_targets=(NetTarget({"txnfe": wf}),),
+            nclients=3 if heavy else 2,
+            ntransfers=4 if heavy else 2,
+            clerk_factory=clerk_factory,
+            weights={"net_fault": 2.0})
+    finally:
+        if wf_scope is not None:
+            netfault.unregister(wf_scope)
+        if router is not None:
+            router.stop()
+        if fe is not None:
+            fe.kill()
+        system.shutdown()

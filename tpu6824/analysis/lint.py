@@ -114,6 +114,14 @@ RULES: dict[str, str] = {
         "that is failing it (ISSUE 12's retry-budget Backoff and "
         "deadline propagation exist to bound exactly this); pace the "
         "loop with services.common.Backoff or bound it by deadline",
+    "blocking-commit-wait":
+        "waiting on a cross-group RPC or future (txn_status / "
+        "transfer_state / txn_op / .wait / .result) while holding the "
+        "server mutex or inside an _apply* function in services scope "
+        "— the classic 2PC deadlock shape: group A's apply blocks on "
+        "group B, whose apply blocks on A, and both RSMs stop draining "
+        "their logs forever.  Consult coordinators from the ticker "
+        "(txnkv.resolve_pass), never under mu or in apply",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -158,6 +166,9 @@ _NATIVE_PATH_SCOPE = ("services/frontend.py", "rpc/native_server.py")
 _DECODE_DOTTED = {"struct.unpack", "struct.unpack_from", "pickle.loads",
                   "pickle.load"}
 _DECODE_TAILS = {"unpack", "unpack_from", "from_bytes"}
+# Commit-wait scope (blocking-commit-wait): the service layer, where
+# RSM apply paths and server mutexes live.
+_COMMIT_SCOPE = ("services/",)
 # Retry-loop scope (unbounded-retry): anywhere clerks/transports retry
 # RPCs.  A loop counts as BOUNDED when its body references any of these
 # identifier substrings (deadlines, budgets, backoffs, timeouts) or
@@ -200,6 +211,13 @@ _WALL_CLOCK = {"time.time", "time.time_ns", "datetime.now", "datetime.utcnow"}
 # even WAIT on a lock/event (lock-blocking-call tolerates `with mu` and
 # polices only what runs inside; a loop callback may not pause at all).
 _EVENTLOOP_BLOCK_TAILS = _BLOCKING_TAILS | {"acquire", "wait", "join"}
+
+# Cross-group waits the blocking-commit-wait rule polices (ISSUE 13):
+# consulting another group's state or parking on a future while holding
+# the server mutex (lock region / *_locked convention) or inside an
+# _apply* function is the 2PC deadlock shape.  Scope: services/.
+_COMMIT_WAIT_TAILS = {"wait", "result", "txn_status", "transfer_state",
+                      "txn_op"}
 
 _SUPPRESS_RE = re.compile(
     r"tpusan:\s*ok\(\s*([\w*,\s-]+?)\s*\)\s*(?:[—–:]|-{1,2})?\s*(.*)")
@@ -308,6 +326,7 @@ class _FileLint(ast.NodeVisitor):
         self.obs_buf_scope = _in_scope(relpath, _OBS_BUF_SCOPE)
         self.native_path_scope = _in_scope(relpath, _NATIVE_PATH_SCOPE)
         self.retry_scope = _in_scope(relpath, _RETRY_SCOPE)
+        self.commit_scope = _in_scope(relpath, _COMMIT_SCOPE)
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
@@ -723,6 +742,10 @@ class _FileLint(ast.NodeVisitor):
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
+    def _in_apply_fn(self) -> bool:
+        return any(getattr(f, "name", "").startswith("_apply")
+                   for f in self._fn_stack)
+
     def visit_Call(self, node: ast.Call) -> None:
         d = _dotted(node.func)
         if self._lock_depth > 0 and d is not None:
@@ -731,6 +754,15 @@ class _FileLint(ast.NodeVisitor):
                     "." in d and tail in _BLOCKING_TAILS):
                 self._flag(node, "lock-blocking-call",
                            f"call to {d}() under a lock region")
+        if self.commit_scope and d is not None and "." in d:
+            tail = d.rsplit(".", 1)[-1]
+            if tail in _COMMIT_WAIT_TAILS and (
+                    self._lock_depth > 0 or self._in_apply_fn()):
+                self._flag(node, "blocking-commit-wait",
+                           f"{d}() — cross-group wait while holding the "
+                           "server mutex / inside an _apply path (the "
+                           "2PC deadlock shape); consult coordinators "
+                           "from the ticker instead")
         if self.step_scope and d is not None:
             tail = d.rsplit(".", 1)[-1]
             if tail in _READBACK_TAILS:

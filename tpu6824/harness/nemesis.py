@@ -72,6 +72,9 @@ DEFAULT_WEIGHTS = {
     "disk_fault": 1.5,
     # netfault (ISSUE 12): byte-level wire faults
     "net_fault": 1.5,
+    # txnkv (ISSUE 13): crash the transaction driver between
+    # prepare-quorum and commit-record
+    "kill_mid_commit": 1.0,
 }
 EXTRA_WEIGHT = 1.5
 
@@ -87,6 +90,11 @@ CRASH_DISK_MODES = ("keep", "dirty", "lose")
 #: reset, the byte-level fault vocabulary of ISSUE 12).
 NET_FAULT_KINDS = ("corrupt", "truncate", "split", "coalesce", "stall",
                    "dup_frame", "reset")
+
+#: Disk dispositions a `kill_mid_commit` event may carry (ISSUE 13):
+#: the crash fired between prepare-quorum and commit-record either
+#: keeps the crashed party's disk or reboots over a power-crashed one.
+MID_COMMIT_DISK_MODES = ("keep", "dirty")
 
 
 def seed_from_env(default: int) -> int:
@@ -115,12 +123,14 @@ class FaultSchedule:
     #: 2 adds the durafault actions (crash_process/reboot_process/
     #: disk_fault) and stamps artifacts explicitly; 3 adds the netfault
     #: action (`net_fault {scope, kind, frac}` — byte-level wire
-    #: faults, ISSUE 12).  `from_dict` accepts unstamped v1 artifacts —
-    #: old /tmp/nemesis-*.json captures keep replaying — loads stamped
-    #: v2 captures byte-exact, and never rejects a NEWER stamp (events
-    #: are plain (t, action, args) rows; unknown actions fail loudly at
-    #: apply time, which is the right place).
-    SCHEMA = 3
+    #: faults, ISSUE 12); 4 adds the txnkv action (`kill_mid_commit
+    #: {disk}` — crash the transaction driver between prepare-quorum
+    #: and commit-record, ISSUE 13).  `from_dict` accepts unstamped v1
+    #: artifacts — old /tmp/nemesis-*.json captures keep replaying —
+    #: loads stamped v2/v3 captures byte-exact, and never rejects a
+    #: NEWER stamp (events are plain (t, action, args) rows; unknown
+    #: actions fail loudly at apply time, which is the right place).
+    SCHEMA = 4
 
     def __init__(self, events: list[NemesisEvent], seed: int | None = None,
                  params: dict | None = None, schema: int | None = None):
@@ -233,6 +243,9 @@ class _GenState:
         # netfault: byte-level wire-fault scopes (NetTarget).
         self.net_scopes = list(spec.get("net_scopes", []))
         self.net_kinds = list(spec.get("net_kinds", NET_FAULT_KINDS))
+        # txnkv: mid-commit kill disk dispositions (TxnKillTarget).
+        self.txn_disk_modes = list(
+            spec.get("txn_disk_modes", MID_COMMIT_DISK_MODES))
 
     def _max_killed(self) -> int:
         return max(0, (self.P - 1) // 2)
@@ -384,6 +397,15 @@ class _GenState:
             return {"scope": rng.choice(sorted(self.net_scopes)),
                     "kind": rng.choice(self.net_kinds),
                     "frac": round(rng.random(), 6)}
+        if action == "kill_mid_commit":
+            # Mostly keep the disk; sometimes reboot over a
+            # power-crashed one (the crash_process weighting, minus
+            # `lose` — losing the coordinator group's whole disk is a
+            # different scenario than a mid-commit crash).
+            return {"disk": rng.choices(
+                self.txn_disk_modes,
+                weights=[{"keep": 3.0, "dirty": 2.0}.get(m, 1.0)
+                         for m in self.txn_disk_modes], k=1)[0]}
         return {}  # extra action: no args
 
     def restore_tail(self) -> list[tuple[str, dict]]:
@@ -605,6 +627,43 @@ class NetTarget:
                 inj.disarm()  # armed-but-unfired faults must not leak
             else:
                 inj.netfault_clear()
+
+
+class TxnKillTarget:
+    """kill-mid-commit as a nemesis dimension (txnkv, ISSUE 13): each
+    `kill_mid_commit {disk}` event ARMS a one-shot hook — typically
+    `txnkv.MidCommitKiller.arm` — that the transaction layer fires
+    between prepare-quorum and commit-record: the driving clerk dies
+    with the participants' locks held and NO coordinator decision
+    written, optionally crashing a coordinator-group party with the
+    given disk disposition (keep | dirty).  The fate of that
+    transaction then rests entirely on the participant resolvers + the
+    first-writer-wins coordinator log, which is exactly what the
+    composite soaks must prove survives partitions, reconfiguration,
+    and wire faults.  `disarm_fn` (optional) clears an armed-but-
+    unfired hook at restore so it cannot leak into the post-soak
+    reads."""
+
+    ACTIONS = ["kill_mid_commit"]
+
+    def __init__(self, arm_fn, disarm_fn=None,
+                 disk_modes: tuple = MID_COMMIT_DISK_MODES):
+        self.arm_fn = arm_fn
+        self.disarm_fn = disarm_fn
+        self.disk_modes = tuple(disk_modes)
+
+    def spec(self) -> dict:
+        return {"kind": "txn", "txn_disk_modes": list(self.disk_modes),
+                "actions": list(self.ACTIONS)}
+
+    def apply(self, action: str, args: dict) -> None:
+        if action != "kill_mid_commit":
+            raise ValueError(f"unknown txn nemesis action {action!r}")
+        self.arm_fn(args.get("disk", "keep"))
+
+    def restore(self) -> None:
+        if self.disarm_fn is not None:
+            self.disarm_fn()
 
 
 class CompositeTarget:

@@ -1,0 +1,290 @@
+"""Wing–Gong checker for TRANSACTIONAL histories (ISSUE 13).
+
+The per-key checker (`harness/linearize.py`) rests on
+P-compositionality: a KV map is linearizable iff every per-key register
+is, so histories partition by key and each sub-history is searched
+alone.  A cross-group transaction breaks that decomposition on purpose
+— one operation reads and writes SEVERAL keys atomically — so the
+compositional unit generalizes from single keys to read/write sets:
+transactions whose key sets never (transitively) overlap are
+independent, and the history partitions into CONNECTED COMPONENTS of
+the key-sharing graph instead of single keys.  Within a component the
+search is Wing & Gong's again, over multi-key states:
+
+  - a total order of the COMMITTED transactions must exist that
+    (a) respects real time — a transaction takes effect somewhere
+    between its call and its return — and (b) is legal: every read
+    sub-op observes exactly the value the preceding writes produced
+    (a never-written key reads "");
+  - an ABORTED transaction must have NO effect: it is excluded from
+    the search entirely, so a value only an aborted transaction wrote
+    can never legally be observed — a dirty read surfaces as
+    non-serializability;
+  - a transaction of UNKNOWN fate (clerk died mid-commit; the
+    coordinator record decides it eventually, but this history never
+    observed which way) may take effect anywhere after its call or
+    not at all — its reads constrain nothing (never returned), its
+    writes are optional;
+  - a HALF-APPLIED transaction — some groups committed, others did
+    not — is exactly a state no total order of atomic transactions
+    can produce, which is what makes this checker the atomicity
+    yardstick for the 2PC layer.
+
+Plain KV ops interleave freely: `kv_record` adapts a
+`linearize.OpRecord` (get/put/append) into a single-op transaction, so
+mixed workloads (transfers + ordinary clerk traffic) check under ONE
+verdict.
+
+Memoized states (Porcupine-style): a (remaining-mask, state-hash) pair
+that already failed is never re-explored; state is the component's
+key→value map, hashed canonically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+_INF = float("inf")
+
+STATUSES = ("committed", "aborted", "unknown")
+
+
+@dataclasses.dataclass(frozen=True)
+class TxnRecord:
+    """One transaction's invocation/response pair.
+
+    `ops` is the flattened sub-op tuple, entries ("r", key, observed) /
+    ("w", key, value) / ("a", key, appended): reads are checked against
+    the state BEFORE the transaction's writes apply (so a CAS
+    contributes an "r" with its expectation and a "w" with its new
+    value), then writes/appends apply in order.  `ret` is None when no
+    response was observed; `status` is 'committed' | 'aborted' |
+    'unknown' (unknown ⇒ ret is None)."""
+
+    client: object
+    ops: tuple
+    call: float
+    ret: float | None
+    status: str = "committed"
+
+    def keys(self) -> frozenset:
+        return frozenset(k for _, k, _v in self.ops)
+
+    def describe(self) -> str:
+        body = ", ".join(f"{o}({k!r})={v!r}" for o, k, v in self.ops)
+        end = "?" if self.ret is None else f"{self.ret:.6f}"
+        return (f"[{self.call:.6f},{end}] client {self.client} "
+                f"{self.status}: {body}")
+
+
+def kv_record(rec) -> TxnRecord:
+    """Adapt a linearize.OpRecord (get/put/append) into a single-op
+    transaction so plain clerk traffic and transactions check under one
+    verdict.  An incomplete get is dropped by the caller exactly as
+    linearize drops it (it constrains nothing); an incomplete mutation
+    becomes an unknown-fate transaction."""
+    if rec.kind == "get":
+        ops = (("r", rec.key, rec.output if rec.output is not None
+                else ""),)
+    elif rec.kind == "put":
+        ops = (("w", rec.key, rec.value),)
+    else:
+        ops = (("a", rec.key, rec.value),)
+    status = "committed" if rec.ret is not None else "unknown"
+    return TxnRecord(client=rec.client, ops=ops, call=rec.call,
+                     ret=rec.ret, status=status)
+
+
+@dataclasses.dataclass
+class ComponentResult:
+    """Verdict for one key-connected component (cf.
+    linearize.KeyResult).  ok: True / False / None (budget)."""
+
+    keys: tuple
+    ok: bool | None
+    ntxns: int
+    nodes: int
+    stuck: list = dataclasses.field(default_factory=list)
+
+    def describe(self) -> str:
+        label = ",".join(map(str, self.keys[:4])) + (
+            ",…" if len(self.keys) > 4 else "")
+        if self.ok:
+            return f"component [{label}]: serializable ({self.ntxns} txns)"
+        verdict = ("NOT atomically serializable" if self.ok is False
+                   else "UNDECIDED (search budget exhausted)")
+        lines = [f"component [{label}]: {verdict} "
+                 f"({self.ntxns} txns, {self.nodes} nodes searched)"]
+        if self.stuck:
+            lines.append("  cannot serialize past:")
+            lines.extend(f"    {s}" for s in self.stuck)
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class TxnCheckResult:
+    results: list
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok is True for r in self.results)
+
+    @property
+    def violations(self) -> list:
+        return [r for r in self.results if r.ok is False]
+
+    @property
+    def undecided(self) -> list:
+        return [r for r in self.results if r.ok is None]
+
+    def describe(self) -> str:
+        if self.ok:
+            n = sum(r.ntxns for r in self.results)
+            return (f"atomically serializable: {n} txns over "
+                    f"{len(self.results)} components")
+        return "\n".join(r.describe() for r in self.results
+                         if r.ok is not True)
+
+
+def check_txn_history(history, max_nodes_per_component: int = 2_000_000
+                      ) -> TxnCheckResult:
+    """Check a transactional history — a TxnHistory
+    (services.txnkv.TxnHistory), or an iterable of TxnRecord — for
+    strict serializability with atomic effects."""
+    recs = (history.records() if hasattr(history, "records")
+            else list(history))
+    # Aborted transactions must have no effect — excluded up front; the
+    # probe for their effects is every OTHER record's reads.
+    recs = [r for r in recs if r.status != "aborted"]
+    # Union-find over keys → connected components (the generalized
+    # P-compositionality unit).
+    parent: dict = {}
+
+    def find(k):
+        r = k
+        while parent.get(r, r) != r:
+            r = parent[r]
+        while parent.get(k, k) != k:
+            parent[k], k = r, parent[k]
+        return r
+
+    for rec in recs:
+        ks = sorted(rec.keys())
+        for k in ks:
+            parent.setdefault(k, k)
+        for a, b in zip(ks, ks[1:]):
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[ra] = rb
+    comps: dict = {}
+    for rec in recs:
+        ks = rec.keys()
+        if not ks:
+            continue
+        comps.setdefault(find(next(iter(sorted(ks)))), []).append(rec)
+    results = [
+        _check_component(comp, max_nodes_per_component)
+        for _, comp in sorted(comps.items())
+    ]
+    return TxnCheckResult(results)
+
+
+def _apply(state: dict, rec: TxnRecord) -> dict | None:
+    """rec against `state`: None if a read mismatches (illegal here),
+    else the post-state.  Unknown-fate reads never constrain (they were
+    never observed)."""
+    check_reads = rec.status == "committed"
+    for o, k, v in rec.ops:
+        if o == "r" and check_reads and state.get(k, "") != v:
+            return None
+    ns = None
+    for o, k, v in rec.ops:
+        if o == "r":
+            continue
+        if ns is None:
+            ns = dict(state)
+        if o == "w":
+            ns[k] = v
+        else:  # append
+            ns[k] = ns.get(k, "") + v
+    return state if ns is None else ns
+
+
+def _check_component(recs: list, max_nodes: int) -> ComponentResult:
+    keys = tuple(sorted({k for r in recs for k in r.keys()}))
+    # Unknown-fate READ-ONLY transactions constrain nothing: drop.
+    recs = [r for r in recs
+            if not (r.status == "unknown"
+                    and all(o == "r" for o, _k, _v in r.ops))]
+    recs.sort(key=lambda r: (r.call, _INF if r.ret is None else r.ret))
+    n = len(recs)
+    if n == 0:
+        return ComponentResult(keys, True, 0, 0)
+    if n > 62:
+        # Mask-width guard: a component this entangled exceeds the
+        # search's practical budget anyway — report UNDECIDED loudly
+        # rather than degrade into a silent non-verdict.
+        return ComponentResult(keys, None, n, 0,
+                               stuck=["component too wide for search"])
+    call = [r.call for r in recs]
+    ret = [_INF if r.ret is None else r.ret for r in recs]
+    committed = 0
+    for i, r in enumerate(recs):
+        if r.status == "committed":
+            committed |= 1 << i
+
+    def minimal(mask: int) -> list[int]:
+        idx = [i for i in range(n) if mask >> i & 1]
+        if len(idx) == 1:
+            return idx
+        m1 = m2 = _INF
+        a1 = -1
+        for i in idx:
+            if ret[i] < m1:
+                m1, m2, a1 = ret[i], m1, i
+            elif ret[i] < m2:
+                m2 = ret[i]
+        return [i for i in idx if call[i] < (m2 if i == a1 else m1)]
+
+    full = (1 << n) - 1
+    seen: set = set()
+    nodes = 0
+    # Each frame: (mask, state, candidate list, cursor).  A candidate
+    # entry is (i, apply?) — unknown-fate transactions branch twice:
+    # take effect here, or never (drop from mask, state unchanged).
+    def cands_for(mask):
+        out = []
+        for i in minimal(mask):
+            out.append((i, True))
+            if recs[i].status == "unknown":
+                out.append((i, False))
+        return out
+
+    stack = [(full, {}, cands_for(full), 0)]
+    best_mask = full
+    while stack:
+        mask, state, cands, ci = stack.pop()
+        if bin(mask & committed).count("1") < \
+                bin(best_mask & committed).count("1"):
+            best_mask = mask
+        if mask & committed == 0:
+            return ComponentResult(keys, True, n, nodes)
+        if ci >= len(cands):
+            continue
+        stack.append((mask, state, cands, ci + 1))
+        i, take = cands[ci]
+        nstate = _apply(state, recs[i]) if take else state
+        if nstate is None:
+            continue  # reads illegal at this point in the order
+        nmask = mask & ~(1 << i)
+        nk = (nmask, hash(tuple(sorted(nstate.items()))))
+        if nk in seen:
+            continue
+        seen.add(nk)
+        nodes += 1
+        if nodes > max_nodes:
+            return ComponentResult(keys, None, n, nodes)
+        stack.append((nmask, nstate, cands_for(nmask), 0))
+    stuck = [recs[i].describe() for i in range(n)
+             if best_mask >> i & 1 and recs[i].status == "committed"][:6]
+    return ComponentResult(keys, False, n, nodes, stuck=stuck)

@@ -24,10 +24,22 @@ namespace fewire {
 constexpr uint8_t kFeVersion = 1;
 
 // kind codes (closed enum, order is schema): get / put / append.
+// Codes 3-6 (txn_prepare/txn_commit/txn_abort/txn_coord — the
+// caps-gated txn extension, ISSUE 13, rpc/wire.py) are DELIBERATELY
+// above kNumKinds: the C++ ingest path serves the columnar kvpaxos
+// seam, which cannot run 2PC, so an ingest server never advertises
+// `fe_txn` and this decoder rejects a stray txn frame as malformed
+// (counted, connection-scoped) instead of mis-parsing it.  txn frames
+// are decoded by the PYTHON side only (shardkv frontends keep the
+// Python decode path).
 constexpr int32_t kKindGet = 0;
 constexpr int32_t kKindPut = 1;
 constexpr int32_t kKindAppend = 2;
 constexpr int32_t kNumKinds = 3;
+constexpr int32_t kKindTxnPrepare = 3;  // Python-decode-only from here
+constexpr int32_t kKindTxnCommit = 4;
+constexpr int32_t kKindTxnAbort = 5;
+constexpr int32_t kKindTxnCoord = 6;
 
 // err codes: OK / ErrNoKey / ErrWrongGroup; 255 = pickled escape hatch
 // (only the Python encoder emits it).

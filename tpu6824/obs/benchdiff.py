@@ -162,6 +162,17 @@ METRICS = [
     Metric(("service", "overload", "capacity_ops_s"), 0.65,
            host_bound=True,
            leg_shape=[("service", "overload", "shape")]),
+    # Transaction leg (ISSUE 13, txnkv): cross-shard 2PC commit
+    # throughput + commit-latency tail — host-edge noisy like every
+    # clerk-path leg (contention makes it swing further), gated on the
+    # leg's OWN shape (a BENCH_TXN_ACCOUNTS-trimmed contract run must
+    # skip loudly, not false-alarm).  First recorded artifact baselines
+    # them; gated thereafter.
+    Metric(("service", "txn", "value"), 0.65, host_bound=True,
+           leg_shape=[("service", "txn", "shape")]),
+    Metric(("service", "txn", "latency", "p99_ms"), 0.65,
+           higher_is_better=False, host_bound=True,
+           leg_shape=[("service", "txn", "shape")]),
     # Host-edge legs: the demonstrated noise floor is −55% (wire
     # −40%/−53%, thread-per-clerk −55% between real artifacts).
     Metric(("wire", "value"), 0.65, host_bound=True),

@@ -40,6 +40,10 @@ Rules (thresholds via env, see TUNING):
     signature netfault's overload protection exists to prevent
     (`TPU6824_WD_RETRY_RATE` floor keeps ordinary failover retries
     quiet).
+  - ``abort-storm``         — txn aborts climbing while commits fall
+    (ISSUE 13): the 2PC layer burning its work on lock conflicts /
+    recovery aborts instead of committing (`TPU6824_WD_ABORT_RATE`
+    floor keeps ordinary optimistic-CAS retries quiet).
 
 Default-off like tracing: a watchdog only exists when constructed, and
 evaluation is sampling-clock granular — no per-op cost anywhere.
@@ -141,12 +145,15 @@ class LatencySpike(Rule):
 
 class QueueGrowth(Rule):
     name = "queue-growth"
-    # Consumer-side depth gauges: the fabric's decided-feed depth and the
+    # Consumer-side depth gauges: the fabric's decided-feed depth, the
     # native ingest path's in-flight op count (ISSUE 11 — a stuck reply
     # ring shows as inflight_ops climbing monotonically while the engine
-    # keeps mirroring the gauge).
+    # keeps mirroring the gauge), and the in-flight transaction gauge
+    # (ISSUE 13 — transactions piling up means prepares are outliving
+    # their resolvers: a wedged coordinator or a lock convoy).
     series = ("fabric.health.feed_depth_max",
-              "frontend.native_ingest.inflight_ops")
+              "frontend.native_ingest.inflight_ops",
+              "txn.inflight")
 
     def __init__(self, limit: float | None = None):
         self.limit = _envf("TPU6824_WD_FEED_DEPTH", 1024.0) \
@@ -280,10 +287,53 @@ class RetryStorm(Rule):
         return None
 
 
+class AbortStorm(Rule):
+    """Transactional churn amplification (ISSUE 13): the txn abort rate
+    climbing across the window while the commit rate falls.  Both
+    halves matter — aborts alone spike benignly on any contention burst
+    (the CAS-retry loop is SUPPOSED to abort and retry), and falling
+    commits alone is throughput-collapse's job; the STORM signature is
+    the 2PC layer burning its work on lock conflicts and recovery
+    aborts instead of committing (a deadlocked key convoy, a wedged
+    coordinator group, or a reconfiguration livelock)."""
+
+    name = "abort-storm"
+    aborts = "txn.abort.rate"
+    commits = "txn.commit.rate"
+
+    def __init__(self, min_rate: float | None = None,
+                 climb: float = 1.5, fall: float = 0.5):
+        # Floor on the late-window abort rate: ordinary optimistic-CAS
+        # retries under mild contention stay quiet.
+        self.min_rate = _envf("TPU6824_WD_ABORT_RATE", 20.0) \
+            if min_rate is None else min_rate
+        self.climb = climb
+        self.fall = fall
+
+    def check(self, wd):
+        commits = wd.points(self.commits)
+        if len(commits) < 4:
+            return None
+        c_before, c_after = RetryStorm._halves(commits)
+        if c_before <= 0 or c_after >= c_before * self.fall:
+            return None  # commits holding: contention, not a storm
+        aborts = wd.points(self.aborts)
+        if len(aborts) < 4:
+            return None
+        a_before, a_after = RetryStorm._halves(aborts)
+        if a_after >= self.min_rate and \
+                a_after >= max(a_before, 1e-9) * self.climb:
+            return (f"txn aborts climbed {a_before:.1f} -> "
+                    f"{a_after:.1f}/s while commits fell "
+                    f"{c_before:.1f} -> {c_after:.1f}/s "
+                    "(2PC work burning on aborts, not committing)")
+        return None
+
+
 def default_rules() -> list[Rule]:
     return [StalledGroups(), ThroughputCollapse(), LatencySpike(),
             QueueGrowth(), ThreadCrashes(), DroppedClimbing(),
-            JitRecompile(), RetryStorm()]
+            JitRecompile(), RetryStorm(), AbortStorm()]
 
 
 class Watchdog:

@@ -72,8 +72,18 @@ FLAG_CRC = 4       # bit 2: u32 frame crc32 present (caps-gated);
 #                    replies echo the flag + carry their own crc
 
 # Closed op-kind enum — the int32 the native decoder writes into the
-# kind column.  Order is part of the schema.
-KINDS = ("get", "put", "append")
+# kind column.  Order is part of the schema.  Codes 3-6 are the
+# caps-gated TXN EXTENSION (ISSUE 13): 2PC phase ops whose value field
+# carries a JSON payload (utf-8, so the existing value bytes layout is
+# untouched).  A clerk only sends them to an endpoint whose `fe_caps`
+# advertised `fe_txn` — an old Python decoder's KINDS lookup would
+# refuse them as malformed, and the C++ ingest decoder REFUSES them by
+# design (fewire.h keeps kNumKinds at 3: an ingest server cannot serve
+# 2PC, so its caps never advertise fe_txn and a stray txn frame is a
+# counted connection-scoped reject, never a mis-parse).
+KINDS = ("get", "put", "append",
+         "txn_prepare", "txn_commit", "txn_abort", "txn_coord")
+TXN_KINDS = frozenset(KINDS[3:])
 KIND_CODE = {k: i for i, k in enumerate(KINDS)}
 
 # Closed reply-err enum; 255 = pickled escape hatch.

@@ -57,7 +57,7 @@ from tpu6824.rpc.native_server import NativeServer, make_server
 from tpu6824.services.common import Backoff, fresh_cid
 from tpu6824.services.kvpaxos import _DEAD, Op
 from tpu6824.utils import crashsink
-from tpu6824.utils.errors import OK, RPCError
+from tpu6824.utils.errors import OK, ErrTxnLocked, RPCError
 
 # The multi-op frame's rpc name.  An old server answers it with
 # (False, "no such rpc: fe_batch") → RPCError at the client → the clerk
@@ -333,9 +333,17 @@ class ClerkFrontend:
         # "malformed" — a retry loop, not an interop path (set after
         # enable_ingest below; the lambda reads it per probe).
         self._ext_ok = True
+        # Txn capability (ISSUE 13): only an op factory that builds 2PC
+        # log entries (shardkv_op marks itself) may receive the
+        # caps-gated txn frame kinds — a kvpaxos frontend (incl. the
+        # native-ingest path, whose C++ decoder refuses kind codes ≥ 3
+        # by design) never advertises it, so old and txn-less endpoints
+        # alike simply never see a txn frame.
+        self._txn_ok = bool(getattr(op_factory, "supports_txn", False))
         srv.register("fe_caps", lambda: {"fe_wire": wire.VERSION,
                                          "fe_deadline": self._ext_ok,
-                                         "fe_crc": self._ext_ok})
+                                         "fe_crc": self._ext_ok,
+                                         "fe_txn": self._txn_ok})
         # Observability plane (regular threaded handlers — pollers are
         # rare and must never touch the event loop): a fleet Collector
         # polls a live frontend process like any fabric process — the
@@ -1120,10 +1128,16 @@ class ClerkFrontend:
 
 def shardkv_op(kind, key, value, cid, cseq, tc):
     """Op factory reusing the frontend per shardkv group (extra=None on
-    client ops; the reconf path never travels this wire)."""
+    client ops; the reconf path never travels this wire).  Txn phase
+    ops (kind ∈ txnkv.TXN_KINDS, JSON payload in `value`) pass through
+    unchanged — `supports_txn` below is what lets the frontend
+    advertise the caps-gated `fe_txn` capability (ISSUE 13)."""
     from tpu6824.services.shardkv import Op as SOp
 
     return SOp(kind, key, value, cid, cseq, None, tc)
+
+
+shardkv_op.supports_txn = True
 
 
 # ---------------------------------------------------------------------------
@@ -1296,7 +1310,15 @@ class FrontendClerk:
                     else:
                         replies = self._request(addr,
                                                 (FE_BATCH, ((op_tuple,),)))
-                    return replies[0]
+                    rep = replies[0]
+                    if not (isinstance(rep, tuple) and rep
+                            and rep[0] == ErrTxnLocked):
+                        return rep
+                    # ErrTxnLocked (ISSUE 13): the key is held by a
+                    # prepared cross-group transaction — paced retry
+                    # with the SAME cseq (the lock reply is never
+                    # recorded in the dup filter), same endpoint; falls
+                    # through to the backoff below.
                 except RPCError as e:
                     if "no such rpc" in str(e):
                         self._legacy.add(addr)
@@ -1309,6 +1331,77 @@ class FrontendClerk:
         finally:
             if sp is not None:
                 sp.end()
+
+    def _txn_caps(self, addr) -> dict:
+        """The endpoint's capability dict, probed on demand — txn ops
+        are STRICTLY caps-gated in BOTH frame forms (an endpoint that
+        never advertised `fe_txn` must never see a txn kind, pickled or
+        binary: a pre-txn apply loop has no branch for it).  Reuses
+        `_format_for`'s probe (one fe_caps round-trip per endpoint);
+        a non-dict answer is NOT cached, so a transient oddity never
+        pins an endpoint as transaction-less forever."""
+        self._format_for(addr)  # fills _caps for fe-wire endpoints
+        caps = self._caps.get(addr)
+        if caps is None:
+            got = self._request(addr, ("fe_caps", ()))
+            if isinstance(got, dict):
+                self._caps[addr] = caps = got
+            else:
+                caps = {}
+        return caps
+
+    def txn_call(self, op_tuple, timeout=None):
+        """One 2PC phase op (kind ∈ wire.TXN_KINDS) through the
+        frontend wire → the (err, val) reply (ISSUE 13).  Caps-gated in
+        both directions: an endpoint is sent txn frames — binary kind
+        codes on the fe wire, or the pickled fe_batch form — ONLY after
+        its fe_caps advertised `fe_txn`; pre-txn and pre-frontend
+        endpoints refuse loudly, and old clerks never emit the kinds at
+        all (interop unchanged both ways)."""
+        deadline = time.monotonic() + timeout if timeout else None
+        self._backoff.reset()
+        while True:
+            addr = self.addrs[self._i % len(self.addrs)]
+            budget_s = (deadline - time.monotonic()) if deadline \
+                else self.timeout
+            try:
+                if addr in self._legacy:
+                    raise RPCError(
+                        f"{addr}: endpoint predates the frontend wire "
+                        "— no transaction support")
+                caps = self._txn_caps(addr)
+                if not caps.get("fe_txn"):
+                    raise RPCError(
+                        f"{addr}: endpoint does not advertise fe_txn "
+                        "— no transaction support")
+                if self.wire_format != "pickle" \
+                        and caps.get("fe_wire") == wire.VERSION:
+                    try:
+                        replies = self._request_native(
+                            addr, (op_tuple,), budget_s=budget_s)
+                    except wire.CapacityError:
+                        # Op does not FIT the binary layout (key >
+                        # u16): this request rides the pickled frame —
+                        # the _call fallback, same contract.
+                        replies = self._request(
+                            addr, (FE_BATCH, ((op_tuple,),)))
+                else:
+                    replies = self._request(addr,
+                                            (FE_BATCH, ((op_tuple,),)))
+                return replies[0]
+            except RPCError as e:
+                if "no such rpc" in str(e):
+                    self._legacy.add(addr)
+                    raise RPCError(
+                        f"{addr}: endpoint predates the frontend wire "
+                        "— no transaction support") from e
+                if "no transaction support" in str(e):
+                    raise
+                self._i += 1
+            now = time.monotonic()
+            if deadline and now >= deadline:
+                raise RPCError("txn clerk timeout")
+            self._backoff.sleep(deadline - now if deadline else None)
 
     def _single_op(self, addr, t, sp):
         """Classic single-op frame against a legacy (pre-frontend)

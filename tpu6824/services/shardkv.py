@@ -39,7 +39,7 @@ from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.obs import tracing as _tracing
 from tpu6824.ops.hashing import NSHARDS, key2shard
-from tpu6824.services import shardmaster
+from tpu6824.services import shardmaster, txnkv
 from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
 from tpu6824.services.kvpaxos import _DEAD, _Fut
 from tpu6824.services.shardmaster import Config
@@ -48,13 +48,14 @@ from tpu6824.utils.errors import (
     OK,
     ErrNoKey,
     ErrNotReady,
+    ErrTxnLocked,
     ErrWrongGroup,
     RPCError,
 )
 
 
 class Op(NamedTuple):
-    kind: str  # 'get' | 'put' | 'append' | 'reconf'
+    kind: str  # 'get' | 'put' | 'append' | 'reconf' | txnkv.TXN_KINDS
     key: str
     value: str
     cid: str  # string CIDs, as on the reference wire (shardkv/common.go:23)
@@ -67,14 +68,23 @@ class Op(NamedTuple):
 
 
 class XState(NamedTuple):
-    """Transferable shard state (shardkv/server.go:71-102)."""
+    """Transferable shard state (shardkv/server.go:71-102).
+
+    `txn` (ISSUE 13, arxiv 1906.01365): the prepared-lock-table rows
+    whose keys fall in the migrating shards — (tid, coord_gid,
+    coord_srv_names, sub-ops) — so a shard migrating MID-COMMIT carries
+    its 2PC state to the new owner, which re-locks the keys and
+    resolves the inherited prepares against the coordinator record
+    before they can serve conflicting ops."""
 
     kv: tuple  # ((key, value), ...)
     dup: tuple  # ((cid, (cseq, reply)), ...)
+    txn: tuple = ()  # ((tid, coord_gid, coord_srv, sub-ops), ...)
 
 
 class ShardKVServer:
-    RPC_METHODS = ["get", "put_append", "transfer_state"]  # wire surface
+    RPC_METHODS = ["get", "put_append", "transfer_state",
+                   "txn_op", "txn_status"]  # wire surface
 
     def __init__(
         self,
@@ -104,6 +114,25 @@ class ShardKVServer:
         self.mu = threading.RLock()
         self.kv: dict[str, str] = {}
         self.dup: dict[str, tuple[int, object]] = {}
+        # txnkv (ISSUE 13): replicated 2PC state, mutated ONLY in _apply
+        # (deterministic across replicas).  txn_prepared: tid → entry
+        # (coord gid/names, buffered sub-ops, reads, inherited flag,
+        # monotonic stamp — the stamp only PACES the resolver, never
+        # decides an outcome); txn_locks: key → tid; txn_decisions: the
+        # coordinator-role commit records (write-once, first writer
+        # wins); txn_done: finished-txn idempotency records (capped,
+        # trimmed in apply order).  `_test_partial_commit` is the
+        # PR 3-style atomicity fault hook: commit drops this group's
+        # writes so the transactional checker can prove it catches a
+        # real half-applied transaction; never set outside tests.
+        self.txn_prepared: dict[str, dict] = {}
+        self.txn_locks: dict[str, str] = {}
+        self.txn_decisions: dict[str, str] = {}
+        self.txn_done: dict[str, str] = {}
+        self.txn_resolve_after = txnkv.RESOLVE_AFTER
+        self.txn_resolve_inherited = 0.05
+        self.txn_abort_after = txnkv.ABORT_AFTER
+        self._test_partial_commit = False
         self.config: Config = Config.initial()
         self.applied = -1
         self.op_timeout = op_timeout
@@ -159,16 +188,52 @@ class ShardKVServer:
                 seen, _ = self.dup.get(cid, (-1, None))
                 if cseq > seen:
                     self.dup[cid] = (cseq, reply)
+            # Reconfiguration safety (ISSUE 13): for shards this group
+            # IMPORTS, the incoming prepared-lock rows are the
+            # authoritative surviving set — stale local portions from a
+            # previous ownership stint are pruned FIRST (a migrate-away
+            # → resolve-elsewhere → migrate-back cycle must not
+            # re-apply old buffered writes), then the migrated-in
+            # prepares re-lock their keys under this (new) owner; the
+            # resolver consults their coordinator records.
+            imported = {s for s in range(NSHARDS)
+                        if cfg.shards[s] == self.gid
+                        and self.config.shards[s] != self.gid}
             self.config = cfg
+            if imported:
+                txnkv.prune_for_import(self, imported)
+            if getattr(xstate, "txn", ()):
+                txnkv.install_inherited(self, xstate.txn)
             return None
 
         seen, reply = self.dup.get(op.cid, (-1, None))
         if op.cseq <= seen:
             return self._resolve(op, reply)
+        if op.kind in txnkv.TXN_KINDS:
+            # 2PC ops: per-payload-key ownership (prepare) / tid-keyed
+            # state (commit/abort/coord — the fix-en-route semantics:
+            # a prepared transaction outlives the shard map, so its
+            # finish ops never answer ErrWrongGroup from a routing
+            # key).  Retryable outcomes stay OUT of the dup filter.
+            reply, record = txnkv.apply_txn(self, op)
+            if record:
+                self.dup[op.cid] = (op.cseq, reply)
+            if op.tc is not None:
+                _tracing.complete("service.apply", op.tc[0], op.tc[1],
+                                  time.monotonic_ns(), comp="shardkv",
+                                  gid=self.gid, me=self.me, kind=op.kind)
+            return self._resolve(op, reply)
         if not self._owns(op.key):
             # NOT recorded in the dup filter: the client will retry at the
             # right group with the same cseq (shardkv/server.go:205-242).
             return self._resolve(op, (ErrWrongGroup, ""))
+        if op.key in self.txn_locks:
+            # Key locked by a prepared cross-group transaction: answer
+            # the retryable lock error, NOT recorded — the client
+            # re-sends the same cseq through its Backoff budget once
+            # the lock releases (commit/abort/resolver).
+            txnkv._M_LOCK_CONFLICTS.inc()
+            return self._resolve(op, (ErrTxnLocked, ""))
         if op.kind == "get":
             reply = (OK, self.kv[op.key]) if op.key in self.kv else (ErrNoKey, "")
         elif op.kind == "put":
@@ -303,6 +368,12 @@ class ShardKVServer:
                 # sends no new Query ops to the sm group — G x R pollers
                 # must not saturate the sm log between poll intervals.
                 self.tick(poll=poll)
+                # txnkv resolver (ISSUE 13): settle aged/inherited
+                # prepared transactions against their coordinator
+                # records.  Runs OUTSIDE the mutex and outside _apply
+                # by construction (the blocking-commit-wait rule).
+                if self.txn_prepared:
+                    txnkv.resolve_pass(self)
             except RPCError:
                 continue  # shardmaster unreachable: retry next loop
 
@@ -365,6 +436,7 @@ class ShardKVServer:
 
         kv_merge: dict[str, str] = {}
         dup_merge: dict[int, tuple[int, object]] = {}
+        txn_merge: dict[str, tuple] = {}  # tid -> (coord, coord_srv, ops)
         for old_gid, shards_list in need.items():
             got = self._pull_shards(old, old_gid, cfg.num, shards_list)
             if got is None:
@@ -375,10 +447,25 @@ class ShardKVServer:
                 seen, _ = dup_merge.get(cid, (-1, None))
                 if cseq > seen:
                     dup_merge[cid] = (cseq, reply)
+            for tid, coord, coord_srv, tops in getattr(got, "txn", ()):
+                prev = txn_merge.get(tid)
+                if prev is not None:  # portions from two donors: union
+                    tops = tuple(dict.fromkeys(prev[2] + tuple(tops)))
+                txn_merge[tid] = (coord, tuple(coord_srv), tuple(tops))
 
         xstate = XState(
             kv=tuple(sorted(kv_merge.items())),
-            dup=tuple(sorted(dup_merge.items())),
+            # Type-robust deterministic order: frontend-submitted ops
+            # carry INT cids (fresh_cid) while this wire's native clerks
+            # use strings — a mixed dup table must still sort (a plain
+            # sorted() raised TypeError and killed the ticker the first
+            # time a frontend-fed group reconfigured; fix en route,
+            # ISSUE 13).
+            dup=tuple(sorted(dup_merge.items(),
+                             key=lambda kv: (str(type(kv[0])),
+                                             repr(kv[0])))),
+            txn=tuple(sorted((tid, c, cs, ops) for tid, (c, cs, ops)
+                             in txn_merge.items())),
         )
         op = Op("reconf", "", "", f"reconf-{cfg.num}", cfg.num, (cfg, xstate))
         try:
@@ -416,7 +503,13 @@ class ShardKVServer:
                 (k, v) for k, v in self.kv.items() if key2shard(k) in shards_list
             )
             dup = tuple(self.dup.items())
-            return XState(kv=kv, dup=dup)
+            # Prepared-lock-table rows for the migrating shards ride
+            # along (ISSUE 13): the new owner re-locks and resolves
+            # them against the coordinator record.  The donor KEEPS its
+            # copy (like kv) — it no longer serves these keys, and its
+            # own resolver settles the stale entry the same way.
+            return XState(kv=kv, dup=dup,
+                          txn=txnkv.export_prepared(self, shards_list))
         finally:
             self.mu.release()
 
@@ -444,7 +537,12 @@ class ShardKVServer:
                     if sink is not None:
                         fut.sink = sink
                     fut.set(reply)
-                elif not self._owns(op.key):
+                elif op.kind not in txnkv.TXN_KINDS \
+                        and not self._owns(op.key):
+                    # Ownership fast-path for PLAIN ops only: 2PC ops
+                    # judge ownership per payload key (prepare) or by
+                    # tid (commit/abort/coord) at apply — the
+                    # fix-en-route semantics (ISSUE 13).
                     fut = _Fut()
                     if sink is not None:
                         fut.sink = sink
@@ -546,6 +644,24 @@ class ShardKVServer:
     def put_append(self, key: str, kind: str, value: str, cid: str, cseq: int):
         return self._serve(Op(kind, key, value, cid, cseq, None))
 
+    def txn_op(self, kind: str, key: str, value: str, cid: str, cseq: int):
+        """2PC phase surface (ISSUE 13): kind ∈ txnkv.TXN_KINDS, `key`
+        is the routing key (never an ownership claim), `value` the JSON
+        payload.  Same blocking `_serve` path as every clerk op."""
+        if kind not in txnkv.TXN_KINDS:
+            raise RPCError(f"not a txn op kind: {kind!r}")
+        return self._serve(Op(kind, key, value, cid, cseq, None))
+
+    def txn_status(self, tid: str):
+        """Coordinator-record read: the recorded decision for `tid`, or
+        None.  Lock-free on purpose — decisions are write-once (a stale
+        read can only under-report, never lie), and a resolver polling
+        a BUSY coordinator must not convoy behind its mutex (the
+        blocking-commit-wait shape)."""
+        if self.dead:
+            raise RPCError("dead")
+        return self.txn_decisions.get(tid)
+
     def _serve(self, op: Op):
         # tpuscope: stamp the caller's trace context into the proposed
         # value (the clerk/rpc leg set it current; see kvpaxos for the
@@ -562,7 +678,7 @@ class ShardKVServer:
             seen, reply = self.dup.get(op.cid, (-1, None))
             if op.cseq <= seen:
                 return reply
-            if not self._owns(op.key):
+            if op.kind not in txnkv.TXN_KINDS and not self._owns(op.key):
                 return (ErrWrongGroup, "")
             return self._sync(op)
 
@@ -621,6 +737,12 @@ class Clerk:
                 except RPCError:
                     continue
                 if err == ErrWrongGroup:
+                    break
+                if err == ErrTxnLocked:
+                    # Key locked by a prepared cross-group transaction:
+                    # paced retry with the SAME cseq (the lock reply was
+                    # never recorded in the dup filter) — falls through
+                    # to the backoff below, like a wrong-group miss.
                     break
                 return err, val
             now = time.monotonic()
@@ -722,12 +844,22 @@ SKVOP_WIRE = Struct("SKVOp", [
 
 
 def _op_to_wire(op: Op) -> dict:
+    if op.kind in txnkv.TXN_KINDS:
+        # The decentralized gob backend does not speak 2PC (SKVOP_WIRE
+        # has no txn fields; silently dropping a prepare would be a
+        # half-applied transaction by construction) — refuse loudly.
+        raise ValueError(
+            f"txn op {op.kind!r} unsupported on the gob host backend")
     d = {"Kind": op.kind, "Key": op.key, "Value": op.value,
          "CID": op.cid, "Seq": op.cseq,
          "Config": {"Num": 0, "Shards": [0] * NSHARDS, "Groups": {}},
          "XKV": {}, "XSeq": {}, "XErr": {}, "XVal": {}}
     if op.kind == "reconf":
         cfg, xs = op.extra
+        if getattr(xs, "txn", ()):
+            raise ValueError(
+                "XState with prepared transactions cannot ride the gob "
+                "wire (no txn fields) — txnkv requires the fabric backend")
         d["Config"] = {"Num": cfg.num, "Shards": list(cfg.shards),
                        "Groups": {g: list(s) for g, s in cfg.groups}}
         d["XKV"] = dict(xs.kv)

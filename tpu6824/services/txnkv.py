@@ -1,0 +1,1035 @@
+"""txnkv — cross-group atomic transactions: 2PC over Paxos groups
+(ISSUE 13, ROADMAP item 5; design shape per arxiv 1906.01365,
+*Reconfigurable Atomic Transaction Commit*).
+
+The widest workload class shardkv cannot serve alone is a multi-key
+operation SPANNING groups — a cross-shard transfer, a multi-key CAS.
+This module layers classic two-phase commit on the per-group Paxos logs
+so 2PC state is replicated and crash-recoverable for free:
+
+  - **Participants**: `txn_prepare` / `txn_commit` / `txn_abort` are
+    ordinary shardkv log entries (plain `Op`s whose kind is one of
+    `TXN_KINDS` and whose value carries a JSON payload), applied
+    deterministically by every replica of the group.  A prepare locks
+    its keys IN THE APPLY PATH — conflicting ordinary ops (and
+    conflicting prepares) answer `ErrTxnLocked` and retry through the
+    existing clerk `Backoff` budget; the vote (yes + read values, or a
+    deterministic `ErrTxnAbort` on a failed CAS expectation) is itself
+    the replicated log entry's reply, so a replica crash forgets
+    nothing.
+  - **Coordinator record — the single commit point**: `txn_coord
+    {tid, decision}` is a log entry in the COORDINATOR group whose
+    apply is first-writer-wins: whichever decision reaches that group's
+    Paxos log first IS the transaction's fate, forever.  The clerk
+    proposes `commit` after a full prepare quorum; a participant's
+    recovery path proposes `abort` for a transaction whose coordinator
+    record never appeared — the race is settled by log order, so a
+    clerk crash between prepare-quorum and commit-record can never
+    yield a half-applied transaction.
+  - **Reconfiguration safety** (the hard part and the point): a shard
+    migrating mid-commit carries its prepared-lock table inside
+    `XState.txn` (`transfer_state`), and the new owner installs the
+    inherited prepares — the keys stay locked — then resolves them by
+    consulting the coordinator record (`_txn_resolve_pass` on the
+    shardkv ticker) before the keys can serve conflicting ops.
+    Kill-mid-commit + `reconfig` + dirty-disk reboot converge to the
+    coordinator's decision from any interleaving.
+
+Two clerk surfaces:
+
+  - `TxnClerk` — in-process (directory + shardmaster config), the
+    harness/bench surface: `txn(ops)`, `multi_cas`, `transfer`.
+  - `TxnFrontendClerk` — the WIRE surface: phases ride the
+    ClerkFrontend's existing multi-group `route=` machinery as new
+    frame kinds (`txn_*`, caps-gated behind the `fe_txn` capability —
+    old clerks/servers interop unchanged in both directions; see
+    rpc/wire.py).
+
+Payloads are JSON (text-safe on every wire path, incl. the binary fe
+frame's utf-8 value field).  The decentralized gob host backend does
+NOT speak txn ops (guarded loudly in shardkv's wire codec).
+
+Pinned tradeoffs (ROADMAP item-5 successor list):
+  - coordinator decision records (`txn_decisions`) are retained
+    FOREVER — a trimmed decision that a still-unresolved prepare later
+    consults would un-decide a transaction, so bounding them needs GC
+    tied to prepare resolution, not a cap (`txn_done`, which is only
+    an idempotency cache, IS capped);
+  - `ErrTxnLocked` is a NEW error on the shared plain-op surface:
+    clerks from this PR on retry it (same cseq, Backoff-paced), but a
+    pre-txn clerk sees it as a terminal error for the lock window —
+    deployments running transactions should run upgraded clerks.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import tracing as _tracing
+from tpu6824.ops.hashing import key2shard
+from tpu6824.services import shardmaster
+from tpu6824.services.common import Backoff, FlakyNet, fresh_cid
+from tpu6824.utils import crashsink
+from tpu6824.utils.errors import (
+    OK,
+    ErrTxnAbort,
+    ErrTxnLocked,
+    ErrWrongGroup,
+    RPCError,
+)
+
+# The transactional kinds a shardkv log may carry (ISSUE 13).  These are
+# also the caps-gated fe wire kind extension — see rpc/wire.py TXN_KINDS.
+TXN_KINDS = frozenset(
+    ("txn_prepare", "txn_commit", "txn_abort", "txn_coord"))
+
+# Sub-op kinds inside a prepare payload: read (lock + report value),
+# put/append (lock + buffered write), cas (lock + expectation check +
+# buffered write).
+TXN_OP_KINDS = ("read", "put", "append", "cas")
+
+COMMIT = "commit"
+ABORT = "abort"
+
+# Participant-side recovery pacing (liveness only — SAFETY rests on the
+# coordinator record): how old a prepared entry must be before the
+# ticker consults the coordinator, and before a decision-less entry may
+# be ABORTED at the coordinator (first-writer-wins vs the clerk's
+# commit).  Inherited entries consult promptly — a migrated-in prepare
+# blocks its keys until resolved.
+import os as _os
+
+RESOLVE_AFTER = float(_os.environ.get("TPU6824_TXN_RESOLVE_AFTER", 0.5))
+ABORT_AFTER = float(_os.environ.get("TPU6824_TXN_ABORT_AFTER", 2.0))
+# Bounded memory for finished-transaction idempotency records (trimmed
+# in apply order, so every replica trims identically).
+DONE_CAP = int(_os.environ.get("TPU6824_TXN_DONE_CAP", 4096))
+
+# tpuscope metrics (module scope per the metric-unregistered rule).
+_M_BEGIN = _metrics.counter("txn.begin")
+_M_COMMIT = _metrics.counter("txn.commit")
+_M_ABORT = _metrics.counter("txn.abort")
+_M_LOCK_CONFLICTS = _metrics.counter("txn.lock_conflicts")
+_M_INHERITED = _metrics.counter("txn.inherited_prepares")
+_G_INFLIGHT = _metrics.gauge("txn.inflight")
+
+_inflight_mu = threading.Lock()
+_inflight_n = 0
+
+
+def _inflight_add(d: int) -> None:
+    global _inflight_n
+    with _inflight_mu:
+        _inflight_n += d
+        _G_INFLIGHT.set(_inflight_n)
+
+
+class TxnAborted(Exception):
+    """The transaction's coordinator decision is ABORT (CAS expectation
+    failed, lock-wait budget exhausted, or a recovery abort won the
+    commit-point race).  The caller may safely retry with a fresh
+    transaction."""
+
+
+class TxnAbandoned(RPCError):
+    """Raised by an armed mid-commit kill hook: the clerk dies between
+    prepare-quorum and commit-record, leaving the transaction's fate to
+    the participant resolvers + the coordinator log."""
+
+
+# ------------------------------------------------------------- payloads
+# JSON in Op.value: text-safe on the pickled frame, the binary fe frame
+# (utf-8 value bytes), and in-process calls alike.
+
+
+def encode_prepare(tid: str, coord: int, coord_srv, tops) -> str:
+    """tops: iterable of (key, kind, value, expect) sub-ops."""
+    return json.dumps({"tid": tid, "coord": int(coord),
+                       "coord_srv": list(coord_srv),
+                       "ops": [list(t) for t in tops]},
+                      separators=(",", ":"))
+
+
+def encode_finish(tid: str) -> str:
+    return json.dumps({"tid": tid}, separators=(",", ":"))
+
+
+def encode_coord(tid: str, decision: str) -> str:
+    return json.dumps({"tid": tid, "decision": decision},
+                      separators=(",", ":"))
+
+
+def decode_payload(value: str) -> dict:
+    return json.loads(value)
+
+
+# ------------------------------------------------------- the RSM logic
+# Called from ShardKVServer._apply under the server mutex — pure state
+# transition, deterministic across replicas, no I/O, no clock reads in
+# anything that decides an outcome (the monotonic stamp below only paces
+# the resolver, never picks a fate).
+
+
+def apply_txn(srv, op) -> tuple[tuple, bool]:
+    """Apply one decided transactional op to `srv` (a ShardKVServer).
+    Returns (reply, record): `record` is False for the retryable
+    outcomes (`ErrTxnLocked`, `ErrWrongGroup`) that must NOT enter the
+    dup filter — the clerk re-sends the same cseq after backoff."""
+    p = decode_payload(op.value)
+    tid = p["tid"]
+    if op.kind == "txn_coord":
+        # The single commit point: first decision to reach this group's
+        # log wins; every later proposal reads the recorded fate.
+        d = srv.txn_decisions.get(tid)
+        if d is None:
+            d = p["decision"]
+            srv.txn_decisions[tid] = d
+        return (OK, d), True
+
+    if op.kind == "txn_prepare":
+        tops = tuple(tuple(t) for t in p["ops"])
+        ent = srv.txn_prepared.get(tid)
+        if ent is not None and tops == ent["ops"]:
+            # True replay (re-proposed / retried, identical sub-ops):
+            # idempotent, return the recorded reads.
+            return (OK, json.dumps(ent["reads"])), True
+        # NOTE a same-tid prepare with DIFFERENT sub-ops is NOT a
+        # replay: a stale route can land another group's portion here
+        # (reads for the wrong keys would silently alias — the partial-
+        # read bug the pallas soak caught), and a clerk whose config
+        # lags can legitimately send two portions to one group that
+        # owns both.  Fall through: the incoming portion passes the
+        # SAME ownership/lock/CAS gauntlet and merges into the entry.
+        done = srv.txn_done.get(tid)
+        if done is not None:  # terminal: the txn already finished here
+            return ((OK, "{}") if done == COMMIT
+                    else (ErrTxnAbort, "")), True
+        for key, _k, _v, _e in tops:
+            if not srv._owns(key):
+                # Not recorded: the clerk re-queries the config and
+                # retries the whole transaction (shardkv's contract).
+                return (ErrWrongGroup, ""), False
+        for key, _k, _v, _e in tops:
+            holder = srv.txn_locks.get(key)
+            if holder is not None and holder != tid:
+                _M_LOCK_CONFLICTS.inc()
+                return (ErrTxnLocked, ""), False
+        reads: dict[str, str] = {}
+        for key, k, _v, exp in tops:
+            cur = srv.kv.get(key, "")
+            if k == "cas" and cur != exp:
+                # Deterministic vote NO — recorded, the txn aborts.
+                return (ErrTxnAbort, key), True
+            if k in ("read", "cas"):
+                reads[key] = cur
+        for key, _k, _v, _e in tops:
+            srv.txn_locks[key] = tid
+        if ent is not None:  # second portion at the true owner: merge
+            ent["ops"] = tuple(dict.fromkeys(ent["ops"] + tops))
+            ent["reads"].update(reads)
+        else:
+            srv.txn_prepared[tid] = {
+                "coord": int(p["coord"]),
+                "coord_srv": tuple(p.get("coord_srv", ())),
+                "ops": tops, "reads": reads,
+                "t": time.monotonic(), "inherited": False,
+            }
+        return (OK, json.dumps(reads)), True
+
+    # txn_commit / txn_abort — applies wherever the tid is prepared and
+    # is a decision RECORD everywhere else: a commit landing at a new
+    # shard owner BEFORE the migrated prepare arrives must not be lost
+    # (the reconf apply replays it against the inherited entry), and a
+    # commit landing at the pre-reconfig donor applies to its stale copy
+    # harmlessly.  NO ownership check — the fix-en-route semantics
+    # (ISSUE 13): prepared transactions outlive the shard map.
+    decision = COMMIT if op.kind == "txn_commit" else ABORT
+    ent = srv.txn_prepared.pop(tid, None)
+    if ent is not None:
+        _release_locks(srv, tid, ent)
+        # _test_partial_commit: the PR 3-style atomicity fault hook — a
+        # committing group drops its writes, manufacturing exactly the
+        # half-applied transaction the checker must catch.
+        if decision == COMMIT \
+                and not getattr(srv, "_test_partial_commit", False):
+            _apply_writes(srv, ent["ops"])
+    prior = srv.txn_done.get(tid)
+    if prior is None:
+        _record_done(srv, tid, decision)
+        prior = decision
+    return (OK, prior), True
+
+
+def _release_locks(srv, tid: str, ent: dict) -> None:
+    for key, _k, _v, _e in ent["ops"]:
+        if srv.txn_locks.get(key) == tid:
+            del srv.txn_locks[key]
+
+
+def _apply_writes(srv, tops) -> None:
+    for key, k, val, _e in tops:
+        if k in ("put", "cas"):
+            srv.kv[key] = val
+        elif k == "append":
+            srv.kv[key] = srv.kv.get(key, "") + val
+
+
+def _record_done(srv, tid: str, decision: str) -> None:
+    srv.txn_done[tid] = decision
+    if len(srv.txn_done) > DONE_CAP:
+        # Deterministic trim: applied in log order, identical on every
+        # replica (bounded idempotency records, reference dup-filter
+        # class tradeoff).
+        srv.txn_done.pop(next(iter(srv.txn_done)))
+
+
+def prune_for_import(srv, imported_shards) -> None:
+    """Reconf-apply prelude (review hardening, ISSUE 13): when shards
+    are IMPORTED, the incoming XState.txn is the AUTHORITATIVE set of
+    surviving prepares for them — any LOCAL prepared portion covering
+    those shards is a stale leftover from a previous ownership stint
+    (the shard migrated away, its 2PC state was resolved elsewhere,
+    and it migrated back).  Without this prune, the stale entry's
+    resolver would later read the eternal coordinator COMMIT and
+    re-apply old buffered writes over newer committed state (a lost
+    update; a double-apply for appends).  Deterministic: pure function
+    of RSM state, applied in log order on every replica."""
+    if not srv.txn_prepared:
+        return
+    dead_tids = []
+    for tid, ent in srv.txn_prepared.items():
+        kept = tuple(t for t in ent["ops"]
+                     if key2shard(t[0]) not in imported_shards)
+        if len(kept) == len(ent["ops"]):
+            continue
+        for key, _k, _v, _e in ent["ops"]:
+            if key2shard(key) in imported_shards \
+                    and srv.txn_locks.get(key) == tid:
+                del srv.txn_locks[key]
+        if kept:
+            ent["ops"] = kept
+            ent["reads"] = {k: v for k, v in ent["reads"].items()
+                            if key2shard(k) not in imported_shards}
+        else:
+            dead_tids.append(tid)
+    for tid in dead_tids:
+        del srv.txn_prepared[tid]
+
+
+def install_inherited(srv, txn_entries) -> None:
+    """Reconf-apply half of reconfiguration safety: install the
+    prepared entries that traveled with the shard state (`XState.txn`).
+    Keys re-lock under the new owner; a decision that arrived BEFORE
+    the migration (recorded in txn_done) replays against the inherited
+    writes immediately."""
+    for tid, coord, coord_srv, tops in txn_entries:
+        tops = tuple(tuple(t) for t in tops)
+        done = srv.txn_done.get(tid)
+        if done is not None:
+            if done == COMMIT:
+                _apply_writes(srv, tops)
+            continue
+        ent = srv.txn_prepared.get(tid)
+        if ent is not None:
+            # A second donor's portion of the same transaction: merge.
+            merged = tuple(dict.fromkeys(ent["ops"] + tops))
+            ent["ops"] = merged
+            for key, _k, _v, _e in tops:
+                srv.txn_locks[key] = tid
+            continue
+        for key, _k, _v, _e in tops:
+            srv.txn_locks[key] = tid
+        srv.txn_prepared[tid] = {
+            "coord": int(coord), "coord_srv": tuple(coord_srv),
+            "ops": tops, "reads": {},
+            "t": time.monotonic(), "inherited": True,
+        }
+        _M_INHERITED.inc()
+
+
+def export_prepared(srv, shards_list) -> tuple:
+    """Donor half (`transfer_state`): the prepared-lock-table rows whose
+    keys fall in the migrating shards, in XState.txn shape —
+    (tid, coord_gid, coord_srv, sub-ops)."""
+    out = []
+    for tid, ent in sorted(srv.txn_prepared.items()):
+        tops = tuple(t for t in ent["ops"]
+                     if key2shard(t[0]) in shards_list)
+        if tops:
+            out.append((tid, ent["coord"], tuple(ent["coord_srv"]), tops))
+    return tuple(out)
+
+
+# --------------------------------------------------------- the resolver
+# Runs on the shardkv ticker thread, NEVER under the server mutex and
+# never inside _apply (the tpusan `blocking-commit-wait` shape): consult
+# the coordinator, then drive the outcome through this group's OWN log.
+
+
+def resolve_pass(srv, limit: int = 4) -> int:
+    """One recovery pass over srv's aged/inherited prepared entries.
+    Returns the number of transactions resolved."""
+    now = time.monotonic()
+    with srv.mu:
+        if srv.dead or not srv.txn_prepared:
+            return 0
+        cands = []
+        for tid, ent in srv.txn_prepared.items():
+            age_floor = (getattr(srv, "txn_resolve_inherited", 0.05)
+                         if ent["inherited"]
+                         else getattr(srv, "txn_resolve_after",
+                                      RESOLVE_AFTER))
+            if now - ent["t"] >= age_floor:
+                cands.append((tid, dict(ent)))
+            if len(cands) >= limit:
+                break
+    resolved = 0
+    for tid, ent in cands:
+        d = consult_coordinator(srv, ent, tid)
+        if d is None:
+            if now - ent["t"] < getattr(srv, "txn_abort_after",
+                                        ABORT_AFTER):
+                continue
+            # No decision anywhere and the clerk is presumed dead:
+            # race an ABORT into the coordinator log.  First writer
+            # wins — if the clerk's commit got there first, we read
+            # COMMIT back and apply it.
+            d = decide_at_coordinator(srv, ent, tid, ABORT)
+        if d is None:
+            continue
+        kind = "txn_commit" if d == COMMIT else "txn_abort"
+        from tpu6824.services.shardkv import Op as _SOp
+        op = _SOp(kind, "", encode_finish(tid), f"txr-{tid}", 1, None)
+        try:
+            with srv.mu:
+                if tid not in srv.txn_prepared:
+                    continue  # another path finished it meanwhile
+                srv._sync(op)
+            resolved += 1
+        except RPCError:
+            continue
+    return resolved
+
+
+def _coord_servers(srv, ent):
+    names = ent["coord_srv"]
+    if not names:
+        # Fallback: shardkv servers self-register as "g<gid>-<me>".
+        pfx = f"g{ent['coord']}-"
+        names = tuple(sorted(n for n in srv.directory if n.startswith(pfx)))
+    return names
+
+
+def consult_coordinator(srv, ent, tid: str):
+    """The coordinator record's decision for tid, or None (no decision
+    yet / coordinator unreachable).  Decisions are write-once, so a
+    stale read can only under-report — never lie."""
+    if ent["coord"] == srv.gid:
+        return srv.txn_decisions.get(tid)  # lock-free: write-once value
+    for name in _coord_servers(srv, ent):
+        peer = srv.directory.get(name)
+        if peer is None or peer is srv:
+            continue
+        try:
+            d = peer.txn_status(tid)
+        except Exception:  # noqa: BLE001 — dead/partitioned peer: next
+            continue
+        if d is not None:
+            return d
+    return None
+
+
+def decide_at_coordinator(srv, ent, tid: str, decision: str):
+    """Propose `decision` into the coordinator group's log (first
+    writer wins); returns the ACTUAL recorded decision, or None."""
+    payload = encode_coord(tid, decision)
+    cid = f"txr-{srv.gid}-{tid}"
+    from tpu6824.services.shardkv import Op as _SOp
+    if ent["coord"] == srv.gid:
+        op = _SOp("txn_coord", "", payload, cid, 1, None)
+        try:
+            with srv.mu:
+                err, d = srv._sync(op)
+        except RPCError:
+            return None
+        return d if err == OK else None
+    for name in _coord_servers(srv, ent):
+        peer = srv.directory.get(name)
+        if peer is None:
+            continue
+        try:
+            err, d = peer.txn_op("txn_coord", "", payload, cid, 1)
+        except Exception:  # noqa: BLE001 — try the next replica
+            continue
+        if err == OK:
+            return d
+    return None
+
+
+# -------------------------------------------------- mid-commit killing
+
+
+class MidCommitKiller:
+    """One-shot kill-between-prepare-quorum-and-commit-record, armed by
+    the nemesis `kill_mid_commit {disk}` action (TxnKillTarget).
+    Install as `clerk.mid_commit_hook` on every clerk under test; the
+    next transaction that reaches its commit point fires `crash_fn(disk)`
+    (e.g. kill a coordinator-group replica, with the disk disposition
+    recorded for durafault deployments) and dies via `TxnAbandoned` —
+    the fate of that transaction is then entirely the resolvers' +
+    coordinator log's problem, which is the scenario's point."""
+
+    def __init__(self, crash_fn=None):
+        self.crash_fn = crash_fn
+        self._mu = threading.Lock()
+        self._armed: str | None = None
+        self.fired: list[tuple[str, str]] = []  # (tid, disk)
+
+    def arm(self, disk: str = "keep") -> None:
+        with self._mu:
+            self._armed = disk
+
+    def disarm(self) -> None:
+        with self._mu:
+            self._armed = None
+
+    def __call__(self, tid: str, coord_gid: int) -> None:
+        with self._mu:
+            disk, self._armed = self._armed, None
+        if disk is None:
+            return
+        self.fired.append((tid, disk))
+        if self.crash_fn is not None:
+            try:
+                self.crash_fn(coord_gid, disk)
+            except Exception as e:  # noqa: BLE001 — the kill must land
+                crashsink.record("mid-commit-kill", e, fatal=False)
+        raise TxnAbandoned(f"killed mid-commit (tid={tid}, disk={disk})")
+
+
+# ------------------------------------------------------------- history
+
+
+class TxnHistory:
+    """Thread-safe transactional history recorder (the txn analog of
+    harness.linearize.History) — consumed by harness/txn_check.py."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._recs: list = []
+        self.t0 = time.monotonic()
+
+    def now(self) -> float:
+        return time.monotonic() - self.t0
+
+    def record(self, rec) -> None:
+        with self._mu:
+            self._recs.append(rec)
+
+    def records(self) -> list:
+        with self._mu:
+            return list(self._recs)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._recs)
+
+
+# ------------------------------------------------------------ the clerk
+
+
+class _TxnClerkBase:
+    """The 2PC driver shared by the in-process and wire clerks; the
+    transport-specific half is `_phase_call` + `_config`."""
+
+    #: prepare attempts per group before giving up on a lock (the
+    #: distributed-deadlock breaker: abort + fresh transaction).
+    LOCK_RETRIES = 24
+    #: config-snapshot TTL: a shardmaster Query is a LOGGED Paxos op —
+    #: per-attempt re-queries from a fleet of clerks would saturate the
+    #: sm log exactly like the shardkv poller problem
+    #: (shardkv.py::_tick_loop docstring).  Both clerks cache through
+    #: `_cached_cfg`.
+    CFG_TTL = 0.05
+
+    def __init__(self, history: TxnHistory | None = None,
+                 lock_retries: int | None = None):
+        self.history = history
+        self.lock_retries = (self.LOCK_RETRIES if lock_retries is None
+                             else lock_retries)
+        self.mid_commit_hook = None  # nemesis/test seam
+        self._backoff = Backoff()
+        self.cid = f"txn-{fresh_cid():x}"
+        self._cseq = 0
+        self._cseq_mu = threading.Lock()
+        self._cfg_at = -float("inf")
+        self._cfg = None
+
+    def _cached_cfg(self):
+        now = time.monotonic()
+        if self._cfg is None or now - self._cfg_at >= self.CFG_TTL:
+            self._cfg = self.smck.query(-1, timeout=5.0)
+            self._cfg_at = now
+        return self._cfg
+
+    def _next(self) -> int:
+        with self._cseq_mu:
+            self._cseq += 1
+            return self._cseq
+
+    # transport half -----------------------------------------------------
+    def _config(self):
+        raise NotImplementedError
+
+    def _phase_call(self, gid, kind, routing_key, payload, cseq,
+                    deadline, retry_locked=False):
+        """One phase op against group `gid` → (err, val).  Transport
+        retries until `deadline`; with retry_locked, ErrTxnLocked also
+        retries (same cseq) until the deadline."""
+        raise NotImplementedError
+
+    # the protocol -------------------------------------------------------
+    def txn(self, ops, timeout: float = 20.0):
+        """Run `ops` — (key, kind, value[, expect]) sub-ops, kinds from
+        TXN_OP_KINDS — as ONE atomic cross-group transaction.
+
+        Returns (status, reads): status 'committed' | 'aborted';
+        `reads` maps key → value observed at the commit point for
+        read/cas sub-ops (None when aborted).  Raises TxnAbandoned if a
+        mid-commit kill hook fired (fate unknown — resolvers own it)
+        and RPCError when the coordinator was unreachable (fate
+        unknown).  Every outcome is recorded into `self.history`."""
+        ops = [self._norm(t) for t in ops]
+        call_t = self.history.now() if self.history is not None else 0.0
+        root = _tracing.span("txn.op", comp="txn",
+                             nops=len(ops)) if _tracing.enabled() else None
+        try:
+            status, reads = self._txn_inner(ops, timeout, root)
+            self._record(ops, call_t, status, reads)
+            return status, reads
+        except TxnAbandoned:
+            self._record(ops, call_t, "unknown", None)
+            raise
+        except RPCError:
+            self._record(ops, call_t, "unknown", None)
+            raise
+        finally:
+            if root is not None:
+                root.end()
+
+    @staticmethod
+    def _norm(t):
+        key, kind, value = t[0], t[1], t[2]
+        expect = t[3] if len(t) > 3 else ""
+        if kind not in TXN_OP_KINDS:
+            raise ValueError(f"unknown txn sub-op kind {kind!r}")
+        return (key, kind, value, expect)
+
+    def _record(self, ops, call_t, status, reads) -> None:
+        if self.history is None:
+            return
+        from tpu6824.harness.txn_check import TxnRecord
+        rec_ops = []
+        for k, kind, v, exp in ops:
+            if kind == "read":
+                rec_ops.append(("r", k, (reads or {}).get(k, "")))
+            elif kind == "cas":
+                rec_ops.append(("r", k, exp))
+                rec_ops.append(("w", k, v))
+            elif kind == "append":
+                rec_ops.append(("a", k, v))
+            else:
+                rec_ops.append(("w", k, v))
+        self.history.record(TxnRecord(
+            client=self.cid, ops=tuple(rec_ops), call=call_t,
+            ret=self.history.now() if status != "unknown" else None,
+            status=status))
+
+    def _txn_inner(self, ops, timeout, root):
+        deadline = time.monotonic() + timeout
+        self._backoff.reset()
+        while True:
+            out = self._attempt(ops, deadline, root)
+            if out is not None:
+                return out
+            if time.monotonic() >= deadline:
+                raise RPCError("txn timeout (config churn?)")
+            self._backoff.sleep(deadline - time.monotonic())
+
+    def _attempt(self, ops, deadline, root):
+        """One transaction attempt.  None = config raced us
+        (ErrWrongGroup after re-route) — the caller retries with a
+        fresh config and a fresh tid."""
+        cfg_view = self._config()
+        parts: dict[int, list] = {}
+        for t in ops:
+            gid = cfg_view.gid_of(t[0])
+            if gid is None:
+                return None  # unassigned shard: config still settling
+            parts.setdefault(gid, []).append(t)
+        gids = sorted(parts)
+        coord = gids[0]
+        tid = f"t{fresh_cid():x}"
+        _M_BEGIN.inc()
+        _inflight_add(1)
+        rctx = root.ctx if root is not None else None
+        try:
+            decision = COMMIT
+            reads: dict[str, str] = {}
+            prepared: list[int] = []
+            unknown_phase = False  # a prepare whose fate we can't see
+            sp = _tracing.child("txn.begin", parent=rctx, comp="txn",
+                                tid=tid)
+            if sp is not None:
+                sp.end()
+            for gid in gids:
+                payload = encode_prepare(
+                    tid, cfg_view.real_gid(coord),
+                    cfg_view.server_names(coord), parts[gid])
+                psp = _tracing.child("txn.prepare", parent=rctx,
+                                     comp="txn", gid=gid)
+                try:
+                    with _tracing.use_ctx(psp.ctx if psp is not None
+                                          else None):
+                        err, val = self._phase_call(
+                            gid, "txn_prepare", parts[gid][0][0],
+                            payload, self._next(),
+                            min(deadline, time.monotonic() + 4.0),
+                            retry_locked=True)
+                except RPCError:
+                    err, val = None, None  # fate at gid unknown
+                    unknown_phase = True
+                finally:
+                    if psp is not None:
+                        psp.end()
+                if err == OK:
+                    prepared.append(gid)
+                    reads.update(json.loads(val) if val else {})
+                    continue
+                decision = ABORT
+                abort_reason = (val if err == ErrTxnAbort
+                                else err or "unreachable")
+                break
+            if decision == ABORT and not prepared and not unknown_phase:
+                # Nothing is held under this tid ANYWHERE (every
+                # refusal was a definitive no-lock reply: ErrTxnLocked
+                # budget, CAS-fail vote, wrong group) — a coordinator
+                # record would be a pure-overhead Paxos round plus an
+                # eternal decision row no resolver can ever consult
+                # (review hardening: at contention-level abort rates
+                # that roughly doubles coordinator log traffic).
+                _M_ABORT.inc()
+                return None if abort_reason == ErrWrongGroup \
+                    else ("aborted", None)
+            if decision == COMMIT and self.mid_commit_hook is not None:
+                self.mid_commit_hook(tid, coord)
+            csp = _tracing.child("txn.commit", parent=rctx, comp="txn",
+                                 tid=tid, decision=decision)
+            try:
+                with _tracing.use_ctx(csp.ctx if csp is not None
+                                      else None):
+                    err, actual = self._phase_call(
+                        coord, "txn_coord", cfg_view.coord_key(coord),
+                        encode_coord(tid, decision), self._next(),
+                        deadline)
+            except RPCError:
+                err, actual = None, None
+            if err != OK or actual not in (COMMIT, ABORT):
+                # The commit point itself is unreachable: the fate is
+                # genuinely unknown — resolvers will settle it.
+                if csp is not None:
+                    csp.end()
+                raise RPCError(f"txn {tid}: coordinator unreachable, "
+                               "fate unknown")
+            for gid in prepared:
+                fk = "txn_commit" if actual == COMMIT else "txn_abort"
+                try:
+                    self._phase_call(gid, fk, parts[gid][0][0],
+                                     encode_finish(tid), self._next(),
+                                     deadline)
+                except RPCError:
+                    pass  # the resolver finishes stragglers
+            if csp is not None:
+                rsp = _tracing.child("txn.reply", parent=csp.ctx,
+                                     comp="txn", tid=tid)
+                if rsp is not None:
+                    rsp.end()
+                csp.end()
+            if actual == COMMIT:
+                _M_COMMIT.inc()
+                return ("committed", reads)
+            _M_ABORT.inc()
+            if decision == COMMIT:
+                # We asked for commit but a recovery abort won the
+                # race: aborted, retryable.
+                return ("aborted", None)
+            if abort_reason == ErrWrongGroup:
+                return None  # re-route with a fresh config
+            return ("aborted", None)
+        finally:
+            _inflight_add(-1)
+
+    # convenience surface ------------------------------------------------
+    def multi_cas(self, triples, timeout: float = 20.0) -> bool:
+        """Atomically set every key whose current value matches its
+        expectation: triples = (key, expect, new).  True on commit."""
+        status, _ = self.txn([(k, "cas", new, exp)
+                              for k, exp, new in triples], timeout=timeout)
+        return status == "committed"
+
+    def read(self, keys, timeout: float = 20.0) -> dict:
+        """One atomic multi-key snapshot (a read-only transaction).
+        An aborted attempt (a lock window, a lost commit-point race)
+        is retried within the deadline — a read-only txn is always
+        safely retryable; TxnAborted surfaces only at exhaustion."""
+        deadline = time.monotonic() + timeout
+        bo = Backoff()
+        ops = [(k, "read", "", "") for k in keys]
+        while True:
+            status, reads = self.txn(
+                ops, timeout=max(0.5, deadline - time.monotonic()))
+            if status == "committed":
+                return reads
+            if time.monotonic() >= deadline:
+                raise TxnAborted("read-only txn aborted")
+            bo.sleep(deadline - time.monotonic())
+
+    def transfer(self, src: str, dst: str, amount: int,
+                 timeout: float = 30.0) -> bool:
+        """Cross-shard transfer: atomically move `amount` from src to
+        dst (integer balances, missing key = 0), conserving the sum.
+        Optimistic CAS loop: snapshot, compute, multi_cas, retry on
+        expectation failure."""
+        deadline = time.monotonic() + timeout
+        bo = Backoff()
+        while True:
+            try:
+                snap = self.read(
+                    [src, dst],
+                    timeout=max(0.5, deadline - time.monotonic()))
+            except TxnAborted:
+                # The snapshot's read-only txn lost a commit-point race
+                # (a resolver's recovery abort) — retryable like any
+                # CAS miss.
+                if time.monotonic() >= deadline:
+                    return False
+                bo.sleep(deadline - time.monotonic())
+                continue
+            a = int(snap.get(src) or 0)
+            b = int(snap.get(dst) or 0)
+            if self.multi_cas(
+                    [(src, snap.get(src, ""), str(a - amount)),
+                     (dst, snap.get(dst, ""), str(b + amount))],
+                    timeout=max(0.5, deadline - time.monotonic())):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            bo.sleep(deadline - time.monotonic())
+
+
+class _ConfigView:
+    """One attempt's routing snapshot: key → gid, gid → server names,
+    and the coordinator routing token for the wire path.  Wire clerks
+    work in FRONTEND GROUP-INDEX space (gid_to_idx given); payloads
+    always carry the REAL gid (`real_gid`) so participant resolvers can
+    find the coordinator group in the directory."""
+
+    def __init__(self, cfg, gid_to_idx=None):
+        self.cfg = cfg
+        self._g2i = gid_to_idx
+        self._i2g = (None if gid_to_idx is None
+                     else {i: g for g, i in gid_to_idx.items()})
+
+    def gid_of(self, key: str):
+        gid = self.cfg.shards[key2shard(key)]
+        if gid == shardmaster.UNASSIGNED:
+            return None
+        if self._g2i is not None:
+            return self._g2i.get(gid)
+        return gid
+
+    def real_gid(self, gid):
+        return self._i2g[gid] if self._i2g is not None else gid
+
+    def server_names(self, gid) -> tuple:
+        return tuple(self.cfg.groups_dict().get(self.real_gid(gid), ()))
+
+    def coord_key(self, gid) -> str:
+        # In-process clerks route by gid directly; the wire clerk's
+        # coordinator op routes via the NUL-prefixed token its route fn
+        # understands (frontend_route below — collision-proof against
+        # user keys).
+        return _coord_token(gid) if self._g2i is not None else ""
+
+
+class TxnClerk(_TxnClerkBase):
+    """In-process transactional clerk over a shardkv deployment: routes
+    by shardmaster config, talks to ShardKVServer.txn_op through the
+    lossy FlakyNet leg like every other in-process clerk."""
+
+    def __init__(self, sm_servers, directory: dict,
+                 net: FlakyNet | None = None,
+                 history: TxnHistory | None = None, **kw):
+        super().__init__(history=history, **kw)
+        self.smck = shardmaster.Clerk(sm_servers)
+        self.directory = directory
+        self.net = net or FlakyNet()
+
+    def _config(self):
+        return _ConfigView(self._cached_cfg())
+
+    def _phase_call(self, gid, kind, routing_key, payload, cseq,
+                    deadline, retry_locked=False):
+        cfg = self._cached_cfg()
+        names = cfg.groups_dict().get(gid, ())
+        if not names:
+            # Group left the config (still serving): directory fallback.
+            pfx = f"g{gid}-"
+            names = tuple(sorted(n for n in self.directory
+                                 if n.startswith(pfx)))
+        bo = Backoff()
+        attempts = 0
+        while True:
+            for name in names:
+                srv = self.directory.get(name)
+                if srv is None:
+                    continue
+                try:
+                    err, val = self.net.call(
+                        srv, srv.txn_op, kind, routing_key, payload,
+                        self.cid, cseq)
+                except RPCError:
+                    continue
+                if err == ErrTxnLocked and retry_locked:
+                    attempts += 1
+                    if attempts >= self.lock_retries \
+                            or time.monotonic() >= deadline:
+                        return err, val  # give up: caller aborts
+                    bo.sleep(max(0.0, deadline - time.monotonic()))
+                    break  # re-send same cseq from the head
+                return err, val
+            else:
+                if time.monotonic() >= deadline:
+                    raise RPCError(f"txn phase {kind}@g{gid}: no live "
+                                   "replica within deadline")
+                bo.sleep(max(0.0, deadline - time.monotonic()))
+
+
+class TxnFrontendClerk(_TxnClerkBase):
+    """The WIRE transactional clerk: every phase op is one frame op
+    through a multi-group ClerkFrontend — (kind, routing_key, payload,
+    cid, cseq) tuples with the caps-gated txn frame kinds.  `gids`
+    fixes the frontend's group order (index space); `sm_servers` feeds
+    the routing snapshot.  An endpoint whose fe_caps does not advertise
+    `fe_txn` refuses transactions loudly (old servers interop unchanged
+    for every pre-txn op)."""
+
+    def __init__(self, addrs, sm_servers, gids, timeout: float = 10.0,
+                 history: TxnHistory | None = None, wire_format="auto",
+                 **kw):
+        super().__init__(history=history, **kw)
+        from tpu6824.services.frontend import FrontendClerk
+        self._fc = FrontendClerk(addrs, timeout=timeout,
+                                 wire_format=wire_format)
+        self.smck = shardmaster.Clerk(sm_servers)
+        self.gids = list(gids)
+        self._g2i = {g: i for i, g in enumerate(self.gids)}
+        self.cid = self._fc.cid  # one wire identity, one dup-filter row
+
+    def _config(self):
+        return _ConfigView(self._cached_cfg(), gid_to_idx=self._g2i)
+
+    def _phase_call(self, gid, kind, routing_key, payload, cseq,
+                    deadline, retry_locked=False):
+        bo = Backoff()
+        attempts = 0
+        while True:
+            budget = max(0.2, deadline - time.monotonic())
+            err, val = self._fc.txn_call(
+                (kind, routing_key, payload, self.cid, cseq),
+                timeout=budget)
+            if err == ErrTxnLocked and retry_locked:
+                attempts += 1
+                if attempts >= self.lock_retries \
+                        or time.monotonic() >= deadline:
+                    return err, val
+                bo.sleep(max(0.0, deadline - time.monotonic()))
+                continue
+            return err, val
+
+    def close(self) -> None:
+        self._fc.close()
+
+
+# Coordinator routing token ("\x00g<idx>!"): leads with a NUL byte so
+# it cannot collide with any printable user key, and the route falls
+# through to the shard map on anything that does not match the exact
+# shape (a user key merely STARTING with the prefix is still routed,
+# never rejected).  Produced only by _ConfigView.coord_key, consumed
+# only by frontend_route; keys beginning with NUL are reserved.
+_COORD_TOKEN_PREFIX = "\x00g"
+
+
+def _coord_token(idx: int) -> str:
+    return f"{_COORD_TOKEN_PREFIX}{idx}!"
+
+
+def _parse_coord_token(key: str):
+    """Group index for an exact coordinator token, else None."""
+    if not key.startswith(_COORD_TOKEN_PREFIX):
+        return None
+    bang = key.find("!")
+    if bang <= len(_COORD_TOKEN_PREFIX):
+        return None
+    digits = key[len(_COORD_TOKEN_PREFIX):bang]
+    return int(digits) if digits.isdigit() else None
+
+
+def frontend_route(gids, cfg_cell):
+    """The route= closure for a txn-capable multi-group ClerkFrontend:
+    ordinary keys follow the CURRENT shard map (cfg_cell is a 1-slot
+    mutable holding the latest Config — see ConfigRouter), and the
+    coordinator token routes straight to that group index (the
+    txn_coord op's apply never checks ownership)."""
+    g2i = {g: i for i, g in enumerate(gids)}
+    ng = len(gids)
+
+    def route(key: str) -> int:
+        idx = _parse_coord_token(key)
+        if idx is not None and 0 <= idx < ng:
+            return idx
+        gid = cfg_cell[0].shards[key2shard(key)]
+        return g2i.get(gid, 0)
+
+    return route
+
+
+class ConfigRouter:
+    """Keeps a frontend route's config snapshot fresh: a daemon poller
+    queries the shardmaster every `interval` and writes the 1-slot cell
+    `frontend_route` reads — the engine thread never blocks on a config
+    Query."""
+
+    def __init__(self, sm_servers, gids, interval: float = 0.05):
+        self.smck = shardmaster.Clerk(sm_servers)
+        self.cell = [self.smck.query(-1, timeout=5.0)]
+        self.route = frontend_route(gids, self.cell)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=crashsink.guarded(self._loop, "txn-config-router"),
+            daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.cell[0] = self.smck.query(-1, timeout=2.0)
+            except RPCError:
+                continue  # sm group busy/partitioned: keep the old map
+
+    def stop(self):
+        self._stop.set()

@@ -12,6 +12,13 @@ ErrWrongServer = "ErrWrongServer"
 ErrWrongGroup = "ErrWrongGroup"
 ErrNotReady = "ErrNotReady"
 ErrUninitServer = "ErrUninitServer"
+# txnkv (ISSUE 13): a key is locked by a prepared cross-group transaction
+# — retryable, NEVER recorded in the dup filter (the client re-sends the
+# same cseq once the lock releases, exactly the ErrWrongGroup contract);
+# and a prepare vote of no (CAS expectation failed / deterministic
+# refusal) — recorded, the transaction must abort.
+ErrTxnLocked = "ErrTxnLocked"
+ErrTxnAbort = "ErrTxnAbort"
 
 Err = str
 
