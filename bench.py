@@ -83,6 +83,46 @@ def _tpuscope_delta(before):
         return {"error": repr(e)[:200]}
 
 
+def _mem_begin():
+    """Start a per-leg memory block (ISSUE 14, horizon): RSS + the
+    horizon snapshot/install counters, so every service-shaped leg
+    records what host memory did WHILE it ran and whether compaction
+    was live during it."""
+    from tpu6824.obs import metrics as _m
+    from tpu6824.obs.pulse import read_rss_bytes
+
+    ctr = _m.snapshot().get("counters", {})
+    return {
+        "t": time.monotonic(),
+        "rss": read_rss_bytes() or 0,
+        "snapshots": ctr.get("horizon.snapshots", {}).get("total", 0),
+        "installs": ctr.get("horizon.installs", {}).get("total", 0),
+    }
+
+
+def _mem_delta(m0):
+    from tpu6824.obs import metrics as _m
+    from tpu6824.obs.pulse import read_peak_rss_bytes, read_rss_bytes
+
+    dt = max(time.monotonic() - m0["t"], 1e-9)
+    rss1 = read_rss_bytes() or 0
+    peak = read_peak_rss_bytes()
+    ctr = _m.snapshot().get("counters", {})
+    return {
+        "rss_before_bytes": m0["rss"],
+        "rss_after_bytes": rss1,
+        # ru_maxrss is a PROCESS-LIFETIME high-water mark — named so,
+        # because a leg that runs after a hungry one inherits it; the
+        # per-leg numbers are rss before/after and the slope.
+        "process_peak_rss_bytes": peak,
+        "slope_mb_per_s": round((rss1 - m0["rss"]) / 1e6 / dt, 4),
+        "snapshots": ctr.get("horizon.snapshots", {}).get("total", 0)
+        - m0["snapshots"],
+        "installs": ctr.get("horizon.installs", {}).get("total", 0)
+        - m0["installs"],
+    }
+
+
 def _environment_begin():
     """The run's environment block skeleton: cgroup cpu budget, load
     averages, cpu count (obs/pulse.py probes).  Captured BEFORE the
@@ -352,10 +392,12 @@ def child_main():
         # API-driven configs (never cost the headline line on failure):
         _spin(env, "service")
         leg0 = _tpuscope_begin()
+        mem0 = _mem_begin()
         try:
             service = _service_rate()
         except Exception as e:  # noqa: BLE001
             service = {"value": 0.0, "error": repr(e)[:200]}
+        service["mem"] = _mem_delta(mem0)
         service["tpuscope"] = _tpuscope_delta(leg0)
         _spin(env, "clerk")
         leg0 = _tpuscope_begin()
@@ -389,11 +431,22 @@ def child_main():
         # p99 commit latency, conserved-sum asserted.
         _spin(env, "txn")
         leg0 = _tpuscope_begin()
+        mem0 = _mem_begin()
         try:
             service["txn"] = _txn_rate()
         except Exception as e:  # noqa: BLE001
             service["txn"] = {"value": 0.0, "error": repr(e)[:200]}
+        service["txn"]["mem"] = _mem_delta(mem0)
         service["txn"]["tpuscope"] = _tpuscope_delta(leg0)
+        # Catch-up micro-leg (ISSUE 14, horizon): snapshot-install vs
+        # log-replay wall time at three horizon depths.
+        _spin(env, "catchup")
+        leg0 = _tpuscope_begin()
+        try:
+            service["catchup"] = _catchup_rate()
+        except Exception as e:  # noqa: BLE001
+            service["catchup"] = {"value": 0.0, "error": repr(e)[:200]}
+        service["catchup"]["tpuscope"] = _tpuscope_delta(leg0)
         # Durability leg (durafault): recovery-time percentiles, gated by
         # benchdiff like every throughput leg.
         _spin(env, "recovery")
@@ -1682,6 +1735,87 @@ def _txn_rate():
         }
     finally:
         system.shutdown()
+
+
+def _catchup_rate():
+    """service.catchup (ISSUE 14, horizon): wall time for a replica
+    revived BEHIND the group to rejoin, measured both ways at three
+    horizon depths — (a) LOG REPLAY (compaction off: the amnesiac
+    replica fast-forwards to Min and replays the live window) and
+    (b) SNAPSHOT-INSTALL (horizon on: chunked peer snapshot over the
+    snapshot_fetch route, then replay from the watermark).  Value =
+    installed ops/sec at the deepest depth; the per-depth table is the
+    judgeable artifact (install should win increasingly with depth —
+    replay cost grows with the missed span, install cost with state
+    size).  Knobs: BENCH_CATCHUP_DEPTHS ("64,192,384")."""
+    from tpu6824.services.kvpaxos import Clerk, KVPaxosServer, make_cluster
+
+    depths = [int(x) for x in os.environ.get(
+        "BENCH_CATCHUP_DEPTHS", "64,192,384").split(",") if x.strip()]
+    legs = []
+    for depth in depths:
+        fabric, servers = make_cluster(
+            3, ninstances=depth + 160, snapshot_every=32,
+            dup_retire_ops=0)
+        try:
+            ck = Clerk(servers)
+            for i in range(16):
+                ck.put(f"pre{i}", "x")
+            servers[2].kill()
+            for i in range(depth):
+                ck.put(f"d{i % 31}", f"v{i}")
+            head = servers[0].applied
+            deadline = time.monotonic() + 30.0
+            while servers[0].horizon.last_applied < head - 64 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+
+            def revive(**kw):
+                fabric.revive(0, 2)
+                # peers in the CTOR: the driver's boot-time Min probe
+                # must already see donors, or it falls back to the
+                # legacy skip-forward and the timing measures nothing.
+                fresh = KVPaxosServer(fabric, 0, 2, peers=servers, **kw)
+                servers[2] = fresh
+                t0 = time.perf_counter()
+                dl = time.monotonic() + 60.0
+                while (fresh.applied < head or fresh._behind_min) and \
+                        time.monotonic() < dl:
+                    time.sleep(0.002)
+                dt = time.perf_counter() - t0
+                assert fresh.applied >= head, \
+                    f"catch-up stalled at {fresh.applied}/{head}"
+                return fresh, dt
+
+            # (a) log replay: horizon off — the legacy path.
+            fresh, t_replay = revive(snapshot_every=0)
+            fresh.kill()
+            # (b) snapshot-install: horizon on, donors serving chunks.
+            head = servers[0].applied
+            fresh, t_install = revive(snapshot_every=32,
+                                      dup_retire_ops=0)
+            snap = servers[0].horizon.snap
+            snap_bytes = len(snap[1]) if snap else 0
+            legs.append({"depth": depth,
+                         "replay_ms": round(t_replay * 1e3, 2),
+                         "install_ms": round(t_install * 1e3, 2),
+                         "snapshot_bytes": snap_bytes})
+        finally:
+            for s in servers:
+                s.kill()
+            fabric.stop_clock()
+    deepest = legs[-1]
+    return {
+        "value": round(depths[-1] / max(deepest["install_ms"] / 1e3,
+                                        1e-9), 1),
+        "install_ms_deepest": deepest["install_ms"],
+        "legs": legs,
+        "shape": {"depths": depths, "replicas": 3},
+        "note": ("value = missed ops recovered per second via "
+                 "snapshot-install at the deepest depth; legs table "
+                 "compares install vs log-replay wall time per depth"),
+        "knobs": "BENCH_CATCHUP_DEPTHS",
+    }
 
 
 def _recovery_rate():

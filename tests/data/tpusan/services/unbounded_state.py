@@ -1,0 +1,26 @@
+"""Golden for unbounded-host-state (ISSUE 14): an RSM apply path that
+grows self-attribute stores with no trim/GC/snapshot path anywhere in
+the class — every decided op grows host memory forever.  Expected
+findings: 2 (the audit log list and the results dict; `self.kv` is
+exempt because `_install` rebinds it — the snapshot-replace path)."""
+
+
+class LeakyServer:
+    def __init__(self):
+        self.kv = {}
+        self.results = {}
+        self.audit = []
+        self.pending = {}
+
+    def _apply(self, op):
+        self.kv[op.key] = op.value          # exempt: _install rebinds it
+        self.results[op.cid] = (op.cseq, "ok")   # finding: never trimmed
+        self.audit.append((op.cid, op.key))      # finding: never trimmed
+        self.pending[op.cid] = op                # exempt: popped below
+        return "ok"
+
+    def _resolve(self, cid):
+        self.pending.pop(cid, None)
+
+    def _install(self, blob):
+        self.kv = dict(blob["kv"])

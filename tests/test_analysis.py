@@ -52,6 +52,7 @@ GOLDEN_EXPECT = {
     "services/persist_rename.py": {"durable-write-discipline": 2},
     "services/frontend.py": {"blocking-in-eventloop": 5},
     "services/commit_wait.py": {"blocking-commit-wait": 2},
+    "services/unbounded_state.py": {"unbounded-host-state": 2},
     "rpc/native_server.py": {"python-decode-in-native-path": 3},
     "rpc/retry_loop.py": {"unbounded-retry": 2},
     "obs/unbounded.py": {"unbounded-obs-buffer": 3},

@@ -443,16 +443,22 @@ def test_disk_fault_soak_checkpointing_fabric(kernel, tmp_path,
                          actions=["partition_minority", "partition_random",
                                   "heal", "unreliable", "reliable"]),
             ProcessTarget(names, crash_fn, reboot_fn,
-                          proc_groups={n: f"g{gid}" for n in names}),
+                          proc_groups={n: f"g{gid}" for n in names},
+                          # lag_revive (ISSUE 14): same crash primitive,
+                          # but the victim stays down while traffic
+                          # drives the group past it — the scheduled
+                          # reboot then exercises the horizon catch-up.
+                          lag_fn=crash_fn),
             DiskTarget({n: dsys.disks[n] for n in names}),
         )
         seed = seed_from_env(62824 if heavy else 62825)
         sched = FaultSchedule.generate(
             seed, 2.5 if heavy else 1.8, target.spec(),
             weights={"disk_fault": 3.0, "crash_process": 1.5,
-                     "reboot_process": 4.0})
+                     "lag_revive": 1.5, "reboot_process": 4.0})
         acts = {e.action for e in sched}
-        assert {"disk_fault", "crash_process"} <= acts, acts
+        assert "disk_fault" in acts, acts
+        assert acts & {"crash_process", "lag_revive"}, acts
         nem = Nemesis(target, sched).start()
         nemesis_report.attach(nemesis=nem, seed=seed)
 
@@ -546,7 +552,7 @@ def test_new_vocabulary_schedules_are_stamped_and_round_trip(tmp_path):
             "scopes": ["a", "b"], "actions": [
                 "crash_process", "reboot_process", "disk_fault"]}
     sched = FaultSchedule.generate(99, 4.0, spec)
-    assert sched.schema == FaultSchedule.SCHEMA == 4
+    assert sched.schema == FaultSchedule.SCHEMA == 5
     acts = [e.action for e in sched]
     assert "crash_process" in acts and "disk_fault" in acts
     # Every crash ends rebooted (the revival guarantee).
@@ -562,7 +568,7 @@ def test_new_vocabulary_schedules_are_stamped_and_round_trip(tmp_path):
     with open(p, "w") as f:
         json.dump(sched.to_dict(), f)
     again = FaultSchedule.from_json(p)
-    assert again == sched and again.schema == 4
+    assert again == sched and again.schema == 5
     assert again.signature() == sched.signature()
     # Determinism across the new vocabulary.
     assert FaultSchedule.generate(99, 4.0, spec) == sched

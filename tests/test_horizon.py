@@ -1,0 +1,825 @@
+"""horizon acceptance (ISSUE 14): service-level log compaction,
+snapshot-install catch-up, and bounded-memory operation.
+
+Covers:
+  - the compaction primitives: ColumnarDups seq-stamped retirement, the
+    checksum-framed Snapshotter (publish / durable spill / torn-frame
+    fallback), and the chunked+resumable `install_from_peer` assembly;
+  - the shared behind-vs-unreachable peer-pull discipline
+    (`services.common.pull_from_peers`, hoisted from diskv);
+  - kvpaxos end to end: replicated `compact` entries bound the dup
+    table IDENTICALLY on every replica; a replica revived behind the
+    GC horizon installs a peer snapshot over the `snapshot_fetch`
+    route (instead of the legacy state-losing fast-forward) and keeps
+    at-most-once across the install;
+  - shardkv/txnkv: snapshot install carries the full 2PC state;
+    resolution-tied decision GC (participant acks at finish-apply →
+    resolved watermark → compact trim), the trim-safety invariant
+    (never while a prepare is unresolved / waits outstanding — and no
+    trimmed decision is ever consulted, counted + asserted zero), and
+    the `txn_done` linger watermark that replaced the naive size cap;
+  - the `lag_revive` nemesis action (schema 5) with the schema-4
+    fixture loading byte-exact, plus the diskv lag-revive scenario
+    under armed disk faults with the Wing–Gong checker green and
+    replay identity;
+  - the bounded-memory contract: a tier-1 smoke (row counts flat after
+    warmup with compaction live) and the slow two-engine soak (fixed-
+    rate mixed kv+txn traffic, flat rows + flat RSS + jitguard zero
+    steady-state recompiles through snapshot/truncate cycles).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpu6824.harness.linearize import History, HistoryClerk, check_history
+from tpu6824.harness.nemesis import (
+    CompositeTarget,
+    DiskTarget,
+    FaultSchedule,
+    Nemesis,
+    ProcessTarget,
+    seed_from_env,
+)
+from tpu6824.obs import metrics as obs_metrics
+from tpu6824.services import horizon, txnkv
+from tpu6824.services.common import ColumnarDups, pull_from_peers
+from tpu6824.services.diskv import DisKVSystem
+from tpu6824.services.kvpaxos import Clerk, KVPaxosServer, make_cluster
+from tpu6824.services.shardkv import ShardKVServer, ShardSystem
+from tpu6824.utils.errors import OK
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------------ primitives
+
+
+def test_columnar_dups_seq_stamped_retirement():
+    d = ColumnarDups()
+    d.put(1, 5, (OK, "a"), seq=100)
+    d.put(2, 3, (OK, "b"), seq=900)
+    d.put(3, 7, (OK, "c"))  # no seq recorded: never retired
+    d.apply_batch({4: (1, (OK, "d"), 950), 1: (6, (OK, "a2"), 960)})
+    assert d.seen(1) == 6 and d.last_seq(1) == 960
+    n = d.retire_below(500)
+    assert n == 0  # cid 1 was refreshed by the batch; nothing stale
+    d.put(5, 1, (OK, "e"), seq=10)
+    assert d.retire_below(500) == 1
+    assert 5 not in d and d.seen(5) == -1
+    assert d.seen(3) == 7, "seq-less rows must survive retirement"
+    assert d.seen(1) == 6 and d.reply(1) == (OK, "a2")
+    assert sorted(dict(d.items())) == [1, 2, 3, 4]
+
+
+def test_snapshotter_publish_spill_and_torn_fallback(tmp_path):
+    hz = horizon.Snapshotter(every=10, persist_dir=str(tmp_path), keep=2)
+    assert hz.enabled() and not hz.due(5)
+    assert hz.due(9)  # 9 - (-1) >= 10
+    hz.publish(9, {"kv": {"a": "1"}, "dup": []})
+    hz.publish(25, {"kv": {"a": "2"}, "dup": []})
+    hz.publish(40, {"kv": {"a": "3"}, "dup": []})
+    names = sorted(n for n in os.listdir(tmp_path) if n.endswith(".bin"))
+    assert len(names) == 2, names  # pruned to keep=2
+    # Tear the newest persisted snapshot: load_newest must fall back to
+    # the older valid frame, never serve garbage (durafault property).
+    newest = os.path.join(tmp_path, names[-1])
+    blob = open(newest, "rb").read()
+    with open(newest, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    applied, decoded = horizon.load_newest(str(tmp_path))
+    assert applied == 25 and decoded["kv"] == {"a": "2"}
+
+
+def test_install_from_peer_chunked_and_resumable(monkeypatch):
+    monkeypatch.setattr(horizon, "CHUNK_BYTES", 64)
+    hz = horizon.Snapshotter(every=1)
+    payload = {"kv": {f"k{i}": "v" * 17 for i in range(40)}, "dup": []}
+    hz.publish(99, payload)
+    calls = {"n": 0}
+
+    def fetch(floor, off, n):
+        calls["n"] += 1
+        return hz.chunk(floor, off, n, donor_applied=120)
+
+    st, applied, blob = horizon.install_from_peer(fetch, 50)
+    assert (st, applied) == ("ok", 99) and blob["kv"] == payload["kv"]
+    assert calls["n"] > 3, "chunking did not engage"
+
+    # Donor re-snapshots MID-PULL: assembly restarts at the new
+    # (immutable) watermark and still completes.
+    flip = {"done": False}
+
+    def fetch_flip(floor, off, n):
+        r = hz.chunk(floor, off, n, donor_applied=300)
+        if not flip["done"] and off > 0:
+            flip["done"] = True
+            hz.publish(200, {"kv": {"fresh": "x"}, "dup": []})
+        return r
+
+    st, applied, blob = horizon.install_from_peer(fetch_flip, 50)
+    assert st == "ok" and applied == 200 and blob["kv"] == {"fresh": "x"}
+
+    # Behind / stale-with-nudge surfaces.
+    hz2 = horizon.Snapshotter(every=1)
+    st, applied, _ = horizon.install_from_peer(
+        lambda f, o, n: hz2.chunk(f, o, n, donor_applied=10), 50)
+    assert st == "behind" and applied == 10
+    st, _, _ = horizon.install_from_peer(
+        lambda f, o, n: hz2.chunk(f, o, n, donor_applied=500), 50)
+    assert st == "unreachable" and hz2.nudged, \
+        "a stale donor must be nudged to cut a fresh snapshot"
+
+
+def test_pull_from_peers_discipline():
+    # "behind" and "ok" return immediately; "unreachable" retries to
+    # the deadline and reports WHY (the diskv-hoisted discipline).
+    assert pull_from_peers(lambda: "behind", 5.0) == "behind"
+    tries = {"n": 0}
+
+    def attempt():
+        tries["n"] += 1
+        return "ok" if tries["n"] >= 3 else "unreachable"
+
+    assert pull_from_peers(attempt, 5.0, retry_sleep=0.01) == "ok"
+    assert tries["n"] == 3
+    t0 = time.monotonic()
+    assert pull_from_peers(lambda: "unreachable", 0.2,
+                           retry_sleep=0.02) == "unreachable"
+    assert time.monotonic() - t0 >= 0.18
+    # dead cuts the retry loop short
+    assert pull_from_peers(lambda: "unreachable", 30.0,
+                           is_dead=lambda: True) == "unreachable"
+
+
+# ------------------------------------------------------- kvpaxos horizon
+
+
+def _kv_cluster(**kw):
+    kw.setdefault("ninstances", 128)
+    kw.setdefault("snapshot_every", 24)
+    kw.setdefault("dup_retire_ops", 64)
+    return make_cluster(3, **kw)
+
+
+def test_kvpaxos_compact_bounds_dup_table_identically():
+    """Many one-shot clients, a compaction horizon of 64 ops: the dup
+    table must stay bounded, and — the at-most-once-preserving property
+    — every replica must retire the IDENTICAL rows (trim rides a
+    replicated compact entry, never local timing)."""
+    fabric, servers = _kv_cluster()
+    try:
+        steady = Clerk(servers)
+        for i in range(40):
+            one_shot = Clerk(servers)  # fresh cid, one op, never again
+            one_shot.put(f"os{i}", "x")
+            steady.put("steady", f"v{i}")
+        for i in range(120):
+            steady.append("steady2", f".{i}")
+        # Compaction live: snapshots cut, compact entries applied, and
+        # the one-shot rows (idle > 64 ops) folded out everywhere.
+        _wait(lambda: all(s.horizon.written >= 1 for s in servers),
+              msg="snapshots on every replica")
+        _wait(lambda: all(len(s.dup) < 30 for s in servers),
+              msg=f"dup retirement "
+                  f"(rows={[len(s.dup) for s in servers]})")
+        _wait(lambda: servers[0].dup.to_dict() == servers[1].dup.to_dict()
+              == servers[2].dup.to_dict(),
+              msg="replica dup tables identical after compaction")
+        assert steady.get("steady") == "v39"  # state untouched by trim
+    finally:
+        for s in servers:
+            s.kill()
+        fabric.stop_clock()
+
+
+def test_kvpaxos_revived_replica_installs_snapshot():
+    """THE lag-revive gap this PR closes for in-memory services: a
+    replica revived behind the GC horizon (amnesiac — applied=-1 while
+    Min() is far ahead) used to fast-forward past the forgotten span
+    with an empty store and an empty dup filter.  With horizon + peers
+    it must install a peer snapshot over the chunked snapshot_fetch
+    route, converge to the donors' state, and keep at-most-once for
+    clients whose ops predate the crash."""
+    fabric, servers = _kv_cluster()
+    try:
+        ck = Clerk(servers)
+        for i in range(30):
+            ck.put(f"pre{i}", f"p{i}")
+        pre_cid, pre_cseq = ck.cid, ck.cseq  # last pre-crash op identity
+        servers[2].kill()  # fabric lane goes silent too (px.kill)
+        for i in range(60):
+            ck.put(f"mid{i}", f"m{i}")
+        _wait(lambda: servers[0].horizon.written >= 1,
+              msg="donor snapshot")
+        installs0 = obs_metrics.snapshot()["counters"].get(
+            "horizon.installs", {}).get("total", 0)
+        fabric.revive(0, 2)
+        # peers in the CTOR (not assigned after): the driver's boot
+        # Min probe runs concurrently and must already see donors.
+        fresh = KVPaxosServer(fabric, 0, 2, snapshot_every=24,
+                              dup_retire_ops=64, peers=servers)
+        servers[2] = fresh
+        _wait(lambda: fresh._behind_min == 0 and fresh.applied >= 60,
+              msg=f"snapshot-install catch-up (applied={fresh.applied}, "
+                  f"behind={fresh._behind_min})")
+        installs1 = obs_metrics.snapshot()["counters"].get(
+            "horizon.installs", {}).get("total", 0)
+        assert installs1 > installs0, "catch-up did not install a snapshot"
+        _wait(lambda: fresh.applied >= servers[0].applied - 2,
+              msg="replay to the donors' watermark")
+        _wait(lambda: all(fresh.kv.get(f"mid{i}") == f"m{i}"
+                          for i in range(60)), msg="kv convergence")
+        assert all(fresh.kv.get(f"pre{i}") == f"p{i}" for i in range(30))
+        # At-most-once ACROSS the install: replaying the clerk's last
+        # pre-crash op against the revived replica must dedup from the
+        # INSTALLED table, not re-apply.
+        err, _val = fresh.put_append("put", f"pre29", "CLOBBER",
+                                     pre_cid, pre_cseq)
+        assert err == OK
+        assert fresh.kv["pre29"] == "p29", "install lost the dup filter"
+    finally:
+        for s in servers:
+            s.kill()
+        fabric.stop_clock()
+
+
+def test_kvpaxos_persist_dir_restores_from_spilled_snapshot(tmp_path):
+    fabric, servers = make_cluster(3, ninstances=128, snapshot_every=16,
+                                   dup_retire_ops=0,
+                                   persist_dir=None)
+    try:
+        # Only replica 1 spills (per-server persist dirs in a real
+        # deployment; one is enough to prove the restore path).
+        servers[1].horizon.persist_dir = str(tmp_path)
+        os.makedirs(str(tmp_path), exist_ok=True)
+        ck = Clerk(servers)
+        for i in range(40):
+            ck.put(f"k{i}", f"v{i}")
+        _wait(lambda: servers[1].horizon.written >= 1
+              and horizon.load_newest(str(tmp_path)) is not None,
+              msg="durable spill")
+        applied, blob = horizon.load_newest(str(tmp_path))
+        assert applied >= 15 and blob["kv"]["k0"] == "v0"
+        # A new server booted over the spill dir adopts the snapshot
+        # instead of starting amnesiac.
+        servers[1].kill()
+        fabric.revive(0, 1)
+        fresh = KVPaxosServer(fabric, 0, 1, snapshot_every=16,
+                              persist_dir=str(tmp_path), peers=servers)
+        servers[1] = fresh
+        assert fresh.applied >= applied
+        _wait(lambda: fresh.kv.get("k39") == "v39", msg="restore+replay")
+    finally:
+        for s in servers:
+            s.kill()
+        fabric.stop_clock()
+
+
+# ------------------------------------------------- shardkv/txnkv horizon
+
+
+def _shard_system(**server_kw):
+    server_kw.setdefault("snapshot_every", 24)
+    server_kw.setdefault("dup_retire_ops", 64)
+    ninst = server_kw.pop("ninstances", 128)
+    system = ShardSystem(ngroups=2, nreplicas=3, ninstances=ninst,
+                         **server_kw)
+    for gid in system.gids:
+        system.join(gid)
+    system.clerk().put("warm", "1")
+    return system
+
+
+def test_shardkv_revived_replica_installs_txn_state():
+    """A shardkv replica revived behind the horizon installs the FULL
+    applied state — store, dup table, config, and the 2PC tables — so
+    transactions keep their guarantees across the install."""
+    system = _shard_system()
+    try:
+        g0 = system.gids[0]
+        tck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        assert tck.multi_cas([("acct_a", "", "100"), ("acct_b", "", "100")])
+        assert tck.transfer("acct_a", "acct_b", 10)
+        victim = system.groups[g0][2]
+        victim.kill()
+        ck = system.clerk()
+        for i in range(60):
+            ck.put(f"lag{i}", f"v{i}")
+        assert tck.transfer("acct_b", "acct_a", 5)
+        donors = [s for s in system.groups[g0][:2]]
+        _wait(lambda: any(s.horizon.written >= 1 for s in donors),
+              msg="donor snapshot")
+        fg = 1 + system.gids.index(g0)
+        system.fabric.revive(fg, 2)
+        fresh = ShardKVServer(system.fabric, fg, g0, 2,
+                              system.sm_servers, system.directory,
+                              snapshot_every=24, dup_retire_ops=64)
+        system.groups[g0][2] = fresh
+        _wait(lambda: fresh._behind_min == 0
+              and fresh.applied >= donors[0].applied - 4,
+              msg=f"catch-up (applied={fresh.applied}, "
+                  f"behind={fresh._behind_min})")
+        _wait(lambda: fresh.config.num == donors[0].config.num,
+              msg="config installed")
+        # The installed state serves: a read through the revived
+        # replica's group converges with the donors.
+        _wait(lambda: all(fresh.kv.get(k) == donors[0].kv.get(k)
+                          for k in ("acct_a", "acct_b")),
+              msg="txn-applied state converged")
+        # Decision records and their GC bookkeeping traveled too.
+        assert set(fresh.txn_decisions) >= set(
+            t for t, s in donors[0].txn_decision_seq.items()
+            if s <= fresh.applied)
+        snap = tck.read(["acct_a", "acct_b"])
+        assert int(snap["acct_a"]) + int(snap["acct_b"]) == 200
+    finally:
+        system.shutdown()
+
+
+def test_txn_decision_gc_unit_invariants():
+    """apply_compact's trim-safety invariant, in isolation: a decision
+    with outstanding acks is NEVER linger-trimmed; a resolved decision
+    waits out the linger; a still-prepared tid is never trimmed even
+    when resolved; txn_done retires on its own (longer) watermark; the
+    observability ring records what was trimmed."""
+
+    class FakeSrv:
+        pass
+
+    srv = FakeSrv()
+    srv.dup = {"c1": (1, (OK, ""))}
+    srv.dup_seq = {"c1": 10}
+    srv.dup_retire_ops = 100
+    srv.txn_prepared = {"t_prep": {"ops": ()}}
+    srv.txn_decisions = {"t_open": "commit", "t_res": "commit",
+                         "t_prep": "commit", "t_old": "abort"}
+    srv.txn_decision_seq = {"t_open": 10, "t_res": 10, "t_prep": 10,
+                            "t_old": 10}
+    srv.txn_decision_waits = {"t_open": {2}}
+    srv.txn_resolved = {"t_res": 20, "t_prep": 20}
+    srv.txn_done = {"t_res": "commit"}
+    srv.txn_done_seq = {"t_res": 30}
+    srv._trimmed_tids = {}
+
+    # Linger floor passed for resolved tids only (seq=20+LINGER+1).
+    seq = 20 + txnkv.DECISION_LINGER_OPS + 1
+    txnkv.apply_compact(srv, seq)
+    assert "t_res" not in srv.txn_decisions, "resolved+linger must trim"
+    assert "t_res" in srv._trimmed_tids
+    assert "t_open" in srv.txn_decisions, \
+        "outstanding acks: trim would un-decide the transaction"
+    assert "t_prep" in srv.txn_decisions, \
+        "locally-prepared tid: trim would un-decide the transaction"
+    assert "t_old" in srv.txn_decisions  # no resolution, MAX not reached
+    assert srv.txn_done == {"t_res": "commit"}, \
+        "done rows outlive decision rows (linger ordering)"
+    # dup retirement on the same entry: floor = seq - 100 > 10.
+    assert srv.dup == {} and srv.dup_seq == {}
+
+    # The MAX_OPS fallback reaps never-fully-ackable records — but
+    # still never a locally-prepared tid.
+    seq = 10 + txnkv.DECISION_MAX_OPS + 1
+    txnkv.apply_compact(srv, seq)
+    assert "t_open" not in srv.txn_decisions
+    assert "t_prep" in srv.txn_decisions
+    assert srv.txn_done == {}, "done linger watermark must reap too"
+
+
+def test_txn_decisions_bounded_by_resolution_live(monkeypatch):
+    """End to end on a live system: transactions commit, participant
+    acks flow back to the coordinator, resolution watermarks stamp, and
+    compact entries trim the decision records — rows track in-flight
+    transactions, not history; no trimmed decision is ever consulted
+    (counter asserted zero)."""
+    monkeypatch.setattr(txnkv, "DECISION_LINGER_OPS", 8)
+    monkeypatch.setattr(txnkv, "DONE_LINGER_OPS", 48)
+    consults0 = obs_metrics.snapshot()["counters"].get(
+        "txn.trimmed_decision_consults", {}).get("total", 0)
+    system = _shard_system(snapshot_every=16, dup_retire_ops=64)
+    try:
+        tck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        accounts = [chr(ord("a") + i) + "gc" for i in range(6)]
+        for a in accounts:
+            assert tck.multi_cas([(a, "", "100")])
+        for i in range(10):
+            assert tck.transfer(accounts[i % 6], accounts[(i + 1) % 6], 1)
+        servers = [s for grp in system.groups.values() for s in grp]
+        # Resolution: every decision's wait set drains via acks.
+        _wait(lambda: all(not s.txn_decision_waits for s in servers),
+              timeout=60.0,
+              msg=f"acks resolve every decision "
+                  f"(waits={[len(s.txn_decision_waits) for s in servers]})")
+        # Drive plain traffic so snapshots + compact entries advance the
+        # trim floor past the resolved watermarks.
+        ck = system.clerk()
+        for i in range(160):
+            # First byte picks the shard: spread the driver traffic over
+            # EVERY group so each group's log reaches its next compact.
+            ck.put(f"{chr(ord('a') + i % 26)}drv", f"v{i}")
+        _wait(lambda: all(len(s.txn_decisions) == 0 for s in servers),
+              timeout=60.0,
+              msg=f"decision rows trimmed "
+                  f"(rows={[len(s.txn_decisions) for s in servers]})")
+        # Replica-identical trim (log-position determinism), and the
+        # trim-safety sentinel never fired.
+        for grp in system.groups.values():
+            assert grp[0].txn_decisions == grp[1].txn_decisions \
+                == grp[2].txn_decisions
+        snap = tck.read(accounts)
+        assert sum(int(v or 0) for v in snap.values()) == 600
+        consults1 = obs_metrics.snapshot()["counters"].get(
+            "txn.trimmed_decision_consults", {}).get("total", 0)
+        assert consults1 == consults0, "a trimmed decision was consulted"
+    finally:
+        system.shutdown()
+
+
+# ------------------------------------------------ nemesis: lag_revive
+
+
+def test_pre_horizon_schema4_capture():
+    """Replay compatibility: a schema-4 stamped capture carrying the
+    txn-era vocabulary loads byte-exact through the schema-4 loader
+    path — identity, not upgrade — and the CURRENT generator stamps
+    schema 5 (the lag_revive vocabulary)."""
+    sched = FaultSchedule.from_json(os.path.join(DATA, "nemesis_v4.json"))
+    assert sched.schema == 4
+    assert sched.seed == 1407
+    acts = [e.action for e in sched]
+    assert acts.count("kill_mid_commit") == 2
+    assert "crash_process" in acts and "net_fault" in acts \
+        and "disk_fault" in acts
+    assert sched.events[0].args == {"name": "g500-1", "disk": "dirty"}
+    again = FaultSchedule.from_dict(sched.to_dict())
+    assert again == sched and again.schema == 4
+    assert again.signature() == sched.signature()
+    assert FaultSchedule.SCHEMA == 5
+
+
+def test_lag_revive_schedule_generation_deterministic():
+    spec = ProcessTarget(["a", "b", "c"], lambda n, d: None,
+                         lambda n: None,
+                         lag_fn=lambda n, d: None).spec()
+    assert "lag_revive" in spec["actions"]
+    s1 = FaultSchedule.generate(141, 4.0, spec,
+                                weights={"lag_revive": 4.0})
+    s2 = FaultSchedule.generate(141, 4.0, spec,
+                                weights={"lag_revive": 4.0})
+    assert s1 == s2 and s1.schema == 5
+    lagged = [e for e in s1 if e.action == "lag_revive"]
+    assert lagged, "weighted lag_revive never sampled"
+    assert all(e.args["disk"] in ("keep", "dirty", "lose")
+               for e in lagged)
+    # Revival guarantee: every lag-crashed proc ends rebooted.
+    crashed: set = set()
+    for e in s1:
+        if e.action in ("crash_process", "lag_revive"):
+            crashed.add(e.args["name"])
+        elif e.action == "reboot_process":
+            crashed.discard(e.args["name"])
+    assert not crashed, f"schedule left {crashed} dead"
+
+
+@pytest.mark.nemesis
+def test_lag_revive_acceptance_diskv(tmp_path, nemesis_report):
+    """The lag_revive scenario end to end (acceptance): a replica is
+    crashed (keep/dirty/lose disk dispositions all reachable under the
+    seeded schedule), traffic drives the group on past it under ARMED
+    DISK FAULTS, and revival must catch up — suffix replay over an
+    intact disk, peer snapshot-pull over a lost one, the shared
+    behind/unreachable discipline either way — with the Wing–Gong
+    checker green and replay identity (signature == schedule)."""
+    dsys = DisKVSystem(str(tmp_path / "kv"), ngroups=1, nreplicas=3,
+                       ninstances=32, fault_disks=True)
+    dsys.join(dsys.gids[0])
+    gid = dsys.gids[0]
+    names = [f"g{gid}-{p}" for p in range(3)]
+    history = History()
+    try:
+        def crash_fn(name, disk):
+            p = int(name.rsplit("-", 1)[1])
+            dsys.crash(gid, p, lose_disk=(disk == "lose"),
+                       power_crash=(disk == "dirty"))
+
+        def reboot_fn(name):
+            p = int(name.rsplit("-", 1)[1])
+            dsys.reboot(gid, p)
+
+        target = CompositeTarget(
+            ProcessTarget(names, crash_fn, reboot_fn,
+                          proc_groups={n: f"g{gid}" for n in names},
+                          lag_fn=crash_fn),
+            DiskTarget({n: dsys.disks[n] for n in names}),
+        )
+        seed = seed_from_env(1414)
+        sched = FaultSchedule.generate(
+            seed, 2.2, target.spec(),
+            weights={"lag_revive": 4.0, "crash_process": 0.5,
+                     "disk_fault": 2.5, "reboot_process": 2.0})
+        acts = [e.action for e in sched]
+        assert "lag_revive" in acts and "disk_fault" in acts, acts
+        nem = Nemesis(target, sched).start()
+        nemesis_report.attach(nemesis=nem, seed=seed)
+
+        errs: list = []
+
+        def client(idx):
+            try:
+                ck = HistoryClerk(dsys.clerk(), history, client=idx)
+                for j in range(5):
+                    ck.append("k", f"x {idx} {j} y", timeout=120.0)
+                    ck.put(f"lag-{idx}-{j}", f"v{j}", timeout=120.0)
+            except Exception as e:  # pragma: no cover
+                errs.append((idx, e))
+
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=240.0)
+        assert not any(t.is_alive() for t in ts), "client stuck"
+        nem.join(60.0)
+        assert nem.done
+        assert nem.signature() == sched.signature()  # replay identity
+        assert not errs, errs
+        for p in range(3):  # self-halted replicas revive too
+            if dsys.groups[gid][p].dead:
+                dsys.reboot(gid, p)
+        # Every replica caught up and rejoined: converged watermarks.
+        _wait(lambda: max(s.applied for s in dsys.groups[gid])
+              - min(s.applied for s in dsys.groups[gid]) <= 2,
+              timeout=60.0, msg="revived replicas converge")
+        final = HistoryClerk(dsys.clerk(), history, client="final")
+        value = final.get("k", timeout=60.0)
+        for idx in range(2):
+            for j in range(5):
+                assert f"x {idx} {j} y" in value, (idx, j)
+        res = check_history(history)
+        assert res.ok, res.describe()
+    finally:
+        dsys.shutdown()
+
+
+@pytest.mark.nemesis
+def test_decision_gc_safe_under_kill_mid_commit_and_lag_revive(
+        monkeypatch, nemesis_report):
+    """Decision-GC safety acceptance: kill_mid_commit + lag_revive +
+    partitions under ONE seeded composite schedule against a horizon-
+    enabled system with aggressive trim knobs — every transaction
+    reaches exactly one fate (no prepared entry survives, the transfer
+    sum is conserved), NO trimmed decision is ever consulted (counted,
+    asserted zero), and the injected timeline replays identically."""
+    from tpu6824.harness.nemesis import FabricTarget, TxnKillTarget
+
+    monkeypatch.setattr(txnkv, "DECISION_LINGER_OPS", 24)
+    monkeypatch.setattr(txnkv, "DONE_LINGER_OPS", 96)
+    consults0 = obs_metrics.snapshot()["counters"].get(
+        "txn.trimmed_decision_consults", {}).get("total", 0)
+    system = _shard_system(snapshot_every=20, dup_retire_ops=96,
+                           ninstances=96)
+    killer = txnkv.MidCommitKiller()
+    try:
+        for grp in system.groups.values():
+            for s in grp:
+                s.txn_resolve_after = 0.3
+                s.txn_abort_after = 0.8
+
+        def crash_fn(name, _disk):
+            gid, p = (int(x) for x in name[1:].split("-"))
+            system.groups[gid][p].kill()
+
+        def reboot_fn(name):
+            gid, p = (int(x) for x in name[1:].split("-"))
+            fg = 1 + system.gids.index(gid)
+            system.fabric.revive(fg, p)
+            system.groups[gid][p] = ShardKVServer(
+                system.fabric, fg, gid, p, system.sm_servers,
+                system.directory, snapshot_every=20, dup_retire_ops=96)
+
+        names = [f"g{gid}-{p}" for gid in system.gids for p in range(3)]
+        target = CompositeTarget(
+            FabricTarget(system.fabric, groups=[1, 2],
+                         actions=["partition_minority", "heal",
+                                  "unreliable", "reliable"]),
+            TxnKillTarget(killer.arm, disarm_fn=killer.disarm),
+            ProcessTarget(names, crash_fn, reboot_fn,
+                          proc_groups={n: n.split("-")[0]
+                                       for n in names},
+                          lag_fn=crash_fn),
+        )
+        seed = seed_from_env(1428)
+        sched = FaultSchedule.generate(
+            seed, 2.0, target.spec(),
+            weights={"kill_mid_commit": 2.5, "lag_revive": 2.0,
+                     "crash_process": 0.5, "reboot_process": 3.0,
+                     "clock_pause": 0.0})
+        acts = [e.action for e in sched]
+        assert "kill_mid_commit" in acts and "lag_revive" in acts, acts
+        nem = Nemesis(target, sched).start()
+        nemesis_report.attach(nemesis=nem, seed=seed)
+
+        accounts = [chr(ord("a") + i) + "gcx" for i in range(6)]
+        init = txnkv.TxnClerk(system.sm_servers, system.directory)
+        for a in accounts:
+            assert init.multi_cas([(a, "", "100")], timeout=60.0), a
+        errs: list = []
+
+        def client(idx):
+            ck = txnkv.TxnClerk(system.sm_servers, system.directory)
+            ck.mid_commit_hook = killer
+            for j in range(4):
+                try:
+                    ck.transfer(accounts[(idx + j) % 6],
+                                accounts[(idx + j + 1) % 6], 5,
+                                timeout=90.0)
+                except (txnkv.TxnAbandoned, Exception):  # noqa: BLE001
+                    continue  # unknown fate: the resolvers own it
+
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=240.0)
+        assert not any(t.is_alive() for t in ts), "client stuck"
+        nem.join(60.0)
+        assert nem.done
+        assert nem.signature() == sched.signature()  # replay identity
+        # One fate each: every prepared entry resolves.
+        servers = lambda: [s for grp in system.groups.values()  # noqa: E731
+                           for s in grp]
+        _wait(lambda: not any(s.txn_prepared for s in servers()),
+              timeout=90.0,
+              msg="prepared transactions resolve to one fate")
+        # Conserved sum == every txn applied atomically or not at all.
+        final = txnkv.TxnClerk(system.sm_servers, system.directory)
+        snap = {}
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                snap = final.read(accounts, timeout=30.0)
+                break
+            except Exception:  # noqa: BLE001 — healing cluster
+                time.sleep(0.2)
+        assert snap, "final read never served"
+        assert sum(int(v or 0) for v in snap.values()) == 600
+        consults1 = obs_metrics.snapshot()["counters"].get(
+            "txn.trimmed_decision_consults", {}).get("total", 0)
+        assert consults1 == consults0, "a trimmed decision was consulted"
+    finally:
+        system.shutdown()
+
+
+# --------------------------------------------------- bounded memory
+
+
+def test_bounded_memory_smoke():
+    """Tier-1 bounded-memory contract: with compaction live, dup rows
+    and txn decision rows go FLAT after warmup even under one-shot-
+    client churn (the worst case for dup growth), and the horizon
+    gauges see it."""
+    fabric, servers = _kv_cluster(snapshot_every=16, dup_retire_ops=48)
+    try:
+        steady = Clerk(servers)
+
+        def churn(n):
+            for i in range(n):
+                Clerk(servers).put(f"c{i % 5}", "x")  # fresh cid each
+                steady.put("s", f"v{i}")
+
+        churn(60)  # warmup: snapshots + compacts flowing
+        _wait(lambda: all(s.horizon.written >= 1 for s in servers),
+              msg="snapshot cadence")
+        _wait(lambda: max(len(s.dup) for s in servers) < 40,
+              msg="warmup retirement")
+        rows0 = max(len(s.dup) for s in servers)
+        churn(120)  # 3x more one-shot clients
+        _wait(lambda: max(len(s.dup) for s in servers) <= rows0 + 8,
+              msg=f"dup rows flat after warmup "
+                  f"(was {rows0}, now {[len(s.dup) for s in servers]})")
+        totals = horizon.sample_gauges()
+        assert totals["dup_rows"] >= 1
+        assert totals["window_live_slots"] >= 0
+        gsnap = obs_metrics.snapshot()["gauges"]
+        assert gsnap["horizon.dup_rows"]["value"] == totals["dup_rows"]
+    finally:
+        for s in servers:
+            s.kill()
+        fabric.stop_clock()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_bounded_memory_soak(kernel, monkeypatch):
+    """The acceptance soak (slow, both engines): ≥60s of fixed-rate
+    mixed kv+txn traffic with compaction live — tracked-structure row
+    counts and RSS flat after warmup (asserted slopes), jitguard zero
+    steady-state recompiles through snapshot/truncate cycles."""
+    from tpu6824.analysis.jitguard import RecompileGuard
+    from tpu6824.obs import pulse as obs_pulse
+
+    monkeypatch.setattr(txnkv, "DECISION_LINGER_OPS", 16)
+    monkeypatch.setattr(txnkv, "DONE_LINGER_OPS", 64)
+    system = ShardSystem(ngroups=2, nreplicas=3, ninstances=192,
+                         fabric_kw=dict(kernel=kernel, io_mode="compact",
+                                        steps_per_dispatch=2),
+                         snapshot_every=24, dup_retire_ops=96)
+    for gid in system.gids:
+        system.join(gid)
+    system.clerk().put("warm", "1")
+    servers = [s for grp in system.groups.values() for s in grp]
+    stop = threading.Event()
+    errs: list = []
+
+    def kv_load():
+        i = 0
+        while not stop.is_set():
+            try:
+                ck = system.clerk()  # fresh cid: worst-case dup churn
+                ck.put(f"soak{i % 11}", f"v{i}", timeout=60.0)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e)[:120])
+            i += 1
+            time.sleep(0.01)
+
+    def txn_load():
+        tck = txnkv.TxnClerk(system.sm_servers, system.directory)
+        accounts = [chr(ord("a") + i) + "soak" for i in range(4)]
+        for a in accounts:
+            tck.multi_cas([(a, "", "100")], timeout=60.0)
+        i = 0
+        while not stop.is_set():
+            try:
+                tck.transfer(accounts[i % 4], accounts[(i + 1) % 4], 1,
+                             timeout=60.0)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e)[:120])
+            i += 1
+            time.sleep(0.05)
+
+    def rows():
+        return {
+            "dup": sum(len(s.dup) for s in servers),
+            "decisions": sum(len(s.txn_decisions) for s in servers),
+            "done": sum(len(s.txn_done) for s in servers),
+            "prepared": sum(len(s.txn_prepared) for s in servers),
+        }
+
+    try:
+        ts = [threading.Thread(target=kv_load, daemon=True),
+              threading.Thread(target=txn_load, daemon=True)]
+        for t in ts:
+            t.start()
+        time.sleep(20.0)  # warmup: caches, jit, first compaction cycles
+        assert all(s.horizon.written >= 1 for s in servers)
+        with RecompileGuard() as guard:
+            samples = []
+            for _ in range(10):  # 40s steady state, sampled at 4s
+                time.sleep(4.0)
+                r = rows()
+                r["rss"] = obs_pulse.read_rss_bytes()
+                samples.append(r)
+        stop.set()
+        for t in ts:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in ts)
+        assert guard.compiles == 0, \
+            "steady-state recompiles through compaction cycles"
+        # Row-count flatness: the late-window mean must not exceed the
+        # early-window mean by more than a small band.
+        half = len(samples) // 2
+        for k in ("dup", "decisions", "done", "prepared"):
+            early = sum(s[k] for s in samples[:half]) / half
+            late = sum(s[k] for s in samples[half:]) / (len(samples) - half)
+            # Band absorbs compaction-cadence phase + box contention
+            # (a co-scheduled suite slows the drains, not the bound):
+            # the leak signature this asserts against is monotone
+            # growth proportional to ops applied, which would blow far
+            # past 1.5x in a 40s window.
+            assert late <= max(early * 1.5, early + 60), \
+                (k, early, late, [s[k] for s in samples])
+        # RSS flatness: bounded late-vs-early growth after warmup.
+        early = sum(s["rss"] for s in samples[:half]) / half
+        late = sum(s["rss"] for s in samples[half:]) / (len(samples) - half)
+        assert late - early < 96 << 20, \
+            f"RSS grew {(late - early) / 1e6:.1f}MB in steady state"
+        consults = obs_metrics.snapshot()["counters"].get(
+            "txn.trimmed_decision_consults", {}).get("total", 0)
+        assert consults == 0
+    finally:
+        stop.set()
+        system.shutdown()

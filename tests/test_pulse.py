@@ -342,6 +342,70 @@ def test_watchdog_abort_storm_control_stays_silent(tmp_path):
     assert not wd.incidents, wd.incidents
 
 
+def test_watchdog_memory_growth(tmp_path, monkeypatch):
+    """ISSUE 14 satellite: process RSS climbing steadily across the
+    window while traffic stays FLAT fires the memory-growth rule
+    against a seeded synthetic condition (a leak outrunning the
+    horizon compaction machinery)."""
+    from tpu6824.obs.watchdog import MemoryGrowth
+
+    traffic = obs_metrics.counter("fabric.decided_cells")
+    rss = {"v": 100 << 20}
+
+    def fake_rss():
+        rss["v"] += 8 << 20  # +8MB per tick, relentless
+        return rss["v"]
+
+    monkeypatch.setattr(obs_pulse, "read_rss_bytes", fake_rss)
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[MemoryGrowth(window=60.0,
+                                      min_growth=float(16 << 20))],
+                  window=60.0, cooldown=60.0).start()
+    p.sample_once()
+    for _ in range(10):  # flat traffic, climbing rss
+        traffic.inc(300)
+        time.sleep(0.02)
+        p.sample_once()
+    assert wd.incidents, "memory growth not detected"
+    inc = wd.incidents[0]
+    assert inc["rule"] == "memory-growth"
+    assert "traffic flat" in inc["reason"]
+    assert os.path.exists(inc["path"])
+
+
+def test_watchdog_memory_growth_control_stays_silent(tmp_path,
+                                                     monkeypatch):
+    """The fault-free control, both halves: (a) flat RSS under flat
+    traffic (the bounded-memory steady state compaction guarantees) is
+    silent; (b) RSS growing WHILE traffic grows is a warming working
+    set, not a leak — also silent."""
+    from tpu6824.obs.watchdog import MemoryGrowth
+
+    traffic = obs_metrics.counter("fabric.decided_cells")
+    rss = {"v": 100 << 20, "step": 0}
+    monkeypatch.setattr(obs_pulse, "read_rss_bytes",
+                        lambda: rss["v"] + rss["step"])
+    p = _manual_pulse()
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[MemoryGrowth(window=60.0,
+                                      min_growth=float(16 << 20))],
+                  window=60.0, cooldown=0.0).start()
+    p.sample_once()
+    for _ in range(10):  # flat rss (allocator jitter), flat traffic
+        traffic.inc(300)
+        rss["step"] = (rss["step"] + (1 << 20)) % (2 << 20)
+        time.sleep(0.02)
+        p.sample_once()
+    assert not wd.incidents, wd.incidents
+    for i in range(10):  # rss climbs but traffic RAMPS with it
+        traffic.inc(300 + 400 * i)
+        rss["v"] += 8 << 20
+        time.sleep(0.02)
+        p.sample_once()
+    assert not wd.incidents, wd.incidents
+
+
 def test_queue_growth_watches_txn_inflight(tmp_path):
     """ISSUE 13 satellite: the txn.inflight gauge is wired into the
     existing queue-growth rule — transactions piling up (prepares

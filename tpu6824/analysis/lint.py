@@ -114,6 +114,16 @@ RULES: dict[str, str] = {
         "that is failing it (ISSUE 12's retry-budget Backoff and "
         "deadline propagation exist to bound exactly this); pace the "
         "loop with services.common.Backoff or bound it by deadline",
+    "unbounded-host-state":
+        "an RSM apply path (`_apply*` in services scope) grows a "
+        "self-attribute dict/list that NOTHING in the class ever "
+        "trims, GCs, or snapshot-replaces — every decided op then "
+        "grows host memory forever, exactly the class of leak the "
+        "horizon compaction machinery (ISSUE 14) exists to bound; "
+        "give the store a retirement path (a replicated compact "
+        "entry, a del/pop on a resolution event, or a snapshot "
+        "install that rebinds it) or suppress with the justification "
+        "for why THIS store is the service's actual data",
     "blocking-commit-wait":
         "waiting on a cross-group RPC or future (txn_status / "
         "transfer_state / txn_op / .wait / .result) while holding the "
@@ -332,6 +342,7 @@ class _FileLint(ast.NodeVisitor):
         self._daemon_targets = self._resolve_daemon_targets()
         self._jit_defs = self._resolve_jit_defs()
         self._scan_persistence()
+        self._scan_apply_growth()
         self._scan_eventloop_callbacks()
         self._scan_native_decode()
         self._scan_obs_buffers()
@@ -462,6 +473,75 @@ class _FileLint(ast.NodeVisitor):
                                    "write-then-rename persistence outside "
                                    "the durafs seam — use "
                                    "durafs.atomic_write()")
+
+    def _scan_apply_growth(self) -> None:
+        """unbounded-host-state: per class in services scope, find
+        self-attributes GROWN inside `_apply*` methods (subscript
+        assignment, append/add/extend/insert/setdefault) with no trim
+        evidence anywhere else in the class — no `del self.X[...]`,
+        no pop/popitem/clear/remove/discard/retire_below call, and no
+        rebinding `self.X = ...` outside __init__ (a snapshot install
+        that replaces the store wholesale counts as the GC path).
+        One finding per (class, attr), at the first growth site."""
+        if not self.commit_scope:
+            return
+        grow_verbs = {"append", "add", "extend", "insert", "setdefault"}
+        trim_verbs = {"pop", "popitem", "clear", "remove", "discard",
+                      "retire_below"}
+
+        def self_attr(node) -> str | None:
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return node.attr
+            return None
+
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            grown: dict[str, ast.AST] = {}  # attr -> first growth site
+            trimmed: set[str] = set()
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                in_apply = fn.name.startswith("_apply")
+                in_init = fn.name == "__init__"
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Assign):
+                        for t in n.targets:
+                            if isinstance(t, ast.Subscript):
+                                a = self_attr(t.value)
+                                if a and in_apply and a not in grown:
+                                    grown[a] = n
+                            else:
+                                a = self_attr(t)
+                                if a and not in_init:
+                                    trimmed.add(a)  # rebinding path
+                    elif isinstance(n, ast.Delete):
+                        for t in n.targets:
+                            if isinstance(t, ast.Subscript):
+                                a = self_attr(t.value)
+                                if a:
+                                    trimmed.add(a)
+                    elif isinstance(n, ast.Call) and \
+                            isinstance(n.func, ast.Attribute):
+                        a = self_attr(n.func.value)
+                        if a is None:
+                            continue
+                        if n.func.attr in trim_verbs:
+                            trimmed.add(a)
+                        elif n.func.attr in grow_verbs and in_apply \
+                                and a not in grown:
+                            grown[a] = n
+            for attr, site in grown.items():
+                if attr in trimmed:
+                    continue
+                self._flag(site, "unbounded-host-state",
+                           f"self.{attr} grows in an _apply path of "
+                           f"{cls.name} with no trim/GC/snapshot-"
+                           "replace path anywhere in the class — "
+                           "unbounded host state on the decided path")
 
     def _scan_eventloop_callbacks(self) -> None:
         """blocking-in-eventloop: inside an event-loop callback (`_on_*`

@@ -1210,6 +1210,13 @@ class PaxosFabric:
     def pipeline_depth(self) -> int:
         return self._pipeline_depth
 
+    @property
+    def live_slots(self) -> int:
+        """Live instance-window cells across all groups — an advisory
+        lock-free read (the horizon bounded-memory gauges sample it;
+        one int load, no lock, staleness is free)."""
+        return int(self._live_slots)
+
     def set_pipeline_depth(self, depth: int) -> None:
         """Live pipeline-depth churn (the nemesis uses this as a fault
         dimension): the free-running clock adapts on its next step_async —

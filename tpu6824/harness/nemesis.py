@@ -75,6 +75,10 @@ DEFAULT_WEIGHTS = {
     # txnkv (ISSUE 13): crash the transaction driver between
     # prepare-quorum and commit-record
     "kill_mid_commit": 1.0,
+    # horizon (ISSUE 14): crash a process and leave it down long enough
+    # for the group's GC horizon to pass it — revival (the ordinary
+    # reboot_process / restore tail) must catch up via snapshot-install
+    "lag_revive": 1.0,
 }
 EXTRA_WEIGHT = 1.5
 
@@ -125,12 +129,15 @@ class FaultSchedule:
     #: action (`net_fault {scope, kind, frac}` — byte-level wire
     #: faults, ISSUE 12); 4 adds the txnkv action (`kill_mid_commit
     #: {disk}` — crash the transaction driver between prepare-quorum
-    #: and commit-record, ISSUE 13).  `from_dict` accepts unstamped v1
+    #: and commit-record, ISSUE 13); 5 adds the horizon action
+    #: (`lag_revive {name, disk}` — crash a process and hold it down
+    #: past the group's GC horizon so its revival must catch up via
+    #: snapshot-install, ISSUE 14).  `from_dict` accepts unstamped v1
     #: artifacts — old /tmp/nemesis-*.json captures keep replaying —
-    #: loads stamped v2/v3 captures byte-exact, and never rejects a
+    #: loads stamped v2/v3/v4 captures byte-exact, and never rejects a
     #: NEWER stamp (events are plain (t, action, args) rows; unknown
     #: actions fail loudly at apply time, which is the right place).
-    SCHEMA = 4
+    SCHEMA = 5
 
     def __init__(self, events: list[NemesisEvent], seed: int | None = None,
                  params: dict | None = None, schema: int | None = None):
@@ -280,7 +287,7 @@ class _GenState:
             return bool(self.delayed)
         if a in ("deafen", "delay_on"):
             return bool(self._quiet_names())
-        if a == "crash_process":
+        if a in ("crash_process", "lag_revive"):
             return bool(self._crashable())
         if a == "reboot_process":
             return bool(self.crashed)
@@ -383,6 +390,24 @@ class _GenState:
             weights = {"keep": 3.0, "dirty": 2.0, "lose": 1.0}
             disk = rng.choices(self.disk_modes,
                                weights=[weights.get(m, 1.0)
+                                        for m in self.disk_modes], k=1)[0]
+            return {"name": name, "disk": disk}
+        if action == "lag_revive":
+            # The horizon scenario (ISSUE 14): crash a process that
+            # STAYS down while traffic drives the group's GC horizon
+            # past it — the target's lag hook owns "past the horizon";
+            # the ordinary reboot_process / restore tail revives it,
+            # which must then catch up via snapshot-install.  Disk
+            # disposition spans all three modes: the catch-up path must
+            # hold whether the image is intact, power-crashed, or gone.
+            cands = self._crashable()
+            if not cands:
+                return None
+            name = rng.choice(cands)
+            self.crashed.add(name)
+            disk = rng.choices(self.disk_modes,
+                               weights=[{"keep": 3.0, "dirty": 2.0,
+                                         "lose": 2.0}.get(m, 1.0)
                                         for m in self.disk_modes], k=1)[0]
             return {"name": name, "disk": disk}
         if action == "reboot_process":
@@ -517,24 +542,46 @@ class ProcessTarget:
 
     def __init__(self, procs: list[str], crash_fn, reboot_fn,
                  proc_groups: dict | None = None,
-                 disk_modes: tuple = CRASH_DISK_MODES):
+                 disk_modes: tuple = CRASH_DISK_MODES,
+                 lag_fn=None):
+        """`lag_fn(name, disk)` (optional, ISSUE 14) enables the
+        `lag_revive` action: crash the process AND drive/await the
+        group's GC horizon past its watermark, so the eventual
+        reboot_process (or restore tail) revives it BEHIND Min() and
+        the service-level snapshot-install catch-up is exercised under
+        the schedule like any other fault dimension."""
         self.procs = list(procs)
         self.crash_fn = crash_fn
         self.reboot_fn = reboot_fn
         self.proc_groups = dict(proc_groups or {})
         self.disk_modes = tuple(disk_modes)
+        self.lag_fn = lag_fn
         self._crashed: set = set()
 
     def spec(self) -> dict:
+        acts = list(self.ACTIONS)
+        if self.lag_fn is not None:
+            acts.append("lag_revive")
         return {"kind": "process", "procs": self.procs,
                 "proc_groups": self.proc_groups,
                 "disk_modes": list(self.disk_modes),
-                "actions": list(self.ACTIONS)}
+                "actions": acts}
 
     def apply(self, action: str, args: dict) -> None:
         if action == "crash_process":
             self._crashed.add(args["name"])
             self.crash_fn(args["name"], args.get("disk", "keep"))
+        elif action == "lag_revive":
+            if self.lag_fn is None:
+                # Replaying a schema-5 capture against a target built
+                # without the lag hook: fail loudly with the actual
+                # problem, not a NoneType call.
+                raise ValueError(
+                    "lag_revive event but this ProcessTarget has no "
+                    "lag_fn — construct it with lag_fn=... to replay "
+                    "horizon captures")
+            self._crashed.add(args["name"])
+            self.lag_fn(args["name"], args.get("disk", "keep"))
         elif action == "reboot_process":
             self.reboot_fn(args["name"])
             self._crashed.discard(args["name"])

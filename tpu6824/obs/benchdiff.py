@@ -173,6 +173,15 @@ METRICS = [
     Metric(("service", "txn", "latency", "p99_ms"), 0.65,
            higher_is_better=False, host_bound=True,
            leg_shape=[("service", "txn", "shape")]),
+    # horizon catch-up micro-leg (ISSUE 14): missed-ops/s recovered via
+    # snapshot-install at the deepest depth, and the deepest install
+    # wall time — host-edge tolerance, gated on the leg's own recorded
+    # depth shape, baselined at the first artifact that carries them.
+    Metric(("service", "catchup", "value"), 0.65, host_bound=True,
+           leg_shape=[("service", "catchup", "shape")]),
+    Metric(("service", "catchup", "install_ms_deepest"), 0.65,
+           higher_is_better=False, host_bound=True,
+           leg_shape=[("service", "catchup", "shape")]),
     # Host-edge legs: the demonstrated noise floor is −55% (wire
     # −40%/−53%, thread-per-clerk −55% between real artifacts).
     Metric(("wire", "value"), 0.65, host_bound=True),

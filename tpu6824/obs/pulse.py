@@ -47,12 +47,61 @@ from tpu6824.obs import metrics as _metrics
 from tpu6824.utils import crashsink
 
 __all__ = ["Pulse", "start", "stop", "get", "series_snapshot",
-           "environment_snapshot", "calibration_spin", "SCHEMA_VERSION"]
+           "environment_snapshot", "calibration_spin", "read_rss_bytes",
+           "read_peak_rss_bytes", "SCHEMA_VERSION"]
 
 SCHEMA_VERSION = "pulse-1.0.0"
 
 _DEF_INTERVAL = float(os.environ.get("TPU6824_PULSE_INTERVAL", "1.0"))
 _DEF_CAP = int(os.environ.get("TPU6824_PULSE_CAP", "600"))
+
+# Process RSS, refreshed once per sampling tick (ISSUE 14, horizon):
+# the one host-memory series the bounded-memory soaks and the
+# memory-growth watchdog rule read.  Gauge created at module scope per
+# the metric-unregistered rule; reading /proc/self/statm is one small
+# file read per tick — sampling-clock granular, zero hot-path cost.
+_G_RSS = _metrics.gauge("proc.rss_bytes")
+try:
+    _PAGE_BYTES = os.sysconf("SC_PAGE_SIZE")
+except (ValueError, OSError, AttributeError):  # non-POSIX fallback
+    _PAGE_BYTES = 4096
+
+
+def read_rss_bytes() -> int | None:
+    """Resident set size of THIS process in bytes (None where /proc is
+    unavailable) — stdlib-only like the rest of obs/."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE_BYTES
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+            import sys as _sys
+
+            # Peak, not current — still a usable upper-bound signal
+            # where /proc is missing.  ru_maxrss is KiB on Linux but
+            # BYTES on macOS (the platform most likely to take this
+            # path): scaling unconditionally would inflate it 1024x
+            # and false-fire the memory-growth rule.
+            peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            return peak if _sys.platform == "darwin" else peak * 1024
+        except Exception:  # noqa: BLE001 — telemetry, never fatal
+            return None
+
+
+def read_peak_rss_bytes() -> int:
+    """Process-lifetime resident high-water mark in bytes (0 where
+    rusage is unavailable).  THE one home of the platform-sensitive
+    ru_maxrss scaling rule — KiB on Linux, bytes on macOS — so callers
+    (bench's mem blocks) cannot drift from read_rss_bytes' fallback."""
+    try:
+        import resource
+        import sys as _sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak if _sys.platform == "darwin" else peak * 1024
+    except Exception:  # noqa: BLE001 — telemetry, never fatal
+        return 0
 
 
 class Pulse:
@@ -80,6 +129,11 @@ class Pulse:
         # Observer registry (the watchdog), called on the sampling
         # thread after each tick: fn(pulse, now).
         self._observers: list = []
+        # Sampler registry (ISSUE 14): zero-arg callables invoked at
+        # the TOP of each tick, BEFORE the registry snapshot — how the
+        # service layer (services.horizon row-count gauges) refreshes
+        # gauges at sampling cadence without obs/ importing services.
+        self._samplers: list = []
         self.samples = 0
         self.last_stats: dict | None = None
         self.t_started: float | None = None
@@ -119,6 +173,25 @@ class Pulse:
             if fn in self._observers:
                 self._observers.remove(fn)
 
+    def add_sampler(self, fn) -> None:
+        with self._mu:
+            if fn not in self._samplers:
+                # tpusan: ok(unbounded-obs-buffer) — sampler registry:
+                # one callable per attached gauge source, deduplicated
+                # above; it never accumulates samples
+                self._samplers.append(fn)
+
+    def remove_sampler(self, fn) -> None:
+        with self._mu:
+            if fn in self._samplers:
+                self._samplers.remove(fn)
+
+    def _all_samplers(self) -> list:
+        with _sampler_mu:
+            g = list(_GLOBAL_SAMPLERS)
+        with self._mu:
+            return g + [f for f in self._samplers if f not in g]
+
     # ----------------------------------------------------------- sampling
 
     def _run(self) -> None:
@@ -132,6 +205,15 @@ class Pulse:
         """One sampling tick (public so tests can drive the clock
         deterministically without the thread)."""
         now = time.monotonic()
+        rss = read_rss_bytes()
+        if rss is not None:
+            _G_RSS.set(rss)
+        for fn in self._all_samplers():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — a broken gauge
+                # source must not kill the sampling clock.
+                crashsink.record("pulse-sampler", e, fatal=False)
         if self.fabric is not None:
             try:
                 self.last_stats = (
@@ -244,6 +326,28 @@ class Pulse:
 
 _PULSE: Pulse | None = None
 _pulse_mu = threading.Lock()
+
+# Global sampler registry: gauge sources that must be sampled by
+# WHICHEVER pulse runs, regardless of registration order (a server
+# constructed before pulse.start() still gets its gauges refreshed).
+# Bounded: one deduplicated callable per gauge source, never samples.
+_GLOBAL_SAMPLERS: list = []
+_sampler_mu = threading.Lock()
+
+
+def add_global_sampler(fn) -> None:
+    """Register a gauge-refresh callable with EVERY pulse instance
+    (current and future) — the order-independent form of
+    `Pulse.add_sampler`, used by services.horizon's row-count gauges."""
+    with _sampler_mu:
+        if fn not in _GLOBAL_SAMPLERS:
+            _GLOBAL_SAMPLERS.append(fn)
+
+
+def remove_global_sampler(fn) -> None:
+    with _sampler_mu:
+        if fn in _GLOBAL_SAMPLERS:
+            _GLOBAL_SAMPLERS.remove(fn)
 
 
 def start(fabric=None, interval: float | None = None,

@@ -44,6 +44,11 @@ Rules (thresholds via env, see TUNING):
     (ISSUE 13): the 2PC layer burning its work on lock conflicts /
     recovery aborts instead of committing (`TPU6824_WD_ABORT_RATE`
     floor keeps ordinary optimistic-CAS retries quiet).
+  - ``memory-growth``       — process RSS with a sustained positive
+    slope over `TPU6824_WD_MEM_WINDOW` while traffic stays flat
+    (ISSUE 14): host state outrunning the horizon compaction machinery
+    — the leak signature, not a warming working set
+    (`TPU6824_WD_MEM_MIN_BYTES` keeps allocator jitter quiet).
 
 Default-off like tracing: a watchdog only exists when constructed, and
 evaluation is sampling-clock granular — no per-op cost anywhere.
@@ -330,10 +335,63 @@ class AbortStorm(Rule):
         return None
 
 
+class MemoryGrowth(Rule):
+    """Host-memory leak signature (ISSUE 14, horizon): process RSS with
+    a SUSTAINED positive slope across `TPU6824_WD_MEM_WINDOW` while
+    traffic stays flat.  Both halves matter — RSS climbing WITH traffic
+    is a workload growing its working set (caches warming, batches
+    widening), and flat RSS under any traffic is exactly what the
+    compaction machinery exists to guarantee; the LEAK signature is
+    memory growing when the offered load is not.  The growth floor
+    (`TPU6824_WD_MEM_MIN_BYTES`) keeps allocator jitter and gc cycles
+    quiet."""
+
+    name = "memory-growth"
+    rss = "proc.rss_bytes"
+    traffic = "fabric.decided_cells.rate"
+
+    def __init__(self, window: float | None = None,
+                 min_growth: float | None = None,
+                 flat_band: float = 1.25, rise_frac: float = 0.8):
+        self.window = _envf("TPU6824_WD_MEM_WINDOW", 30.0) \
+            if window is None else float(window)
+        self.min_growth = _envf("TPU6824_WD_MEM_MIN_BYTES",
+                                float(32 << 20)) \
+            if min_growth is None else float(min_growth)
+        self.flat_band = flat_band
+        self.rise_frac = rise_frac
+
+    def check(self, wd):
+        pts = wd.points(self.rss, window=self.window)
+        if len(pts) < 6:
+            return None
+        vs = [v for _, v in pts]
+        half = len(vs) // 2
+        before = sum(vs[:half]) / half
+        after = sum(vs[half:]) / (len(vs) - half)
+        if after - before < self.min_growth:
+            return None
+        # SUSTAINED: most consecutive deltas STRICTLY positive (RSS is
+        # near-monotone, so counting flats would make this a no-op and
+        # a one-off allocation step — one big delta, then flat — would
+        # read as a slope).
+        rises = sum(1 for a, b in zip(vs, vs[1:]) if b > a)
+        if rises < self.rise_frac * (len(vs) - 1):
+            return None
+        tr = wd.points(self.traffic, window=self.window)
+        if len(tr) >= 4:
+            t_before, t_after = RetryStorm._halves(tr)
+            if t_before > 0 and t_after > t_before * self.flat_band:
+                return None  # traffic growing: working set, not a leak
+        return (f"rss grew {before / 1e6:.1f}MB -> {after / 1e6:.1f}MB "
+                f"over the window with traffic flat "
+                "(host state outrunning compaction)")
+
+
 def default_rules() -> list[Rule]:
     return [StalledGroups(), ThroughputCollapse(), LatencySpike(),
             QueueGrowth(), ThreadCrashes(), DroppedClimbing(),
-            JitRecompile(), RetryStorm(), AbortStorm()]
+            JitRecompile(), RetryStorm(), AbortStorm(), MemoryGrowth()]
 
 
 class Watchdog:

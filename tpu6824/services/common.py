@@ -167,15 +167,26 @@ class ColumnarDups:
     them into the columns in one pass — one slot lookup per unique
     client per drain instead of one per op.
 
+    Retirement (ISSUE 14, horizon): a third parallel column tracks the
+    APPLIED LOG SEQ of each client's newest op (`pend` values may be
+    (cseq, reply, seq) 3-tuples), and `retire_below(floor)` folds out
+    every row whose last activity predates `floor` — called ONLY from
+    the replicated `compact` log entry's apply, so every replica
+    retires the identical rows at the identical log position and the
+    table stays log-deterministic (what at-most-once rests on).  Rows
+    written without a seq (legacy callers) carry -1 and are never
+    retired.
+
     NOT thread-safe: callers hold the server mutex, exactly as they did
     for the dict it replaces."""
 
-    __slots__ = ("_slot", "_cseqs", "_replies")
+    __slots__ = ("_slot", "_cseqs", "_replies", "_seqs")
 
     def __init__(self, items=()):
         self._slot: dict[object, int] = {}
         self._cseqs = array("q")
         self._replies: list[object] = []
+        self._seqs = array("q")  # applied seq of the row's newest op
         for cid, (cseq, reply) in dict(items).items():
             self.put(cid, cseq, reply)
 
@@ -214,34 +225,65 @@ class ColumnarDups:
         """The cached reply ref for `cid` (caller checked `seen`)."""
         return self._replies[self._slot[cid]]
 
-    def put(self, cid, cseq, reply) -> None:
+    def put(self, cid, cseq, reply, seq: int = -1) -> None:
         i = self._slot.get(cid)
         if i is None:
             self._slot[cid] = len(self._cseqs)
             self._cseqs.append(cseq)
             self._replies.append(reply)
+            self._seqs.append(seq)
         else:
             self._cseqs[i] = cseq
             self._replies[i] = reply
+            self._seqs[i] = seq
 
     def __setitem__(self, cid, pair) -> None:
         self.put(cid, pair[0], pair[1])
 
     def apply_batch(self, pend: dict) -> None:
-        """Fold a drain's collected (cid → (cseq, reply)) writes into the
-        columns — the once-per-drain batch update."""
+        """Fold a drain's collected (cid → (cseq, reply[, seq])) writes
+        into the columns — the once-per-drain batch update."""
         slot_get = self._slot.get
         cseqs = self._cseqs
         replies = self._replies
-        for cid, (cseq, reply) in pend.items():
+        seqs = self._seqs
+        for cid, ent in pend.items():
+            cseq, reply = ent[0], ent[1]
+            seq = ent[2] if len(ent) > 2 else -1
             i = slot_get(cid)
             if i is None:
                 self._slot[cid] = len(cseqs)
                 cseqs.append(cseq)
                 replies.append(reply)
+                seqs.append(seq)
             else:
                 cseqs[i] = cseq
                 replies[i] = reply
+                seqs[i] = seq
+
+    def retire_below(self, seq_floor: int) -> int:
+        """Fold out every row whose last applied seq is below
+        `seq_floor` (rows with no recorded seq, -1, are kept); returns
+        the retired count.  Deterministic rebuild — callers invoke this
+        only from a replicated compact entry's apply."""
+        seqs = self._seqs
+        keep = [(cid, i) for cid, i in self._slot.items()
+                if not (0 <= seqs[i] < seq_floor)]
+        retired = len(self._slot) - len(keep)
+        if not retired:
+            return 0
+        cseqs, replies = self._cseqs, self._replies
+        self._slot = {}
+        self._cseqs = array("q")
+        self._replies = []
+        self._seqs = array("q")
+        for cid, i in keep:
+            self.put(cid, cseqs[i], replies[i], seqs[i])
+        return retired
+
+    def last_seq(self, cid) -> int:
+        i = self._slot.get(cid)
+        return -1 if i is None else self._seqs[i]
 
     def items(self):
         cseqs = self._cseqs
@@ -249,9 +291,46 @@ class ColumnarDups:
         for cid, i in self._slot.items():
             yield cid, (cseqs[i], replies[i])
 
+    def items_with_seq(self):
+        """(cid, (cseq, reply, last_seq)) rows — the snapshot export
+        shape, so an installed table keeps its retirement clock."""
+        cseqs = self._cseqs
+        replies = self._replies
+        seqs = self._seqs
+        for cid, i in self._slot.items():
+            yield cid, (cseqs[i], replies[i], seqs[i])
+
     def to_dict(self) -> dict:
         """Plain-dict snapshot (persistence / shard-transfer interop)."""
         return dict(self.items())
+
+
+def pull_from_peers(attempt_once, deadline_s: float,
+                    is_dead=None, retry_sleep: float = 0.15) -> str:
+    """THE peer-recovery retry discipline (ISSUE 14, generalized from
+    diskv's `_snapshot_from_peer` so every service shares one hardened
+    implementation).  `attempt_once()` tries every reachable donor once
+    and returns:
+
+      - "ok"          — state adopted; done.
+      - "behind"      — every REACHABLE donor is at/below our watermark
+                        (nothing to pull, ever): limping is safe.
+      - "unreachable" — donors exist but none answered this pass (busy
+                        mutex, mid-persist fsync, partition): retried
+                        until `deadline_s`, because treating a busy
+                        donor like "no donor exists" lets the caller's
+                        limp-forward path permanently skip GC'd data a
+                        donor could still supply (the PR 7 flake).
+
+    `deadline_s=0` is the single-pass form (drain-path callers, whose
+    tick cadence IS the retry loop); boot-path callers pass seconds."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        st = attempt_once()
+        if st != "unreachable" or (is_dead is not None and is_dead()) \
+                or time.monotonic() >= deadline:
+            return st
+        time.sleep(retry_sleep)
 
 
 def fresh_cid() -> int:

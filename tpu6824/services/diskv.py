@@ -157,14 +157,8 @@ class DisKVServer(ShardKVServer):
             elif self.px.min() > self.applied + 1:
                 self._snapshot_from_peer()
 
-    def _group_peers(self):
-        """Live directory entries of this group's OTHER replicas —
-        in-process servers or socket proxies alike (selected by name,
-        the g<gid>-<p> convention)."""
-        prefix = f"g{self.gid}-"
-        for name, srv in list(self.directory.items()):
-            if name != self.name and name.startswith(prefix):
-                yield name, srv
+    # _group_peers is inherited from ShardKVServer (hoisted there for
+    # the horizon snapshot-install catch-up, ISSUE 14).
 
     def _try_lower_amnesia_floor(self, deadline_s: float) -> bool:
         """Blank-disk rejoin, floor half: lower the boot quarantine
@@ -399,16 +393,16 @@ class DisKVServer(ShardKVServer):
         "busy" transiently, and treating that like "no donor exists"
         used to let the caller's limp-forward path permanently skip the
         GC'd prefix (surfaced as a rare {'m0': '+more'} full-suite-
-        contention flake in the disk-loss rejoin test).  Retries until
-        the deadline, then reports WHY it failed so callers limp only
+        contention flake in the disk-loss rejoin test).  The retry/
+        report discipline itself is `services.common.pull_from_peers`
+        (ISSUE 14 hoisted it so kvpaxos/shardkv snapshot-install and
+        this path share the exact hardened loop); callers limp only
         when limping is actually safe."""
-        deadline = time.monotonic() + deadline_s
-        while True:
-            st = self._snapshot_from_peer_once(require_ahead)
-            if st != "unreachable" or self.dead or \
-                    time.monotonic() >= deadline:
-                return st
-            time.sleep(0.15)
+        from tpu6824.services.common import pull_from_peers
+
+        return pull_from_peers(
+            lambda: self._snapshot_from_peer_once(require_ahead),
+            deadline_s=deadline_s, is_dead=lambda: self.dead)
 
     def _snapshot_from_peer_once(self, require_ahead: bool = True) -> str:
         behind = False

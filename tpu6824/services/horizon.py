@@ -1,0 +1,349 @@
+"""horizon — service-level log compaction, snapshot-install catch-up,
+and bounded-memory operation for the replicated-KV services (ISSUE 14,
+the compaction half of ROADMAP item 3).
+
+The fabric's window GC (Done()/Min()) has always been able to reclaim
+instance slots, but nothing above it ever shrank: kvpaxos/shardkv dup
+tables, txnkv's decision records, and the replay state a revived replica
+needs all grew monotonically with every decided op, and a replica
+revived BEHIND Min() could only catch up in diskv (which persists its
+state).  This module closes both gaps for the in-memory services:
+
+  - **Snapshotter** — a per-server snapshot cell: every `snapshot_every`
+    applied ops the server copies its applied state under its own mutex
+    (copy only — serialization and any disk spill run OFF the lock,
+    checkpointd-style), frames it with the PR 7 checksum frame
+    (`core.fabric.frame_checkpoint`), publishes the immutable
+    `(applied, bytes)` pair for lock-free donor serving, and optionally
+    spills it durably (durafs discipline) when a `persist_dir` is
+    configured.  The published snapshot is what `snapshot_fetch` serves
+    — chunked, resumable, never under `mu` (the tpusan rules).
+  - **Catch-up** — a server whose next-needed seq is below a peer's
+    Min() installs a peer snapshot over the `snapshot_fetch` route and
+    resumes log replay from the watermark.  The "behind vs unreachable"
+    retry discipline diskv pioneered lives in
+    `services.common.pull_from_peers`; this module supplies the chunked
+    fetch/assemble half (`install_from_peer`).
+  - **Compaction horizon** — dup-table retirement and txn record GC are
+    driven by a REPLICATED `compact` log entry (proposed by any
+    replica's snapshot cadence, applied deterministically by all), so
+    every replica trims the identical rows at the identical log
+    position: host state stays log-deterministic, which is the property
+    at-most-once rests on.  The trim thresholds are expressed in
+    applied-ops (log progress), not wall time, so replay is exact.
+
+Knobs (TUNING round 18): `TPU6824_SNAPSHOT_EVERY` (applied ops between
+snapshots; 0 disables — the per-server `snapshot_every=` kwarg
+overrides), `TPU6824_SNAPSHOT_KEEP` (persisted files kept),
+`TPU6824_SNAP_CHUNK` (fetch chunk bytes), `TPU6824_DUP_RETIRE_OPS`
+(dup rows idle for this many applied ops fold out at the next compact;
+0 disables), and the txnkv linger knobs documented there.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import threading
+
+from tpu6824.core.fabric import (  # the PR 7 checksum frame, reused
+    CorruptCheckpointError,
+    frame_checkpoint,
+    unframe_checkpoint,
+)
+from tpu6824.obs import metrics as _metrics
+from tpu6824.utils import durafs
+
+__all__ = [
+    "Snapshotter", "install_from_peer", "load_newest",
+    "SNAPSHOT_EVERY", "DUP_RETIRE_OPS", "CHUNK_BYTES",
+    "register_tracker", "unregister_tracker", "sample_gauges",
+]
+
+#: Applied-ops cadence between service snapshots (0 = no snapshots; the
+#: per-server kwarg overrides).  Deliberately an env default so soaks
+#: and deployments can turn bounded-memory operation on fleet-wide.
+SNAPSHOT_EVERY = int(os.environ.get("TPU6824_SNAPSHOT_EVERY", "0"))
+#: Persisted snapshot files kept per server (persist_dir spill).
+SNAPSHOT_KEEP = int(os.environ.get("TPU6824_SNAPSHOT_KEEP", "2"))
+#: Dup-table retirement horizon in applied ops: a client row whose last
+#: applied op is older than this folds into the snapshot at the next
+#: compact entry (0 disables).  Must comfortably exceed any clerk retry
+#: window measured in ops — a retry of a retired row would re-apply.
+DUP_RETIRE_OPS = int(os.environ.get("TPU6824_DUP_RETIRE_OPS", "0"))
+#: snapshot_fetch chunk size (bytes) — the resumable-install unit.
+CHUNK_BYTES = int(os.environ.get("TPU6824_SNAP_CHUNK", str(256 * 1024)))
+
+# Persisted snapshot naming: monotone applied watermark, so "newest" is
+# an ordering on names (never mtimes), checkpointd-style.
+SNAP_RE = re.compile(r"^svc-(\d{12})\.bin$")
+
+# tpuscope metrics (module scope per the metric-unregistered rule).
+_M_SNAPSHOTS = _metrics.counter("horizon.snapshots")
+_M_INSTALLS = _metrics.counter("horizon.installs")
+_M_INSTALL_BYTES = _metrics.counter("horizon.install_bytes")
+_M_DUP_RETIRED = _metrics.counter("horizon.dup_retired")
+_G_SNAP_BYTES = _metrics.gauge("horizon.snapshot_bytes")
+# Row-count gauges the bounded-memory contract watches (summed across
+# every registered tracker by `sample_gauges`, which pulse drives).
+_G_KV_ROWS = _metrics.gauge("horizon.kv_rows")
+_G_DUP_ROWS = _metrics.gauge("horizon.dup_rows")
+_G_PREPARED = _metrics.gauge("horizon.txn_prepared_rows")
+_G_DECISIONS = _metrics.gauge("horizon.txn_decision_rows")
+_G_DONE_ROWS = _metrics.gauge("horizon.txn_done_rows")
+_G_WINDOW = _metrics.gauge("horizon.window_live_slots")
+
+
+def note_dup_retired(n: int) -> None:
+    """Counter hook for the services' compact applies (the metric
+    object stays module-scoped here per the metric-unregistered rule)."""
+    _M_DUP_RETIRED.inc(n)
+
+
+class Snapshotter:
+    """One server's snapshot cell: cadence bookkeeping + the published
+    immutable snapshot + optional durable spill.
+
+    Thread contract: `due`/`note_applied` are called with the server
+    mutex held (cheap int math); `publish` runs OFF the mutex with the
+    already-copied state; `chunk` is called from ANY thread with no lock
+    at all — it reads the one-slot `self.snap` reference atomically
+    (tuple publication is a single store under the GIL) and never
+    blocks, per the never-under-mu donor rule."""
+
+    def __init__(self, every: int | None = None,
+                 persist_dir: str | None = None,
+                 keep: int | None = None):
+        self.every = SNAPSHOT_EVERY if every is None else int(every)
+        self.persist_dir = persist_dir
+        self.keep = max(1, SNAPSHOT_KEEP if keep is None else int(keep))
+        #: (applied, framed_bytes) — immutable once published.
+        self.snap: tuple[int, bytes] | None = None
+        self.written = 0
+        self.last_applied = -1  # watermark of the newest snapshot
+        #: A puller found our snapshot stale: cut a fresh one promptly
+        #: (checked by the owner's driver/ticker next pass).
+        self.nudged = False
+        if persist_dir:
+            os.makedirs(persist_dir, exist_ok=True)
+
+    def enabled(self) -> bool:
+        return self.every > 0
+
+    def due(self, applied: int) -> bool:
+        """True when `applied` has advanced at least `every` ops past
+        the newest snapshot (or a puller nudged us)."""
+        if not self.enabled():
+            return False
+        if applied < 0:
+            return False
+        if self.nudged and applied > self.last_applied:
+            return True
+        return applied - self.last_applied >= self.every
+
+    def publish(self, applied: int, blob: dict) -> bytes:
+        """Serialize + frame + publish `blob` as the snapshot at
+        `applied`; spill durably when persist_dir is set.  Runs OFF the
+        server mutex (the caller copied the state under it)."""
+        framed = frame_checkpoint(
+            pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL))
+        self.snap = (applied, framed)
+        self.last_applied = applied
+        self.nudged = False
+        self.written += 1
+        _M_SNAPSHOTS.inc()
+        _G_SNAP_BYTES.set(len(framed))
+        if self.persist_dir:
+            path = os.path.join(self.persist_dir,
+                                f"svc-{applied:012d}.bin")
+            durafs.atomic_write(path, framed)
+            self._prune()
+        return framed
+
+    def _prune(self) -> None:
+        snaps = sorted(
+            ((int(m.group(1)), n) for n in os.listdir(self.persist_dir)
+             for m in (SNAP_RE.match(n),) if m),
+            reverse=True)
+        for _seq, name in snaps[self.keep:]:
+            try:
+                os.unlink(os.path.join(self.persist_dir, name))
+            except OSError:
+                continue
+        # Torn-write debris from an injected/real fault mid-spill: the
+        # SNAP_RE never matches a ".tmp", so sweep it like checkpointd
+        # does or a fault-heavy soak grows the dir without bound.
+        for name in os.listdir(self.persist_dir):
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(self.persist_dir, name))
+                except OSError:
+                    continue
+
+    # ------------------------------------------------------- donor side
+
+    def chunk(self, floor: int, off: int, n: int | None = None,
+              donor_applied: int = -1) -> dict:
+        """One `snapshot_fetch` answer — lock-free (see class docstring).
+
+        Returns {"applied", "total", "off", "data"} for a snapshot that
+        covers `floor`; {"behind": True} when the donor itself has not
+        applied to `floor` (`donor_applied` is the donor's live
+        watermark, passed by the RPC wrapper); {"stale": True} when the
+        donor HAS the state but its published snapshot predates `floor`
+        — the puller retries after the donor's nudged cadence cuts a
+        fresh one."""
+        n = CHUNK_BYTES if n is None else min(int(n), 4 * CHUNK_BYTES)
+        snap = self.snap  # one atomic read; immutable afterwards
+        if snap is None or snap[0] < floor:
+            if donor_applied >= 0 and donor_applied < floor:
+                return {"behind": True, "applied": donor_applied}
+            self.nudged = True
+            return {"stale": True,
+                    "applied": -1 if snap is None else snap[0]}
+        applied, framed = snap
+        off = max(0, int(off))
+        return {"applied": applied, "total": len(framed), "off": off,
+                "data": framed[off:off + n]}
+
+
+def decode_snapshot(framed: bytes) -> dict:
+    """Verified blob of a framed service snapshot (raises
+    CorruptCheckpointError on a torn/bit-rotted frame)."""
+    return pickle.loads(unframe_checkpoint(framed, "<service-snapshot>"))
+
+
+def load_newest(persist_dir: str):
+    """(applied, blob) from the newest VALID persisted snapshot under
+    `persist_dir`, discarding torn frames newest-first (the durafault
+    acceptance property), or None when nothing restores."""
+    try:
+        names = os.listdir(persist_dir)
+    except FileNotFoundError:
+        return None
+    snaps = sorted(((int(m.group(1)), n) for n in names
+                    for m in (SNAP_RE.match(n),) if m), reverse=True)
+    for applied, name in snaps:
+        try:
+            with open(os.path.join(persist_dir, name), "rb") as f:
+                return applied, decode_snapshot(f.read())
+        except (CorruptCheckpointError, OSError, pickle.UnpicklingError,
+                EOFError):
+            continue
+    return None
+
+
+def install_from_peer(fetch, floor: int) -> tuple[str, int, dict | None]:
+    """Pull one donor's snapshot through its chunked `snapshot_fetch`
+    surface: `fetch(floor, off, n)` is the bound RPC.  Returns
+    (status, applied, blob): status "ok" (blob decoded, covers floor),
+    "behind" (donor itself below floor), or "unreachable" (stale
+    snapshot pending a nudge, torn data, or transport failure — the
+    caller's pull_from_peers discipline retries).
+
+    Resumable by construction: a published snapshot is immutable per
+    `applied`, so chunks re-fetched after a transient failure continue
+    at the same offset; a donor that re-snapshotted mid-pull (applied
+    changed) restarts the assembly at the new watermark."""
+    buf = bytearray()
+    applied = -1
+    while True:
+        try:
+            r = fetch(floor, len(buf), CHUNK_BYTES)
+        except Exception:  # noqa: BLE001 — transport failure: next donor
+            return "unreachable", -1, None
+        if not isinstance(r, dict):
+            return "unreachable", -1, None
+        if r.get("behind"):
+            return "behind", int(r.get("applied", -1)), None
+        if r.get("stale"):
+            return "unreachable", int(r.get("applied", -1)), None
+        if r["applied"] != applied:
+            # First chunk, or the donor re-snapshotted mid-pull:
+            # restart assembly at the new (immutable) watermark.
+            applied = r["applied"]
+            buf = bytearray()
+            if r["off"] != 0:
+                continue  # re-request from 0 against the new snapshot
+        buf += r["data"]
+        if len(buf) >= r["total"]:
+            break
+        if not r["data"]:
+            return "unreachable", applied, None  # donor went quiet
+    try:
+        blob = decode_snapshot(bytes(buf))
+    except (CorruptCheckpointError, pickle.UnpicklingError, EOFError):
+        return "unreachable", applied, None
+    _M_INSTALLS.inc()
+    _M_INSTALL_BYTES.inc(len(buf))
+    return "ok", applied, blob
+
+
+# ---------------------------------------------------- row-count gauges
+# The bounded-memory observability satellite: servers register a
+# tracker callable returning their live row counts; `sample_gauges`
+# (driven by pulse's per-tick sampler hook) sums them into the horizon.*
+# gauges so the memory-growth watchdog and the soak assertions read one
+# surface.  Registration is explicit and unregistration happens at
+# kill(), so the registry is bounded by live servers.
+
+_trackers_mu = threading.Lock()
+_trackers: dict[object, object] = {}  # key -> fn() -> dict
+
+
+def register_tracker(key, fn) -> None:
+    with _trackers_mu:
+        _trackers[key] = fn
+    # Ride the pulse sampling clock, whichever side starts first: the
+    # GLOBAL sampler registry is consulted by every Pulse instance at
+    # each tick, so gauges refresh at sampling cadence with no thread
+    # of their own and no registration-order dependency.
+    try:
+        from tpu6824.obs import pulse as _pulse
+
+        _pulse.add_global_sampler(sample_gauges)
+    except Exception:  # noqa: BLE001 — gauges are advisory telemetry
+        pass
+
+
+def unregister_tracker(key) -> None:
+    with _trackers_mu:
+        _trackers.pop(key, None)
+
+
+_GAUGE_FIELDS = (
+    ("kv_rows", _G_KV_ROWS),
+    ("dup_rows", _G_DUP_ROWS),
+    ("txn_prepared_rows", _G_PREPARED),
+    ("txn_decision_rows", _G_DECISIONS),
+    ("txn_done_rows", _G_DONE_ROWS),
+    ("window_live_slots", _G_WINDOW),
+)
+
+
+def sample_gauges() -> dict:
+    """Sum every registered tracker's row counts into the horizon.*
+    gauges; returns the totals (the soak assertions read them
+    directly).  Window cells are MAXed per distinct fabric, not summed
+    per server (P replicas share one window)."""
+    with _trackers_mu:
+        fns = list(_trackers.values())
+    totals = {k: 0 for k, _ in _GAUGE_FIELDS}
+    windows: dict[int, int] = {}
+    for fn in fns:
+        try:
+            d = fn()
+        except Exception:  # noqa: BLE001 — a dying server is not data
+            continue
+        for k, _g in _GAUGE_FIELDS:
+            if k == "window_live_slots":
+                continue
+            totals[k] += int(d.get(k, 0))
+        w = d.get("window_live_slots")
+        if w is not None:
+            windows[d.get("window_key", id(fn))] = int(w)
+    totals["window_live_slots"] = sum(windows.values())
+    for k, g in _GAUGE_FIELDS:
+        g.set(totals[k])
+    return totals

@@ -26,12 +26,14 @@ from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.obs import metrics as _metrics
 from tpu6824.obs import tracing as _tracing
 from tpu6824.rpc import wire as _wire
+from tpu6824.services import horizon as _horizon
 from tpu6824.services.common import (
     Backoff,
     ColumnarDups,
     DecidedTap,
     FlakyNet,
     fresh_cid,
+    pull_from_peers,
 )
 from tpu6824.utils.errors import OK, ErrNoKey, RPCError
 from tpu6824.utils.profiling import PhaseProfiler
@@ -101,10 +103,13 @@ class _Fut:
 
 
 class KVPaxosServer:
-    RPC_METHODS = ["get", "put_append"]  # wire surface (rpc.Server)
+    RPC_METHODS = ["get", "put_append", "snapshot_fetch"]  # wire surface
 
     def __init__(self, fabric: PaxosFabric | None, g: int, me: int,
-                 op_timeout: float = 8.0, px=None):
+                 op_timeout: float = 8.0, px=None, peers=None,
+                 snapshot_every: int | None = None,
+                 persist_dir: str | None = None,
+                 dup_retire_ops: int | None = None):
         """`px` overrides the consensus backend: anything with the PaxosPeer
         contract (start/status/done/min/max/kill) — the batched TPU fabric
         peer by default, or a decentralized `HostOpPeer` (see
@@ -184,6 +189,30 @@ class KVPaxosServer:
         sub_fn = getattr(self.px, "subscribe_decided", None)
         sub = sub_fn(wake=self._wake.set) if sub_fn is not None else None
         self._tap = DecidedTap(sub) if sub is not None else None
+        # horizon (ISSUE 14): service snapshots + Done()-driven
+        # compaction + snapshot-install catch-up.  `peers` (sibling
+        # servers/proxies; make_cluster wires it) is what makes a
+        # revived replica behind the GC horizon installable instead of
+        # skip-forwarded; `snapshot_every`/`persist_dir` configure the
+        # Snapshotter (env defaults; 0 disables and keeps the legacy
+        # fast-forward semantics byte-for-byte).
+        self.peers = peers
+        self.g = g
+        self.dup_retire_ops = (_horizon.DUP_RETIRE_OPS
+                               if dup_retire_ops is None
+                               else int(dup_retire_ops))
+        self.horizon = _horizon.Snapshotter(every=snapshot_every,
+                                            persist_dir=persist_dir)
+        self._behind_min = 0  # FORGOTTEN floor awaiting snapshot-install
+        self._cmp_cid = f"cmp-{g}-{me}"
+        self._cmp_cseq = 0
+        if self.horizon.enabled():
+            _horizon.register_tracker(self, self._horizon_rows)
+            if persist_dir:
+                loaded = _horizon.load_newest(persist_dir)
+                if loaded is not None and loaded[0] > self.applied:
+                    self._adopt_blob_locked(loaded[0], loaded[1])
+                    self._done_fn(self.applied)
         # The driver doubles as the background catch-up ticker: it applies
         # already-decided instances and advances Done() even when no client
         # talks to this replica.  The reference only applies inside RPC
@@ -237,9 +266,12 @@ class KVPaxosServer:
             elif op.kind == "append":
                 self.kv[op.key] = self.kv.get(op.key, "") + op.value
                 reply = (OK, "")
+            elif op.kind == "compact":
+                self._compact_locked(self.applied + 1)
+                reply = (OK, "")
             else:
                 reply = (OK, "")
-            self.dup[op.cid] = (op.cseq, reply)
+            self.dup.put(op.cid, op.cseq, reply, self.applied + 1)
         fut = self._waiters.pop((op.cid, op.cseq), None)
         if fut is not None:
             if op.tc is not None:
@@ -309,9 +341,20 @@ class KVPaxosServer:
                     elif kind == "append":
                         kv[v.key] = kv_get(v.key, "") + v.value
                         reply = (OK, "")
+                    elif kind == "compact":
+                        # Fold the batch's pending dup writes FIRST so
+                        # the retirement scan sees exactly the table
+                        # every op below this seq produced — batch
+                        # boundaries differ per replica, the compact's
+                        # log position does not (determinism).
+                        if pend:
+                            dup.apply_batch(pend)
+                            pend.clear()
+                        self._compact_locked(self.applied)
+                        reply = (OK, "")
                     else:
                         reply = (OK, "")
-                    pend[v.cid] = (v.cseq, reply)
+                    pend[v.cid] = (v.cseq, reply, self.applied)
                 else:
                     reply = ent[1] if ent is not None else dup.reply(v.cid)
                 fut = waiters_pop((v.cid, v.cseq), None)
@@ -352,6 +395,13 @@ class KVPaxosServer:
                 if tap.should_probe_min(self.applied):
                     mn = self.px.min()
                     if mn > self.applied + 1:
+                        if self._can_install():
+                            # Behind the GC horizon with donors
+                            # configured: flag for the driver's
+                            # OUTSIDE-mu snapshot-install pass instead
+                            # of skipping state (ISSUE 14).
+                            self._behind_min = mn
+                            break
                         while self.applied + 1 < mn:
                             self.applied += 1
                             self._inflight.pop(self.applied, None)
@@ -405,6 +455,9 @@ class KVPaxosServer:
                 mn = self.px.min()
                 if mn <= self.applied + 1:
                     break  # transient view; retry next pass
+                if self._can_install():
+                    self._behind_min = mn  # driver installs outside mu
+                    break
                 while self.applied + 1 < mn:
                     self.applied += 1
                     self._inflight.pop(self.applied, None)
@@ -454,6 +507,153 @@ class KVPaxosServer:
         self._last_drain = self.applied + 1 - base0
         if self.applied >= base0:
             self._done_fn(self.applied)
+
+    # ------------------------------------------------------ horizon (ISSUE 14)
+
+    def _can_install(self) -> bool:
+        """Donor-backed catch-up is possible: horizon configured and at
+        least one sibling to pull from.  False keeps the legacy
+        fast-forward semantics byte-for-byte."""
+        return self.horizon.enabled() and bool(self.peers)
+
+    def _compact_locked(self, seq: int) -> None:
+        """Apply one replicated `compact` log entry at `seq`: retire
+        dup-table rows idle for more than `dup_retire_ops` applied ops.
+        Pure function of (seq, table state) — identical on every
+        replica at this log position."""
+        if self.dup_retire_ops > 0:
+            floor = seq - self.dup_retire_ops
+            if floor > 0:
+                n = self.dup.retire_below(floor)
+                if n:
+                    _horizon.note_dup_retired(n)
+
+    def _horizon_rows(self) -> dict:
+        d = {"kv_rows": len(self.kv), "dup_rows": len(self.dup)}
+        fab = getattr(self.px, "fabric", None)
+        if fab is not None:
+            d["window_live_slots"] = fab.live_slots
+            d["window_key"] = id(fab)
+        return d
+
+    def _adopt_blob_locked(self, applied: int, blob: dict) -> None:
+        """Install a decoded snapshot: replace the applied state, jump
+        the watermark, and settle anything parked below it."""
+        self.kv = dict(blob["kv"])
+        dup = ColumnarDups()
+        for cid, row in blob["dup"]:
+            dup.put(cid, row[0], row[1], row[2] if len(row) > 2 else -1)
+        self.dup = dup
+        self.applied = applied
+        for seq in [s for s in self._inflight if s <= applied]:
+            del self._inflight[seq]
+        # Waiters whose ops the snapshot already covers resolve from
+        # the installed dup table (their decided seqs are below the
+        # horizon — nothing will ever apply them here again).
+        for key in list(self._waiters):
+            cid, cseq = key
+            if cseq <= dup.seen(cid):
+                self._waiters.pop(key).set(dup.reply(cid))
+        if self._csink is not None and self._ccseq:
+            tags, reps = [], []
+            for cid in list(self._ccseq):
+                if self._ccseq[cid] <= dup.seen(cid):
+                    del self._ccseq[cid]
+                    tags.append(self._ctag.pop(cid))
+                    reps.append(dup.reply(cid))
+            if tags:
+                self._csink.push(tags, reps, [None] * len(tags))
+        if self._tap is not None:
+            self._tap.discard_through(applied)
+        self._next_seq = max(self._next_seq, applied + 1)
+        # A restored/installed table may carry OUR compact cid from a
+        # previous life at a higher cseq — reseed the counter or the
+        # next `dup.seen(_cmp_cid)` proposals would be silently
+        # dup-swallowed for a whole run of snapshot cadences.
+        self._cmp_cseq = max(self._cmp_cseq,
+                             self.dup.seen(self._cmp_cid))
+
+    def _catchup_attempt_once(self) -> str:
+        """One pass over the configured donors (the shared behind-vs-
+        unreachable discipline's attempt body)."""
+        floor = self._behind_min - 1
+        behind = False
+        for peer in self.peers or ():
+            if peer is self or getattr(peer, "dead", False):
+                continue
+            fetch = getattr(peer, "snapshot_fetch", None)
+            if fetch is None:
+                continue
+            st, applied, blob = _horizon.install_from_peer(fetch, floor)
+            if st == "ok":
+                with self.mu:
+                    if not self.dead and applied > self.applied:
+                        self._adopt_blob_locked(applied, blob)
+                self._done_fn(self.applied)
+                return "ok"
+            if st == "behind":
+                behind = True
+        return "behind" if behind else "unreachable"
+
+    def _catchup_pass(self) -> None:
+        """Driver-side snapshot-install (OUTSIDE mu — donor fetches
+        must never run under our own server mutex).  Single-pass per
+        driver tick: the driver cadence is the retry loop, diskv
+        drain-style."""
+        st = pull_from_peers(self._catchup_attempt_once, deadline_s=0.0,
+                             is_dead=lambda: self.dead)
+        if st == "ok":
+            self._behind_min = 0
+            self._wake.set()
+        elif st == "behind":
+            # Every reachable donor is at/below our watermark (a whole-
+            # group restart): nothing to install, ever — fall back to
+            # the legacy skip-forward so the group keeps living.
+            with self.mu:
+                mn = self._behind_min
+                while self.applied + 1 < mn:
+                    self.applied += 1
+                    self._inflight.pop(self.applied, None)
+                if self._tap is not None:
+                    self._tap.discard_through(self.applied)
+            self._behind_min = 0
+
+    def _maybe_snapshot(self) -> None:
+        """Driver-side snapshot cadence: copy under mu, serialize +
+        publish + spill OFF it (checkpointd cost model), then ride the
+        cadence with one replicated `compact` proposal so the whole
+        group trims at one log position."""
+        hz = self.horizon
+        if not hz.due(self.applied):
+            return
+        with self.mu:
+            if self.dead:
+                return
+            applied = self.applied
+            if applied <= hz.last_applied:
+                return
+            blob = {"applied": applied, "kv": dict(self.kv),
+                    "dup": list(self.dup.items_with_seq())}
+        hz.publish(applied, blob)
+        if self.dup_retire_ops > 0:
+            self._cmp_cseq += 1
+            try:
+                self.submit_batch(
+                    (Op("compact", "", "", self._cmp_cid,
+                        self._cmp_cseq),))
+            except RPCError:
+                self._cmp_cseq -= 1  # dead/racing kill: nothing queued
+
+    def snapshot_fetch(self, floor: int, off: int = 0, n: int | None = None):
+        """The snapshot-install RPC route (chunked, resumable): serve a
+        chunk of the last published snapshot covering `floor`.
+        LOCK-FREE on purpose — the published snapshot is immutable and
+        `applied` is an advisory int read, so a donor mid-drain never
+        convoys a puller behind its mutex (the tpusan donor rule)."""
+        if self.dead:
+            raise RPCError("dead")
+        return self.horizon.chunk(floor, off, n,
+                                  donor_applied=self.applied)
 
     def _collect_proposals_locked(self):
         """Assign consecutive seqs to everything queued; returns the
@@ -611,6 +811,10 @@ class KVPaxosServer:
                         with self.mu:
                             self._unpropose_locked(props, 0)
                         raise
+                if self._behind_min:
+                    self._catchup_pass()
+                if self.horizon.enabled():
+                    self._maybe_snapshot()
                 if busy:
                     # Ops outstanding: pace on consensus progress, then
                     # drain again immediately — no idle tick in the
@@ -840,6 +1044,7 @@ class KVPaxosServer:
             self._trace_prop.clear()
             if self._tap is not None:
                 self._tap.close()  # stop the fabric fanning into a corpse
+        _horizon.unregister_tracker(self)
         self._wake.set()
         self.px.kill()
 
@@ -1170,7 +1375,16 @@ def make_cluster(nservers=3, ninstances=64, fabric=None, g=0, **kw):
     if fabric is None:
         fabric = PaxosFabric(ngroups=1, npeers=nservers, ninstances=ninstances,
                              auto_step=True)
-    servers = [KVPaxosServer(fabric, g, p, **kw) for p in range(nservers)]
+    # Sibling handles for horizon's snapshot-install catch-up go in via
+    # the CTOR as the shared (progressively filled) list: each server's
+    # driver starts inside __init__, and its boot-time Min probe must
+    # already see `peers` — assigning after construction raced the
+    # probe into the legacy skip-forward on a warm fabric.
+    servers: list[KVPaxosServer] = []
+    if "peers" not in kw:
+        kw["peers"] = servers
+    for p in range(nservers):
+        servers.append(KVPaxosServer(fabric, g, p, **kw))
     return fabric, servers
 
 

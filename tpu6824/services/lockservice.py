@@ -42,10 +42,18 @@ class LockServer:
         held = self.locks.get(name, False)
         if kind == "lock":
             reply = not held
+            # tpusan: ok(unbounded-host-state) — the lock table IS the
+            # service's data: one row per distinct lock NAME (the
+            # app's keyspace), not per op; unlock flips the row, it
+            # does not leak
             self.locks[name] = True
         else:  # unlock
             reply = held
             self.locks[name] = False
+        # tpusan: ok(unbounded-host-state) — reference-fidelity lab 2
+        # surface: one dup row per CLIENT, and this service predates
+        # the horizon machinery by design (kvpaxos/shardkv carry the
+        # bounded-memory contract)
         self.dup[cid] = (cseq, reply)
         return reply
 

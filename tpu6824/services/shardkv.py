@@ -39,8 +39,15 @@ from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.obs import tracing as _tracing
 from tpu6824.ops.hashing import NSHARDS, key2shard
+from tpu6824.services import horizon as _horizon
 from tpu6824.services import shardmaster, txnkv
-from tpu6824.services.common import Backoff, DecidedTap, FlakyNet, fresh_cid
+from tpu6824.services.common import (
+    Backoff,
+    DecidedTap,
+    FlakyNet,
+    fresh_cid,
+    pull_from_peers,
+)
 from tpu6824.services.kvpaxos import _DEAD, _Fut
 from tpu6824.services.shardmaster import Config
 from tpu6824.utils import crashsink
@@ -84,7 +91,7 @@ class XState(NamedTuple):
 
 class ShardKVServer:
     RPC_METHODS = ["get", "put_append", "transfer_state",
-                   "txn_op", "txn_status"]  # wire surface
+                   "txn_op", "txn_status", "snapshot_fetch"]  # wire surface
 
     def __init__(
         self,
@@ -98,6 +105,9 @@ class ShardKVServer:
         start_ticker: bool = True,
         sm_poll_interval: float = 0.05,
         px=None,
+        snapshot_every: int | None = None,
+        persist_dir: str | None = None,
+        dup_retire_ops: int | None = None,
     ):
         """`px` overrides the consensus backend (PaxosPeer contract) — the
         batched fabric by default, or the decentralized wire backend via
@@ -129,6 +139,30 @@ class ShardKVServer:
         self.txn_locks: dict[str, str] = {}
         self.txn_decisions: dict[str, str] = {}
         self.txn_done: dict[str, str] = {}
+        # horizon (ISSUE 14) — all RSM state (mutated only in _apply /
+        # the replicated compact entry, identical on every replica):
+        # dup_seq: cid → applied seq of its newest op (the dup-table
+        # retirement clock); txn_decision_seq/waits/resolved: the
+        # resolution-tied decision-GC bookkeeping (see txnkv);
+        # txn_done_seq: the done-row linger clock that replaced PR 12's
+        # naive size cap.  `_txn_acks_owed`/`_trimmed_tids` are
+        # VOLATILE (send-queue + observability ring, never RSM state).
+        self.dup_seq: dict[str, int] = {}
+        self.txn_decision_seq: dict[str, int] = {}
+        self.txn_decision_waits: dict[str, set] = {}
+        self.txn_resolved: dict[str, int] = {}
+        self.txn_done_seq: dict[str, int] = {}
+        self._txn_acks_owed: dict[tuple, tuple] = {}
+        self._trimmed_tids: dict[str, bool] = {}
+        self.dup_retire_ops = (_horizon.DUP_RETIRE_OPS
+                               if dup_retire_ops is None
+                               else int(dup_retire_ops))
+        self.horizon = _horizon.Snapshotter(every=snapshot_every,
+                                            persist_dir=persist_dir)
+        self._behind_min = 0  # FORGOTTEN floor awaiting snapshot-install
+        self._cmp_cseq = 0
+        if self.horizon.enabled():
+            _horizon.register_tracker(self, self._horizon_rows)
         self.txn_resolve_after = txnkv.RESOLVE_AFTER
         self.txn_resolve_inherited = 0.05
         self.txn_abort_after = txnkv.ABORT_AFTER
@@ -188,6 +222,9 @@ class ShardKVServer:
                 seen, _ = self.dup.get(cid, (-1, None))
                 if cseq > seen:
                     self.dup[cid] = (cseq, reply)
+                    # Imported rows restart their retirement clock at
+                    # the reconf entry's own seq — deterministic.
+                    self.dup_seq[cid] = self.applied + 1
             # Reconfiguration safety (ISSUE 13): for shards this group
             # IMPORTS, the incoming prepared-lock rows are the
             # authoritative surviving set — stale local portions from a
@@ -209,6 +246,15 @@ class ShardKVServer:
         seen, reply = self.dup.get(op.cid, (-1, None))
         if op.cseq <= seen:
             return self._resolve(op, reply)
+        if op.kind == "compact":
+            # Replicated compaction entry (ISSUE 14): retire dup rows,
+            # done rows, and fully-resolved decision records at ONE log
+            # position so every replica trims identically.
+            txnkv.apply_compact(self, self.applied + 1)
+            reply = (OK, "")
+            self.dup[op.cid] = (op.cseq, reply)
+            self.dup_seq[op.cid] = self.applied + 1
+            return self._resolve(op, reply)
         if op.kind in txnkv.TXN_KINDS:
             # 2PC ops: per-payload-key ownership (prepare) / tid-keyed
             # state (commit/abort/coord — the fix-en-route semantics:
@@ -218,6 +264,7 @@ class ShardKVServer:
             reply, record = txnkv.apply_txn(self, op)
             if record:
                 self.dup[op.cid] = (op.cseq, reply)
+                self.dup_seq[op.cid] = self.applied + 1
             if op.tc is not None:
                 _tracing.complete("service.apply", op.tc[0], op.tc[1],
                                   time.monotonic_ns(), comp="shardkv",
@@ -243,6 +290,7 @@ class ShardKVServer:
             self.kv[op.key] = self.kv.get(op.key, "") + op.value
             reply = (OK, "")
         self.dup[op.cid] = (op.cseq, reply)
+        self.dup_seq[op.cid] = self.applied + 1
         if op.tc is not None:  # tpuscope: apply-side span for traced ops
             _tracing.complete("service.apply", op.tc[0], op.tc[1],
                               time.monotonic_ns(), comp="shardkv",
@@ -287,6 +335,13 @@ class ShardKVServer:
                     if tap.should_probe_min(self.applied):
                         mn = self.px.min()
                         if mn > self.applied + 1:
+                            if self._can_install():
+                                # Behind the GC horizon with donors
+                                # available: flag for the ticker's
+                                # OUTSIDE-mu snapshot-install pass
+                                # instead of skipping state (ISSUE 14).
+                                self._behind_min = mn
+                                break
                             # GC'd past us before we subscribed (warm
                             # boot); skip the forgotten span.
                             self.applied = mn - 1
@@ -308,6 +363,10 @@ class ShardKVServer:
                 self._requeue_lost_locked(v)
                 self.px.done(self.applied)
             elif fate == Fate.FORGOTTEN:
+                if self._can_install():
+                    self._behind_min = max(self.px.min(),
+                                           self.applied + 2)
+                    return
                 self.applied += 1
                 self._inflight.pop(self.applied, None)
             else:
@@ -345,6 +404,177 @@ class ShardKVServer:
                 raise RPCError("op timeout (no majority?)")
             time.sleep(0.002)
 
+    # ------------------------------------------------- horizon (ISSUE 14)
+
+    def _group_peers(self):
+        """Live directory entries of this group's OTHER replicas —
+        in-process servers or socket proxies alike (selected by name,
+        the g<gid>-<p> convention; diskv inherits this)."""
+        prefix = f"g{self.gid}-"
+        for name, srv in list(self.directory.items()):
+            if name != self.name and name.startswith(prefix):
+                yield name, srv
+
+    def _can_install(self) -> bool:
+        # Like kvpaxos's peers guard: horizon on AND at least one
+        # same-group sibling that can serve snapshots — otherwise keep
+        # the legacy skip-forward so a donor-less replica never wedges
+        # behind the horizon waiting for a pull that cannot happen.
+        return self.horizon.enabled() and any(
+            hasattr(srv, "snapshot_fetch")
+            for _n, srv in self._group_peers())
+
+    def _compact_due(self) -> bool:
+        return self.dup_retire_ops > 0 or self.txn_decision_seq \
+            or self.txn_done_seq
+
+    def _horizon_rows(self) -> dict:
+        d = {"kv_rows": len(self.kv), "dup_rows": len(self.dup),
+             "txn_prepared_rows": len(self.txn_prepared),
+             "txn_decision_rows": len(self.txn_decisions),
+             "txn_done_rows": len(self.txn_done)}
+        fab = getattr(self.px, "fabric", None)
+        if fab is not None:
+            d["window_live_slots"] = fab.live_slots
+            d["window_key"] = id(fab)
+        return d
+
+    def _snapshot_blob_locked(self) -> dict:
+        """Deep-enough copy of the applied state (mutable leaves
+        copied UNDER mu — serialization runs off it, and the live
+        dicts keep mutating while pickle walks the blob otherwise)."""
+        return {
+            "applied": self.applied,
+            "kv": dict(self.kv),
+            "dup": dict(self.dup),
+            "dup_seq": dict(self.dup_seq),
+            "config": self.config,
+            "txn_prepared": {
+                tid: {**e, "reads": dict(e["reads"]),
+                      "origins": set(e.get("origins") or (self.gid,))}
+                for tid, e in self.txn_prepared.items()},
+            "txn_locks": dict(self.txn_locks),
+            "txn_decisions": dict(self.txn_decisions),
+            "txn_decision_seq": dict(self.txn_decision_seq),
+            "txn_decision_waits": {t: set(s) for t, s in
+                                   self.txn_decision_waits.items()},
+            "txn_resolved": dict(self.txn_resolved),
+            "txn_done": dict(self.txn_done),
+            "txn_done_seq": dict(self.txn_done_seq),
+        }
+
+    def _adopt_blob_locked(self, applied: int, blob: dict) -> None:
+        self.kv = dict(blob["kv"])
+        self.dup = dict(blob["dup"])
+        self.dup_seq = dict(blob.get("dup_seq", {}))
+        self.config = blob["config"]
+        now = time.monotonic()
+        self.txn_prepared = {
+            tid: {**e, "t": now}  # re-arm resolver pacing, never fate
+            for tid, e in blob.get("txn_prepared", {}).items()}
+        self.txn_locks = dict(blob.get("txn_locks", {}))
+        self.txn_decisions = dict(blob.get("txn_decisions", {}))
+        self.txn_decision_seq = dict(blob.get("txn_decision_seq", {}))
+        self.txn_decision_waits = {
+            t: set(s) for t, s in blob.get("txn_decision_waits",
+                                           {}).items()}
+        self.txn_resolved = dict(blob.get("txn_resolved", {}))
+        self.txn_done = dict(blob.get("txn_done", {}))
+        self.txn_done_seq = dict(blob.get("txn_done_seq", {}))
+        self.applied = applied
+        for seq in [s for s in self._inflight if s <= applied]:
+            del self._inflight[seq]
+        # Waiters whose ops the snapshot already covers resolve from
+        # the installed dup table.
+        for key in list(self._waiters):
+            cid, cseq = key
+            seen, reply = self.dup.get(cid, (-1, None))
+            if cseq <= seen:
+                self._waiters.pop(key).set(reply)
+        if self._tap is not None:
+            self._tap.discard_through(applied)
+        self._next_seq = max(self._next_seq, applied + 1)
+        # Reseed the compact-proposal counter from the installed dup
+        # table (see kvpaxos._adopt_blob_locked): a restored replica's
+        # own cmp row must not dup-swallow its future compacts.
+        seen, _ = self.dup.get(f"cmp-{self.gid}-{self.me}", (-1, None))
+        self._cmp_cseq = max(self._cmp_cseq, seen)
+
+    def _catchup_attempt_once(self) -> str:
+        floor = self._behind_min - 1
+        behind = False
+        candidates = 0
+        for _name, peer in self._group_peers():
+            fetch = getattr(peer, "snapshot_fetch", None)
+            if fetch is None or getattr(peer, "dead", False):
+                continue
+            candidates += 1
+            st, applied, blob = _horizon.install_from_peer(fetch, floor)
+            if st == "ok":
+                with self.mu:
+                    if not self.dead and applied > self.applied:
+                        self._adopt_blob_locked(applied, blob)
+                self.px.done(self.applied)
+                return "ok"
+            if st == "behind":
+                behind = True
+        if candidates == 0:
+            # Every sibling vanished (or can't serve snapshots) since
+            # the drain flagged us: nothing to pull, EVER — report
+            # "behind" so the caller's legacy skip-forward keeps the
+            # replica living instead of wedging on retries.
+            return "behind"
+        return "behind" if behind else "unreachable"
+
+    def _catchup_pass(self) -> None:
+        """Ticker-side snapshot-install (OUTSIDE mu; the tick cadence
+        is the retry loop — the shared behind/unreachable discipline
+        from services.common)."""
+        st = pull_from_peers(self._catchup_attempt_once, deadline_s=0.0,
+                             is_dead=lambda: self.dead)
+        if st == "ok":
+            self._behind_min = 0
+            self._wake_submit()
+        elif st == "behind":
+            with self.mu:
+                if self._behind_min > self.applied + 1:
+                    self.applied = self._behind_min - 1
+                    for seq in [s for s in self._inflight
+                                if s <= self.applied]:
+                        del self._inflight[seq]
+                    if self._tap is not None:
+                        self._tap.discard_through(self.applied)
+            self._behind_min = 0
+
+    def _maybe_snapshot(self) -> None:
+        hz = self.horizon
+        if not hz.due(self.applied):
+            return
+        with self.mu:
+            if self.dead:
+                return
+            applied = self.applied
+            if applied <= hz.last_applied:
+                return
+            blob = self._snapshot_blob_locked()
+        hz.publish(applied, blob)
+        if self._compact_due():
+            self._cmp_cseq += 1
+            try:
+                self.submit_batch((Op(
+                    "compact", "", "", f"cmp-{self.gid}-{self.me}",
+                    self._cmp_cseq, None),))
+            except RPCError:
+                self._cmp_cseq -= 1
+
+    def snapshot_fetch(self, floor: int, off: int = 0, n: int | None = None):
+        """The snapshot-install RPC route — lock-free donor serving
+        from the last published (immutable) snapshot; see kvpaxos."""
+        if self.dead:
+            raise RPCError("dead")
+        return self.horizon.chunk(floor, off, n,
+                                  donor_applied=self.applied)
+
     # ----------------------------------------------------------- reconfig
 
     def _tick_loop(self):
@@ -374,6 +604,16 @@ class ShardKVServer:
                 # by construction (the blocking-commit-wait rule).
                 if self.txn_prepared:
                     txnkv.resolve_pass(self)
+                # horizon (ISSUE 14): participant acks → coordinator,
+                # snapshot-install catch-up when a drain found us
+                # behind the GC horizon, and the snapshot cadence —
+                # all OUTSIDE the mutex on this ticker.
+                if self._txn_acks_owed:
+                    txnkv.ack_pass(self)
+                if self._behind_min:
+                    self._catchup_pass()
+                if self.horizon.enabled():
+                    self._maybe_snapshot()
             except RPCError:
                 continue  # shardmaster unreachable: retry next loop
 
@@ -398,6 +638,11 @@ class ShardKVServer:
                 return True
             self._drain_decided()
             cur = self.config.num
+        if self._behind_min:
+            # Behind the GC horizon: the config walk would _sync at a
+            # FORGOTTEN seq and spin out the whole op_timeout under mu
+            # — let the ticker's catch-up pass install first.
+            return False
         if poll:
             try:
                 self._cfg_target = max(
@@ -409,6 +654,8 @@ class ShardKVServer:
                 if self.dead:
                     return True
                 self._drain_decided()
+                if self._behind_min:
+                    return False  # install first; walk resumes after
                 if self.config.num >= n:
                     self._cfg_cache.pop(n, None)
                     continue
@@ -447,11 +694,15 @@ class ShardKVServer:
                 seen, _ = dup_merge.get(cid, (-1, None))
                 if cseq > seen:
                     dup_merge[cid] = (cseq, reply)
-            for tid, coord, coord_srv, tops in getattr(got, "txn", ()):
+            for row in getattr(got, "txn", ()):
+                tid, coord, coord_srv, tops = row[0], row[1], row[2], row[3]
+                origins = txnkv._row_origins(row, old_gid)
                 prev = txn_merge.get(tid)
                 if prev is not None:  # portions from two donors: union
                     tops = tuple(dict.fromkeys(prev[2] + tuple(tops)))
-                txn_merge[tid] = (coord, tuple(coord_srv), tuple(tops))
+                    origins |= prev[3]
+                txn_merge[tid] = (coord, tuple(coord_srv), tuple(tops),
+                                  origins)
 
         xstate = XState(
             kv=tuple(sorted(kv_merge.items())),
@@ -464,8 +715,9 @@ class ShardKVServer:
             dup=tuple(sorted(dup_merge.items(),
                              key=lambda kv: (str(type(kv[0])),
                                              repr(kv[0])))),
-            txn=tuple(sorted((tid, c, cs, ops) for tid, (c, cs, ops)
-                             in txn_merge.items())),
+            txn=tuple(sorted(
+                (tid, c, cs, ops, tuple(sorted(origins)))
+                for tid, (c, cs, ops, origins) in txn_merge.items())),
         )
         op = Op("reconf", "", "", f"reconf-{cfg.num}", cfg.num, (cfg, xstate))
         try:
@@ -538,11 +790,13 @@ class ShardKVServer:
                         fut.sink = sink
                     fut.set(reply)
                 elif op.kind not in txnkv.TXN_KINDS \
+                        and op.kind != "compact" \
                         and not self._owns(op.key):
                     # Ownership fast-path for PLAIN ops only: 2PC ops
                     # judge ownership per payload key (prepare) or by
                     # tid (commit/abort/coord) at apply — the
-                    # fix-en-route semantics (ISSUE 13).
+                    # fix-en-route semantics (ISSUE 13); compact
+                    # entries are group-local maintenance with no key.
                     fut = _Fut()
                     if sink is not None:
                         fut.sink = sink
@@ -660,7 +914,10 @@ class ShardKVServer:
         blocking-commit-wait shape)."""
         if self.dead:
             raise RPCError("dead")
-        return self.txn_decisions.get(tid)
+        d = self.txn_decisions.get(tid)
+        if d is None and tid in self._trimmed_tids:
+            txnkv._M_TRIMMED_CONSULTS.inc()  # trim-safety sentinel
+        return d
 
     def _serve(self, op: Op):
         # tpuscope: stamp the caller's trace context into the proposed
@@ -690,6 +947,7 @@ class ShardKVServer:
             self._waiters.clear()
             if self._tap is not None:
                 self._tap.close()
+        _horizon.unregister_tracker(self)
         self._wake.set()
         self.px.kill()
 

@@ -95,6 +95,10 @@ class ShardMasterServer:
             reply = self._do_move(op.shard, op.gid)
         elif op.kind == "query":
             reply = None  # resolved read-side after apply
+        # tpusan: ok(unbounded-host-state) — one dup row per ADMIN
+        # clerk (join/leave/move issuers + config pollers), a
+        # population bounded by deployment size, not by traffic; the
+        # config history itself is the replicated data of this service
         self.dup[op.cid] = (op.cseq, reply)
         if op.kind != "query":
             dprintf("shardmaster", "s%d applied %s gid=%d shard=%d -> "

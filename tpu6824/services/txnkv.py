@@ -50,11 +50,17 @@ frame's utf-8 value field).  The decentralized gob host backend does
 NOT speak txn ops (guarded loudly in shardkv's wire codec).
 
 Pinned tradeoffs (ROADMAP item-5 successor list):
-  - coordinator decision records (`txn_decisions`) are retained
-    FOREVER — a trimmed decision that a still-unresolved prepare later
-    consults would un-decide a transaction, so bounding them needs GC
-    tied to prepare resolution, not a cap (`txn_done`, which is only
-    an idempotency cache, IS capped);
+  - coordinator decision records (`txn_decisions`) are bounded by
+    RESOLUTION-TIED GC (ISSUE 14, closing successor item 5e): every
+    participant portion acks at finish-apply (`txn_ack`, origin gids
+    carried through reconfiguration in XState.txn), the last ack
+    stamps a resolved watermark, and a replicated `compact` entry
+    trims the row only after resolution + DECISION_LINGER_OPS more
+    applied ops (DECISION_MAX_OPS is the fallback for records that can
+    never be fully acked).  The trim-safety invariant stands: no
+    trimmed decision is ever consulted — counted by
+    `txn.trimmed_decision_consults`, asserted zero under the
+    kill_mid_commit + lag_revive soaks;
   - `ErrTxnLocked` is a NEW error on the shared plain-op surface:
     clerks from this PR on retry it (same cseq, Backoff-paced), but a
     pre-txn clerk sees it as a terminal error for the lock window —
@@ -81,10 +87,13 @@ from tpu6824.utils.errors import (
     RPCError,
 )
 
-# The transactional kinds a shardkv log may carry (ISSUE 13).  These are
-# also the caps-gated fe wire kind extension — see rpc/wire.py TXN_KINDS.
+# The transactional kinds a shardkv log may carry (ISSUE 13; `txn_ack`
+# added by ISSUE 14's resolution-tied decision GC).  The first four are
+# also the caps-gated fe wire kind extension — see rpc/wire.py
+# TXN_KINDS; `txn_ack` is participant→coordinator plumbing that never
+# rides a clerk frontend (resolvers propose it via the directory).
 TXN_KINDS = frozenset(
-    ("txn_prepare", "txn_commit", "txn_abort", "txn_coord"))
+    ("txn_prepare", "txn_commit", "txn_abort", "txn_coord", "txn_ack"))
 
 # Sub-op kinds inside a prepare payload: read (lock + report value),
 # put/append (lock + buffered write), cas (lock + expectation check +
@@ -104,8 +113,31 @@ import os as _os
 
 RESOLVE_AFTER = float(_os.environ.get("TPU6824_TXN_RESOLVE_AFTER", 0.5))
 ABORT_AFTER = float(_os.environ.get("TPU6824_TXN_ABORT_AFTER", 2.0))
-# Bounded memory for finished-transaction idempotency records (trimmed
-# in apply order, so every replica trims identically).
+
+# Decision/record GC horizons (ISSUE 14, horizon) — all in APPLIED OPS
+# of the owning group's log, applied only at replicated `compact`
+# entries so every replica trims identically.  The trim-safety
+# invariant: a `txn_decisions` row may go ONLY once no unresolved
+# prepare can ever consult it — every participant portion acked
+# (`txn_ack`, tracked per decision in `txn_decision_waits`) AND
+# `DECISION_LINGER_OPS` more ops applied (covers a split-portion ack
+# racing its sibling's finish).  `DECISION_MAX_OPS` is the fallback for
+# decisions that can never be fully acked (an abort recorded before
+# some participant ever prepared) — far beyond any clerk retry window.
+# `DONE_LINGER_OPS` replaces PR 12's naive `txn_done` size cap: rows
+# now retire on the same log-progress watermark (stamped at recording
+# seq), so a slow clerk's outcome poll can't find its row evicted by a
+# burst of unrelated transactions — eviction needs the log to advance
+# `DONE_LINGER_OPS` past the row, not merely 4096 newer txns.
+DECISION_LINGER_OPS = int(
+    _os.environ.get("TPU6824_TXN_DECISION_LINGER_OPS", 1024))
+DECISION_MAX_OPS = int(
+    _os.environ.get("TPU6824_TXN_DECISION_MAX_OPS", 65536))
+DONE_LINGER_OPS = int(
+    _os.environ.get("TPU6824_TXN_DONE_LINGER_OPS", 8192))
+# Legacy `txn_done` bound for deployments running WITHOUT the horizon
+# machinery (no compact entries → the linger watermark never advances):
+# _record_done falls back to this deterministic apply-order cap.
 DONE_CAP = int(_os.environ.get("TPU6824_TXN_DONE_CAP", 4096))
 
 # tpuscope metrics (module scope per the metric-unregistered rule).
@@ -115,6 +147,15 @@ _M_ABORT = _metrics.counter("txn.abort")
 _M_LOCK_CONFLICTS = _metrics.counter("txn.lock_conflicts")
 _M_INHERITED = _metrics.counter("txn.inherited_prepares")
 _G_INFLIGHT = _metrics.gauge("txn.inflight")
+# horizon decision GC (ISSUE 14)
+_M_ACKS = _metrics.counter("txn.acks")
+_M_DECISIONS_TRIMMED = _metrics.counter("txn.decisions_trimmed")
+_M_DONE_TRIMMED = _metrics.counter("txn.done_trimmed")
+# The trim-safety sentinel: a consult (txn_status / local decision
+# read) for a tid whose decision row was TRIMMED.  Nonzero means the
+# resolution-tied GC un-decided a transaction's record while someone
+# still needed it — the soaks assert this stays zero.
+_M_TRIMMED_CONSULTS = _metrics.counter("txn.trimmed_decision_consults")
 
 _inflight_mu = threading.Lock()
 _inflight_n = 0
@@ -145,20 +186,38 @@ class TxnAbandoned(RPCError):
 # (utf-8 value bytes), and in-process calls alike.
 
 
-def encode_prepare(tid: str, coord: int, coord_srv, tops) -> str:
-    """tops: iterable of (key, kind, value, expect) sub-ops."""
-    return json.dumps({"tid": tid, "coord": int(coord),
-                       "coord_srv": list(coord_srv),
-                       "ops": [list(t) for t in tops]},
-                      separators=(",", ":"))
+def encode_prepare(tid: str, coord: int, coord_srv, tops,
+                   gids=None) -> str:
+    """tops: iterable of (key, kind, value, expect) sub-ops.  `gids`
+    (ISSUE 14): the FULL participant gid list, so any participant's
+    resolver can tell the coordinator who must ack before the decision
+    record may ever be trimmed."""
+    d = {"tid": tid, "coord": int(coord),
+         "coord_srv": list(coord_srv),
+         "ops": [list(t) for t in tops]}
+    if gids is not None:
+        d["gids"] = [int(g) for g in gids]
+    return json.dumps(d, separators=(",", ":"))
 
 
 def encode_finish(tid: str) -> str:
     return json.dumps({"tid": tid}, separators=(",", ":"))
 
 
-def encode_coord(tid: str, decision: str) -> str:
-    return json.dumps({"tid": tid, "decision": decision},
+def encode_coord(tid: str, decision: str, gids=None) -> str:
+    """`gids` (ISSUE 14): the participant gids whose acks resolve this
+    decision — ALL participants for a commit, the PREPARED set for a
+    clerk abort.  Absent (old writers / resolver without the list) the
+    decision is never fast-trimmed; only the DECISION_MAX_OPS fallback
+    horizon reaps it."""
+    d = {"tid": tid, "decision": decision}
+    if gids is not None:
+        d["gids"] = [int(g) for g in gids]
+    return json.dumps(d, separators=(",", ":"))
+
+
+def encode_ack(tid: str, gid: int) -> str:
+    return json.dumps({"tid": tid, "gid": int(gid)},
                       separators=(",", ":"))
 
 
@@ -180,6 +239,7 @@ def apply_txn(srv, op) -> tuple[tuple, bool]:
     dup filter — the clerk re-sends the same cseq after backoff."""
     p = decode_payload(op.value)
     tid = p["tid"]
+    seq = srv.applied + 1  # the seq this op applies at (caller bumps after)
     if op.kind == "txn_coord":
         # The single commit point: first decision to reach this group's
         # log wins; every later proposal reads the recorded fate.
@@ -187,7 +247,28 @@ def apply_txn(srv, op) -> tuple[tuple, bool]:
         if d is None:
             d = p["decision"]
             srv.txn_decisions[tid] = d
+            srv.txn_decision_seq[tid] = seq
+            gids = p.get("gids")
+            if gids:
+                # Resolution tracking (ISSUE 14): the decision row may
+                # be trimmed only once every one of these participant
+                # gids has acked its finish-apply (+ linger).  Without
+                # the list, only the MAX_OPS fallback ever reaps it.
+                srv.txn_decision_waits[tid] = {int(g) for g in gids}
         return (OK, d), True
+
+    if op.kind == "txn_ack":
+        # A participant portion finished applying the decision: discard
+        # it from the decision's wait set; the last ack stamps the
+        # resolution watermark the compact-entry GC trims against.
+        gid = int(p["gid"])
+        waits = srv.txn_decision_waits.get(tid)
+        if waits is not None:
+            waits.discard(gid)
+            if not waits:
+                del srv.txn_decision_waits[tid]
+                srv.txn_resolved[tid] = seq
+        return (OK, ""), True
 
     if op.kind == "txn_prepare":
         tops = tuple(tuple(t) for t in p["ops"])
@@ -236,6 +317,13 @@ def apply_txn(srv, op) -> tuple[tuple, bool]:
                 "coord_srv": tuple(p.get("coord_srv", ())),
                 "ops": tops, "reads": reads,
                 "t": time.monotonic(), "inherited": False,
+                # ISSUE 14: the full participant list (resolver→coord
+                # recovery payloads carry it) and this portion's ORIGIN
+                # gid(s) — what the coordinator's decision-GC wait set
+                # expects the finish-apply ack to name, carried through
+                # reconfiguration in XState.txn.
+                "gids": tuple(int(g) for g in p.get("gids", ())) or None,
+                "origins": {srv.gid},
             }
         return (OK, json.dumps(reads)), True
 
@@ -256,9 +344,16 @@ def apply_txn(srv, op) -> tuple[tuple, bool]:
         if decision == COMMIT \
                 and not getattr(srv, "_test_partial_commit", False):
             _apply_writes(srv, ent["ops"])
+        # Participant ack at finish-apply (ISSUE 14): this portion will
+        # never again consult the coordinator decision — owe an ack per
+        # origin gid (volatile send-queue, drained by the ticker's
+        # ack_pass; the coordinator's dup filter makes resends free).
+        for origin in (ent.get("origins") or (srv.gid,)):
+            srv._txn_acks_owed[(tid, int(origin))] = (
+                ent["coord"], tuple(ent["coord_srv"]))
     prior = srv.txn_done.get(tid)
     if prior is None:
-        _record_done(srv, tid, decision)
+        _record_done(srv, tid, decision, seq)
         prior = decision
     return (OK, prior), True
 
@@ -277,13 +372,27 @@ def _apply_writes(srv, tops) -> None:
             srv.kv[key] = srv.kv.get(key, "") + val
 
 
-def _record_done(srv, tid: str, decision: str) -> None:
+def _record_done(srv, tid: str, decision: str, seq: int) -> None:
+    # ISSUE 14: no size cap on the horizon path (PR 12's naive
+    # `txn_done` cap could evict a row a slow clerk's outcome poll
+    # still needed under a burst of unrelated transactions) — rows are
+    # stamped with their recording seq and retired by the compact
+    # entry's DONE_LINGER_OPS log-progress watermark instead,
+    # deterministically on every replica.
     srv.txn_done[tid] = decision
-    if len(srv.txn_done) > DONE_CAP:
-        # Deterministic trim: applied in log order, identical on every
-        # replica (bounded idempotency records, reference dup-filter
-        # class tradeoff).
-        srv.txn_done.pop(next(iter(srv.txn_done)))
+    srv.txn_done_seq[tid] = seq
+    hz = getattr(srv, "horizon", None)
+    if hz is None or not hz.enabled():
+        # Compaction OFF (no snapshot cadence → no compact entries →
+        # the watermark never advances): keep the legacy deterministic
+        # cap as the memory bound, trimmed in apply order exactly as
+        # PR 12 did.  Horizon config must be uniform across a group
+        # (like every other replicated knob) for trims to stay
+        # log-deterministic.
+        while len(srv.txn_done) > DONE_CAP:
+            old = next(iter(srv.txn_done))
+            del srv.txn_done[old]
+            srv.txn_done_seq.pop(old, None)
 
 
 def prune_for_import(srv, imported_shards) -> None:
@@ -319,24 +428,45 @@ def prune_for_import(srv, imported_shards) -> None:
         del srv.txn_prepared[tid]
 
 
+def _row_origins(row, default) -> set:
+    """Origin gid set of an XState.txn row: 5-tuples carry it (int or
+    tuple — ISSUE 14's resolved-watermark plumbing); legacy 4-tuples
+    default to the installer's own gid (the fallback horizon covers
+    the un-matchable ack)."""
+    if len(row) > 4:
+        o = row[4]
+        return {int(x) for x in (o if isinstance(o, (tuple, list))
+                                 else (o,))}
+    return {int(default)}
+
+
 def install_inherited(srv, txn_entries) -> None:
     """Reconf-apply half of reconfiguration safety: install the
     prepared entries that traveled with the shard state (`XState.txn`).
     Keys re-lock under the new owner; a decision that arrived BEFORE
     the migration (recorded in txn_done) replays against the inherited
     writes immediately."""
-    for tid, coord, coord_srv, tops in txn_entries:
+    for row in txn_entries:
+        tid, coord, coord_srv, tops = row[0], row[1], row[2], row[3]
+        origins = _row_origins(row, srv.gid)
         tops = tuple(tuple(t) for t in tops)
         done = srv.txn_done.get(tid)
         if done is not None:
             if done == COMMIT:
                 _apply_writes(srv, tops)
+            # The migrated portion is already finished here: it still
+            # owes the coordinator its origin's ack (the resolved
+            # watermark travels WITH the shard — ISSUE 14).
+            for origin in origins:
+                srv._txn_acks_owed[(tid, origin)] = (
+                    int(coord), tuple(coord_srv))
             continue
         ent = srv.txn_prepared.get(tid)
         if ent is not None:
             # A second donor's portion of the same transaction: merge.
             merged = tuple(dict.fromkeys(ent["ops"] + tops))
             ent["ops"] = merged
+            ent["origins"] = set(ent.get("origins") or ()) | origins
             for key, _k, _v, _e in tops:
                 srv.txn_locks[key] = tid
             continue
@@ -346,6 +476,7 @@ def install_inherited(srv, txn_entries) -> None:
             "coord": int(coord), "coord_srv": tuple(coord_srv),
             "ops": tops, "reads": {},
             "t": time.monotonic(), "inherited": True,
+            "gids": None, "origins": origins,
         }
         _M_INHERITED.inc()
 
@@ -353,13 +484,17 @@ def install_inherited(srv, txn_entries) -> None:
 def export_prepared(srv, shards_list) -> tuple:
     """Donor half (`transfer_state`): the prepared-lock-table rows whose
     keys fall in the migrating shards, in XState.txn shape —
-    (tid, coord_gid, coord_srv, sub-ops)."""
+    (tid, coord_gid, coord_srv, sub-ops, origin-gids).  The origin
+    column is the per-group resolved watermark's identity: whoever
+    finally applies this portion's finish acks THESE gids at the
+    coordinator, however many migrations later."""
     out = []
     for tid, ent in sorted(srv.txn_prepared.items()):
         tops = tuple(t for t in ent["ops"]
                      if key2shard(t[0]) in shards_list)
         if tops:
-            out.append((tid, ent["coord"], tuple(ent["coord_srv"]), tops))
+            out.append((tid, ent["coord"], tuple(ent["coord_srv"]), tops,
+                        tuple(sorted(ent.get("origins") or (srv.gid,)))))
     return tuple(out)
 
 
@@ -428,7 +563,10 @@ def consult_coordinator(srv, ent, tid: str):
     yet / coordinator unreachable).  Decisions are write-once, so a
     stale read can only under-report — never lie."""
     if ent["coord"] == srv.gid:
-        return srv.txn_decisions.get(tid)  # lock-free: write-once value
+        d = srv.txn_decisions.get(tid)  # lock-free: write-once value
+        if d is None and tid in srv._trimmed_tids:
+            _M_TRIMMED_CONSULTS.inc()  # the trim-safety sentinel
+        return d
     for name in _coord_servers(srv, ent):
         peer = srv.directory.get(name)
         if peer is None or peer is srv:
@@ -444,8 +582,10 @@ def consult_coordinator(srv, ent, tid: str):
 
 def decide_at_coordinator(srv, ent, tid: str, decision: str):
     """Propose `decision` into the coordinator group's log (first
-    writer wins); returns the ACTUAL recorded decision, or None."""
-    payload = encode_coord(tid, decision)
+    writer wins); returns the ACTUAL recorded decision, or None.  The
+    prepare-payload participant list rides along so the decision's ack
+    wait set is complete even for recovery-raced records."""
+    payload = encode_coord(tid, decision, gids=ent.get("gids"))
     cid = f"txr-{srv.gid}-{tid}"
     from tpu6824.services.shardkv import Op as _SOp
     if ent["coord"] == srv.gid:
@@ -467,6 +607,120 @@ def decide_at_coordinator(srv, ent, tid: str, decision: str):
         if err == OK:
             return d
     return None
+
+
+def ack_pass(srv, limit: int = 8) -> int:
+    """Drain this server's owed participant acks (ISSUE 14): for each
+    (tid, origin) finished locally, propose `txn_ack` into the
+    coordinator group's log.  Runs on the shardkv TICKER, never under
+    mu and never in _apply (the blocking-commit-wait rule); a
+    coordinator that is unreachable keeps the entry owed — resends are
+    dup-filtered there, so retry is free.  Returns acks landed."""
+    with srv.mu:
+        if srv.dead or not srv._txn_acks_owed:
+            return 0
+        pend = list(srv._txn_acks_owed.items())[:limit]
+    landed = 0
+    for (tid, origin), (coord, coord_srv) in pend:
+        payload = encode_ack(tid, origin)
+        cid = f"txa-{srv.gid}-{origin}-{tid}"
+        ent = {"coord": coord, "coord_srv": coord_srv}
+        ok = False
+        from tpu6824.services.shardkv import Op as _SOp
+        if coord == srv.gid:
+            op = _SOp("txn_ack", "", payload, cid, 1, None)
+            try:
+                with srv.mu:
+                    if not srv.dead:
+                        err, _ = srv._sync(op)
+                        ok = err == OK
+            except RPCError:
+                ok = False
+        else:
+            for name in _coord_servers(srv, ent):
+                peer = srv.directory.get(name)
+                if peer is None:
+                    continue
+                try:
+                    err, _ = peer.txn_op("txn_ack", "", payload, cid, 1)
+                except Exception:  # noqa: BLE001 — next replica
+                    continue
+                if err == OK:
+                    ok = True
+                    break
+        if ok:
+            landed += 1
+            _M_ACKS.inc()
+            with srv.mu:
+                srv._txn_acks_owed.pop((tid, origin), None)
+    return landed
+
+
+# ------------------------------------------------ compaction (horizon)
+# Applied ONLY from the replicated `compact` log entry (shardkv._apply)
+# — pure function of (seq, RSM state), identical on every replica.
+
+
+def _note_trimmed(srv, tid: str) -> None:
+    """Bounded observability ring of trimmed decision tids, consulted
+    by the trim-safety sentinel counter (volatile, never RSM state)."""
+    srv._trimmed_tids[tid] = True
+    while len(srv._trimmed_tids) > 4096:
+        srv._trimmed_tids.pop(next(iter(srv._trimmed_tids)))
+
+
+def apply_compact(srv, seq: int) -> None:
+    """One replicated compact entry at `seq`: retire dup rows idle past
+    the dup horizon, txn_done rows past DONE_LINGER_OPS, and — the
+    trim-safety invariant — decision records that are FULLY RESOLVED
+    (every participant acked) plus DECISION_LINGER_OPS of linger, with
+    DECISION_MAX_OPS as the fallback for never-fully-ackable records.
+    A decision whose tid is still locally prepared is NEVER trimmed."""
+    retire = getattr(srv, "dup_retire_ops", 0)
+    if retire > 0:
+        floor = seq - retire
+        if floor > 0:
+            dup_seq = srv.dup_seq
+            stale = [cid for cid, s in dup_seq.items() if s < floor]
+            for cid in stale:
+                srv.dup.pop(cid, None)
+                del dup_seq[cid]
+            if stale:
+                from tpu6824.services import horizon as _hz
+                _hz.note_dup_retired(len(stale))
+    floor = seq - DONE_LINGER_OPS
+    if floor > 0:
+        stale = [tid for tid, s in srv.txn_done_seq.items() if s < floor]
+        for tid in stale:
+            srv.txn_done.pop(tid, None)
+            del srv.txn_done_seq[tid]
+        if stale:
+            _M_DONE_TRIMMED.inc(len(stale))
+    trimmed = []
+    floor = seq - DECISION_LINGER_OPS
+    if floor > 0:
+        for tid, s in list(srv.txn_resolved.items()):
+            if s < floor and tid not in srv.txn_prepared:
+                trimmed.append(tid)
+                del srv.txn_resolved[tid]
+    floor = seq - DECISION_MAX_OPS
+    if floor > 0:
+        # Fallback horizon: decisions that can never be fully acked
+        # (e.g. an abort recorded before some participant prepared) —
+        # far beyond any clerk retry/replay window by construction.
+        for tid, s in list(srv.txn_decision_seq.items()):
+            if s < floor and tid in srv.txn_decisions \
+                    and tid not in srv.txn_prepared \
+                    and tid not in trimmed:
+                trimmed.append(tid)
+                srv.txn_decision_waits.pop(tid, None)
+                srv.txn_resolved.pop(tid, None)
+    for tid in trimmed:
+        srv.txn_decisions.pop(tid, None)
+        srv.txn_decision_seq.pop(tid, None)
+        _note_trimmed(srv, tid)
+    if trimmed:
+        _M_DECISIONS_TRIMMED.inc(len(trimmed))
 
 
 # -------------------------------------------------- mid-commit killing
@@ -672,6 +926,7 @@ class _TxnClerkBase:
             parts.setdefault(gid, []).append(t)
         gids = sorted(parts)
         coord = gids[0]
+        all_real = [cfg_view.real_gid(g) for g in gids]
         tid = f"t{fresh_cid():x}"
         _M_BEGIN.inc()
         _inflight_add(1)
@@ -681,6 +936,7 @@ class _TxnClerkBase:
             reads: dict[str, str] = {}
             prepared: list[int] = []
             unknown_phase = False  # a prepare whose fate we can't see
+            unknown_gids: list[int] = []  # those groups, specifically
             sp = _tracing.child("txn.begin", parent=rctx, comp="txn",
                                 tid=tid)
             if sp is not None:
@@ -688,7 +944,8 @@ class _TxnClerkBase:
             for gid in gids:
                 payload = encode_prepare(
                     tid, cfg_view.real_gid(coord),
-                    cfg_view.server_names(coord), parts[gid])
+                    cfg_view.server_names(coord), parts[gid],
+                    gids=all_real)
                 psp = _tracing.child("txn.prepare", parent=rctx,
                                      comp="txn", gid=gid)
                 try:
@@ -702,6 +959,7 @@ class _TxnClerkBase:
                 except RPCError:
                     err, val = None, None  # fate at gid unknown
                     unknown_phase = True
+                    unknown_gids.append(cfg_view.real_gid(gid))
                 finally:
                     if psp is not None:
                         psp.end()
@@ -733,8 +991,21 @@ class _TxnClerkBase:
                                       else None):
                     err, actual = self._phase_call(
                         coord, "txn_coord", cfg_view.coord_key(coord),
-                        encode_coord(tid, decision), self._next(),
-                        deadline)
+                        encode_coord(
+                            tid, decision,
+                            # Commit awaits every participant's ack; an
+                            # abort awaits the groups that hold locks —
+                            # including UNKNOWN-fate prepares (a timed-
+                            # out RPC whose op still landed holds locks
+                            # and WILL consult this record; omitting it
+                            # from the wait set would let the linger
+                            # trim un-decide the abort under load).  A
+                            # never-landed unknown simply never acks and
+                            # the MAX_OPS fallback reaps the row.
+                            gids=(all_real if decision == COMMIT else
+                                  [cfg_view.real_gid(g)
+                                   for g in prepared] + unknown_gids)),
+                        self._next(), deadline)
             except RPCError:
                 err, actual = None, None
             if err != OK or actual not in (COMMIT, ABORT):
