@@ -1215,6 +1215,50 @@ def _clerk_rate():
     }
 
 
+def _waterfall_block(before_snap):
+    """The opscope waterfall for one leg (ISSUE 15): per-stage latency
+    histograms DELTA'd over the leg, decomposed as (a) share of the
+    MEAN op (stage-edge µs sums / total) and (b) the tail shape —
+    per-stage p99 plus its share of the summed stage p99s (log2-bucket
+    resolution: anything under 2× is quantization).  From here on every
+    headline number in a BENCH artifact ships with where the time
+    went."""
+    from tpu6824.obs import metrics as _m
+    from tpu6824.obs import opscope as _osc
+
+    delta = _m.diff_snapshots(before_snap or {}, _m.snapshot())
+    hists = delta.get("histograms", {})
+    pref = "opscope.stage."
+    stages = {}
+    total_sum = 0
+    for name, h in hists.items():
+        if not name.startswith(pref):
+            continue
+        stage = name[len(pref):].split(".", 1)[0]
+        stages[stage] = {"count": h["count"], "sum_us": h["sum"],
+                         "p50_us": h["p50"], "p95_us": h["p95"],
+                         "p99_us": h["p99"]}
+        total_sum += h["sum"]
+    for s in stages.values():
+        s["share_of_mean"] = (round(s["sum_us"] / total_sum, 4)
+                              if total_sum else None)
+    p99_total = sum(s["p99_us"] for s in stages.values() if s["p99_us"])
+    for s in stages.values():
+        s["p99_share"] = (round((s["p99_us"] or 0) / p99_total, 4)
+                          if p99_total else None)
+    op = hists.get("opscope.op.latency_us") or {}
+    return {
+        "enabled": _osc.enabled(),
+        "stages": {st: stages[st] for st in _osc.EDGES if st in stages},
+        "total_mean_us": (round(op["sum"] / op["count"], 1)
+                          if op.get("count") else None),
+        "total_p99_us": op.get("p99"),
+        "note": "share_of_mean = stage-edge µs sum / total; p99_share "
+                "= stage p99 / summed stage p99s (tail decomposition "
+                "at log2-bucket resolution)",
+    }
+
+
 def _clerk_frontend_rate():
     """service.clerk_frontend (ISSUE 8): aggregate clerk ops/sec through
     the BATCHED request path — FrontendStream clients speaking multi-op
@@ -1268,6 +1312,7 @@ def _clerk_frontend_rate():
     wire_fmt = os.environ.get("BENCH_FE_WIRE", "native")
     sweep = []
     best = None
+    wf0 = _tpuscope_begin()  # opscope stage-hist baseline for the leg
 
     def run_point(pt, conns, width, fmt):
         count = [0]
@@ -1353,6 +1398,33 @@ def _clerk_frontend_rate():
                     "epoll loop (zero-GIL ingest); control re-runs the "
                     "best point through the pickled fe_batch path",
         }
+        # opscope waterfall (ISSUE 15): the leg's per-stage latency
+        # decomposition, plus the always-on overhead A/B — the SAME
+        # shape re-run with opscope disabled (acceptance: ≤2% on a
+        # quiet box; recorded, judged against the environment block).
+        from tpu6824.obs import opscope as _osc
+
+        waterfall = _waterfall_block(wf0)
+        if os.environ.get("BENCH_FE_OPSCOPE_AB", "1") != "0" \
+                and _osc.enabled():
+            _osc.disable()
+            try:
+                off = run_point(len(points) + 1, best["conns"],
+                                best["batch_width"], wire_fmt)
+            finally:
+                _osc.enable()
+            waterfall["overhead_ab"] = {
+                "on_ops_s": best["value"],
+                "off_ops_s": off["value"],
+                "overhead_frac": (round(1.0 - best["value"]
+                                        / off["value"], 4)
+                                  if off["value"] else None),
+                "note": "same shape, TPU6824_OPSCOPE off; positive = "
+                        "opscope cost — judge on a quiet box (the env "
+                        "block brackets both windows)",
+            }
+        else:
+            waterfall["overhead_ab"] = None
         # Per-client order + exact-once spot check: a client key holds
         # exactly its consecutive markers from 0 (prefix of its stream).
         from tpu6824.rpc import transport as _tr
@@ -1398,9 +1470,11 @@ def _clerk_frontend_rate():
         "latency": best.get("latency"),
         "sweep": sweep,
         "native_ingest": native_ingest,
+        "waterfall": waterfall,
         "protocol": clerk_protocol,
         "knobs": "TPU6824_FRONTEND_OP_TIMEOUT, TPU6824_FRONTEND_DEPTH; "
-                 "BENCH_FE_GROUPS/INSTANCES/SWEEP/SECONDS, BENCH_FE_WIRE",
+                 "BENCH_FE_GROUPS/INSTANCES/SWEEP/SECONDS, BENCH_FE_WIRE, "
+                 "BENCH_FE_OPSCOPE_AB, TPU6824_OPSCOPE",
     }
 
 

@@ -1,0 +1,51 @@
+"""Golden fixture for the wallclock-duration rule (ISSUE 15): durations
+computed from the WALL clock in rpc/services/core scope.  Expected: two
+active findings (the direct delta and the carried-name delta), the
+monotonic function and the bare human-facing timestamp stay clean, and
+the justified suppression registers without counting."""
+
+import time
+
+
+def work():
+    pass
+
+
+class LatencyProbe:
+    def op_latency(self):
+        t0 = time.time()
+        work()
+        return time.time() - t0  # finding: wall-clock duration
+
+    def remaining(self, started):
+        started = time.time()
+        budget = 5.0
+        left = budget - (started - 1.0)  # finding: carried wall name
+        return left
+
+    def op_latency_monotonic(self):
+        t0 = time.monotonic()
+        work()
+        return time.monotonic() - t0  # clean: monotonic duration
+
+    def stamp(self):
+        return time.time()  # clean: a human-facing timestamp, no delta
+
+    def nested_scopes_are_separate(self):
+        def _helper():
+            t0 = time.time()  # clean: an inner-scope stamp
+            return t0
+
+        t0 = time.monotonic()
+        _helper()
+        # clean: the NESTED def's wall name must not contaminate this
+        # scope's monotonic duration (review-caught false positive).
+        return time.monotonic() - t0
+
+    def suppressed_delta(self):
+        t0 = time.time()
+        work()
+        # tpusan: ok(wallclock-duration) — golden exemplar of a
+        # justified suppression (e.g. diffing two wall timestamps a
+        # remote artifact recorded; no monotonic base exists for them)
+        return time.time() - t0

@@ -55,6 +55,7 @@ GOLDEN_EXPECT = {
     "services/unbounded_state.py": {"unbounded-host-state": 2},
     "rpc/native_server.py": {"python-decode-in-native-path": 3},
     "rpc/retry_loop.py": {"unbounded-retry": 2},
+    "rpc/wallclock.py": {"wallclock-duration": 2},
     "obs/unbounded.py": {"unbounded-obs-buffer": 3},
 }
 

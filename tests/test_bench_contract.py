@@ -94,6 +94,23 @@ def test_one_json_line_with_required_keys():
     proto = few["protocol"]
     assert "error" not in proto and proto["totals"]["decides"] > 0, proto
     assert "tpuscope" in few and "error" not in few["tpuscope"], few
+    # opscope waterfall provenance (ISSUE 15): every recorded run must
+    # decompose the frontend leg's headline into per-stage latency —
+    # stage histograms populated, shares summing sensibly, the whole-op
+    # tail, and the always-on overhead A/B — or "which stage is the
+    # time in" stays a bring-up probe instead of an artifact.
+    wf = few["waterfall"]
+    assert wf["enabled"] is True, wf
+    for stage in ("poll", "park", "materialize", "dispatch", "decide",
+                  "apply", "reply"):
+        st = wf["stages"][stage]
+        assert st["count"] > 0, (stage, st)
+        assert st["p99_us"] is not None and st["p99_us"] >= 0, (stage, st)
+        assert 0.0 <= st["share_of_mean"] <= 1.0, (stage, st)
+    assert wf["total_mean_us"] > 0 and wf["total_p99_us"] > 0, wf
+    ab = wf["overhead_ab"]
+    assert ab is not None and ab["on_ops_s"] > 0 and ab["off_ops_s"] > 0
+    assert ab["overhead_frac"] is not None, ab
     # Overload provenance (ISSUE 12, netfault): every recorded run must
     # carry the overload leg — measured capacity, the 1×/2×/4× offered-
     # load table (goodput, explicit-shed fraction, p99), and the leg's

@@ -1,0 +1,378 @@
+"""opscope tests (ISSUE 15) — always-on per-stage latency attribution.
+
+Layers:
+  - fold unit: stage stamps → per-edge histograms, back-fill for
+    missing stages, monotone vectors, the disabled contract;
+  - exemplars: the K slowest ops land in the flight recorder as
+    synthetic span chains, monotone and monotonic-joinable;
+  - END-TO-END ATTRIBUTION ACCEPTANCE: a seeded stall in the apply
+    stage (the `_test_apply_delay` seam) is independently named by
+    (a) the per-stage p99 series, (b) the watchdog latency-spike
+    bundle's culprit evidence, and (c) at least one tail exemplar —
+    with a fault-free control staying silent;
+  - both engines (native-ingest C++ and the pure-Python fallback
+    server) emit the SAME stage-name set with populated histograms;
+  - fleet plumbing: the Collector's opscope surface (mixed-fleet
+    disabled shell for a pre-opscope member), merge_opscope, and the
+    obs.top waterfall pane's stable keys.
+"""
+
+import time
+
+import pytest
+
+from tpu6824.core.fabric import PaxosFabric
+from tpu6824.obs import metrics as obs_metrics
+from tpu6824.obs import opscope
+from tpu6824.obs.collector import Collector
+from tpu6824.obs.pulse import Pulse
+from tpu6824.obs.tracing import FLIGHT
+from tpu6824.obs.watchdog import LatencySpike, Watchdog
+from tpu6824.rpc.native_server import native_available
+from tpu6824.services.frontend import ClerkFrontend, FrontendClerk
+from tpu6824.services.kvpaxos import KVPaxosServer, make_cluster
+
+NATIVE = native_available()
+
+
+def _edge_counts():
+    """Current count per stage-edge histogram (module-global metrics:
+    tests diff against a baseline, never assert absolutes)."""
+    return {e: opscope._H_EDGE[e].snapshot()["count"]
+            for e in opscope.EDGES}
+
+
+def _teardown(fab, servers, fe=None):
+    if fe is not None:
+        fe.kill()
+    for s in servers:
+        s.dead = True
+    fab.stop_clock()
+
+
+# ------------------------------------------------------------- fold unit
+
+
+def test_fold_populates_every_edge_and_backfills_missing_stages():
+    before = _edge_counts()
+    t = time.monotonic_ns()
+    cid = 987_001
+    # Only park is stamped (the in-process clerk shape): earlier stages
+    # back-fill, so every edge still observes — a zero for poll, a real
+    # delta for materialize onward.
+    opscope.note_park([cid], t)
+    opscope.note_materialize_many([cid], t + 1_000_000)
+    opscope.note_dispatch_many([cid], t + 2_000_000)
+    opscope.fold([cid], t + 3_000_000, t + 4_000_000, t + 5_000_000)
+    after = _edge_counts()
+    for e in opscope.EDGES[:-1]:  # flush is the native reply path's
+        assert after[e] == before[e] + 1, e
+    # The op's stamps were consumed by the fold.
+    assert cid not in opscope._tpark and cid not in opscope._tmat
+
+
+def test_fold_total_and_monotone_out_of_order_stamps():
+    h = opscope._H_TOTAL.snapshot()["count"]
+    t = time.monotonic_ns()
+    cid = 987_002
+    opscope.note_ingest_poll([cid], t, t + 500_000)
+    opscope.note_park([cid], t + 1_000_000)
+    # A re-proposal stamped materialize AFTER dispatch: the fold's
+    # maximum-accumulate keeps the vector monotone (clipped edges).
+    opscope.note_dispatch_many([cid], t + 2_000_000)
+    opscope.note_materialize_many([cid], t + 2_500_000)
+    opscope.fold([cid], t + 3_000_000, t + 4_000_000, t + 5_000_000)
+    assert opscope._H_TOTAL.snapshot()["count"] == h + 1
+
+
+def test_disabled_means_no_stamps_and_no_fold_work(tmp_path):
+    fab, servers = make_cluster(3, 32)
+    try:
+        opscope.disable()
+        before = _edge_counts()
+        from tpu6824.services.kvpaxos import Clerk
+
+        ck = Clerk(servers)
+        for i in range(5):
+            ck.put(f"off{i}", "v")
+        assert _edge_counts() == before
+    finally:
+        opscope.enable()
+        _teardown(fab, servers)
+
+
+# ------------------------------------------------------------- exemplars
+
+
+def test_exemplars_flush_as_monotone_span_chains():
+    opscope.reset()
+    FLIGHT.clear()
+    t = time.monotonic_ns()
+    for j in range(opscope.EXEMPLAR_K + 4):  # more ops than slots
+        cid = 988_000 + j
+        opscope.note_park([cid], t)
+        opscope.fold([cid], t + 1_000_000, t + 2_000_000,
+                     t + 3_000_000 + j * 1_000_000)
+    n = opscope.flush_exemplars()
+    assert n == opscope.EXEMPLAR_K  # K slowest, not everything
+    recs = [r for r in FLIGHT.snapshot() if r["comp"] == "opscope"]
+    roots = [r for r in recs if r["name"] == "opscope.op"]
+    assert len(roots) == n
+    # The slowest op survived the reservoir.
+    assert any(r["args"]["cid"] == str(988_000 + opscope.EXEMPLAR_K + 3)
+               for r in roots), roots
+    for root in roots:
+        chain = [r for r in recs
+                 if r["trace_id"] == root["trace_id"] and r is not root]
+        assert len(chain) == len(opscope.EDGES) - 1
+        # Monotone non-decreasing stage vector, monotonic-ns timestamps
+        # joinable to nemesis timelines (same clock as every flight
+        # record): child spans tile the root exactly.
+        chain.sort(key=lambda r: r["ts"])
+        cur = root["ts"]
+        for c in chain:
+            assert c["ts"] >= cur - 1
+            cur = c["ts"] + c["dur"]
+        assert t <= root["ts"] <= time.monotonic_ns()
+    # The flush reset the reservoir: nothing further to emit.
+    assert opscope.flush_exemplars() == 0
+    FLIGHT.clear()
+
+
+# ---------------------------------------- end-to-end attribution (ACCEPT)
+
+
+def _drive(servers, n, key="att", base=0):
+    from tpu6824.services.kvpaxos import PipelinedClerk
+
+    ck = PipelinedClerk(servers, width=min(8, n))
+    ck.append_wave(key, [f"x{base + i}" for i in range(n)])
+
+
+def test_seeded_apply_stall_named_by_series_watchdog_and_exemplar(
+        tmp_path):
+    """ACCEPTANCE: a known stall injected into ONE stage (slow apply)
+    is named by the per-stage p99 series, the watchdog bundle's culprit
+    evidence, and at least one tail exemplar — independently."""
+    fab, servers = make_cluster(3, 64)
+    p = Pulse(interval=3600.0)  # manual sampling only
+    wd = Watchdog(p, outdir=str(tmp_path), rules=[LatencySpike(factor=4.0)],
+                  window=3600.0, cooldown=3600.0).start()
+    try:
+        opscope.reset()
+        p.sample_once()
+        for i in range(4):  # baseline: healthy apply stage
+            _drive(servers, 6, base=i * 10)
+            time.sleep(0.02)
+            p.sample_once()
+        assert not wd.incidents, wd.incidents
+        FLIGHT.clear()
+        opscope.reset()  # reservoir: spike-phase exemplars only
+        for s in servers:
+            s._test_apply_delay = 0.08
+        _drive(servers, 6, base=100)
+        time.sleep(0.02)
+        p.sample_once()
+        # (a) the per-stage p99 SERIES names apply: its last point is
+        # the widest riser across the waterfall series.
+        apply_pts = p.points("opscope.stage.apply.latency_us.p99")
+        assert apply_pts and apply_pts[-1][1] >= 8192.0, apply_pts
+        # (b) the watchdog named the culprit stage in its evidence.
+        assert wd.incidents, "latency-spike did not fire"
+        inc = wd.incidents[0]
+        assert inc["rule"] == "latency-spike"
+        assert "culprit stage: apply" in inc["reason"], inc["reason"]
+        import json
+        import os
+
+        assert inc["path"] and os.path.exists(inc["path"])
+        with open(inc["path"]) as f:
+            bundle = json.load(f)
+        ev = bundle["watchdog"]["evidence"]
+        assert ev["culprit_stage"] == "apply", ev
+        assert ev["stage_p99_delta_us"]["apply"] > 0, ev
+        # (c) ≥1 tail exemplar in the flight recorder names apply as
+        # the widest stage (sample_once's global sampler flushed it).
+        recs = [r for r in FLIGHT.snapshot()
+                if r["comp"] == "opscope" and r["name"] == "opscope.op"]
+        assert recs, "no exemplar promoted"
+        assert any(r["args"]["stage"] == "apply" for r in recs), \
+            [r["args"] for r in recs]
+    finally:
+        wd.stop()
+        for s in servers:
+            s._test_apply_delay = 0.0
+        _teardown(fab, servers)
+        FLIGHT.clear()
+
+
+def test_fault_free_control_stays_silent(tmp_path):
+    fab, servers = make_cluster(3, 64)
+    p = Pulse(interval=3600.0)
+    wd = Watchdog(p, outdir=str(tmp_path), rules=[LatencySpike(factor=4.0)],
+                  window=3600.0, cooldown=3600.0).start()
+    try:
+        p.sample_once()
+        for i in range(5):
+            _drive(servers, 6, key="ctl", base=i * 10)
+            time.sleep(0.02)
+            p.sample_once()
+        assert not wd.incidents, wd.incidents
+    finally:
+        wd.stop()
+        _teardown(fab, servers)
+
+
+# -------------------------------------------------- both engines (ACCEPT)
+
+
+def _frontend_roundtrip(tmp_path, name, prefer_native):
+    fab = PaxosFabric(ngroups=1, npeers=3, ninstances=64, auto_step=True)
+    servers = [KVPaxosServer(fab, 0, p) for p in range(3)]
+    fe = ClerkFrontend(servers, str(tmp_path / name),
+                       prefer_native=prefer_native)
+    try:
+        ck = FrontendClerk([fe.addr], wire_format="native")
+        for i in range(8):
+            ck.append("k2e", f"x{i}")
+        assert ck.get("k2e") == "".join(f"x{i}" for i in range(8))
+        # Let the engine's next pass mirror the C++ flush histogram.
+        time.sleep(0.4)
+    finally:
+        _teardown(fab, servers, fe)
+
+
+@pytest.mark.skipif(not NATIVE, reason="no C++ toolchain")
+def test_both_engines_emit_the_same_stage_name_set(tmp_path):
+    """ACCEPTANCE: the native-ingest C++ engine and the pure-Python
+    fallback server populate the SAME per-stage histograms — every edge
+    including flush — so waterfalls compare across deployments."""
+    before = _edge_counts()
+    _frontend_roundtrip(tmp_path, "native.sock", prefer_native=True)
+    mid = _edge_counts()
+    native_stages = {e for e in opscope.EDGES if mid[e] > before[e]}
+    assert native_stages == set(opscope.EDGES), \
+        set(opscope.EDGES) - native_stages
+    _frontend_roundtrip(tmp_path, "fallback.sock", prefer_native=False)
+    after = _edge_counts()
+    fallback_stages = {e for e in opscope.EDGES if after[e] > mid[e]}
+    assert fallback_stages == set(opscope.EDGES), \
+        set(opscope.EDGES) - fallback_stages
+
+
+# -------------------------------------------------------- fleet plumbing
+
+
+class _PreOpscopeMember:
+    """A healthy pre-opscope fleet member: every surface but opscope."""
+
+    def stats(self):
+        return {"decided_cells": 1}
+
+    def metrics(self):
+        return obs_metrics.snapshot()
+
+    def flight(self):
+        return {"records": [], "dropped": 0}
+
+    def pulse(self):
+        return {"enabled": False, "series": {}, "samples": 0}
+
+    def opscope(self):
+        from tpu6824.utils.errors import RPCError
+
+        raise RPCError("no such rpc: opscope")
+
+
+def test_collector_mixed_fleet_disabled_shell_not_error():
+    col = Collector()
+    col.add("old", _PreOpscopeMember())
+    col.add_local("new")
+    snap = col.snapshot()
+    assert not [k for k in snap["errors"] if k.startswith("old.")], \
+        snap["errors"]
+    shell = snap["processes"]["old"]["opscope"]
+    assert shell["enabled"] is False and shell["stages"] == []
+    assert "unavailable" in shell
+    assert snap["processes"]["new"]["opscope"]["enabled"] is True
+    merged = Collector.merge_opscope(snap)
+    assert merged is not None  # the local member is enabled
+    assert set(merged["stages"]) == set(opscope.EDGES)
+
+
+def test_merge_opscope_none_when_no_member_enabled():
+    snap = {"processes": {"a": {"opscope": opscope.snapshot_shell()},
+                          "b": {}}}
+    assert Collector.merge_opscope(snap) is None
+
+
+def test_merge_opscope_sums_buckets_and_requantiles():
+    def proc(count, bucket):
+        return {"opscope": {
+            "enabled": True, "stages": ["apply"],
+            "histograms": {"apply": {"count": count, "sum": count,
+                                     "pow2": {str(bucket): count}}}}}
+
+    snap = {"processes": {"p1": proc(10, 3), "p2": proc(10, 9)}}
+    m = Collector.merge_opscope(snap)
+    h = m["histograms"]["apply"]
+    assert h["count"] == 20
+    assert h["p50"] == float(1 << 3)   # half the mass in bucket 3
+    assert h["p99"] == float(1 << 9)   # tail in bucket 9
+
+
+def test_top_waterfall_pane_stable_keys():
+    from tpu6824.obs.top import _PROC_KEYS, build_view
+
+    col = Collector()
+    col.add_local("local")
+    view = build_view(col.snapshot())
+    p = view["processes"]["local"]
+    assert set(p) == set(_PROC_KEYS)
+    wf = p["waterfall"]
+    assert set(wf) == {"enabled", "op_p99_us", "p99_us"}
+    assert wf["enabled"] is True
+    assert "waterfall" in view["fleet"]
+
+
+# --------------------------------------------- nemesis soak (ACCEPT)
+
+
+@pytest.mark.nemesis
+@pytest.mark.parametrize("engine",
+                         (["native", "fallback"] if NATIVE
+                          else ["fallback"]))
+def test_stage_set_under_nemesis_composite_soak(tmp_path, engine,
+                                                nemesis_report,
+                                                monkeypatch):
+    """ACCEPTANCE: under the fixed-seed nemesis composite (partitions /
+    kill-revive / unreliable wire, Wing–Gong checked by the shared
+    soak), BOTH engines populate the same per-stage histogram set —
+    attribution keeps working exactly when it matters."""
+    import functools
+
+    import tests.test_frontend as tf
+    from tpu6824.harness.nemesis import seed_from_env
+
+    if engine == "fallback":
+        monkeypatch.setattr(
+            tf, "ClerkFrontend",
+            functools.partial(ClerkFrontend, prefer_native=False))
+    before = _edge_counts()
+    tf._frontend_nemesis_soak(tmp_path, "xla", seed_from_env(8815),
+                              duration=1.2, nemesis_report=nemesis_report,
+                              wire_format="native")
+    after = _edge_counts()
+    populated = {e for e in opscope.EDGES if after[e] > before[e]}
+    assert populated == set(opscope.EDGES), \
+        (engine, set(opscope.EDGES) - populated)
+
+
+def test_opscope_snapshot_shapes_stable():
+    s = opscope.snapshot()
+    shell = opscope.snapshot_shell(reason="x")
+    assert set(s) | {"unavailable"} == set(shell) | {"unavailable"}
+    assert s["enabled"] is True and shell["enabled"] is False
+    for e in opscope.EDGES:
+        assert set(s["histograms"][e]) == {"count", "sum", "p50", "p95",
+                                           "p99", "pow2"}

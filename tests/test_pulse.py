@@ -920,6 +920,13 @@ def test_top_once_json_smoke_against_live_fabricd():
         assert set(p) == set(_PROC_KEYS)
         assert p["decided_cells"] >= 3
         assert p["pulse"]["enabled"] is True and p["pulse"]["samples"] >= 3
+        # opscope waterfall pane (ISSUE 15): a live fabricd serves the
+        # opscope RPC, so the pane is enabled with the stable key set
+        # (its stage histograms may be empty — fabricd proposes through
+        # the raw fabric surface, not a service driver).
+        wf = p["waterfall"]
+        assert set(wf) == {"enabled", "op_p99_us", "p99_us"}, wf
+        assert wf["enabled"] is True, wf
         assert p["protocol"]["decides"] is None or \
             p["protocol"]["decides"] >= 0
         # The human rendering exercises the same view without crashing.

@@ -132,6 +132,14 @@ RULES: dict[str, str] = {
         "group B, whose apply blocks on A, and both RSMs stop draining "
         "their logs forever.  Consult coordinators from the ticker "
         "(txnkv.resolve_pass), never under mu or in apply",
+    "wallclock-duration":
+        "time.time() delta used as a duration in rpc/services/core "
+        "scope — the wall clock jumps under NTP slew and the nemesis "
+        "clock-pause fault, corrupting timeouts and latency accounting "
+        "(opscope's whole stage waterfall is monotonic-ns by "
+        "invariant); compute durations from time.monotonic()/"
+        "monotonic_ns(), keep time.time() for human-facing timestamps "
+        "only",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -186,6 +194,12 @@ _COMMIT_SCOPE = ("services/",)
 _RETRY_SCOPE = ("rpc/", "services/")
 _RETRY_BOUND_SUBSTR = ("deadline", "budget", "backoff", "timeout")
 _RETRY_PACE_TAILS = {"sleep", "wait"}
+# Wallclock-duration scope (wallclock-duration): the layers whose
+# timeouts, retries, and latency accounting feed decisions — the rpc
+# transports, the service RSMs, and the fabric core.  Harness modules
+# already have the stricter nondet-clock rule.
+_WALLDUR_SCOPE = ("rpc/", "services/", "core/")
+_WALL_CALLS = ("time.time", "time.time_ns")
 
 # Receivers that denote the tpuscope metrics registry, and the
 # get-or-create constructors the metric-unregistered rule polices.
@@ -337,6 +351,7 @@ class _FileLint(ast.NodeVisitor):
         self.native_path_scope = _in_scope(relpath, _NATIVE_PATH_SCOPE)
         self.retry_scope = _in_scope(relpath, _RETRY_SCOPE)
         self.commit_scope = _in_scope(relpath, _COMMIT_SCOPE)
+        self.walldur_scope = _in_scope(relpath, _WALLDUR_SCOPE)
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
@@ -347,6 +362,7 @@ class _FileLint(ast.NodeVisitor):
         self._scan_native_decode()
         self._scan_obs_buffers()
         self._scan_retry_loops()
+        self._scan_wallclock_durations()
         self._fn_stack: list[ast.AST] = []
         self._calls_subscribe = False
         self._refs_columnar_consumer = False
@@ -729,6 +745,60 @@ class _FileLint(ast.NodeVisitor):
                            "sleep — a retry storm amplifier; pace it "
                            "with services.common.Backoff or bound it "
                            "by deadline")
+
+    def _scan_wallclock_durations(self) -> None:
+        """wallclock-duration: in rpc/services/core scope, a SUBTRACTION
+        whose operand is `time.time()`/`time.time_ns()` (directly, or a
+        name assigned from one inside the same function) is a duration
+        computed from the wall clock — monotonic required (the opscope
+        invariant: NTP slew and the clock-pause nemesis make wall-clock
+        deltas lie).  Bare `time.time()` stamps (logging, artifact
+        metadata) are untouched.  One finding per subtraction site."""
+        if not self.walldur_scope:
+            return
+
+        def is_wall(n: ast.AST) -> bool:
+            return isinstance(n, ast.Call) and _dotted(n.func) in _WALL_CALLS
+
+        flagged: set[int] = set()
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # Nested defs are their own scope both ways (the retry-loop
+            # rule's discipline): an inner helper's wall-clock stamp
+            # must not contaminate the enclosing function's monotonic
+            # subtraction — each def is walked on its own visit.
+            skip: set[int] = set()
+            for n in ast.walk(fn):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n is not fn:
+                    skip.update(id(m) for m in ast.walk(n))
+            wall_names: set[str] = set()
+            for n in ast.walk(fn):
+                if id(n) in skip:
+                    continue
+                if isinstance(n, ast.Assign) and is_wall(n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            wall_names.add(t.id)
+                elif isinstance(n, (ast.AnnAssign, ast.AugAssign)) and \
+                        n.value is not None and is_wall(n.value) and \
+                        isinstance(n.target, ast.Name):
+                    wall_names.add(n.target.id)
+            for n in ast.walk(fn):
+                if id(n) in skip or not (
+                        isinstance(n, ast.BinOp)
+                        and isinstance(n.op, ast.Sub)) or id(n) in flagged:
+                    continue
+                for side in (n.left, n.right):
+                    if is_wall(side) or (isinstance(side, ast.Name)
+                                         and side.id in wall_names):
+                        flagged.add(id(n))
+                        self._flag(n, "wallclock-duration",
+                                   "duration computed from time.time() "
+                                   "— wall clock jumps corrupt it; use "
+                                   "time.monotonic()/monotonic_ns()")
+                        break
 
     def _resolve_jit_defs(self) -> set[int]:
         """FunctionDefs that are jit-compiled: decorated with jax.jit /

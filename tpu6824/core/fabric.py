@@ -2203,6 +2203,17 @@ class PaxosFabric:
         shell when no pulse is running in this process."""
         return obs_pulse.series_snapshot()
 
+    def opscope(self) -> dict:
+        """The process-global opscope waterfall snapshot (obs/opscope.py,
+        ISSUE 15) — per-stage latency histograms of the request path,
+        served over the fabric_service wire so `obs.top`'s waterfall
+        pane and the fleet collector can merge per-stage attribution
+        across processes.  A stable `enabled: False` shell when opscope
+        is disabled in this process."""
+        from tpu6824.obs import opscope as obs_opscope
+
+        return obs_opscope.snapshot()
+
     def start_pulse(self, interval: float | None = None,
                     cap: int | None = None,
                     stall_after: float | None = None):

@@ -50,8 +50,12 @@ FABRIC_RPCS = [
     # time-series snapshot — bounded rings of counter rates / gauges /
     # per-interval latency percentiles sampled by obs/pulse.py, the
     # surface `python -m tpu6824.obs.top` and the watchdog read — a
-    # stable `enabled: False` shell when no pulse runs in the process)
-    "dims", "stats", "metrics", "flight", "pulse",
+    # stable `enabled: False` shell when no pulse runs in the process;
+    # opscope is the per-stage request-path latency waterfall
+    # (obs/opscope.py, ISSUE 15) — always-on stage histograms + tail
+    # exemplars, merged fleet-wide by the Collector, with the same
+    # mixed-fleet rule: a pre-opscope member yields the disabled shell)
+    "dims", "stats", "metrics", "flight", "pulse", "opscope",
 ]
 
 

@@ -16,10 +16,29 @@
 
 #pragma once
 
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 
 namespace fewire {
+
+// opscope (ISSUE 15) plumbing: the frame-parse timestamp stamped on the
+// loop thread and the log2-µs bucketing rule shared with the Python
+// metrics registry (bucket k = values with bit_length k).  steady_clock
+// is CLOCK_MONOTONIC on Linux — the SAME clock CPython's
+// time.monotonic_ns() reads, so C++ stamps subtract directly against
+// Python-side stage stamps (the opscope monotonic-only invariant).
+inline int64_t mono_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+inline int log2_bucket_us(int64_t us) {
+  if (us <= 0) return 0;
+  int b = 64 - __builtin_clzll(uint64_t(us));
+  return b > 63 ? 63 : b;
+}
 
 constexpr uint8_t kFeVersion = 1;
 

@@ -102,6 +102,10 @@ struct Conn {
 struct Reply {
   uint64_t conn_id;
   std::vector<uint8_t> data;
+  // opscope (ISSUE 15): the reply-ring completion instant for fe
+  // frames (0 for everything else) — the flush stage measures from
+  // here to the loop's serialize/flush of the frame.
+  int64_t t_ns = 0;
 };
 
 // One ingested fe_batch frame: columnar op buffers (filled by the loop
@@ -116,6 +120,11 @@ struct FeFrame {
   bool has_tc = false;
   bool want_crc = false;     // request carried kFlagCrc: echo it back
   uint32_t deadline_ms = 0;  // propagated clerk op budget (0 = none)
+  // opscope (ISSUE 15): frame-parse instant, stamped on the loop
+  // thread (steady clock ns == time.monotonic_ns) — rides the poll1
+  // hdr as the ingest-ring ts column's per-frame value, the origin of
+  // every op's stage waterfall.
+  int64_t ts_ns = 0;
   uint64_t tc[2] = {0, 0};
   std::vector<int32_t> kind, key_id, val_id;
   std::vector<int64_t> cid, cseq;
@@ -140,6 +149,12 @@ struct Ingest {
   // native_ingest counters (mirrored into the Python metrics registry).
   std::atomic<int64_t> c_frames{0}, c_ops{0}, c_bytes{0}, c_full{0};
   std::atomic<int64_t> c_done_ops{0};  // ops answered (reply or fail)
+  // opscope flush-stage histogram (ISSUE 15): log2 µs buckets of the
+  // reply-ring-push → serialize/flush interval, per completed frame.
+  // Cumulative; the Python engine mirrors deltas once per pass
+  // (rpcsrv_opscope_flush).  Aggregate-initialized to zero.
+  std::atomic<int64_t> fl_buckets[64] = {};
+  std::atomic<int64_t> fl_count{0}, fl_sum_us{0};
 };
 
 struct Server {
@@ -226,10 +241,11 @@ void handle_accept(Server* s) {
 // Thread-safe reply enqueue: the loop's pending deque + eventfd wake —
 // usable from the loop thread itself (immediate ingest errors) and from
 // any Python thread (the push path's completed frames).
-void enqueue_reply(Server* s, uint64_t conn_id, std::vector<uint8_t>&& data) {
+void enqueue_reply(Server* s, uint64_t conn_id, std::vector<uint8_t>&& data,
+                   int64_t t_ns = 0) {
   {
     std::lock_guard<std::mutex> g(s->mu);
-    s->pending.push_back(Reply{conn_id, std::move(data)});
+    s->pending.push_back(Reply{conn_id, std::move(data), t_ns});
   }
   uint64_t one = 1;
   ssize_t ignored = write(s->evfd, &one, 8);
@@ -258,6 +274,10 @@ void ingest_wake_engine(Ingest* ing) {
 // values read out of the native store), hand it to the loop, and retire
 // the frame to the reap queue.  Caller holds ing->mu.
 void fe_complete_locked(Server* s, Ingest* ing, FeFrame* f) {
+  // opscope flush stage starts here: the last reply-ring push just
+  // completed the frame; everything from this instant to the loop's
+  // socket flush is native serialize/flush cost.
+  int64_t t_push = fewire::mono_ns();
   std::vector<int64_t> vlens(f->nops, 0);
   size_t total = fewire::kHdrSize + (f->want_crc ? 4 : 0);
   {
@@ -279,7 +299,8 @@ void fe_complete_locked(Server* s, Ingest* ing, FeFrame* f) {
       if (f->rep_val[i] >= 0)
         intern_core::store_decref(&ing->vals, f->rep_val[i]);
     enqueue_reply(s, f->conn_id,
-                  fe_error_bytes("reply too large for one fe frame"));
+                  fe_error_bytes("reply too large for one fe frame"),
+                  t_push);
     ing->done.push_back(f->id);
     ing->inflight_ops -= f->nops;
     ing->c_done_ops.fetch_add(f->nops, std::memory_order_relaxed);
@@ -322,7 +343,7 @@ void fe_complete_locked(Server* s, Ingest* ing, FeFrame* f) {
                       c);
     fewire::store<uint32_t>(out.data() + crc_off, c);
   }
-  enqueue_reply(s, f->conn_id, std::move(out));
+  enqueue_reply(s, f->conn_id, std::move(out), t_push);
   ing->done.push_back(f->id);
   ing->inflight_ops -= f->nops;
   ing->c_done_ops.fetch_add(f->nops, std::memory_order_relaxed);
@@ -407,6 +428,7 @@ void ingest_frame(Server* s, Ingest* ing, uint64_t conn_id,
     }
   }
   auto* f = new FeFrame;
+  f->ts_ns = fewire::mono_ns();  // opscope: the frame-parse origin stamp
   f->conn_id = conn_id;
   f->nops = nops;
   f->remaining = nops;
@@ -711,6 +733,22 @@ void drain_replies(Server* s) {
     c.deadline_ms = now_ms() + s->io_deadline_ms.load(std::memory_order_relaxed);
     epoll_mod(s, r.conn_id, c);
     handle_write(s, r.conn_id);  // opportunistic immediate flush
+    if (r.t_ns) {
+      // opscope flush stage (ISSUE 15): reply-ring completion →
+      // serialize + the loop's flush attempt, per fe frame.  The rare
+      // partial write that finishes on a later EPOLLOUT is attributed
+      // to the attempt that staged it — batch-granular telemetry, and
+      // the loop never tracks per-reply state past this point.
+      Ingest* ing = s->ingest.load(std::memory_order_acquire);
+      if (ing != nullptr) {
+        int64_t us = (fewire::mono_ns() - r.t_ns) / 1000;
+        ing->fl_buckets[fewire::log2_bucket_us(us)].fetch_add(
+            1, std::memory_order_relaxed);
+        ing->fl_count.fetch_add(1, std::memory_order_relaxed);
+        ing->fl_sum_us.fetch_add(us > 0 ? us : 0,
+                                 std::memory_order_relaxed);
+      }
+    }
   }
 }
 
@@ -929,8 +967,10 @@ int rpcsrv_ingest_enable(void* srv, int64_t max_ops) {
   return ing->efd;
 }
 
-// Pop one ready frame: hdr7 = {frame_id, conn_id, nops, has_tc, tc0, tc1,
-// deadline_ms (0 = none — the propagated clerk op budget)},
+// Pop one ready frame: hdr8 = {frame_id, conn_id, nops, has_tc, tc0, tc1,
+// deadline_ms (0 = none — the propagated clerk op budget), ts_ns (the
+// loop thread's frame-parse monotonic stamp — opscope's ingest-ring ts
+// column, per-frame value)},
 // columns memcpy'd into the caller's buffers (cap ops each).  Returns nops,
 // -1 when no frame is ready, -2 when cap is too small (frame stays
 // queued).  The frame's column storage is released here — the caller's
@@ -960,6 +1000,7 @@ int64_t rpcsrv_ingest_poll1(void* srv, uint64_t* hdr, int32_t* kinds,
     hdr[4] = f->tc[0];
     hdr[5] = f->tc[1];
     hdr[6] = f->deadline_ms;
+    hdr[7] = uint64_t(f->ts_ns);
     memcpy(kinds, f->kind.data(), f->nops * sizeof(int32_t));
     memcpy(cids, f->cid.data(), f->nops * sizeof(int64_t));
     memcpy(cseqs, f->cseq.data(), f->nops * sizeof(int64_t));
@@ -1122,6 +1163,23 @@ int64_t rpcsrv_ingest_decref(void* srv, int which, const int32_t* ids,
     if (ids[i] >= 0 && intern_core::store_decref(st, ids[i]))
       freed[nf++] = ids[i];
   return nf;
+}
+
+// opscope flush-stage histogram (ISSUE 15), cumulative: out[0..63] =
+// log2 µs buckets, out[64] = count, out[65] = µs sum.  The Python
+// engine mirrors DELTAS into the registry once per pass — one FFI call,
+// batch-columnar like every opscope fold.
+void rpcsrv_opscope_flush(void* srv, int64_t* out) {
+  auto* s = static_cast<Server*>(srv);
+  Ingest* ing = s->ingest.load(std::memory_order_acquire);
+  if (ing == nullptr) {
+    memset(out, 0, 66 * sizeof(int64_t));
+    return;
+  }
+  for (int k = 0; k < 64; k++)
+    out[k] = ing->fl_buckets[k].load(std::memory_order_relaxed);
+  out[64] = ing->fl_count.load(std::memory_order_relaxed);
+  out[65] = ing->fl_sum_us.load(std::memory_order_relaxed);
 }
 
 // {frames, ops, bytes, ring_full, inflight_ops, live_frames, keys_live,

@@ -28,6 +28,18 @@ kernelscope (ISSUE 6) adds two fleet-level tools on top:
     host-edge regressions demoted to `suspect-environment` when the
     box itself demonstrably degraded between the runs.
 
+opscope (ISSUE 15) adds the *which stage* layer:
+
+  - `obs.opscope` — always-on columnar per-stage latency attribution:
+    stage timestamps ride the request path as parallel int64
+    monotonic-ns columns (frame parse → engine poll → park →
+    materialize → dispatch → decide → apply → reply → flush), folded
+    per drain into per-stage log2 histograms, with the K slowest ops
+    per pulse interval promoted into the flight recorder as synthetic
+    span chains (tail-based capture, no TPU6824_TRACE needed).  Served
+    as the `opscope` RPC, merged fleet-wide by the Collector, rendered
+    by obs.top's waterfall pane, decomposed per bench leg.
+
 pulse (ISSUE 10) adds the *over time* layer:
 
   - `obs.pulse` — continuous bounded-ring time-series over the
@@ -46,7 +58,14 @@ Stdlib-only on purpose: importable from the analysis CLI, daemons, and
 clerks without dragging in JAX.
 """
 
-from tpu6824.obs import collector, metrics, pulse, tracing, watchdog  # noqa: F401
+from tpu6824.obs import (  # noqa: F401
+    collector,
+    metrics,
+    opscope,
+    pulse,
+    tracing,
+    watchdog,
+)
 from tpu6824.obs.collector import Collector, local_handle  # noqa: F401
 from tpu6824.obs.tracing import (  # noqa: F401
     FLIGHT,

@@ -152,6 +152,22 @@ METRICS = [
            leg_shape=[("service", "clerk_frontend", "groups"),
                       ("service", "clerk_frontend", "conns"),
                       ("service", "clerk_frontend", "batch_width")]),
+    # opscope waterfall (ISSUE 15): the leg's whole-op p99 and the apply
+    # stage's p99 — host-edge noisy like every clerk-path number, and
+    # log2-bucket quantized like the tpuscope percentile entries (one
+    # bucket = 2× is noise, two buckets = 4× is real — gate between).
+    # Leg-shape-gated on the fe sweep shape; first recorded artifact
+    # baselines them, gated thereafter.
+    Metric(("service", "clerk_frontend", "waterfall", "total_p99_us"),
+           2.0, higher_is_better=False, host_bound=True,
+           leg_shape=[("service", "clerk_frontend", "groups"),
+                      ("service", "clerk_frontend", "conns"),
+                      ("service", "clerk_frontend", "batch_width")]),
+    Metric(("service", "clerk_frontend", "waterfall", "stages", "apply",
+            "p99_us"), 2.0, higher_is_better=False, host_bound=True,
+           leg_shape=[("service", "clerk_frontend", "groups"),
+                      ("service", "clerk_frontend", "conns"),
+                      ("service", "clerk_frontend", "batch_width")]),
     # Overload leg (ISSUE 12, netfault): goodput under 4× offered load
     # and the measured closed-loop capacity it is relative to.  Both
     # host-edge noisy like every clerk-path leg; gated on the leg's OWN

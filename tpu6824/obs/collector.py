@@ -38,6 +38,7 @@ import threading
 import time
 
 from tpu6824.obs import metrics as obs_metrics
+from tpu6824.obs import opscope as obs_opscope
 from tpu6824.obs import pulse as obs_pulse
 from tpu6824.obs import tracing as obs_tracing
 from tpu6824.utils import crashsink
@@ -84,6 +85,9 @@ class _LocalProcess:
     def pulse(self):
         return obs_pulse.series_snapshot()
 
+    def opscope(self):
+        return obs_opscope.snapshot()
+
 
 def local_handle(fabric=None) -> _LocalProcess:
     """A collector handle for THIS process (the harness/driver process is
@@ -95,7 +99,7 @@ def local_handle(fabric=None) -> _LocalProcess:
 class Collector:
     """Named fabric-shaped handles → one merged observability artifact."""
 
-    _SURFACES = ("stats", "metrics", "flight", "pulse")
+    _SURFACES = ("stats", "metrics", "flight", "pulse", "opscope")
 
     def __init__(self, poll_timeout: float = 15.0):
         # Per-MEMBER wall budget for one snapshot poll: a hung member
@@ -156,6 +160,15 @@ class Collector:
                                 "cap": None, "samples": 0,
                                 "t_mono": None, "series": {},
                                 "unavailable": repr(e)[:200]}
+                        continue
+                    if surface == "opscope":
+                        # Same mixed-fleet rule for the opscope surface
+                        # (ISSUE 15): a pre-opscope member answering
+                        # "no such rpc" yields the STABLE disabled
+                        # shell, never an error entry.
+                        with mu:
+                            out[surface] = obs_opscope.snapshot_shell(
+                                reason=repr(e)[:200])
                         continue
                     with mu:
                         errors[f"{name}.{surface}"] = repr(e)[:200]
@@ -246,6 +259,50 @@ class Collector:
                     e["latest_sum"] = round(
                         e.get("latest_sum", 0.0) + s["v"][-1], 6)
         return out if any_enabled else None
+
+    @staticmethod
+    def merge_opscope(snapshot: dict) -> dict | None:
+        """Fleet waterfall (ISSUE 15): per stage, the raw log2 buckets
+        summed across every opscope-enabled member, with p50/p95/p99
+        recomputed from the MERGED buckets — averaging per-process
+        percentiles would weight an idle frontend equally with a loaded
+        one, the same rule merge_protocol applies to ratios.  None when
+        no member serves an enabled opscope."""
+        from tpu6824.obs.metrics import _NBUCKETS, _bucket_quantile
+
+        merged: dict[str, list] = {}
+        counts: dict[str, int] = {}
+        sums: dict[str, int] = {}
+        stages: list[str] = []
+        any_enabled = False
+        for proc in snapshot["processes"].values():
+            osc = proc.get("opscope")
+            if not osc or not osc.get("enabled"):
+                continue
+            any_enabled = True
+            for st in osc.get("stages", ()):
+                if st not in stages:
+                    stages.append(st)
+            for st, h in osc.get("histograms", {}).items():
+                buckets = merged.setdefault(st, [0] * _NBUCKETS)
+                for k, c in h.get("pow2", {}).items():
+                    buckets[min(int(k), _NBUCKETS - 1)] += int(c)
+                counts[st] = counts.get(st, 0) + int(h.get("count", 0))
+                sums[st] = sums.get(st, 0) + int(h.get("sum", 0))
+        if not any_enabled:
+            return None
+        out = {}
+        for st in stages:
+            b = merged.get(st, [0] * _NBUCKETS)
+            n = counts.get(st, 0)
+            out[st] = {
+                "count": n, "sum": sums.get(st, 0),
+                "p50": _bucket_quantile(b, n, 0.50) if n else None,
+                "p95": _bucket_quantile(b, n, 0.95) if n else None,
+                "p99": _bucket_quantile(b, n, 0.99) if n else None,
+            }
+        return {"schema": obs_opscope.SCHEMA_VERSION, "stages": stages,
+                "histograms": out}
 
     # ------------------------------------------------------------- perfetto
 

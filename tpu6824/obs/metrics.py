@@ -143,6 +143,23 @@ class Histogram:
                 self.sum += iv
             self.count += len(ivs)
 
+    def add_pow2(self, buckets, count: int, total: int) -> None:
+        """Columnar merge of EXTERNALLY-bucketed observations: `buckets`
+        is a sequence of per-log2-bucket counts (bucket k = values with
+        bit_length k — the same rule `observe` applies), `count`/`total`
+        the batch's observation count and value sum.  ONE lock for the
+        whole batch — the opscope fold's bincount output and the native
+        reply ring's flush histogram both land through here, so the hot
+        path never observes per op."""
+        with self._mu:
+            b = self._buckets
+            top = _NBUCKETS - 1
+            for k, c in enumerate(buckets):
+                if c:
+                    b[k if k < top else top] += int(c)
+            self.count += int(count)
+            self.sum += int(total)
+
     def quantile(self, q: float) -> float:
         """Bucket-resolution quantile estimate (the bucket's exclusive
         upper bound, 2^k)."""

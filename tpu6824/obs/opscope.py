@@ -1,0 +1,410 @@
+"""opscope — always-on columnar per-stage latency attribution (ISSUE 15).
+
+PR 10's honest bench note left the sharpest open question on the board:
+after native ingest, the residual host profile is "spread over client
+stream, proposal materialization, and fabric dispatch" — a conclusion
+reached by ad-hoc bring-up probes, not by the system itself.  Every
+remaining perf item (device-resident apply, fast-path quorum variants,
+multi-chip sharding) needs to know WHICH STAGE of an op's life it is
+buying back, continuously and under load.  tpuscope tracing answers that
+per op but is head-sampled, allocation-costly, and off in steady state
+by contract; opscope inverts it:
+
+  - **Stage timestamps ride as parallel int64 monotonic-ns columns**
+    next to the existing request-path columns: frame-parse (stamped on
+    the C++ loop thread, `FeFrame.ts_ns` → the poll1 hdr), engine poll,
+    `submit_columnar` park, proposal materialization
+    (`_collect_proposals_locked`), fabric dispatch (start_many),
+    decide-feed delivery, apply, and the notify-sweep reply push.  The
+    stamps live in plain cid→int dicts (ints are not gc-tracked; two
+    dict entries per op is the established columnar-waiter cost) and
+    batch-level instants are taken ONCE per pass, never per op.
+  - **Folded per drain** into per-stage-edge log2 histograms in the
+    metrics registry: one numpy stack/diff/bincount per drained batch —
+    the histogram update is columnar, never per op.  The pure-Python
+    fallback server and in-process clerks stamp the same stage names,
+    so both engines produce the same waterfall shape.
+  - **Tail exemplars**: the K slowest ops per pulse interval
+    (`TPU6824_OPSCOPE_EXEMPLARS`, default 8) get their full stage
+    vector promoted into the flight recorder as synthetic tpuscope span
+    chains — a p99 spike ships with concrete offending ops WITHOUT
+    `TPU6824_TRACE=1`, inverting head-sampling into tail-based capture.
+    Exemplar timestamps are `time.monotonic_ns()`, joinable to nemesis
+    timelines via the artifact's t0 exactly like every flight record.
+  - The C++ reply path contributes the **flush** stage (reply-ring
+    completion → serialized frame flushed by the epoll loop) as a
+    native-side log2 histogram merged per engine pass
+    (`Histogram.add_pow2`), one FFI call per pass.
+
+Stage-edge semantics (edge named by its DESTINATION stage; each edge's
+histogram observes destination_stamp − previous_stamp in µs):
+
+    poll         frame parsed (C++/event loop) → engine picked it up
+    park         engine poll → columnar park under the server mutex
+    materialize  park → Op log entries built at proposal collection
+    dispatch     materialize → proposal handed to the fabric
+    decide       dispatch → decided value delivered by the feed
+    apply        decide-feed delivery → RSM apply done
+    reply        apply → notify-sweep push into the reply path
+    flush        reply push → frame serialized + flushed (per frame)
+
+Missing stages (an op that skipped a stamp — in-process clerks have no
+wire parse; a dup answer never materializes) back-fill from the next
+known stamp, so their edges observe 0 and the stage-name SET is
+identical on every path.
+
+Always-on contract: default ON (`TPU6824_OPSCOPE=0` disables, and every
+producer guards on `enabled()` so off means zero added work); the
+steady-state cost is dict stamps + one columnar fold per drain —
+regression-pinned by the PR 10 gc alloc probe and the bench leg's
+opscope on/off A/B.  The stamp tables are capacity-bounded
+(`_TRIM_CAP`): abandoned ops' residue is cleared wholesale and counted
+(`opscope.trimmed`), never leaked.
+
+MONOTONIC-ONLY invariant: every stamp here is `time.monotonic_ns()` (or
+the C++ steady clock, same POSIX clock).  Durations from `time.time()`
+jump under NTP slew and the clock-pause nemesis — the tpusan
+`wallclock-duration` rule enforces this repo-wide.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import pulse as _pulse
+from tpu6824.obs import tracing as _tracing
+
+__all__ = ["STAGES", "EDGES", "SCHEMA_VERSION", "enabled", "enable",
+           "disable", "note_ingest_poll", "note_columnar_park",
+           "note_park", "note_materialize_many", "note_dispatch_many",
+           "drop", "fold", "observe_flush", "merge_flush",
+           "flush_exemplars", "snapshot", "snapshot_shell", "reset"]
+
+SCHEMA_VERSION = "opscope-1.0.0"
+
+# The op-life stages, in pipeline order.  `ingest` is the origin stamp
+# (frame parse); every later stage names the EDGE ending at it.
+STAGES = ("ingest", "poll", "park", "materialize", "dispatch",
+          "decide", "apply", "reply")
+# Edge (= per-stage histogram) names: the seven fold-produced edges plus
+# the native reply path's flush stage.
+EDGES = STAGES[1:] + ("flush",)
+
+_ENABLED = os.environ.get("TPU6824_OPSCOPE", "1") not in ("0", "false")
+EXEMPLAR_K = max(1, int(os.environ.get("TPU6824_OPSCOPE_EXEMPLARS", "8")))
+
+# Stamp-table bound: beyond this many live entries the tables are
+# cleared wholesale (abandoned/dup-retried residue — ops in flight
+# simply back-fill their next fold).  Telemetry is allowed to be lossy;
+# it is NOT allowed to leak (the unbounded-obs-buffer philosophy).
+_TRIM_CAP = int(os.environ.get("TPU6824_OPSCOPE_CAP", str(1 << 16)))
+
+# Per-edge latency histograms + the whole-op total, module scope per the
+# metric-unregistered rule.  Names embed the stage so pulse's automatic
+# per-interval percentile series (`opscope.stage.<edge>.latency_us.p99`)
+# carry the stage for the watchdog's culprit attribution.
+_H_EDGE = {e: _metrics.histogram(f"opscope.stage.{e}.latency_us")
+           for e in EDGES}
+_H_TOTAL = _metrics.histogram("opscope.op.latency_us")
+_C_FOLDED = _metrics.counter("opscope.folded")
+_C_TRIM = _metrics.counter("opscope.trimmed")
+
+# Stage stamp columns: cid → monotonic ns.  Plain dicts — single-key
+# get/set/pop are GIL-atomic, values are ints (not gc-tracked), and the
+# fold pops its batch's entries so steady state holds one row per op in
+# flight.  cids are globally unique (fresh_cid; shardkv's are strings).
+_t0: dict = {}
+_tpoll: dict = {}
+_tpark: dict = {}
+_tmat: dict = {}
+_tdisp: dict = {}
+_STAMPS = (_t0, _tpoll, _tpark, _tmat, _tdisp)
+
+# Exemplar reservoir: the K slowest ops since the last flush, kept as
+# preallocated parallel columns (numpy lazily — obs stays importable
+# without it; the reservoir only exists once a fold ran).
+_ex_mu = threading.Lock()
+_ex_tot = None    # np.int64[K] total µs, -1 = empty slot
+_ex_vec = None    # np.int64[K, len(STAGES)] stage stamp vectors (ns)
+_ex_cid: list = []  # parallel cid labels (any hashable; rendered str)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable() -> None:
+    """Turn stamping/folding on (tests / the bench A/B)."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+# ------------------------------------------------------------- stamping
+# All producers guard on enabled() at THEIR end so a disabled opscope
+# costs nothing; these helpers do not re-check.
+
+
+def note_ingest_poll(cids, t0s, poll_ns: int) -> None:
+    """Frame decoded → engine pass picked it up.  `t0s` is either one
+    frame-parse instant for the whole batch or a per-op sequence
+    parallel to `cids` (the native path's ts column)."""
+    d0 = _t0
+    dp = _tpoll
+    if isinstance(t0s, int):
+        for cid in cids:
+            d0[cid] = t0s
+            dp[cid] = poll_ns
+    else:
+        for i, cid in enumerate(cids):
+            d0[cid] = t0s[i]
+            dp[cid] = poll_ns
+    _maybe_trim()
+
+
+def note_columnar_park(cids, t0s, polls, park_ns: int) -> None:
+    """submit_columnar's park: the native block carries per-op ts
+    columns (frame parse + engine poll), the park instant is one stamp
+    for the whole accepted set."""
+    d0 = _t0
+    dp = _tpoll
+    dk = _tpark
+    for i, cid in enumerate(cids):
+        d0[cid] = t0s[i]
+        dp[cid] = polls[i]
+        dk[cid] = park_ns
+    _maybe_trim()
+
+
+def note_park(cids, park_ns: int) -> None:
+    """submit_batch's park (Python frames, in-process clerks)."""
+    dk = _tpark
+    for cid in cids:
+        dk[cid] = park_ns
+    _maybe_trim()
+
+
+def note_materialize_many(cids, ns: int) -> None:
+    dm = _tmat
+    for cid in cids:
+        dm[cid] = ns
+
+
+def note_dispatch_many(cids, ns: int) -> None:
+    dd = _tdisp
+    for cid in cids:
+        dd[cid] = ns
+
+
+def drop(cid) -> None:
+    """Forget an op's stamps — the TERMINAL paths (frame timeout: the
+    op is answered with an error and will never fold).  Failover
+    abandons deliberately do NOT drop: the retry re-parks the same cid
+    and its fold still wants the original parse origin.  Residue from
+    anything else is bounded by the trim cap."""
+    for d in _STAMPS:
+        d.pop(cid, None)
+
+
+def _maybe_trim() -> None:
+    # Park and ingest tables both bound the sweep: ops that stamp but
+    # never park (a frame dropped between decode and admission) must
+    # not leak either.
+    n = max(len(_tpark), len(_t0))
+    if n > _TRIM_CAP:
+        for d in _STAMPS:
+            d.clear()
+        _C_TRIM.inc(n)
+
+
+# ------------------------------------------------------------- the fold
+
+
+def fold(cids, t_decide: int, t_apply: int, t_reply: int) -> None:
+    """One drained batch → per-stage-edge histograms + the exemplar
+    reservoir.  `cids` are the ops this drain resolved; the three
+    drain-level stamps are batch scalars (delivery / applied / pushed).
+    The histogram update is one numpy stack + diff + bincount per batch
+    — never a per-op observe."""
+    if not cids:
+        return
+    import numpy as np
+
+    n = len(cids)
+    cols = []
+    for d in _STAMPS:
+        pop = d.pop
+        cols.append([pop(cid, 0) for cid in cids])
+    m = np.empty((len(STAGES), n), dtype=np.int64)
+    for i, col in enumerate(cols):
+        m[i] = col
+    m[5] = t_decide
+    m[6] = t_apply
+    m[7] = t_reply
+    # Missing early stamps (0) back-fill from the next known stage so
+    # their edges observe 0; then enforce monotone non-decreasing (a
+    # retried op's re-stamp can land out of order by a hair).
+    for i in range(len(STAGES) - 2, -1, -1):
+        np.copyto(m[i], m[i + 1], where=(m[i] == 0))
+    np.maximum.accumulate(m, axis=0, out=m)
+    d_ns = np.diff(m, axis=0)
+    us = d_ns // 1000
+    # bit_length(x) == ceil(log2(x + 1)) for x >= 0 — exact in float64
+    # at every power of two below 2^53.
+    bl = np.ceil(np.log2(us + 1.0)).astype(np.int64)
+    np.clip(bl, 0, 63, out=bl)
+    for i, edge in enumerate(EDGES[:-1]):
+        counts = np.bincount(bl[i], minlength=64)
+        _H_EDGE[edge].add_pow2(counts, n, int(us[i].sum()))
+    tot = (m[-1] - m[0]) // 1000
+    tbl = np.clip(np.ceil(np.log2(tot + 1.0)).astype(np.int64), 0, 63)
+    _H_TOTAL.add_pow2(np.bincount(tbl, minlength=64), n, int(tot.sum()))
+    _C_FOLDED.inc(n)
+    _reservoir_update(np, cids, tot, m)
+
+
+def _reservoir_update(np, cids, tot, m) -> None:
+    """Keep the K slowest ops' full stage vectors since the last flush
+    (preallocated columns — no per-op objects; candidate selection is
+    one argpartition per batch)."""
+    global _ex_tot, _ex_vec
+    k = EXEMPLAR_K
+    with _ex_mu:
+        if _ex_tot is None:
+            _ex_tot = np.full(k, -1, dtype=np.int64)
+            _ex_vec = np.zeros((k, len(STAGES)), dtype=np.int64)
+            _ex_cid.extend([None] * k)
+        n = len(cids)
+        if n > k:
+            cand = np.argpartition(tot, n - k)[n - k:]
+        else:
+            cand = np.arange(n)
+        for j in cand.tolist():
+            slot = int(np.argmin(_ex_tot))
+            if tot[j] > _ex_tot[slot]:
+                _ex_tot[slot] = tot[j]
+                _ex_vec[slot] = m[:, j]
+                _ex_cid[slot] = cids[j]
+
+
+def flush_exemplars() -> int:
+    """Promote the reservoir into the flight recorder as synthetic
+    tpuscope span chains — one root `opscope.op` span per exemplar
+    (args: cid, total µs, the widest stage) with one child span per
+    stage edge — then reset the reservoir for the next interval.
+    Runs on the pulse sampling clock (global sampler) and on demand;
+    works with tracing OFF (flight records are always-on).  Returns the
+    number of exemplars emitted."""
+    with _ex_mu:
+        if _ex_tot is None:
+            # Nothing ever folded — ALSO the numpy-less-process guard:
+            # this runs on every pulse tick via the global sampler, and
+            # the reservoir only exists once a fold (which itself needs
+            # numpy) created it, so the import stays below this
+            # early-out and a stdlib-only poller never crash-loops the
+            # sampler.
+            return 0
+        import numpy as np
+
+        live = np.nonzero(_ex_tot >= 0)[0]
+        if not len(live):
+            return 0
+        tots = _ex_tot[live].tolist()
+        vecs = _ex_vec[live].copy()
+        labels = [_ex_cid[int(i)] for i in live]
+        _ex_tot.fill(-1)
+    emitted = 0
+    for row, tot_us, cid in zip(vecs, tots, labels):
+        v = row.tolist()
+        durs = [v[i + 1] - v[i] for i in range(len(STAGES) - 1)]
+        widest = EDGES[max(range(len(durs)), key=durs.__getitem__)]
+        tid = _tracing.fresh_id()
+        root = _tracing.complete(
+            "opscope.op", tid, 0, v[0], v[-1], comp="opscope",
+            cid=str(cid), total_us=int(tot_us), stage=widest)
+        for i, edge in enumerate(EDGES[:-1]):
+            _tracing.complete(f"opscope.{edge}", tid, root, v[i],
+                              v[i + 1], comp="opscope", stage=edge,
+                              us=durs[i] // 1000)
+        emitted += 1
+    return emitted
+
+
+# Exemplars flush on the pulse sampling clock: per interval, the K
+# slowest ops land in the flight ring.  Registered globally so whichever
+# pulse runs (fabricd --pulse, a test's manual Pulse) drives it without
+# opscope importing any runtime layer.
+_pulse.add_global_sampler(flush_exemplars)
+
+
+# ----------------------------------------------------- native flush leg
+
+
+def observe_flush(ns: int) -> None:
+    """Python reply paths' flush stage: one observation per FRAME (the
+    reply serialize+send the engine just performed) — frame-granular by
+    design, matching the C++ side's per-reply accounting."""
+    _H_EDGE["flush"].observe(ns // 1000)
+
+
+def merge_flush(buckets, count: int, total_us: int) -> None:
+    """Merge the C++ reply ring's cumulative flush histogram DELTA (64
+    log2 µs buckets + count + µs sum) — one call per engine pass."""
+    if count > 0:
+        _H_EDGE["flush"].add_pow2(buckets, count, total_us)
+
+
+# -------------------------------------------------------------- surface
+
+
+def snapshot() -> dict:
+    """The opscope wire surface (served as the `opscope` RPC next to
+    stats/metrics/flight/pulse): per-stage histogram summaries with raw
+    pow2 buckets so the fleet Collector can merge across processes."""
+    hists = {}
+    for e in EDGES:
+        s = _H_EDGE[e].snapshot()
+        hists[e] = {"count": s["count"], "sum": s["sum"],
+                    "p50": s["p50"], "p95": s["p95"], "p99": s["p99"],
+                    "pow2": s["pow2"]}
+    t = _H_TOTAL.snapshot()
+    return {"schema": SCHEMA_VERSION, "enabled": _ENABLED,
+            "stages": list(EDGES),
+            "exemplar_k": EXEMPLAR_K,
+            "t_mono": round(time.monotonic(), 6),
+            "op": {"count": t["count"], "sum": t["sum"], "p50": t["p50"],
+                   "p95": t["p95"], "p99": t["p99"]},
+            "histograms": hists}
+
+
+def snapshot_shell(reason: str | None = None) -> dict:
+    """The stable disabled shell — what a poller reports for a member
+    that does not serve opscope (pre-opscope fleet member, PR 9's
+    mixed-fleet rule): same key set, enabled False, never an error."""
+    out = {"schema": SCHEMA_VERSION, "enabled": False, "stages": [],
+           "exemplar_k": None, "t_mono": round(time.monotonic(), 6),
+           "op": {"count": 0, "sum": 0, "p50": None, "p95": None,
+                  "p99": None},
+           "histograms": {}}
+    if reason is not None:
+        out["unavailable"] = reason
+    return out
+
+
+def reset() -> None:
+    """Test isolation: drop stamps and the reservoir (registry metrics
+    are owned by obs.metrics.reset)."""
+    global _ex_tot, _ex_vec
+    for d in _STAMPS:
+        d.clear()
+    with _ex_mu:
+        _ex_tot = None
+        _ex_vec = None
+        _ex_cid.clear()

@@ -30,11 +30,14 @@ from tpu6824.obs.collector import Collector
 SCHEMA_VERSION = "top-1.0.0"
 
 # Every process block carries EXACTLY these keys (the --json stability
-# contract); absent data is None/empty, never a missing key.
+# contract); absent data is None/empty, never a missing key.  ISSUE 15
+# added `waterfall` — the per-stage opscope p99 pane; a pre-opscope
+# member renders it disabled-with-empty-stages, never missing.
 _PROC_KEYS = ("decided_cells", "decided_per_sec", "steps_per_sec",
               "stalled_groups", "stall_diagnosis", "feed_depth_max",
               "thread_crashes", "events_dropped", "flight_dropped",
-              "protocol", "rpc_pool", "latency_us", "pulse", "error")
+              "protocol", "rpc_pool", "latency_us", "pulse", "waterfall",
+              "error")
 
 
 def scrub(obj):
@@ -72,6 +75,7 @@ def _proc_view(proc: dict, err: str | None) -> dict:
     met = proc.get("metrics") or {}
     fl = proc.get("flight") or {}
     pu = proc.get("pulse") or {}
+    osc = proc.get("opscope") or {}
     health = st.get("health") or {}
     rates = st.get("rates") or {}
     proto = st.get("protocol") or {}
@@ -109,6 +113,15 @@ def _proc_view(proc: dict, err: str | None) -> dict:
         "pulse": {"enabled": bool(pu.get("enabled")),
                   "samples": pu.get("samples", 0),
                   "series": len(pu.get("series") or {})},
+        # The opscope waterfall pane (ISSUE 15): per-stage p99 µs of the
+        # request path, in pipeline order — where an op's latency lives.
+        "waterfall": {
+            "enabled": bool(osc.get("enabled")),
+            "op_p99_us": (osc.get("op") or {}).get("p99"),
+            "p99_us": {st: h.get("p99")
+                       for st, h in (osc.get("histograms") or {}).items()
+                       if h.get("count")},
+        },
         "error": err,
     }
     assert set(view) == set(_PROC_KEYS)
@@ -142,6 +155,7 @@ def build_view(snap: dict) -> dict:
             "decided_per_sec": (round(sum(rates), 1) if rates else None),
             "protocol": merged,
             "pulse": Collector.merge_pulse(snap),
+            "waterfall": Collector.merge_opscope(snap),
         },
     })
 
@@ -175,6 +189,13 @@ def render(view: dict) -> str:
             f"{_fmt(p['latency_us']['p99'], 9)}")
         for g, why in sorted(p["stall_diagnosis"].items()):
             lines.append(f"  !! g{g}: {why}")
+        wf = p.get("waterfall") or {}
+        if wf.get("enabled") and wf.get("p99_us"):
+            # Waterfall pane: stage p99s in pipeline order — the op's
+            # latency, decomposed (ISSUE 15).
+            cells = "  ".join(f"{st}:{_fmt(us, 1).strip()}"
+                              for st, us in wf["p99_us"].items())
+            lines.append(f"  waterfall p99us  {cells}")
         if p["error"]:
             lines.append(f"  !! poll: {p['error']}")
     fleet = view["fleet"]

@@ -78,6 +78,13 @@ def enabled() -> bool:
     return _ENABLED
 
 
+def fresh_id() -> int:
+    """A fresh process-unique id (the span-id counter) — for producers
+    that synthesize complete span chains outside the live-span path
+    (opscope's tail exemplars need a root trace id with tracing OFF)."""
+    return next(_ids)
+
+
 def enable(sample: float = 1.0) -> None:
     """Turn per-op tracing on (tests / live opt-in)."""
     global _ENABLED, _SAMPLE

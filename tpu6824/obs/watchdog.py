@@ -22,7 +22,11 @@ Rules (thresholds via env, see TUNING):
     a collapse).
   - ``latency-spike``       — any per-interval latency p99 series rose
     `TPU6824_WD_SPIKE_FACTOR`× (default 4 = two log2 buckets — one
-    bucket is quantization noise) over its window median.
+    bucket is quantization noise) over its window median.  The bundle
+    names the CULPRIT STAGE (ISSUE 15): the opscope waterfall series
+    with the widest p99 delta in the triggering window rides
+    `watchdog.evidence.culprit_stage`, so a spike says `apply` (or
+    `dispatch`, or `flush`), not just "something got slow".
   - ``queue-growth``        — feed_depth_max grew monotonically across
     the window and ended above `TPU6824_WD_FEED_DEPTH`.
   - ``thread-crashes``      — crashsink reported a NEW daemon-thread
@@ -79,9 +83,13 @@ def _envf(name: str, default: float) -> float:
 class Rule:
     """One watchdog rule: `check(wd)` returns a human-readable reason
     string when triggered, else None.  Subclasses read series through
-    `wd.points/last` and the freshest stats through `wd.stats()`."""
+    `wd.points/last` and the freshest stats through `wd.stats()`.
+    A rule may set `self.evidence` (a JSON-safe dict) during a
+    triggering check — it rides the bundle's `watchdog.evidence` field
+    (the latency-spike rule's per-stage culprit attribution)."""
 
     name = "rule"
+    evidence: dict | None = None
 
     def check(self, wd: "Watchdog") -> str | None:
         raise NotImplementedError
@@ -128,9 +136,62 @@ class ThroughputCollapse(Rule):
 class LatencySpike(Rule):
     name = "latency-spike"
 
-    def __init__(self, factor: float | None = None):
+    def __init__(self, factor: float | None = None,
+                 min_us: float | None = None):
         self.factor = _envf("TPU6824_WD_SPIKE_FACTOR", 4.0) \
             if factor is None else factor
+        # Absolute floor on the spiked value (the min_rate pattern),
+        # applied to the OPSCOPE series (stage edges AND whole-op):
+        # they sit at tens-of-µs scale where an ordinary scheduler
+        # hiccup on a cgroup-capped box is 1-4ms — several log2
+        # buckets and an easy 4x over a healthy median.  8192µs is
+        # the first bucket safely above that noise band; a spike that
+        # matters for the waterfall (the seeded 80ms apply stall, a
+        # wedged flush) clears it by decades.  Other latency series
+        # keep the pre-opscope contract (a 50µs service regressing
+        # 16× must still fire).
+        self.min_us = _envf("TPU6824_WD_SPIKE_MIN_US", 8192.0) \
+            if min_us is None else min_us
+
+    def _stage_evidence(self, wd) -> dict | None:
+        """Name the CULPRIT STAGE (ISSUE 15): across the opscope
+        waterfall's per-stage p99 series, the widest last-point-vs-
+        window-median delta in the triggering window — so a latency
+        spike's bundle says `apply` (or `dispatch`, or `flush`), not
+        just "something got slow".  A culprit is only NAMED when some
+        stage itself spiked (last ≥ median × factor, positive delta,
+        AND clearing the min_us floor — the floor guards attribution
+        exactly like it guards triggering, else a non-floored series'
+        off-path incident could blame sub-floor stage jitter): a spike
+        whose cause lives outside the staged request path (a
+        clerk-side network stall) must not send the operator chasing
+        whichever stage jittered widest."""
+        deltas: dict[str, float] = {}
+        spiked: set[str] = set()
+        for name in wd.series_names():
+            if not (name.startswith("opscope.stage.")
+                    and name.endswith(".p99")):
+                continue
+            pts = wd.points(name)
+            if len(pts) < 2:
+                continue
+            vals = sorted(v for _, v in pts[:-1])
+            median = vals[len(vals) // 2]
+            stage = name[len("opscope.stage."):].split(".", 1)[0]
+            last = pts[-1][1]
+            d = last - median
+            if d > deltas.get(stage, float("-inf")):
+                deltas[stage] = round(d, 3)
+            if d > 0 and median > 0 and last >= median * self.factor \
+                    and last >= self.min_us:
+                spiked.add(stage)
+        if not deltas:
+            return None
+        candidates = {s: deltas[s] for s in spiked}
+        culprit = max(candidates, key=candidates.get) if candidates \
+            else None
+        return {"culprit_stage": culprit,
+                "stage_p99_delta_us": deltas}
 
     def check(self, wd):
         for name in wd.series_names():
@@ -142,9 +203,17 @@ class LatencySpike(Rule):
             vals = sorted(v for _, v in pts[:-1])
             median = vals[len(vals) // 2]
             last = pts[-1][1]
-            if median > 0 and last >= median * self.factor:
-                return (f"{name} spiked to {last:.0f} "
-                        f"(median {median:.0f}, x{last / median:.1f})")
+            if median > 0 and last >= median * self.factor and (
+                    last >= self.min_us
+                    or not name.startswith("opscope.")):
+                self.evidence = self._stage_evidence(wd)
+                reason = (f"{name} spiked to {last:.0f} "
+                          f"(median {median:.0f}, x{last / median:.1f})")
+                if self.evidence is not None \
+                        and self.evidence["culprit_stage"] is not None:
+                    reason += (f"; culprit stage: "
+                               f"{self.evidence['culprit_stage']}")
+                return reason
         return None
 
 
@@ -508,6 +577,10 @@ class Watchdog:
             "schema": SCHEMA_VERSION,
             "rule": rule.name,
             "reason": reason,
+            # Rule-specific structured evidence (the latency-spike
+            # rule's culprit-stage attribution, ISSUE 15); None for
+            # rules that carry everything in the reason string.
+            "evidence": getattr(rule, "evidence", None),
             "t_mono": round(now, 6),
             "detected_after_s": round(self.uptime(), 3),
             "window_s": self.window,

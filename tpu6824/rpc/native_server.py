@@ -105,6 +105,12 @@ def _get_lib():
                     lib.rpcsrv_ingest_decref.argtypes = [
                         vp, ctypes.c_int, vp, ctypes.c_int64, vp]
                     lib.rpcsrv_ingest_stats.argtypes = [vp, vp]
+                # opscope flush-stage histogram (ISSUE 15) — probed like
+                # the rest of the extended surface: a stale .so simply
+                # reports no flush stage rather than crashing.
+                if hasattr(lib, "rpcsrv_opscope_flush"):
+                    lib.rpcsrv_opscope_flush.argtypes = [
+                        ctypes.c_void_p, ctypes.c_void_p]
                 # netfault reply-path hook + decode-reject counter
                 # (ISSUE 12).  Probed like the ingest surface: absent
                 # on a stale .so, in which case injection/counting
@@ -553,7 +559,10 @@ class NativeIngest:
         self.fd = srv._ingest_fd
         self._cap = 0
         self._grow(4096)
-        self._hdr = np.zeros(7, dtype=np.uint64)
+        # hdr8: {frame_id, conn_id, nops, has_tc, tc0, tc1, deadline_ms,
+        # ts_ns} — a stale .so writes only the first 7; slot 7 stays 0
+        # and the engine falls back to its own poll instant.
+        self._hdr = np.zeros(8, dtype=np.uint64)
         self._hdr_p = self._hdr.ctypes.data
         self._reap_buf = np.zeros(self.REAP_CAP, dtype=np.uint64)
         self._reap_p = self._reap_buf.ctypes.data
@@ -561,6 +570,8 @@ class NativeIngest:
         self._keystr: dict[int, str] = {}  # lazy id→str key mirror
         self._stats_buf = np.zeros(9, dtype=np.int64)
         self._stats_p = self._stats_buf.ctypes.data
+        self._flush_buf = np.zeros(66, dtype=np.int64)
+        self._flush_p = self._flush_buf.ctypes.data
 
     def _grow(self, cap: int) -> None:
         np = self._np
@@ -582,9 +593,11 @@ class NativeIngest:
 
     def poll1(self):
         """One ready frame as (frame_id, conn_id, nops, tc, deadline_ms,
-        kind, cid, cseq, key_id, val_id) with engine-owned column
+        ts_ns, kind, cid, cseq, key_id, val_id) with engine-owned column
         copies, or None.  deadline_ms is the clerk op budget the frame
-        header propagated (0 = none)."""
+        header propagated (0 = none); ts_ns is the loop thread's
+        frame-parse monotonic stamp — opscope's waterfall origin (0 on
+        a stale .so; the engine substitutes its poll instant)."""
         while True:
             with self._lock:
                 if self._srv._dead or self._srv._srv is None:
@@ -600,10 +613,24 @@ class NativeIngest:
             n = int(n)
             h = self._hdr
             tc = (int(h[4]), int(h[5])) if h[3] else None
-            return (int(h[0]), int(h[1]), n, tc, int(h[6]),
+            return (int(h[0]), int(h[1]), n, tc, int(h[6]), int(h[7]),
                     self._kind[:n].copy(), self._cid[:n].copy(),
                     self._cseq[:n].copy(), self._keyid[:n].copy(),
                     self._valid[:n].copy())
+
+    def scope_flush(self):
+        """The C++ flush-stage histogram, CUMULATIVE: a 66-slot int64
+        copy (64 log2-µs buckets, count, µs sum), or None when the
+        loaded lib predates the opscope ABI.  The engine diffs against
+        its previous copy and merges the delta into the registry once
+        per pass."""
+        if not hasattr(self._lib, "rpcsrv_opscope_flush"):
+            return None
+        with self._lock:
+            if self._srv._dead or self._srv._srv is None:
+                return None
+            self._lib.rpcsrv_opscope_flush(self._h, self._flush_p)
+        return self._flush_buf.copy()
 
     def push(self, tags, errs, repvals) -> None:
         """Reply-ring write: int64/uint8/int32 arrays of equal length."""

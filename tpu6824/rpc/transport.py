@@ -52,6 +52,7 @@ import threading
 import time
 
 from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import opscope as _opscope
 from tpu6824.obs import tracing as _tracing
 from tpu6824.rpc import netfault as _netfault
 from tpu6824.rpc import wire
@@ -806,11 +807,17 @@ class Server:
                         replies = fn(ops)
                 else:
                     replies = fn(ops)
+                # opscope flush stage (ISSUE 15), blocking-server path:
+                # reply serialize + socket send, one observation per
+                # frame — the pure-Python fallback emits the SAME stage
+                # name set as the C++ reply ring.
+                t_ser = time.monotonic_ns() if _opscope.enabled() else 0
                 out = wire.encode_replies(replies,
                                           crc=meta.get("crc", False))
             except RPCError:
                 return False  # transport-level refusal: drop, no reply
             except Exception as e:  # app-level error → fe error frame
+                t_ser = 0
                 out = wire.encode_error(f"{e!r:.200}")
         if discard_reply:
             _M_SRV_DROP_REP.inc(key="fe_batch")
@@ -820,6 +827,8 @@ class Server:
             return False
         try:
             self._send_raw_reply(conn, out)
+            if fn is not None and t_ser:
+                _opscope.observe_flush(time.monotonic_ns() - t_ser)
         except RPCError:
             # Reply past the frame cap: the size check fires before any
             # bytes move, so the stream is clean — degrade to an error

@@ -50,6 +50,7 @@ import time
 from collections import deque
 
 from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import opscope as _opscope
 from tpu6824.obs import pulse as _obs_pulse
 from tpu6824.obs import tracing as _tracing
 from tpu6824.rpc import transport, wire
@@ -160,7 +161,7 @@ class _NFrame:
     __slots__ = ("fid", "conn_id", "nops", "tc", "kinds", "cids", "cseqs",
                  "key_ids", "val_ids", "kid_arr", "vid_arr", "gids", "tcs",
                  "deadline", "retry_at", "interval", "srv", "cur_srv",
-                 "tickets", "last_pending")
+                 "tickets", "last_pending", "ts0", "tpoll")
 
     def __init__(self, fid, conn_id, nops, tc, now, op_timeout,
                  deadline_ms=0):
@@ -170,6 +171,11 @@ class _NFrame:
         self.tc = tc
         self.gids = None
         self.tcs = None
+        # opscope stage stamps (ISSUE 15): frame-parse origin (the C++
+        # loop's ts column) and the engine-poll instant — per-frame
+        # ints, broadcast per op at block build.
+        self.ts0 = 0
+        self.tpoll = 0
         if deadline_ms:  # propagated clerk budget (the _Frame rule)
             op_timeout = min(op_timeout, deadline_ms / 1000.0)
         self.deadline = now + op_timeout
@@ -187,7 +193,7 @@ class _CBlock:
     KVPaxosServer.submit_columnar consumes."""
 
     __slots__ = ("kinds", "cids", "cseqs", "key_ids", "val_ids", "tags",
-                 "tcs", "resolver")
+                 "tcs", "resolver", "ts0", "tpolls")
 
     def __init__(self, resolver):
         self.kinds = []
@@ -198,6 +204,10 @@ class _CBlock:
         self.tags = []
         self.tcs = None
         self.resolver = resolver
+        # opscope ts columns (None when opscope is off): frame-parse
+        # and engine-poll ns per op, parked with the columnar waiter.
+        self.ts0 = None
+        self.tpolls = None
 
 
 class _NativeSink:
@@ -353,6 +363,7 @@ class ClerkFrontend:
         srv.register("metrics", self._metrics_rpc)
         srv.register("flight", _tracing.flight_snapshot)
         srv.register("pulse", _obs_pulse.series_snapshot)
+        srv.register("opscope", _opscope.snapshot)
         srv.start()
         # Zero-GIL ingest (ISSUE 11): only the kvpaxos submit_columnar
         # seam can consume the columnar frames, so custom op factories
@@ -362,6 +373,7 @@ class ClerkFrontend:
         self._csink = None
         self._wake_armed = False
         self._ing_last = None  # previous counter snapshot (mirror deltas)
+        self._flush_last = None  # opscope flush-hist snapshot (deltas)
         self._mirror_mu = threading.Lock()  # engine pass vs metrics RPC
         if self.deferred and op_factory is _kv_op and all(
                 hasattr(s, "submit_columnar")
@@ -388,28 +400,36 @@ class ClerkFrontend:
     # tpusan blocking-in-eventloop scope: decode + enqueue + wake ONLY.
 
     def _on_batch(self, conn_id, args, wctx) -> None:
-        self._pending.append((conn_id, args[0], wctx, False, False, None))
+        # The trailing element is the opscope frame-parse stamp (one
+        # monotonic read per frame — decode/enqueue/wake discipline
+        # intact); 0 when opscope is off.
+        t0 = time.monotonic_ns() if _opscope.enabled() else 0
+        self._pending.append((conn_id, args[0], wctx, False, False, None,
+                              t0))
         self._wake_engine()
 
     def _on_native_batch(self, conn_id, ops, tc, meta) -> None:
         # fe wire frame decoded in Python (C++ ingest off): same queue,
         # native reply flag set so the answer leaves in the fe layout
         # (meta: propagated deadline + crc echo).
-        self._pending.append((conn_id, ops, tc, False, True, meta))
+        t0 = time.monotonic_ns() if _opscope.enabled() else 0
+        self._pending.append((conn_id, ops, tc, False, True, meta, t0))
         self._wake_engine()
 
     def _on_get(self, conn_id, args, wctx) -> None:
         key, cid, cseq = args
+        t0 = time.monotonic_ns() if _opscope.enabled() else 0
         self._pending.append(
             (conn_id, (("get", key, "", cid, cseq),), wctx, True, False,
-             None))
+             None, t0))
         self._wake_engine()
 
     def _on_put_append(self, conn_id, args, wctx) -> None:
         kind, key, value, cid, cseq = args
+        t0 = time.monotonic_ns() if _opscope.enabled() else 0
         self._pending.append(
             (conn_id, ((kind, key, value, cid, cseq),), wctx, True,
-             False, None))
+             False, None, t0))
         self._wake_engine()
 
     def _on_fut_done(self, fut) -> None:
@@ -549,12 +569,19 @@ class ClerkFrontend:
         self._inflight -= len(fr.replies)
         for fut in fr.futs:
             self._unlink(futmap, fut, fr)
+        scope = _opscope.enabled()
+        t_ser = time.monotonic_ns() if scope else 0
         if fr.native:
             self._srv.send_reply_native(fr.conn_id, tuple(fr.replies),
                                         crc=fr.crc)
         else:
             payload = fr.replies[0] if fr.single else tuple(fr.replies)
             self._srv.send_reply(fr.conn_id, payload)
+        if scope:
+            # opscope flush stage, Python reply path: serialize+send of
+            # this frame (one observation per FRAME, matching the C++
+            # reply ring's per-reply accounting).
+            _opscope.observe_flush(time.monotonic_ns() - t_ser)
         _M_OPS.inc(len(fr.replies))
 
     @staticmethod
@@ -583,12 +610,17 @@ class ClerkFrontend:
     def _drop_frame(self, fr, live, futmap, msg) -> None:
         live.pop(id(fr), None)
         self._inflight -= len(fr.replies)
+        scope = _opscope.enabled()
         for slot, fut in enumerate(fr.futs):
             if fut is None:
                 continue
             self._unlink(futmap, fut, fr)
             if fr.replies[slot] is _UNSET:
                 self._abandon(fr, slot)
+                if scope:
+                    # Terminal for this op: no fold will ever pop its
+                    # stamps — drop them instead of leaning on the cap.
+                    _opscope.drop(fr.ops[slot].cid)
         if fr.native:
             self._srv.send_error_native(fr.conn_id, msg)
         else:
@@ -646,6 +678,20 @@ class ClerkFrontend:
             self._ing_last = st
             _metrics.set_gauge("frontend.native_ingest.inflight_ops",
                                st["inflight_ops"])
+            # opscope flush stage: the C++ reply ring's cumulative log2
+            # histogram, delta-merged once per pass.  The snapshot
+            # ALWAYS advances — the C++ side stamps unconditionally
+            # (two clock reads + relaxed atomics per frame, far below
+            # the A/B noise floor), so a disabled window's delta must
+            # be DROPPED at re-enable, not lump-merged into the first
+            # on-window pass as a phantom batch.
+            cur = ing.scope_flush()
+            if cur is not None:
+                prev = self._flush_last
+                if prev is not None and _opscope.enabled():
+                    d = cur - prev
+                    _opscope.merge_flush(d[:64], int(d[64]), int(d[65]))
+                self._flush_last = cur
 
     def _metrics_rpc(self):
         """The `metrics` RPC: registry snapshot, with the native-ingest
@@ -696,13 +742,20 @@ class ClerkFrontend:
         # authoritative count; the engine's view is one pass stale,
         # which the watermark's headroom below the ring cap absorbs).
         admitted = sum(nf.nops for nf in nframes.values())
+        scope = _opscope.enabled()
         while True:
             got = ing.poll1()
             if got is None:
                 break
-            fid, conn_id, nops, tc, dl_ms, ka, ca, sa, kia, via = got
+            fid, conn_id, nops, tc, dl_ms, ts_ns, ka, ca, sa, kia, via = got
             nf = _NFrame(fid, conn_id, nops, tc, now, self.op_timeout,
                          deadline_ms=dl_ms)
+            if scope:
+                t_poll = time.monotonic_ns()
+                # A stale .so reports no parse stamp (0): the poll
+                # instant stands in and the poll edge reads 0.
+                nf.ts0 = ts_ns or t_poll
+                nf.tpoll = t_poll
             nf.kinds = ka.tolist()
             nf.cids = ca.tolist()
             nf.cseqs = sa.tolist()
@@ -771,6 +824,10 @@ class ClerkFrontend:
                     self._abandon_native(nf, idxs)
                     ing.fail(nf.fid,
                              "frontend: op timeout (no majority?)")
+                    if scope:
+                        # Terminal: these slots' stamps will never fold.
+                        for i in idxs:
+                            _opscope.drop(nf.cids[i])
                     _M_TIMEOUTS.inc()
                     continue
                 if nf.retry_at > 0.0 and npend < nf.last_pending:
@@ -811,10 +868,15 @@ class ClerkFrontend:
                 for gid, ii in per.items():
                     buckets.setdefault(gid, []).append((nf, ii))
         sink = self._csink
+        scope = _opscope.enabled()
         for gid, bucket in buckets.items():
             block = _CBlock(ing)
             kinds, cids, cseqs = block.kinds, block.cids, block.cseqs
             kids, vids, tags = block.key_ids, block.val_ids, block.tags
+            ts0 = tpolls = None
+            if scope:
+                block.ts0 = ts0 = []
+                block.tpolls = tpolls = []
             tcs = None
             if any(nf.tcs is not None for nf, _ in bucket):
                 block.tcs = tcs = []
@@ -822,6 +884,7 @@ class ClerkFrontend:
                 base = nf.fid << 16
                 fk, fc, fs = nf.kinds, nf.cids, nf.cseqs
                 fki, fvi, ftc = nf.key_ids, nf.val_ids, nf.tcs
+                ft0, ftp = nf.ts0, nf.tpoll
                 for i in ii:
                     kinds.append(fk[i])
                     cids.append(fc[i])
@@ -829,6 +892,11 @@ class ClerkFrontend:
                     kids.append(fki[i])
                     vids.append(fvi[i])
                     tags.append(base + i)
+                    if ts0 is not None:
+                        # opscope ts columns: frame-level stamps
+                        # broadcast per op (int appends, no objects).
+                        ts0.append(ft0)
+                        tpolls.append(ftp)
                     if tcs is not None:
                         tcs.append(ftc[i] if ftc is not None else None)
             servers = self.groups[gid]
@@ -920,10 +988,11 @@ class ClerkFrontend:
                 route = self._route
                 multi = len(self.groups) > 1
                 ngroups = len(self.groups)
+                scope = _opscope.enabled()
                 while True:
                     try:
-                        conn_id, ops_wire, wctx, single, native, meta = \
-                            pending.popleft()
+                        (conn_id, ops_wire, wctx, single, native, meta,
+                         t0) = pending.popleft()
                     except IndexError:
                         break
                     # EVERYTHING frame-derived stays inside the guard: a
@@ -982,6 +1051,12 @@ class ClerkFrontend:
                     _M_WIDTH.observe(len(ops_wire))
                     self._inflight += nops
                     live[id(fr)] = fr
+                    if scope and t0:
+                        # Python decode path: frame-parse (enqueue) →
+                        # engine poll, same stage names as C++ ingest.
+                        _opscope.note_ingest_poll(
+                            [op.cid for op in fr.ops], t0,
+                            time.monotonic_ns())
                     for i, op in enumerate(fr.ops):
                         batch_ops.append(op)
                         owners.append((fr, i))
@@ -1043,6 +1118,13 @@ class ClerkFrontend:
         per group; unresolved ops fail over across replicas within the
         op budget."""
         ops = [self._make_op(t, None) for t in ops_wire]
+        if _opscope.enabled():
+            # Blocking fallback (thread-per-connection transport): the
+            # frame is decoded and consumed on one thread, so parse and
+            # poll coincide — the stage-name SET stays identical.
+            _opscope.note_ingest_poll([op.cid for op in ops],
+                                      time.monotonic_ns(),
+                                      time.monotonic_ns())
         multi = len(self.groups) > 1
         gids = [self._route(op.key) for op in ops] if multi \
             else [0] * len(ops)

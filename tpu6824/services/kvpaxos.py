@@ -24,6 +24,7 @@ from typing import NamedTuple
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.obs import metrics as _metrics
+from tpu6824.obs import opscope as _opscope
 from tpu6824.obs import tracing as _tracing
 from tpu6824.rpc import wire as _wire
 from tpu6824.services import horizon as _horizon
@@ -150,6 +151,11 @@ class KVPaxosServer:
         # (harness/linearize.py) can prove it catches a real violation;
         # never set outside tests.
         self._test_disable_dup = False
+        # TEST-ONLY opscope seam: a per-drain stall injected between the
+        # decide-feed delivery and the batch apply, so the attribution
+        # tests can seed a KNOWN slow stage and assert the waterfall,
+        # the watchdog bundle, and the tail exemplars all name `apply`.
+        self._test_apply_delay = 0.0
         self._waiters: dict[tuple[int, int], _Fut] = {}  # (cid, cseq) -> fut
         # tpuscope: (cid, cseq) -> proposal monotonic_ns for traced ops
         # (empty when tracing is off) — lets the apply side emit the
@@ -298,7 +304,8 @@ class KVPaxosServer:
                      or self._ccseq.get(mine.cid) == mine.cseq)):
             self._subq.append(mine)
 
-    def _apply_batch_locked(self, vals, cnotif=None) -> list:
+    def _apply_batch_locked(self, vals, cnotif=None,
+                            scope_cids=None) -> list:
         """Apply one contiguous decided run as a tight batch — the batched
         doGet/doPutAppend (kvpaxos/server.go:115-162) with the dict
         lookups hoisted and every per-op branch inline.  Futures are
@@ -362,12 +369,16 @@ class KVPaxosServer:
                     if v.tc is not None:
                         self._trace_resolve(v, fut)
                     notif.append((fut, reply))
+                    if scope_cids is not None:
+                        scope_cids.append(v.cid)
                 elif cnotif is not None and ccseq_get(v.cid) == v.cseq:
                     del ccseq[v.cid]
                     ctags.append(ctag_pop(v.cid))
                     creps.append(reply)
                     ctctx.append(self._trace_apply(v)
                                  if v.tc is not None else None)
+                    if scope_cids is not None:
+                        scope_cids.append(v.cid)
             self._pop_lost_inflight_locked(v)
         if pend:
             dup.apply_batch(pend)
@@ -388,6 +399,12 @@ class KVPaxosServer:
         base0 = self.applied + 1
         notif = []
         cnotif = ([], [], []) if self._csink is not None else None
+        # opscope (ISSUE 15): per-drain stage stamps — decide-feed
+        # delivery, batch apply done, notify/reply push — plus the
+        # resolved ops' cids, folded ONCE per drain into the per-stage
+        # histograms (numpy diff + bincount, never per op).
+        scope_cids = [] if _opscope.enabled() else None
+        t_decide = 0
         apply_ns = 0
         while True:
             run = tap.pop_ready(self.applied)
@@ -408,13 +425,23 @@ class KVPaxosServer:
                         tap.discard_through(self.applied)
                         continue
                 break
+            if t_decide == 0:
+                t_decide = time.monotonic_ns()
+            if self._test_apply_delay:
+                # tpusan: ok(lock-blocking-call) — TEST-ONLY seeded
+                # stall for the opscope attribution acceptance: the
+                # injected slow stage must sit exactly between the
+                # decide and apply stamps; never set outside tests.
+                time.sleep(self._test_apply_delay)
             t0 = time.perf_counter_ns()
-            notif.extend(self._apply_batch_locked(run, cnotif))
+            notif.extend(self._apply_batch_locked(run, cnotif,
+                                                  scope_cids))
             apply_ns += time.perf_counter_ns() - t0
         applied_n = self.applied + 1 - base0
         if applied_n > 0:
             prof.add("apply", apply_ns)
             _M_APPLIED.inc(applied_n)  # columnar: one bump per drain
+            t_apply = time.monotonic_ns() if scope_cids else 0
             t0 = time.perf_counter_ns()
             for fut, reply in notif:
                 fut.set(reply)
@@ -423,6 +450,9 @@ class KVPaxosServer:
                 # native loop thread serializes and flushes the frames.
                 self._csink.push(*cnotif)
             prof.add("notify", time.perf_counter_ns() - t0)
+            if scope_cids:
+                _opscope.fold(scope_cids, t_decide, t_apply,
+                              time.monotonic_ns())
         self._last_drain = applied_n
         if self.applied >= base0:
             self._done_fn(self.applied)
@@ -733,6 +763,12 @@ class KVPaxosServer:
                 # block's interns is legal from here on.
                 self.columnar_drained = ticket
         self._next_seq = nxt
+        if props and _opscope.enabled():
+            # opscope materialize stamp: one instant for the whole
+            # proposal pass (classic _subq ops and columnar blocks
+            # alike materialized HERE, on the driver, at this pass).
+            _opscope.note_materialize_many(
+                [op.cid for _s, op in props], time.monotonic_ns())
         return props
 
     def _unpropose_locked(self, props, idx):
@@ -798,6 +834,13 @@ class KVPaxosServer:
                                 except WindowFullError as e:
                                     e.index = i
                                     raise
+                        if _opscope.enabled():
+                            # opscope dispatch stamp: the whole block
+                            # just entered the fabric window (rolled-
+                            # back ops re-stamp on their retry pass).
+                            _opscope.note_dispatch_many(
+                                [op.cid for _s, op in props],
+                                time.monotonic_ns())
                     except WindowFullError as e:
                         with self.mu:
                             self._unpropose_locked(
@@ -881,6 +924,7 @@ class KVPaxosServer:
         futs = []
         tr = _tracing.enabled()
         cur = _tracing.current() if tr else None
+        scope_cids = [] if _opscope.enabled() else None
         with self.mu:
             if self.dead:
                 raise RPCError("dead")
@@ -902,6 +946,8 @@ class KVPaxosServer:
                         fut = _Fut()
                         if sink is not None:
                             fut.sink = sink
+                        if scope_cids is not None:
+                            scope_cids.append(op.cid)
                         if tr:
                             # tpuscope: stamp the op's trace metadata —
                             # parent is the rpc leg's context (explicit
@@ -925,6 +971,11 @@ class KVPaxosServer:
                         # adopt it so the frontend hears the resolution.
                         fut.sink = sink
                 futs.append(fut)
+            if scope_cids:
+                # opscope park stamp: one instant for the whole batch
+                # (in-process clerks have no earlier stage; the fold
+                # back-fills their missing parse/poll stamps from here).
+                _opscope.note_park(scope_cids, time.monotonic_ns())
         self._wake.set()
         return futs
 
@@ -967,6 +1018,19 @@ class KVPaxosServer:
                     ccseq[cid] = cseqs[i]
                     ctag[cid] = tags[i]
                     accepted.append(i)
+            if accepted and _opscope.enabled():
+                # opscope park stamp for the columnar waiters, with the
+                # block's frame-parse/engine-poll ts columns when the
+                # engine carried them (int columns, one park instant).
+                if block.ts0 is not None:
+                    _opscope.note_columnar_park(
+                        [cids[i] for i in accepted],
+                        [block.ts0[i] for i in accepted],
+                        [block.tpolls[i] for i in accepted],
+                        time.monotonic_ns())
+                else:
+                    _opscope.note_park([cids[i] for i in accepted],
+                                       time.monotonic_ns())
             self._csink = sink
             if accepted:
                 self._cblocks_submitted += 1
@@ -980,7 +1044,11 @@ class KVPaxosServer:
     def abandon_columnar(self, cids, cseqs) -> None:
         """Drop columnar waiters (the engine's failover/timeout path) —
         the ops may still decide here, dup-filtered as ever, but this
-        server stops re-proposing them and will not answer their tags."""
+        server stops re-proposing them and will not answer their tags.
+        FAILOVER ops keep their opscope stamps (the retry re-parks the
+        same cid on the next replica, overwriting park onward while the
+        frame-parse origin survives); a timed-out frame's residue is
+        bounded by the trim cap."""
         with self.mu:
             ccseq = self._ccseq
             ctag = self._ctag
@@ -995,7 +1063,11 @@ class KVPaxosServer:
     def abandon(self, cid: int, cseq: int) -> None:
         """Drop the waiter for (cid, cseq): the client gave up on this
         server.  The op may still decide here — the dup filter keeps any
-        retry at-most-once — but the driver stops re-proposing it."""
+        retry at-most-once — but the driver stops re-proposing it.
+        Opscope stamps deliberately survive an abandon: the clerk's
+        blocking retry re-submits the SAME (cid, cseq) to a sibling
+        replica, whose fold still wants the original parse/park origin
+        (a never-retried op's residue is the trim cap's job)."""
         with self.mu:
             self._waiters.pop((cid, cseq), None)
             self._trace_prop.pop((cid, cseq), None)

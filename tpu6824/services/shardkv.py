@@ -37,6 +37,7 @@ from typing import NamedTuple
 
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
+from tpu6824.obs import opscope as _opscope
 from tpu6824.obs import tracing as _tracing
 from tpu6824.ops.hashing import NSHARDS, key2shard
 from tpu6824.services import horizon as _horizon
@@ -185,6 +186,11 @@ class ShardKVServer:
         self._subq: list[Op] = []
         self._inflight: dict[int, Op] = {}     # seq -> my undecided proposal
         self._next_seq = 0
+        # opscope (ISSUE 15): per-drain accumulator of resolved-waiter
+        # cids — a list only while _drain_decided's feed pass runs (the
+        # ticker's _sync walk resolves outside the request hot path and
+        # is deliberately not folded).
+        self._scope_acc = None
         self._wake = threading.Event()
         self._client_driver = None
         sub_fn = getattr(self.px, "subscribe_decided", None)
@@ -305,6 +311,8 @@ class ShardKVServer:
             fut = self._waiters.pop((op.cid, op.cseq), None)
             if fut is not None:
                 fut.set(reply)
+                if self._scope_acc is not None:
+                    self._scope_acc.append(op.cid)
         return reply
 
     def _requeue_lost_locked(self, v) -> None:
@@ -329,6 +337,16 @@ class ShardKVServer:
             # proposing) — discard those before reassembling.
             base0 = self.applied + 1
             tap.discard_through(self.applied)
+            # opscope (ISSUE 15): same stage names as the kvpaxos
+            # driver — decide-feed delivery / apply / reply stamps per
+            # drain, resolved cids accumulated by _resolve and folded
+            # once (shardkv resolves waiters inline during apply, so
+            # its reply edge reads ~0 by construction — the waterfall
+            # SHAPE differs, the stage-name set does not).
+            scope = _opscope.enabled()
+            t_decide = 0
+            if scope:
+                self._scope_acc = []
             while True:
                 run = tap.pop_ready(self.applied)
                 if not run:
@@ -348,10 +366,17 @@ class ShardKVServer:
                             tap.discard_through(self.applied)
                             continue
                     break
+                if t_decide == 0:
+                    t_decide = time.monotonic_ns()
                 for v in run:
                     self._apply(v)
                     self.applied += 1
                     self._requeue_lost_locked(v)
+            if scope:
+                acc, self._scope_acc = self._scope_acc, None
+                if acc:
+                    t_now = time.monotonic_ns()
+                    _opscope.fold(acc, t_decide or t_now, t_now, t_now)
             if self.applied >= base0:
                 self.px.done(self.applied)
             return
@@ -777,6 +802,7 @@ class ShardKVServer:
         futures (dup and wrong-group ops resolve immediately).  Same
         contract as KVPaxosServer.submit_batch."""
         futs = []
+        parked = [] if _opscope.enabled() else None
         with self.mu:
             if self.dead:
                 raise RPCError("dead")
@@ -810,9 +836,13 @@ class ShardKVServer:
                             fut.sink = sink
                         self._waiters[key] = fut
                         self._subq.append(op)
+                        if parked is not None:
+                            parked.append(op.cid)
                     elif sink is not None and fut.sink is None:
                         fut.sink = sink
                 futs.append(fut)
+            if parked:
+                _opscope.note_park(parked, time.monotonic_ns())
         self._wake_submit()
         return futs
 
@@ -843,6 +873,9 @@ class ShardKVServer:
             nxt += 1
         self._subq = []
         self._next_seq = nxt
+        if props and _opscope.enabled():
+            _opscope.note_materialize_many(
+                [op.cid for _s, op in props], time.monotonic_ns())
         return props
 
     def _client_drive_loop(self):
@@ -875,6 +908,10 @@ class ShardKVServer:
                                 except WindowFullError as e:
                                     e.index = i
                                     raise
+                        if _opscope.enabled():
+                            _opscope.note_dispatch_many(
+                                [op.cid for _s, op in props],
+                                time.monotonic_ns())
                     except WindowFullError as e:
                         with self.mu:
                             idx = len(props) if e.index is None else e.index
