@@ -1259,6 +1259,38 @@ def _waterfall_block(before_snap):
     }
 
 
+def _devapply_cut_profile():
+    """Snapshot-cut flatness (ISSUE 16 acceptance): the under-mutex cut
+    is an O(1) ref capture of immutable device arrays, so its cost must
+    stay flat across store sizes ≥10× apart — the old path copied the
+    whole host dict under the lock, so cut cost scaled with the store."""
+    import time as _t
+
+    from tpu6824.services.devapply import DevApplyEngine
+
+    sizes = [int(x) for x in os.environ.get(
+        "BENCH_DEVAPPLY_CUT_SIZES", "1024,12288").split(",")]
+    cut_us = []
+    for n in sizes:
+        eng = DevApplyEngine()
+        eng.load_from_dict(
+            {f"key-{i}": f"val-{i}" for i in range(n)}, n - 1)
+        reps = 200
+        t0 = _t.perf_counter()
+        for _ in range(reps):
+            eng.snapshot_cut()
+        cut_us.append(round((_t.perf_counter() - t0) / reps * 1e6, 3))
+    return {
+        "sizes": sizes,
+        "cut_us": cut_us,
+        "ratio": (round(cut_us[-1] / cut_us[0], 2)
+                  if cut_us and cut_us[0] > 0 else None),
+        "note": "under-mutex snapshot-cut cost per store size (us/cut); "
+                "a flat ratio across the >=10x size spread is the "
+                "acceptance — materialization happens off the mutex",
+    }
+
+
 def _clerk_frontend_rate():
     """service.clerk_frontend (ISSUE 8): aggregate clerk ops/sec through
     the BATCHED request path — FrontendStream clients speaking multi-op
@@ -1300,7 +1332,12 @@ def _clerk_frontend_rate():
                       # size the compaction buffer so deep batches never
                       # fall into the full-fetch resync path.
                       summary_k=max(16384, (G * I * 3) // 2))
-    clusters = [[KVPaxosServer(fab, g, p, op_timeout=30.0)
+    # devapply (ISSUE 16): the sweep measures the device-resident
+    # columnar apply by default; BENCH_DEVAPPLY_AB re-runs the best
+    # shape with every engine flipped off (set_devapply — same cluster,
+    # same sockets) as the host-dict control arm.
+    dev_on = os.environ.get("TPU6824_DEVAPPLY", "1") not in ("", "0")
+    clusters = [[KVPaxosServer(fab, g, p, op_timeout=30.0, devapply=dev_on)
                  for p in range(P)] for g in range(G)]
     fe = ClerkFrontend(addr=f"/tmp/bench-fe-{os.getpid()}.sock",
                        groups=clusters,
@@ -1425,6 +1462,50 @@ def _clerk_frontend_rate():
             }
         else:
             waterfall["overhead_ab"] = None
+        # devapply A/B (ISSUE 16): the SAME best shape with every
+        # replica's engine flipped off mid-run — the Python-dict
+        # control arm on one cluster.  Flipped back on afterwards so
+        # the spot check below reads through the live engines (and
+        # exercises the off→on reload under bench load).
+        if dev_on and os.environ.get("BENCH_DEVAPPLY_AB", "1") != "0":
+            from tpu6824.obs import metrics as _met
+
+            csnap = _met.snapshot()["counters"]
+            dev_counters = {
+                k: csnap.get(f"devapply.{k}", {}).get("total", 0)
+                for k in ("applied_ops", "mirror_syncs",
+                          "readback_us", "rebases")}
+            for cl in clusters:
+                for s in cl:
+                    s.set_devapply(False)
+            dev_off = run_point(len(points) + 2, best["conns"],
+                                best["batch_width"], wire_fmt)
+            for cl in clusters:
+                for s in cl:
+                    s.set_devapply(True)
+            devapply = {
+                "enabled": True,
+                "control_off": dev_off,
+                "speedup": (round(best["value"] / dev_off["value"], 2)
+                            if dev_off["value"] > 0 else None),
+                "counters": dev_counters,
+                "snapshot_cut": _devapply_cut_profile(),
+                "note": "main sweep applies on-device (columnar apply, "
+                        "chain store, lazily-synced mirror); control "
+                        "re-runs the best point with the host-dict "
+                        "engine on the same cluster",
+            }
+        else:
+            devapply = {
+                "enabled": dev_on,
+                "control_off": None,
+                "speedup": None,
+                "counters": None,
+                "snapshot_cut": (_devapply_cut_profile()
+                                 if dev_on else None),
+                "note": "devapply off (TPU6824_DEVAPPLY=0) or A/B "
+                        "skipped (BENCH_DEVAPPLY_AB=0)",
+            }
         # Per-client order + exact-once spot check: a client key holds
         # exactly its consecutive markers from 0 (prefix of its stream).
         from tpu6824.rpc import transport as _tr
@@ -1470,11 +1551,14 @@ def _clerk_frontend_rate():
         "latency": best.get("latency"),
         "sweep": sweep,
         "native_ingest": native_ingest,
+        "devapply": devapply,
         "waterfall": waterfall,
         "protocol": clerk_protocol,
         "knobs": "TPU6824_FRONTEND_OP_TIMEOUT, TPU6824_FRONTEND_DEPTH; "
                  "BENCH_FE_GROUPS/INSTANCES/SWEEP/SECONDS, BENCH_FE_WIRE, "
-                 "BENCH_FE_OPSCOPE_AB, TPU6824_OPSCOPE",
+                 "BENCH_FE_OPSCOPE_AB, TPU6824_OPSCOPE; "
+                 "TPU6824_DEVAPPLY(_SLOTS/_CHAIN/_SYNC), "
+                 "BENCH_DEVAPPLY_AB, BENCH_DEVAPPLY_CUT_SIZES",
     }
 
 

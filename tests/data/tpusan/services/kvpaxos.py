@@ -1,0 +1,42 @@
+"""Golden: host dict walks on the decided path (host-walk-in-decided-path).
+
+Three canonical shapes the rule must catch in an RSM apply/drain body:
+a direct `self.kv[op.key]` walk, a local-alias walk (`kv = self.kv`),
+and a bound-verb alias walk (`kv_get = kv.get`).  The cid-keyed dup
+probe must stay clean — the rule keys on the op's `.key`, not on every
+dict access.
+"""
+
+
+class Server:
+    def __init__(self):
+        self.kv = {}
+        self.dup = {}
+        self.applied = -1
+
+    def evict(self, key):
+        # Trim path so the store does not also trip unbounded-host-state
+        # (this golden isolates the decided-walk rule).
+        self.kv.pop(key, None)
+        self.dup.pop(key, None)
+
+    def _apply(self, op):
+        seen = self.dup.get(op.cid, -1)  # cid-keyed: NOT a walk finding
+        if op.cseq <= seen:
+            return None
+        if op.kind == "get":
+            return self.kv.get(op.key, "")
+        self.kv[op.key] = self.kv.get(op.key, "") + op.value
+        return ""
+
+    def _apply_batch_locked(self, vals):
+        kv = self.kv
+        kv_get = kv.get
+        for v in vals:
+            kv[v.key] = kv_get(v.key, "") + v.value
+
+    def drain_decided(self, runs):
+        for run in runs:
+            for op in run:
+                key = op.key
+                self.kv[key] = op.value

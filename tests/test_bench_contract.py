@@ -111,6 +111,21 @@ def test_one_json_line_with_required_keys():
     ab = wf["overhead_ab"]
     assert ab is not None and ab["on_ops_s"] > 0 and ab["off_ops_s"] > 0
     assert ab["overhead_frac"] is not None, ab
+    # devapply provenance (ISSUE 16): every recorded run must carry the
+    # device-apply A/B (the sweep's headline IS the on arm; the control
+    # re-runs the best shape with the host-dict engine) and the
+    # snapshot-cut flatness profile at store sizes ≥10× apart — or the
+    # "evict Python from the decided path" claim has no artifact trail
+    # and benchdiff cannot gate the new entries.
+    da = few["devapply"]
+    assert da["enabled"] is True, da
+    assert da["control_off"] and da["control_off"]["value"] > 0, da
+    assert da["speedup"] is not None, da
+    cut = da["snapshot_cut"]
+    assert len(cut["sizes"]) >= 2 and \
+        cut["sizes"][-1] >= 10 * cut["sizes"][0], cut
+    assert all(us > 0 for us in cut["cut_us"]), cut
+    assert cut["ratio"] is not None, cut
     # Overload provenance (ISSUE 12, netfault): every recorded run must
     # carry the overload leg — measured capacity, the 1×/2×/4× offered-
     # load table (goodput, explicit-shed fraction, p99), and the leg's

@@ -1,0 +1,342 @@
+"""devapply acceptance (ISSUE 16): device-resident columnar apply.
+
+Covers the tentpole's correctness surface end to end:
+  - the engine against a plain-dict reference model through forced
+    rebases (tiny tables so chain-collapse + intern GC actually fire);
+  - `DevVal` unit contract: str-equal everywhere, bytes memoized for
+    the native reply ring, pickles back to a plain str;
+  - at-most-once across dup replay with the device engine applying
+    (same (cid, cseq) twice — including against another replica and a
+    stale get replay after a newer write);
+  - the fixed-seed nemesis composite on BOTH frontend engines with
+    devapply forced on (Wing–Gong checked by the shared soak) — the
+    applied-ops counter proves the device path actually ran;
+  - a MIXED group (device replicas + one host control arm flipped live
+    via `set_devapply`) converging to identical views — the strongest
+    device-vs-host identity check, arbitrated by consensus itself;
+  - snapshot blobs host-vs-dev: same log prefix, equal decoded blobs,
+    every value a plain str (DevVal never leaks into a snapshot), and
+    canonical-order pickles byte-identical;
+  - snapshot-install catch-up landing IN the device table of a revived
+    replica (not just its mirror);
+  - jitguard: ZERO steady-state recompiles through apply + snapshot +
+    compact cycles (the warmup ladder covers every drain bucket).
+"""
+
+import functools
+import pickle
+import random
+import time
+
+import pytest
+
+from tpu6824.core.devapply_kernel import K_APPEND, K_GET, K_PUT
+from tpu6824.harness.nemesis import seed_from_env
+from tpu6824.obs import metrics as obs_metrics
+from tpu6824.rpc.native_server import native_available
+from tpu6824.services import horizon
+from tpu6824.services.devapply import DevApplyEngine, DevVal
+from tpu6824.services.frontend import ClerkFrontend
+from tpu6824.services.kvpaxos import Clerk, KVPaxosServer, make_cluster
+from tpu6824.utils.errors import OK, ErrNoKey
+
+NATIVE = native_available()
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _ctr(name):
+    return obs_metrics.snapshot()["counters"].get(name, {}).get("total", 0)
+
+
+def _teardown(fabric, servers):
+    for s in servers:
+        s.kill()
+    fabric.stop_clock()
+
+
+# ------------------------------------------------------------ engine unit
+
+
+def test_engine_matches_dict_model_through_rebases(monkeypatch):
+    """The engine against a plain-dict reference model, with tables
+    sized so the run MUST rebase (chain collapse + intern GC) several
+    times: gets (hit and miss), puts, appends, mirror syncs — every
+    reply and every synced mirror identical to the model throughout."""
+    monkeypatch.setenv("TPU6824_DEVAPPLY_BUCKET", "64")
+    eng = DevApplyEngine(slots=64, chain=256, sync_every=10**9)
+    model: dict = {}
+    # 30 live keys + a worst-case 16-op batch of all-new keys stays
+    # under the 0.85 load ceiling (54 for 64 slots) after a rebase.
+    keys = [f"k{i}" for i in range(30)]
+    rng = random.Random(1606)
+    rebases0 = _ctr("devapply.rebases")
+    seq = -1
+    for batch in range(100):
+        nb = rng.randrange(1, 17)
+        eng.batch_reset(nb)
+        gets = []
+        for _ in range(nb):
+            k = rng.choice(keys)
+            r = rng.random()
+            if r < 0.25:
+                j = eng.batch_op(K_GET, k, "")
+                gets.append((j, model.get(k)))
+            elif r < 0.55:
+                v = f"v{rng.randrange(1000)},"
+                eng.batch_op(K_PUT, k, v)
+                model[k] = v
+            else:
+                v = f"a{rng.randrange(1000)},"
+                eng.batch_op(K_APPEND, k, v)
+                model[k] = model.get(k, "") + v
+            seq += 1
+        out = dict(eng.batch_commit(seq))
+        for j, want in gets:
+            got = eng.get_reply(out[j])
+            expect = (OK, want) if want is not None else (ErrNoKey, "")
+            assert got == expect, (batch, j, got, expect)
+        if batch % 20 == 19:
+            assert eng.sync_mirror() == model
+    assert eng.last_applied == seq
+    assert eng.sync_mirror() == model
+    assert _ctr("devapply.rebases") > rebases0, \
+        "tables this small must have rebased — the GC path never ran"
+    assert eng.nkeys <= len(keys), "rebase failed to GC dead intern ids"
+    assert 0.0 < eng.table_load() <= 0.85
+
+
+def test_devval_is_a_str_with_memoized_bytes():
+    v = DevVal("hello")
+    assert v == "hello" and isinstance(v, str)
+    assert {v: 1}[str("hello")] == 1  # hashes/compares as the plain str
+    b = v.bytes()
+    assert b == b"hello"
+    assert v.bytes() is b, "bytes() must memoize (native ring contract)"
+    rt = pickle.loads(pickle.dumps(v))
+    assert type(rt) is str and rt == "hello", \
+        "DevVal must pickle as a plain str (snapshot/wire neutrality)"
+
+
+# ------------------------------------------------------ at-most-once
+
+
+def test_dup_replay_applies_once_with_devapply():
+    """Exactly-once under replay with the device engine applying: the
+    same (cid, cseq) append twice — against the same replica AND a
+    sibling — lands once; a stale get replay after a newer write still
+    returns the reply it originally got (dedup, not re-execution)."""
+    fabric, servers = make_cluster(3, ninstances=64, devapply=True)
+    try:
+        err, _ = servers[0].put_append("append", "k", "A", 7001, 1)
+        assert err == OK
+        err, _ = servers[0].put_append("append", "k", "A", 7001, 1)
+        assert err == OK
+        ck = Clerk(servers)
+        assert ck.get("k") == "A"
+        err, _ = servers[1].put_append("append", "k", "A", 7001, 1)
+        assert err == OK
+        assert ck.get("k") == "A", "sibling replay re-applied the append"
+        err, v1 = servers[0].get("k", 7002, 1)
+        assert (err, v1) == (OK, "A")
+        err, _ = servers[0].put_append("append", "k", "B", 7001, 2)
+        assert err == OK
+        err, v2 = servers[0].get("k", 7002, 1)  # stale replay
+        assert (err, v2) == (OK, "A"), "get replay re-executed, not deduped"
+        assert ck.get("k") == "AB"
+    finally:
+        _teardown(fabric, servers)
+
+
+# ------------------------------------------------------ nemesis (ACCEPT)
+
+
+@pytest.mark.nemesis
+@pytest.mark.parametrize("engine",
+                         (["native", "fallback"] if NATIVE
+                          else ["fallback"]))
+def test_devapply_nemesis_soak(tmp_path, engine, nemesis_report,
+                               monkeypatch):
+    """ACCEPTANCE: the fixed-seed nemesis composite (partitions /
+    kill-revive / unreliable wire) with devapply forced on via the env
+    knob, on BOTH frontend engines.  The shared soak checks
+    per-client append integrity and Wing–Gong linearizability; the
+    applied-ops counter delta proves the device path (not the host
+    fallback) did the applying."""
+    import tests.test_frontend as tf
+
+    monkeypatch.setenv("TPU6824_DEVAPPLY", "1")
+    if engine == "fallback":
+        monkeypatch.setattr(
+            tf, "ClerkFrontend",
+            functools.partial(ClerkFrontend, prefer_native=False))
+    applied0 = _ctr("devapply.applied_ops")
+    tf._frontend_nemesis_soak(tmp_path, "xla", seed_from_env(1636),
+                              duration=1.2, nemesis_report=nemesis_report,
+                              wire_format="native")
+    assert _ctr("devapply.applied_ops") > applied0, \
+        "TPU6824_DEVAPPLY=1 did not reach the servers' apply path"
+
+
+# ------------------------------------------------- device-vs-host identity
+
+
+def test_mixed_dev_host_replicas_converge():
+    """One group, device replicas plus a HOST control arm (flipped live
+    via set_devapply, which also exercises the runtime A/B toggle):
+    after a fixed-seed mixed workload with snapshots + compaction live,
+    every replica's view is identical.  Consensus arbitrates — any
+    device/host apply divergence shows up as a view mismatch."""
+    fabric, servers = make_cluster(3, ninstances=128, snapshot_every=24,
+                                   dup_retire_ops=64, devapply=True)
+    try:
+        servers[2].set_devapply(False)  # host control arm
+        assert servers[2]._dev is None and servers[0]._dev is not None
+        rng = random.Random(866)
+        ck = Clerk(servers)
+        for i in range(120):
+            k = f"k{rng.randrange(12)}"
+            if rng.random() < 0.5:
+                ck.put(k, f"v{i},")
+            else:
+                ck.append(k, f"a{i},")
+            if i % 17 == 0:
+                ck.get(k)
+            if i == 60:
+                # Flip a device replica off and back on mid-stream: the
+                # off→on edge reloads the device table from the mirror.
+                servers[1].set_devapply(False)
+                servers[1].set_devapply(True)
+        lead = max(s.applied for s in servers)
+        _wait(lambda: all(s.applied >= lead for s in servers),
+              msg="replica convergence")
+        views = [dict(s.kv_view()) for s in servers]
+        assert views[0] == views[1] == views[2]
+        assert len(views[0]) == 12
+    finally:
+        _teardown(fabric, servers)
+
+
+def test_snapshot_blob_identical_host_vs_dev():
+    """Two 1-replica groups fed the identical op sequence, one host one
+    device, snapshot cut nudged at the same quiesced log position: the
+    decoded blobs must be EQUAL, every value a plain str (DevVal's
+    __reduce__ contract), and the canonical-order pickles
+    byte-identical — installs and spills never depend on which engine
+    cut them."""
+    blobs = {}
+    for mode in (False, True):
+        fabric, servers = make_cluster(1, ninstances=128,
+                                       snapshot_every=1000,
+                                       dup_retire_ops=0, devapply=mode)
+        s = servers[0]
+        try:
+            cid = 4242
+            for i in range(20):
+                if i % 5 == 4:
+                    err, _ = s.get("k0", cid, i + 1)
+                else:
+                    err, _ = s.put_append("append" if i % 2 else "put",
+                                          f"k{i % 7}", f"v{i},", cid, i + 1)
+                assert err == OK
+            assert s.applied == 19
+            s.horizon.nudged = True  # force a cut at this exact position
+            _wait(lambda: s.horizon.snap is not None
+                  and s.horizon.snap[0] == 19, msg="nudged snapshot cut")
+            blobs[mode] = horizon.decode_snapshot(s.horizon.snap[1])
+        finally:
+            _teardown(fabric, servers)
+    host, dev = blobs[False], blobs[True]
+    assert dev["applied"] == host["applied"] == 19
+    assert dev["kv"] == host["kv"] and len(dev["kv"]) == 7
+    assert all(type(v) is str for v in dev["kv"].values()), \
+        "DevVal leaked into a snapshot blob"
+    assert sorted(dev["dup"]) == sorted(host["dup"])
+    assert (pickle.dumps(sorted(dev["kv"].items()))
+            == pickle.dumps(sorted(host["kv"].items())))
+
+
+# --------------------------------------------------- snapshot install
+
+
+def test_snapshot_install_lands_in_device_store():
+    """A device-backed replica revived behind the GC horizon installs a
+    peer snapshot INTO its device table (load_from_dict on adopt): the
+    keys land in the intern/key tables, replay continues on-device, and
+    at-most-once holds across the install."""
+    fabric, servers = make_cluster(3, ninstances=128, snapshot_every=24,
+                                   dup_retire_ops=64, devapply=True)
+    try:
+        ck = Clerk(servers)
+        for i in range(30):
+            ck.put(f"pre{i}", f"p{i}")
+        pre_cid, pre_cseq = ck.cid, ck.cseq
+        servers[2].kill()
+        for i in range(60):
+            ck.put(f"mid{i}", f"m{i}")
+        _wait(lambda: servers[0].horizon.written >= 1,
+              msg="donor snapshot")
+        fabric.revive(0, 2)
+        fresh = KVPaxosServer(fabric, 0, 2, snapshot_every=24,
+                              dup_retire_ops=64, peers=servers,
+                              devapply=True)
+        servers[2] = fresh
+        _wait(lambda: fresh._behind_min == 0 and fresh.applied >= 60,
+              msg=f"snapshot-install catch-up (applied={fresh.applied}, "
+                  f"behind={fresh._behind_min})")
+        _wait(lambda: fresh.applied >= servers[0].applied - 2,
+              msg="replay to the donors' watermark")
+        dev = fresh._dev
+        assert dev is not None
+        # The install landed in the DEVICE table, not just the mirror:
+        # every key is interned (nkeys counts the device key table).
+        _wait(lambda: dev.nkeys >= 90, msg="device table population")
+        view = fresh.kv_view()  # mirror sync straight off the device
+        assert all(view.get(f"mid{i}") == f"m{i}" for i in range(60))
+        assert all(view.get(f"pre{i}") == f"p{i}" for i in range(30))
+        err, _ = fresh.put_append("put", "pre29", "CLOBBER",
+                                  pre_cid, pre_cseq)
+        assert err == OK
+        assert fresh.kv_view()["pre29"] == "p29", \
+            "install lost the dup filter"
+    finally:
+        _teardown(fabric, servers)
+
+
+# ------------------------------------------------------------ jitguard
+
+
+def test_jitguard_zero_steady_state_recompiles():
+    """ACCEPTANCE: a warmed device-backed group re-dispatches cached
+    executables forever — the warmup ladder covers every drain bucket,
+    so steady traffic THROUGH snapshot + compact cycles (both happen
+    inside the guard at this cadence) must compile nothing."""
+    from tpu6824.analysis.jitguard import RecompileGuard
+
+    fabric, servers = make_cluster(3, ninstances=256, snapshot_every=16,
+                                   dup_retire_ops=32, devapply=True)
+    try:
+        ck = Clerk(servers)
+        for i in range(80):  # warm: apply + first snapshot/compact cycles
+            ck.append(f"w{i % 9}", f"v{i},")
+        _wait(lambda: all(s.horizon.written >= 1 for s in servers),
+              msg="first snapshot cycle")
+        written0 = min(s.horizon.written for s in servers)
+        with RecompileGuard() as g:
+            for i in range(48):
+                ck.append(f"w{i % 9}", f"s{i},")
+                if i % 7 == 0:
+                    ck.get(f"w{i % 9}")
+        assert g.compiles == 0, \
+            "steady-state recompile on the devapply path"
+        assert min(s.horizon.written for s in servers) > written0, \
+            "guard window missed the snapshot/compact cycle it must cover"
+    finally:
+        _teardown(fabric, servers)

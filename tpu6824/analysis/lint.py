@@ -140,6 +140,16 @@ RULES: dict[str, str] = {
         "invariant); compute durations from time.monotonic()/"
         "monotonic_ns(), keep time.time() for human-facing timestamps "
         "only",
+    "host-walk-in-decided-path":
+        "per-op host dict walk keyed by the op's key (store[op.key] "
+        "get/set, store.get(op.key)) inside an `_apply*` / decide-drain "
+        "function of a decided-path service module — the decided path "
+        "applies as ONE columnar device step (ISSUE 16 devapply: intern "
+        "probe + int columns, no per-op dict walk, no per-op str "
+        "concat); non-hot ops that legitimately stay host-side "
+        "(reconfig/compact/txn, the host fallback engine) carry "
+        "justified suppressions — that inventory IS the hot-path "
+        "contract",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -187,6 +197,16 @@ _DECODE_TAILS = {"unpack", "unpack_from", "from_bytes"}
 # Commit-wait scope (blocking-commit-wait): the service layer, where
 # RSM apply paths and server mutexes live.
 _COMMIT_SCOPE = ("services/",)
+# Decided-path scope (host-walk-in-decided-path): the RSM services whose
+# apply/drain loops the devapply columnar contract covers (ISSUE 16).
+# Key-keyed store walks there belong on the device; cid-keyed waiter/dup
+# probes are O(1) bookkeeping and are NOT flagged (the rule keys on the
+# op's `.key`).
+_DECIDED_SCOPE = ("services/kvpaxos.py", "services/shardkv.py",
+                  "services/txnkv.py")
+# The dict verbs that constitute a store walk when their key argument is
+# the op's key.
+_DECIDED_WALK_VERBS = {"get", "setdefault"}
 # Retry-loop scope (unbounded-retry): anywhere clerks/transports retry
 # RPCs.  A loop counts as BOUNDED when its body references any of these
 # identifier substrings (deadlines, budgets, backoffs, timeouts) or
@@ -352,12 +372,14 @@ class _FileLint(ast.NodeVisitor):
         self.retry_scope = _in_scope(relpath, _RETRY_SCOPE)
         self.commit_scope = _in_scope(relpath, _COMMIT_SCOPE)
         self.walldur_scope = _in_scope(relpath, _WALLDUR_SCOPE)
+        self.decided_scope = _in_scope(relpath, _DECIDED_SCOPE)
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
         self._jit_defs = self._resolve_jit_defs()
         self._scan_persistence()
         self._scan_apply_growth()
+        self._scan_decided_walks()
         self._scan_eventloop_callbacks()
         self._scan_native_decode()
         self._scan_obs_buffers()
@@ -558,6 +580,106 @@ class _FileLint(ast.NodeVisitor):
                            f"{cls.name} with no trim/GC/snapshot-"
                            "replace path anywhere in the class — "
                            "unbounded host state on the decided path")
+
+    def _scan_decided_walks(self) -> None:
+        """host-walk-in-decided-path: inside `_apply*` / `*drain*`
+        functions of the decided-path services, flag per-op host store
+        walks keyed by the op's key — subscript get/set on a self-attr
+        dict (or a local alias of one: `kv = self.kv`), `.get`/
+        `.setdefault` calls on them, and calls through bound-verb
+        aliases (`kv_get = kv.get`).  A walk counts only when its key
+        expression derives from the op's key (`v.key` / `op.key` / a
+        `key` local), so cid-keyed waiter/dup bookkeeping stays clean.
+        One finding per (function, attr), at the first walk site."""
+        if not self.decided_scope:
+            return
+
+        def self_attr(node) -> str | None:
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return node.attr
+            return None
+
+        def keyish(node) -> bool:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Attribute) and n.attr == "key":
+                    return True
+                if isinstance(n, ast.Name) and n.id == "key":
+                    return True
+            return False
+
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (fn.name.startswith("_apply") or "drain" in fn.name):
+                continue
+            # Pass 1: alias maps.  `kv = self.kv` names the store;
+            # `kv_get = kv.get` / `kv_get = self.kv.get` binds a walk
+            # verb to it.
+            store_alias: dict[str, str] = {}
+            verb_alias: dict[str, str] = {}
+            for n in ast.walk(fn):
+                if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Name)):
+                    continue
+                name = n.targets[0].id
+                attr = self_attr(n.value)
+                if attr is not None:
+                    store_alias[name] = attr
+                    continue
+                v = n.value
+                if isinstance(v, ast.Attribute) \
+                        and v.attr in _DECIDED_WALK_VERBS:
+                    base = self_attr(v.value)
+                    if base is None and isinstance(v.value, ast.Name):
+                        base = store_alias.get(v.value.id)
+                    if base is not None:
+                        verb_alias[name] = base
+
+            def store_of(node) -> str | None:
+                a = self_attr(node)
+                if a is not None:
+                    return a
+                if isinstance(node, ast.Name):
+                    return store_alias.get(node.id)
+                return None
+
+            first: dict[str, ast.AST] = {}  # attr -> earliest walk site
+
+            def flag(site, attr):
+                # ast.walk is breadth-first, not source order: keep the
+                # EARLIEST site so the finding (and its suppression)
+                # anchors where a reader first meets the walk.
+                prev = first.get(attr)
+                if prev is None or site.lineno < prev.lineno:
+                    first[attr] = site
+
+            # Pass 2: walk sites.
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Subscript):
+                    attr = store_of(n.value)
+                    if attr is not None and keyish(n.slice):
+                        flag(n, attr)
+                elif isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Attribute) \
+                            and f.attr in _DECIDED_WALK_VERBS:
+                        attr = store_of(f.value)
+                        if attr is not None and n.args \
+                                and any(keyish(a) for a in n.args):
+                            flag(n, attr)
+                    elif isinstance(f, ast.Name) and f.id in verb_alias \
+                            and n.args and any(keyish(a) for a in n.args):
+                        flag(n, verb_alias[f.id])
+            for attr, site in sorted(first.items(),
+                                     key=lambda kv: kv[1].lineno):
+                self._flag(site, "host-walk-in-decided-path",
+                           f"self.{attr} walked per op by key in "
+                           f"{fn.name} — the decided path applies as "
+                           "one columnar device step (devapply); keep "
+                           "key-addressed state off the host here or "
+                           "justify why this op class stays host-side")
 
     def _scan_eventloop_callbacks(self) -> None:
         """blocking-in-eventloop: inside an event-loop callback (`_on_*`

@@ -1,0 +1,201 @@
+"""Device-resident columnar KV apply kernel (ISSUE 16, devapply).
+
+The hot kvpaxos state machine — get/put/append over interned ids — as a
+pure function of fixed-shape device arrays, so the decided path applies
+a whole drain in ONE jitted device step instead of a per-op host dict
+walk under the server mutex.
+
+State layout (all int32; ids are dense host-assigned intern indices, so
+int32 is exact and x64 is never needed):
+
+  - ``tbl_kid[S+1]``  open-addressed key table: slot → key id, -1 empty.
+    S is a power of two (``TPU6824_DEVAPPLY_SLOTS``); slot S is a guard
+    row that absorbs predicated no-op scatters so the step stays
+    branch-free.
+  - ``tbl_node[S+1]`` slot → chain node id of the key's current value.
+  - ``chain_vid[C+1]`` / ``chain_prev[C+1]`` append chains: node →
+    (value id, previous node).  A put starts a fresh chain (prev = -1);
+    an append links a new node onto the key's current one.  Values stay
+    interned on the host — the device never sees bytes, only ids — and
+    a chain is resolved to a string at readback (services/devapply.py),
+    once, memoized.  Node C is the guard row.
+  - ``n_chain``      bump cursor: next free chain node.
+
+The step is FULLY VECTORIZED — one gather plus three masked scatters
+over op columns padded to a `core.jitshape` bucket, no scan and no
+probe loop.  The sequential parts of the state machine are integer
+bookkeeping the host already does for free while interning: slot
+assignment (open-addressed probing against the host's shadow of
+``tbl_kid`` — the engine owns collision handling), chain-node
+allocation (writes take consecutive nodes, so node ids are known at
+column-build time), and same-drain read-after-write (the predecessor
+node of an op whose key was written earlier in the drain is a
+host-known int).  What the device contributes is the O(batch) state
+update against O(store) persistent arrays and the pre-node gather for
+keys LAST written in some earlier drain — the actual state residency.
+A first-generation kernel did the probing and ordering on-device with
+``lax.scan`` + ``while_loop``; at 512-op buckets the sequential scan
+cost ~16µs/op on CPU and would serialize just as badly on a real
+accelerator — scatter/gather is the shape this machine is fast at.
+
+Column contract: ONE packed ``(8, bucket)`` int32 matrix per step — a
+single host→device transfer per chunk (per-column transfers cost 2×
+the step itself on the CPU backend).  Rows, with their pad fills:
+
+  - ``C_KIND``  op kind (K_NOP pad fill — its lane reads back -1).
+  - ``C_SLOT``  the key's table slot (host-assigned; S for pads).
+  - ``C_KID``   key id (for the table scatter; 0 pad).
+  - ``C_VID``   value id for writes, 0 otherwise.
+  - ``C_NODE``  absolute chain node for writes, -1 for gets/pads.
+  - ``C_PREV``  absolute predecessor node when the key was written
+    earlier in this drain, -1 → gather ``tbl_node[slot]`` instead.
+  - ``C_TMASK`` nonzero on the op that is its key's LAST write in this
+    batch — only that op scatters into the table, so duplicate slot
+    indices never race (guard-row duplicates are junk-writes to a row
+    nothing reads).
+  - ``C_NC``    column 0 carries the bump cursor after this batch
+    (host-known — writes take consecutive nodes).
+
+The step returns, per op, the key's chain node BEFORE the op — which is
+the get result, and the append's prev link — so ONE readback per drain
+serves both reply resolution and the host chain shadow.
+
+Jit/shard-ready by construction: ``apply_step`` is a pure state→state
+function of one group's arrays with no host callbacks, so ROADMAP
+item 1's ``shard_map`` over the ``'g'`` mesh axis composes by stacking
+per-group states on a leading axis (``apply_step_groups`` is exactly
+that ``vmap``); nothing in the kernel closes over host state.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Op kind codes for the device columns.  K_NOP is the pad fill: it
+# neither gathers usefully nor writes (its output is masked to -1 and
+# discarded by the host, which only reads back the first n live lanes).
+K_NOP, K_GET, K_PUT, K_APPEND = 0, 1, 2, 3
+
+# Rows of the packed op-column matrix.
+C_KIND, C_SLOT, C_KID, C_VID, C_NODE, C_PREV, C_TMASK, C_NC = range(8)
+N_COLS = 8
+
+# Per-row pad fill, as a column vector: `fills(S)[:, None]` broadcast
+# over the pad region restores a reused host buffer in one store.
+def col_fills(slots: int) -> np.ndarray:
+    f = np.zeros((N_COLS, 1), np.int32)
+    f[C_KIND, 0] = K_NOP
+    f[C_SLOT, 0] = slots
+    f[C_NODE, 0] = -1
+    f[C_PREV, 0] = -1
+    return f
+
+# Fibonacci-hash multiplier (0x9E3779B1) for the host-side slot probe.
+# Slot assignment lives entirely on the host (`host_insert` against the
+# engine's shadow of tbl_kid); the device consumes assigned slots.  The
+# int32 form is DERIVED from the one constant — a hand-typed twin once
+# differed by 8 and sent every host-built table's probes to the wrong
+# slots (kept as a guard for any future device-side probe).
+_MIX = 0x9E3779B1
+_MIX_I32 = np.uint32(_MIX).astype(np.int32)
+
+
+class DevKVState(NamedTuple):
+    """One group's device-resident KV table (a jax pytree)."""
+
+    tbl_kid: jax.Array
+    tbl_node: jax.Array
+    chain_vid: jax.Array
+    chain_prev: jax.Array
+    n_chain: jax.Array  # int32 scalar
+
+
+def make_state(slots: int, chain: int) -> DevKVState:
+    """Fresh empty state; `slots` must be a power of two."""
+    if slots & (slots - 1):
+        raise ValueError(f"devapply slots must be a power of two: {slots}")
+    return DevKVState(
+        tbl_kid=jnp.full(slots + 1, -1, jnp.int32),
+        tbl_node=jnp.full(slots + 1, -1, jnp.int32),
+        chain_vid=jnp.zeros(chain + 1, jnp.int32),
+        chain_prev=jnp.full(chain + 1, -1, jnp.int32),
+        n_chain=jnp.int32(0),
+    )
+
+
+def host_slot_iter(kid: int, slots: int):
+    """The open-addressed probe sequence for `kid` (Fibonacci hash,
+    linear step).  This is THE slot-assignment authority: the engine
+    probes its host shadow of ``tbl_kid`` with it and hands the device
+    resolved slots in the op columns."""
+    mask = slots - 1
+    h = ((kid ^ (kid >> 16)) * _MIX) & 0xFFFFFFFF
+    s = h & mask
+    for _ in range(slots):
+        yield s
+        s = (s + 1) & mask
+
+
+def host_insert(tbl_kid: np.ndarray, slots: int, kid: int) -> int:
+    """Insert (or find) `kid` in a host numpy table; returns the slot."""
+    for s in host_slot_iter(kid, slots):
+        k = tbl_kid[s]
+        if k == kid or k == -1:
+            tbl_kid[s] = kid
+            return s
+    raise RuntimeError("devapply host table full (rebase threshold bug)")
+
+
+def _apply_cols(state: DevKVState, cols):
+    """One batched apply step over a packed (8, bucket) op matrix:
+    gather pre-nodes, scatter the chain and table updates.  Returns
+    (new state, per-op pre-node column)."""
+    kinds, slots, kids = cols[C_KIND], cols[C_SLOT], cols[C_KID]
+    vids, nodes, prevs = cols[C_VID], cols[C_NODE], cols[C_PREV]
+    tmask = cols[C_TMASK]
+    new_nc = cols[C_NC, 0]
+    neg1 = jnp.int32(-1)
+    guard_c = jnp.int32(state.chain_vid.shape[0] - 1)
+    guard_s = jnp.int32(state.tbl_kid.shape[0] - 1)
+    # Pre-node per op: host-known for same-drain read-after-write,
+    # gathered from the table otherwise.  Pads gather the guard row;
+    # masked to -1 so the readback column is clean end to end.
+    pre = jnp.where(prevs >= 0, prevs, state.tbl_node[slots])
+    pre = jnp.where(kinds == K_NOP, neg1, pre)
+    # Chain scatter: every write owns a distinct pre-assigned node, so
+    # indices never collide; non-writes land on the guard row.
+    iswrite = nodes >= 0
+    cidx = jnp.where(iswrite, nodes, guard_c)
+    chain_vid = state.chain_vid.at[cidx].set(
+        jnp.where(iswrite, vids, jnp.int32(0)))
+    chain_prev = state.chain_prev.at[cidx].set(
+        jnp.where(iswrite & (kinds == K_APPEND), pre, neg1))
+    # Table scatter: only each key's last write in the batch (tmask)
+    # touches its slot — live indices are unique by construction.
+    live = tmask != 0
+    tslot = jnp.where(live, slots, guard_s)
+    tbl_kid = state.tbl_kid.at[tslot].set(jnp.where(live, kids, neg1))
+    tbl_node = state.tbl_node.at[tslot].set(jnp.where(live, nodes, neg1))
+    return (DevKVState(tbl_kid, tbl_node, chain_vid, chain_prev,
+                       jnp.asarray(new_nc, jnp.int32)), pre)
+
+
+# The per-drain entry point: one compiled executable per (S, C, bucket)
+# triple — S and C are fixed per process by env, buckets come from the
+# finite jitshape ladder, so the signature set is finite (jitguard
+# zero-steady-state-recompile contract).
+#
+# The state is DONATED: scatters update the persistent arrays in place
+# instead of copying ~1.3MB of table+chain per step (the XLA functional
+# default).  Callers must treat the passed-in state as consumed and
+# chain the returned one; anything that must outlive the next step
+# (the snapshot cut) copies out first.
+apply_step = jax.jit(_apply_cols, donate_argnums=0)
+
+# shard_map composition hook (ROADMAP item 1): per-group states stacked
+# on a leading 'g' axis apply in one collective-free batched step.
+apply_step_groups = jax.jit(jax.vmap(_apply_cols), donate_argnums=0)

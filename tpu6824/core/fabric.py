@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu6824.core.intern import Intern
+from tpu6824.core.jitshape import pad_i32 as _jitshape_pad_i32
 from tpu6824.core.kernel import (
     NO_VAL, NPROTO, PROTO_ENABLED, PROTO_FIELDS, apply_starts,
     apply_starts_compact, init_state,
@@ -948,13 +949,11 @@ class PaxosFabric:
             self._dummy_keys = ks
         return self._dummy_keys
 
-    @staticmethod
-    def _pad_i32(arr, fill: int, bucket: int):
-        out = np.full(bucket, fill, np.int32)
-        n = 0 if arr is None else len(arr)
-        if n:
-            out[:n] = arr
-        return jnp.asarray(out)
+    # Shared jit-shape discipline (core/jitshape.py): the injection path
+    # and the devapply decided-path kernel (ISSUE 16) pad through ONE
+    # implementation, so every host→device handoff in the tree carries
+    # the same fixed-bucket signature guarantees jitguard enforces.
+    _pad_i32 = staticmethod(_jitshape_pad_i32)
 
     def _launch_compact(self):
         """Stage the queued ops and launch ONE fused dispatch

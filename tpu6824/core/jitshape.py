@@ -1,0 +1,67 @@
+"""jitshape — the shared jit-shape discipline for host→device handoffs.
+
+Every jitted entry point in this tree takes FIXED-shape operands: the
+fabric's injection path pads its (rows, cells, vids, seqs) columns to
+one of two bucket sizes, and the devapply kernel (ISSUE 16) pads its
+per-drain op columns to a geometric bucket ladder.  Variable-length
+batches hitting a jit boundary with their natural length would compile
+one executable per length — the jitguard zero-steady-state-recompile
+contract exists precisely because that failure mode is silent and slow.
+
+This module is that discipline, shared: pick a bucket from a fixed
+ladder (`bucket_for`), pad int32 columns into it (`pad_i32`).  The
+ladder is finite by construction, so the set of compiled signatures is
+finite; callers chunk batches larger than the top rung through repeated
+max-size calls (the fabric's chunked-injection pattern).
+
+Kept stdlib+numpy at import; jax is imported lazily so analysis tooling
+can import the module without a backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def bucket_ladder(lo: int, hi: int) -> tuple[int, ...]:
+    """The geometric (power-of-two) bucket ladder from `lo` to `hi`
+    inclusive — the full set of pad sizes a caller may produce, i.e.
+    the full set of jit signatures it can ever compile."""
+    lo = max(1, int(lo))
+    hi = max(lo, int(hi))
+    out = []
+    b = 1
+    while b < lo:
+        b <<= 1
+    while b < hi:
+        out.append(b)
+        b <<= 1
+    out.append(b)
+    return tuple(out)
+
+
+def bucket_for(n: int, ladder: tuple[int, ...]) -> int:
+    """Smallest rung holding `n` ops; the top rung for anything larger
+    (the caller chunks — see the fabric's injection loop)."""
+    for b in ladder:
+        if n <= b:
+            return b
+    return ladder[-1]
+
+
+def pad_i32(arr, fill: int, bucket: int):
+    """Pad (or create) an int32 column of exactly `bucket` slots, the
+    tail filled with `fill` (a guard row index, a NOP kind — whatever
+    the kernel treats as inert).  Returns a device array.
+
+    This is the fabric's `_pad_i32` (PR 4), extracted verbatim so the
+    decide-feed → apply-kernel handoff (ISSUE 16) and the injection
+    path share one pad implementation and one shape discipline.
+    """
+    import jax.numpy as jnp
+
+    out = np.full(bucket, fill, np.int32)
+    n = 0 if arr is None else len(arr)
+    if n:
+        out[:n] = arr
+    return jnp.asarray(out)

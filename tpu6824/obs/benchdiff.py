@@ -168,6 +168,22 @@ METRICS = [
            leg_shape=[("service", "clerk_frontend", "groups"),
                       ("service", "clerk_frontend", "conns"),
                       ("service", "clerk_frontend", "batch_width")]),
+    # devapply (ISSUE 16): the host-dict control arm at the best shape
+    # and the on/off speedup ratio — host-edge noisy like every
+    # clerk-path number; the ratio is one-box one-window like the
+    # ingest speedup, so steadier than either absolute value.
+    # Leg-shape-gated on the fe sweep shape; first recorded artifact
+    # (r10) baselines them, gated thereafter.
+    Metric(("service", "clerk_frontend", "devapply", "control_off",
+            "value"), 0.65, host_bound=True,
+           leg_shape=[("service", "clerk_frontend", "groups"),
+                      ("service", "clerk_frontend", "conns"),
+                      ("service", "clerk_frontend", "batch_width")]),
+    Metric(("service", "clerk_frontend", "devapply", "speedup"), 0.50,
+           host_bound=True,
+           leg_shape=[("service", "clerk_frontend", "groups"),
+                      ("service", "clerk_frontend", "conns"),
+                      ("service", "clerk_frontend", "batch_width")]),
     # Overload leg (ISSUE 12, netfault): goodput under 4× offered load
     # and the measured closed-loop capacity it is relative to.  Both
     # host-edge noisy like every clerk-path leg; gated on the leg's OWN

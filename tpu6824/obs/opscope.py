@@ -44,7 +44,11 @@ histogram observes destination_stamp − previous_stamp in µs):
     materialize  park → Op log entries built at proposal collection
     dispatch     materialize → proposal handed to the fabric
     decide       dispatch → decided value delivered by the feed
-    apply        decide-feed delivery → RSM apply done
+    apply        decide-feed delivery → RSM apply done — with devapply
+                 (ISSUE 16) this is the per-drain columnar DEVICE step
+                 (column build + one jitted apply + one readback), so a
+                 collapsed apply stage vs the r09 waterfall is the
+                 optimization landing, not a measurement gap
     reply        apply → notify-sweep push into the reply path
     flush        reply push → frame serialized + flushed (per frame)
 

@@ -228,19 +228,29 @@ class QueueGrowth(Rule):
     series = ("fabric.health.feed_depth_max",
               "frontend.native_ingest.inflight_ops",
               "txn.inflight")
+    # Occupancy FRACTIONS ride the same monotone-growth check with
+    # their own threshold: the devapply key-table load (ISSUE 16) names
+    # a near-full device table before the hard capacity raise — past
+    # ~0.85 the engine rebases, so sustained growth toward the limit
+    # means the keyspace is outgrowing TPU6824_DEVAPPLY_SLOTS.
+    frac_series = ("devapply.table_load_frac",)
 
-    def __init__(self, limit: float | None = None):
+    def __init__(self, limit: float | None = None,
+                 frac_limit: float | None = None):
         self.limit = _envf("TPU6824_WD_FEED_DEPTH", 1024.0) \
             if limit is None else limit
+        self.frac_limit = _envf("TPU6824_WD_TABLE_LOAD", 0.7) \
+            if frac_limit is None else frac_limit
 
     def check(self, wd):
-        for name in self.series:
+        for name, limit in [(n, self.limit) for n in self.series] \
+                + [(n, self.frac_limit) for n in self.frac_series]:
             pts = wd.points(name)
-            if len(pts) < 3 or pts[-1][1] < self.limit:
+            if len(pts) < 3 or pts[-1][1] < limit:
                 continue
             vs = [v for _, v in pts]
             if all(b >= a for a, b in zip(vs, vs[1:])) and vs[-1] > vs[0]:
-                return (f"{name} grew {vs[0]:.0f} -> {vs[-1]:.0f} over "
+                return (f"{name} grew {vs[0]:.3g} -> {vs[-1]:.3g} over "
                         f"the window (consumer falling behind)")
         return None
 

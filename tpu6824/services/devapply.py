@@ -1,0 +1,620 @@
+"""devapply — the host half of the device-resident columnar apply (ISSUE 16).
+
+`core/devapply_kernel.py` owns the device state and the jitted step;
+this module owns everything the device must never see: string→id
+interning, the per-drain column build, reply resolution from chain
+nodes back to interned strings, the lazily-synced host mirror, the
+snapshot cut, and capacity management (rebase).
+
+The decided-path contract (the tpusan `host-walk-in-decided-path` rule
+polices its other half in kvpaxos):
+
+  - Per op, the host does ONE key-intern probe (which memoizes the
+    key's table slot) plus O(1) integer bookkeeping — chain-node
+    allocation is a counter bump, same-drain read-after-write is a dict
+    lookup — and list appends.  No store-dict walk, no string
+    concatenation, no per-op device call.
+  - The jitted device step runs per FLUSH, not per drain: get-free
+    drains accumulate columns (padded to a `core.jitshape` bucket;
+    oversized batches chunk through the top rung) and flush on the next
+    drain with gets, on the size cap, or on a snapshot/mirror/rebase
+    boundary.  The flush's pre-node readback serves get replies and the
+    host chain shadow alike — and stays IN FLIGHT when no get needs it,
+    so the driver never blocks on the device between drains.
+  - Get replies resolve node→string through a memo: a single-node chain
+    returns the interned value string itself (zero new bytes), an
+    append chain concatenates ONCE and memoizes.  `DevVal` carries the
+    encoded bytes with the reply so the native reply ring pushes value
+    ids' bytes without re-encoding per reply.
+  - The mirror (the old `self.kv` dict, demoted) syncs from a device
+    readback on cadence, on snapshot cut, and on demand — never on the
+    decided path.
+
+Capacity: the chain store fills as writes accumulate and the intern
+tables grow with unique strings; a rebase (readback → resolve → rebuild
+with single-node chains and a GC'd intern set) bounds both.  The
+`devapply.table_load_frac` gauge names a near-full table before the
+hard ceiling raises (the watchdog queue-growth rule watches it).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import numpy as np
+
+from tpu6824.core import devapply_kernel as _dk
+from tpu6824.core.devapply_kernel import (
+    C_KID, C_KIND, C_NC, C_NODE, C_PREV, C_SLOT, C_TMASK, C_VID,
+    K_APPEND, K_GET, K_PUT, DevKVState, col_fills, host_insert,
+    make_state,
+)
+from tpu6824.core.jitshape import bucket_for, bucket_ladder
+from tpu6824.obs import metrics as _metrics
+from tpu6824.utils.errors import OK, ErrNoKey
+from tpu6824.utils.locks import new_rlock
+
+# Registry wiring (ISSUE 16 observability satellite): counters/gauges at
+# module scope per the metric-unregistered rule; pulse samples them with
+# the rest of the registry, watchdog watches the load gauge.
+_M_APPLIED = _metrics.counter("devapply.applied_ops")
+_M_SYNCS = _metrics.counter("devapply.mirror_syncs")
+_M_READBACK = _metrics.counter("devapply.readback_us")
+_M_REBASES = _metrics.counter("devapply.rebases")
+_M_LOAD = _metrics.gauge("devapply.table_load_frac")
+
+_KIND_CODE = {"get": K_GET, "put": K_PUT, "append": K_APPEND}
+
+# Rebase when the intern/key population would cross this fraction of the
+# table — past it, open-addressed probes cluster and a full table is a
+# liveness bug (the kernel's probe bound).
+_LOAD_MAX = 0.85
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class DevVal(str):
+    """A resolved get-reply value: a plain `str` everywhere (clerks,
+    dup table, history checkers compare it as one), plus the encoded
+    bytes memoized for the native reply ring — the ring pushes a value
+    id's bytes once per NODE, not once per reply."""
+
+    __slots__ = ("_b",)
+
+    def bytes(self) -> bytes:
+        b = getattr(self, "_b", None)
+        if b is None:
+            b = str.encode(self)
+            self._b = b
+        return b
+
+    def __reduce__(self):  # snapshots/wire pickle as the plain value
+        return (str, (str(self),))
+
+
+# Jit warmup memo: one compile pass per (slots, chain) shape per
+# process — every engine with the same env shares the executables.
+_WARMED: set = set()
+
+
+def _locked(fn):
+    """Serialize a public engine entry point on `self.emu`.  The lock
+    is reentrant because entry points nest (snapshot_resolve→resolve,
+    batch_reset→_rebase→load_from_dict→_flush) and a leaf: nothing
+    under it calls back out of the engine, so the server's `mu`→`emu`
+    order can never invert."""
+    @functools.wraps(fn)
+    def inner(self, *args, **kwargs):
+        with self.emu:
+            return fn(self, *args, **kwargs)
+    return inner
+
+
+class DevApplyEngine:
+    """One replica's device-resident KV apply state.
+
+    Thread contract: the decided path (batch_*) runs only on the
+    server's driver thread, but mirror/snapshot entry points are called
+    both OFF the server mutex (the driver's cadence sync, by design —
+    it must not hold `mu` through a readback) and UNDER it (kv_view,
+    set_devapply, snapshot install), and since the accumulate/flush
+    redesign those paths mutate shared column/flush state.  Every
+    public method therefore takes the engine's own reentrant leaf lock
+    `emu` (order: `mu` → `emu`, never inverted — the engine calls
+    nothing that takes `mu`).  `mirror` is still swapped whole, so
+    lock-free debug reads of the previous dict stay consistent.
+    """
+
+    def __init__(self, slots: int | None = None, chain: int | None = None,
+                 sync_every: int | None = None):
+        S = _pow2(max(64, slots if slots is not None
+                      else _env_int("TPU6824_DEVAPPLY_SLOTS", 1 << 15)))
+        C = max(256, chain if chain is not None
+                else _env_int("TPU6824_DEVAPPLY_CHAIN", 4 * S))
+        self.slots = S
+        self.chain = C
+        self._kcap = int(S * _LOAD_MAX)
+        self.sync_every = (sync_every if sync_every is not None
+                           else _env_int("TPU6824_DEVAPPLY_SYNC", 8192))
+        # The top rung doubles as the accumulate cap (get-free drains
+        # pile columns until it trips), so it sets the flush cadence:
+        # every flush is a device dispatch, and under a thread-heavy
+        # host each dispatch's GIL round-trip can eat a scheduler
+        # quantum — fewer, fatter steps win.  16384 ops ≈ 512KB packed
+        # matrix, still one cheap transfer.
+        self._ladder = bucket_ladder(
+            8, _env_int("TPU6824_DEVAPPLY_BUCKET", 16384))
+        # Row fills for the packed op-column matrix: each device step
+        # ships ONE freshly-built (8, bucket) matrix — per-column
+        # transfers cost 2× the step itself, and a fresh buffer per
+        # chunk is what lets the CPU backend zero-copy-alias it (the
+        # engine never mutates a buffer after handing it to the step).
+        self._fills = col_fills(S)
+        self._state: DevKVState = make_state(S, C)
+        # Host interners.  Values skip the dedup dict on purpose: hot
+        # workloads append mostly-unique payloads, so a per-op
+        # val->id probe would buy nothing — the rebase GC reclaims
+        # dead ids either way.  vid 0 is reserved as the get column's
+        # inert fill.
+        self._k2i: dict[str, int] = {}
+        self._i2k: list[str] = []
+        self._i2v: list[str] = [""]
+        # Slot-assignment authority: the host shadow of the device key
+        # table (probed by `host_insert`, slots memoized per kid) — the
+        # device consumes resolved slots and never probes.
+        self._htbl = np.full(S + 1, -1, np.int32)
+        self._kslot: list[int] = []
+        # Host chain shadow: the append-log the host itself emitted
+        # (vid per node) plus prev links from the per-drain readback —
+        # what get replies and mirror syncs resolve against.
+        self._cvid = np.zeros(C, np.int32)
+        self._cprev = np.full(C, -1, np.int32)
+        self._nc = 0
+        self._nnext = 0
+        self._node_val: dict[int, DevVal] = {}
+        self.last_applied = -1
+        self.mirror: dict[str, str] = {}
+        self.mirror_applied = -1
+        # Accumulated column build state (carries across get-free
+        # drains until a flush).  `_blastw` (kid → its latest write's
+        # chain node since the last flush) is how read-after-write
+        # stays host-known: the device table is allowed to lag the
+        # watermark, so any op whose key was written since the last
+        # flush carries its predecessor in the `prevs` column.
+        self._bkinds: list[int] = []
+        self._bslots: list[int] = []
+        self._bkids: list[int] = []
+        self._bvids: list[int] = []
+        self._bnodes: list[int] = []
+        self._bprevs: list[int] = []
+        self._bwvid: list[int] = []
+        self._bwapp: list[bool] = []
+        self._bgets: list[int] = []
+        self._blastw: dict[int, int] = {}
+        self._bj = 0
+        self._jbase = 0
+        # Deferred chain-shadow fills: a get-free drain dispatches its
+        # device step and returns WITHOUT blocking on the readback (the
+        # decided path stays async); the prev links land here and any
+        # shadow reader flushes via `_drain_shadow` first.
+        self._pending: list = []
+        # Engine leaf lock (see the class docstring's thread contract):
+        # serializes the driver's off-`mu` cadence sync against
+        # under-`mu` engine users.  Reentrant because public entry
+        # points nest.
+        self.emu = new_rlock("devapply.emu")
+        self.warmup()
+
+    # ------------------------------------------------------------ jit warmup
+
+    def warmup(self) -> None:
+        """Compile every bucket rung once (throwaway state, identical
+        shapes).  The signature set is finite by construction, so after
+        this pass steady state is zero-recompile (jitguard contract);
+        the jit cache is process-global, so only the first engine with
+        a given (slots, chain) pays."""
+        key = (self.slots, self.chain, self._ladder)
+        if key in _WARMED:
+            return
+        st = make_state(self.slots, self.chain)
+        for b in self._ladder:
+            # Chain the returned state: the step donates its input.
+            st, _ = _dk.apply_step(st, np.repeat(self._fills, b, axis=1))
+        _WARMED.add(key)
+
+    # ------------------------------------------------------- batch building
+
+    @_locked
+    def batch_reset(self, expected_ops: int) -> None:
+        """Start a drain's column build; rebases first if the drain
+        could overrun the chain store or the key-table load ceiling
+        (conservative: every op counted as a potential new key/node,
+        so mid-batch capacity never trips).  Accumulated columns from
+        earlier get-free drains persist — they flush on the next get,
+        size cap, snapshot cut, or mirror sync, not per drain."""
+        if (self._nnext + expected_ops > self.chain
+                or len(self._i2k) + expected_ops > self._kcap):
+            self._rebase()
+            if (self._nnext + expected_ops > self.chain
+                    or len(self._i2k) + expected_ops > self._kcap):
+                raise RuntimeError(
+                    f"devapply table full past rebase (keys="
+                    f"{len(self._i2k)}, slots={self.slots}): raise "
+                    "TPU6824_DEVAPPLY_SLOTS / TPU6824_DEVAPPLY_CHAIN")
+        self._bj = 0
+        self._jbase = 0
+        del self._bgets[:]
+
+    def batch_op(self, code: int, key: str, value: str) -> int:
+        """Append one decided op to the drain's columns; returns its
+        drain-local index `j` (stable across mid-drain commits).  The
+        whole per-op host cost of the decided path lives here: one
+        intern probe (slot memoized) and integer appends — chain nodes
+        are a counter bump, the predecessor is a dict lookup.
+
+        Deliberately NOT `_locked`: it runs only between a drain's
+        `batch_reset`/`batch_commit` on the driver thread under the
+        server's `mu`, so every `emu` holder that touches its state is
+        already serialized against it (off-`mu` engine calls happen
+        only on the driver thread itself) — and a per-op lock acquire
+        is real money on the one per-op path this module has."""
+        kid = self._k2i.get(key)
+        if kid is None:
+            kid = len(self._i2k)
+            self._k2i[key] = kid
+            self._i2k.append(key)
+            self._kslot.append(host_insert(self._htbl, self.slots, kid))
+        prev = self._blastw.get(kid, -1)
+        if code == K_GET:
+            vid = 0
+            node = -1
+            # (drain-local index, accumulated-column index): the former
+            # names the reply, the latter its lane in the flush's pre.
+            self._bgets.append((self._bj, len(self._bkinds)))
+        else:
+            i2v = self._i2v
+            vid = len(i2v)
+            i2v.append(value)
+            node = self._nnext
+            self._nnext = node + 1
+            self._blastw[kid] = node
+            self._bwvid.append(vid)
+            self._bwapp.append(code == K_APPEND)
+        self._bkinds.append(code)
+        self._bslots.append(self._kslot[kid])
+        self._bkids.append(kid)
+        self._bvids.append(vid)
+        self._bnodes.append(node)
+        self._bprevs.append(prev)
+        j = self._bj
+        self._bj = j + 1
+        return j
+
+    @_locked
+    def batch_commit(self, applied_seq: int):
+        """End a drain's column build; returns [(j, pre_node)] for the
+        drain's gets.  Always advances `last_applied` to `applied_seq`
+        — the snapshot cut asserts against it.
+
+        The device step does NOT run here unless it must: a get-free
+        drain is pure integer bookkeeping (the columns carry over), and
+        the accumulated batch flushes on the next drain WITH gets, on
+        the size cap (one top-rung chunk), or on a snapshot/mirror/
+        rebase boundary.  Every flush is a device dispatch the driver
+        thread pays a scheduler round-trip for — amortizing it across
+        drains is most of the decided-path win on a contended host."""
+        self.last_applied = applied_seq
+        nops = self._bj - self._jbase
+        self._jbase = self._bj
+        if nops:
+            _M_APPLIED.inc(nops)
+        if self._bgets:
+            jco = list(self._bgets)
+            del self._bgets[:]
+            pre = self._flush(need_pre=True)
+            return [(j, int(pre[c])) for j, c in jco]
+        if len(self._bkinds) >= self._ladder[-1]:
+            self._flush()
+        return ()
+
+    def _flush(self, need_pre: bool = False):
+        """Apply the accumulated columns through the jitted device step
+        (oversized batches chunk through the top bucket).  With
+        `need_pre` the per-op pre-node column is read back and returned
+        (blocking); otherwise the readback stays in flight and only the
+        chain-shadow fill is deferred to `_drain_shadow`."""
+        n = len(self._bkinds)
+        if n == 0:
+            if need_pre:
+                self._drain_shadow()
+            return None
+        t0 = time.perf_counter_ns()
+        kinds_np = np.asarray(self._bkinds, np.int32)
+        slots_np = np.asarray(self._bslots, np.int32)
+        kids_np = np.asarray(self._bkids, np.int32)
+        vids_np = np.asarray(self._bvids, np.int32)
+        nodes_np = np.asarray(self._bnodes, np.int32)
+        prevs_np = np.asarray(self._bprevs, np.int32)
+        # tmask: each key's LAST write in this commit is the one that
+        # scatters into the device table (unique live slot indices).
+        # np.unique on the reversed write-kid column finds it without a
+        # python loop over ops.
+        tmask_np = np.zeros(n, np.int32)
+        wpos = np.flatnonzero(nodes_np >= 0)
+        nw = len(wpos)
+        if nw:
+            _, first = np.unique(kids_np[wpos][::-1], return_index=True)
+            tmask_np[wpos[nw - 1 - first]] = 1
+        wcum = np.cumsum(nodes_np >= 0)
+        state = self._state
+        top = self._ladder[-1]
+        pres = []
+        off = 0
+        while off < n:
+            seg = min(n - off, top)
+            b = bucket_for(seg, self._ladder)
+            end = off + seg
+            buf = np.repeat(self._fills, b, axis=1)
+            buf[C_KIND, :seg] = kinds_np[off:end]
+            buf[C_SLOT, :seg] = slots_np[off:end]
+            buf[C_KID, :seg] = kids_np[off:end]
+            buf[C_VID, :seg] = vids_np[off:end]
+            buf[C_NODE, :seg] = nodes_np[off:end]
+            buf[C_PREV, :seg] = prevs_np[off:end]
+            buf[C_TMASK, :seg] = tmask_np[off:end]
+            buf[C_NC, 0] = self._nc + int(wcum[end - 1])
+            state, pre = _dk.apply_step(state, buf)
+            pres.append((pre, seg))  # device future; not yet read back
+            off = end
+        self._state = state
+        nc0 = self._nc
+        if nw:
+            # Host half of the chain-shadow update: nodes are allocated
+            # sequentially at column-build time, so node ids are
+            # nc0..nc0+nw-1 in column order and the vids are host data;
+            # only an append's prev link waits on the readback.
+            self._cvid[nc0:nc0 + nw] = self._bwvid
+            self._nc = nc0 + nw
+        pre = None
+        if need_pre:
+            # Pre-nodes wanted NOW (get replies), so this flush pays
+            # the blocking readback; deferred shadow fills from earlier
+            # flushes complete alongside.
+            self._drain_shadow()
+            pre = (np.asarray(pres[0][0])[:pres[0][1]] if len(pres) == 1
+                   else np.concatenate(
+                       [np.asarray(p)[:s] for p, s in pres]))
+            if nw:
+                self._cprev[nc0:nc0 + nw] = np.where(
+                    np.asarray(self._bwapp), pre[wpos], -1)
+        elif nw:
+            # Leave the readback in flight: the driver thread moves
+            # straight on to notify/reply instead of donating its
+            # scheduler quantum to a blocking wait.
+            self._pending.append(
+                (pres, wpos, nc0, np.asarray(self._bwapp)))
+        # The columns are on the device now: the host probe memo stays,
+        # the batch-local read-after-write memo resets (the table has
+        # caught up).
+        self._blastw.clear()
+        del self._bkinds[:], self._bslots[:], self._bkids[:]
+        del self._bvids[:], self._bnodes[:], self._bprevs[:]
+        del self._bwvid[:], self._bwapp[:]
+        _M_READBACK.inc((time.perf_counter_ns() - t0) // 1000)
+        _M_LOAD.set(len(self._i2k) / self.slots)
+        return pre
+
+    @_locked
+    def note_applied(self, applied_seq: int) -> None:
+        """Advance the log watermark past entries with no KV effect
+        (gaps, foreign entries, FORGOTTEN fast-forwards): the snapshot
+        cut asserts the engine watermark equals the service's, and those
+        entries are applied by definition."""
+        if applied_seq > self.last_applied:
+            self.last_applied = applied_seq
+
+    @_locked
+    def get_reply(self, node: int):
+        """A flushed get's reply tuple from its pre-node."""
+        if node < 0:
+            return (ErrNoKey, "")
+        return (OK, self.resolve(node))
+
+    @_locked
+    def apply_one(self, kind: str, key: str, value: str,
+                  applied_seq: int):
+        """Scalar fallback (feedless backends drain per op): the same
+        device state machine, batch of one."""
+        code = _KIND_CODE[kind]
+        self.batch_reset(1)
+        self.batch_op(code, key, value)
+        gres = self.batch_commit(applied_seq)
+        if code == K_GET:
+            return self.get_reply(gres[0][1])
+        return (OK, "")
+
+    # --------------------------------------------------- value resolution
+
+    def _drain_shadow(self) -> None:
+        """Materialize deferred chain-prev links from in-flight device
+        readbacks (get-free drains skip the blocking wait on the
+        decided path; every shadow reader flushes here first)."""
+        if not self._pending:
+            return
+        t0 = time.perf_counter_ns()
+        for pres, wpos, nc0, wapp in self._pending:
+            pre = (np.asarray(pres[0][0])[:pres[0][1]] if len(pres) == 1
+                   else np.concatenate(
+                       [np.asarray(p)[:s] for p, s in pres]))
+            nw = len(wpos)
+            self._cprev[nc0:nc0 + nw] = np.where(wapp, pre[wpos], -1)
+        del self._pending[:]
+        _M_READBACK.inc((time.perf_counter_ns() - t0) // 1000)
+
+    @_locked
+    def resolve(self, node: int) -> DevVal:
+        """Chain node → value string, memoized per node: a single-node
+        chain hands back the interned string (no new bytes); an append
+        chain concatenates once, and any memoized ancestor
+        short-circuits the walk."""
+        cache = self._node_val
+        v = cache.get(node)
+        if v is not None:
+            return v
+        if self._pending:
+            self._drain_shadow()
+        cvid, cprev, i2v = self._cvid, self._cprev, self._i2v
+        parts = []
+        cur = node
+        while cur >= 0:
+            hit = cache.get(cur)
+            if hit is not None:
+                parts.append(hit)
+                break
+            parts.append(i2v[cvid[cur]])
+            cur = int(cprev[cur])
+        if len(parts) == 1:
+            s = parts[0]
+        else:
+            parts.reverse()
+            s = "".join(parts)
+        v = s if type(s) is DevVal else DevVal(s)
+        cache[node] = v
+        return v
+
+    # ------------------------------------------------- mirror and snapshots
+
+    @_locked
+    def snapshot_cut(self):
+        """The under-mutex half of a snapshot: copy the two table
+        columns out (the step donates-and-overwrites them in place, so
+        a ref capture would not survive the next drain).  Cost is the
+        FIXED table capacity — independent of live store size, unlike
+        the old path's whole-host-dict copy under `mu`; the copy also
+        fences any still-in-flight drain (device ops are ordered), so
+        the cut observes exactly the state at `last_applied`."""
+        self._flush()  # the device catches up to the watermark first
+        st = self._state
+        S = self.slots
+        return (np.asarray(st.tbl_kid)[:S], np.asarray(st.tbl_node)[:S],
+                self.last_applied)
+
+    @_locked
+    def snapshot_resolve(self, cut) -> dict:
+        """Materialize a cut into the blob's kv dict (the off-mutex
+        half).  Safe against later drains on the cutting thread: the
+        cut's table columns are host copies, and the chain shadow
+        slots and intern ids they reference are append-only history.
+        When the cut is still current the result doubles as a mirror
+        sync."""
+        kid_np, node_np, applied = cut
+        t0 = time.perf_counter_ns()
+        occ = np.flatnonzero(kid_np >= 0)
+        i2k = self._i2k
+        res = self.resolve
+        d = {}
+        for s in occ.tolist():
+            d[i2k[kid_np[s]]] = res(int(node_np[s]))
+        _M_READBACK.inc((time.perf_counter_ns() - t0) // 1000)
+        if applied == self.last_applied:
+            self.mirror = d
+            self.mirror_applied = applied
+            _M_SYNCS.inc()
+        return d
+
+    @_locked
+    def sync_mirror(self) -> dict:
+        """Readback → resolved dict → swap the mirror (cadence / on
+        demand / snapshot cut — never the decided path)."""
+        return self.snapshot_resolve(self.snapshot_cut())
+
+    def mirror_due(self, applied: int) -> bool:
+        return applied - self.mirror_applied >= self.sync_every
+
+    # ------------------------------------------------------ load and rebase
+
+    @_locked
+    def load_from_dict(self, kv: dict, applied: int) -> None:
+        """Rebuild the device state from a resolved dict (snapshot
+        install, runtime enable, rebase): fresh intern tables, host-
+        probed key table (bit-identical to device probing — same hash),
+        single-node chains."""
+        # Complete accumulated columns and in-flight shadow fills
+        # against the OLD layout before its arrays are replaced.
+        self._flush(need_pre=True)
+        S, C = self.slots, self.chain
+        if len(kv) > self._kcap or len(kv) > C:
+            raise RuntimeError(
+                f"devapply cannot hold {len(kv)} keys (slots={S}, "
+                f"chain={C}): raise TPU6824_DEVAPPLY_SLOTS")
+        k2i: dict[str, int] = {}
+        i2k: list[str] = []
+        i2v: list[str] = [""]
+        kslot: list[int] = []
+        tbl = np.full(S + 1, -1, np.int32)
+        tnode = np.full(S + 1, -1, np.int32)
+        cvid = np.zeros(C, np.int32)
+        cprev = np.full(C, -1, np.int32)
+        nc = 0
+        for k, v in kv.items():
+            kid = len(i2k)
+            k2i[k] = kid
+            i2k.append(k)
+            vid = len(i2v)
+            i2v.append(v)
+            s = host_insert(tbl, S, kid)
+            kslot.append(s)
+            tnode[s] = nc
+            cvid[nc] = vid
+            nc += 1
+        import jax.numpy as jnp
+
+        dev_cvid = np.zeros(C + 1, np.int32)
+        dev_cvid[:C] = cvid
+        dev_cprev = np.full(C + 1, -1, np.int32)
+        dev_cprev[:C] = cprev
+        self._state = DevKVState(
+            tbl_kid=jnp.asarray(tbl), tbl_node=jnp.asarray(tnode),
+            chain_vid=jnp.asarray(dev_cvid),
+            chain_prev=jnp.asarray(dev_cprev),
+            n_chain=jnp.int32(nc))
+        self._k2i, self._i2k, self._i2v = k2i, i2k, i2v
+        # `jnp.asarray` copied `tbl`, so it doubles as the host probe
+        # shadow without aliasing device memory.
+        self._htbl, self._kslot = tbl, kslot
+        self._cvid, self._cprev, self._nc = cvid, cprev, nc
+        self._nnext = nc
+        self._blastw.clear()
+        self._node_val = {}
+        self.last_applied = applied
+        self.mirror = dict(kv)
+        self.mirror_applied = applied
+        _M_LOAD.set(len(i2k) / S)
+
+    def _rebase(self) -> None:
+        """Collapse chains and GC dead intern ids: readback → resolve →
+        rebuild.  The mirror-sync moment; bounds host intern growth and
+        chain occupancy between drains."""
+        self.load_from_dict(self.sync_mirror(), self.last_applied)
+        _M_REBASES.inc()
+
+    @property
+    def nkeys(self) -> int:
+        return len(self._i2k)
+
+    def table_load(self) -> float:
+        return len(self._i2k) / self.slots

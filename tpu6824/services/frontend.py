@@ -56,6 +56,7 @@ from tpu6824.obs import tracing as _tracing
 from tpu6824.rpc import transport, wire
 from tpu6824.rpc.native_server import NativeServer, make_server
 from tpu6824.services.common import Backoff, fresh_cid
+from tpu6824.services.devapply import DevVal
 from tpu6824.services.kvpaxos import _DEAD, Op
 from tpu6824.utils import crashsink
 from tpu6824.utils.errors import OK, ErrTxnLocked, RPCError
@@ -249,7 +250,10 @@ class _NativeSink:
                 val = rep[1]
                 if not val:
                     continue  # (OK, "")-class reply: no value bytes
-                vb = val.encode()
+                # devapply get replies carry their bytes memoized per
+                # chain NODE — repeated gets of a hot key hand the ring
+                # the same bytes object instead of re-encoding each.
+                vb = val.bytes() if type(val) is DevVal else val.encode()
             if vidx is None:
                 vidx, vbytes = [], []
             vidx.append(i)

@@ -17,10 +17,12 @@ Differences from the reference, by design:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import NamedTuple
 
+from tpu6824.core.devapply_kernel import K_APPEND, K_GET, K_PUT
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
 from tpu6824.obs import metrics as _metrics
@@ -28,6 +30,7 @@ from tpu6824.obs import opscope as _opscope
 from tpu6824.obs import tracing as _tracing
 from tpu6824.rpc import wire as _wire
 from tpu6824.services import horizon as _horizon
+from tpu6824.services.devapply import DevApplyEngine
 from tpu6824.services.common import (
     Backoff,
     ColumnarDups,
@@ -110,7 +113,8 @@ class KVPaxosServer:
                  op_timeout: float = 8.0, px=None, peers=None,
                  snapshot_every: int | None = None,
                  persist_dir: str | None = None,
-                 dup_retire_ops: int | None = None):
+                 dup_retire_ops: int | None = None,
+                 devapply: bool | None = None):
         """`px` overrides the consensus backend: anything with the PaxosPeer
         contract (start/status/done/min/max/kill) — the batched TPU fabric
         peer by default, or a decentralized `HostOpPeer` (see
@@ -138,6 +142,15 @@ class KVPaxosServer:
         self.mu = new_rlock("kvpaxos.mu")
         self.kv: dict[str, str] = {}
         self.applied = -1  # highest paxos seq applied to kv
+        # devapply (ISSUE 16): the hot get/put/append state machine on
+        # the device as a per-drain columnar step; `self.kv` demoted to
+        # a lazily-synced mirror (cadence / snapshot cut / kv_view).
+        # Default OFF: the host dict path stays byte-for-byte, and
+        # `set_devapply` can flip a live server for bench A/B.
+        if devapply is None:
+            devapply = os.environ.get("TPU6824_DEVAPPLY", "") not in ("", "0")
+        self._dev: DevApplyEngine | None = \
+            DevApplyEngine() if devapply else None
         # At-most-once filter, columnar: cid → (max cseq, reply) with the
         # cseq column in a C array and reply refs in a parallel list —
         # batch-updated once per drain (see _apply_batch_locked).
@@ -263,7 +276,18 @@ class KVPaxosServer:
         on this (cid, cseq)."""
         seen, reply = self.dup.get(op.cid, (-1, None))
         if op.cseq > seen or self._test_disable_dup:
-            if op.kind == "get":
+            dev = self._dev
+            if dev is not None and op.kind in ("get", "put", "append"):
+                # Device path, batch of one (feedless backends drain per
+                # op); non-hot kinds fall through to the host branches.
+                reply = dev.apply_one(op.kind, op.key, op.value,
+                                      self.applied + 1)
+            elif op.kind == "get":
+                # tpusan: ok(host-walk-in-decided-path) — the host
+                # FALLBACK engine (devapply off, the bench A/B control
+                # arm): these branches only run when self._dev is None
+                # and must stay byte-for-byte the pre-devapply
+                # semantics.
                 reply = ((OK, self.kv[op.key]) if op.key in self.kv
                          else (ErrNoKey, ""))
             elif op.kind == "put":
@@ -340,6 +364,11 @@ class KVPaxosServer:
                 if v.cseq > seen or nodup:
                     kind = v.kind
                     if kind == "get":
+                        # tpusan: ok(host-walk-in-decided-path) — the
+                        # host FALLBACK batch engine:
+                        # `_drain_feed_locked` dispatches here only
+                        # when self._dev is None; the devapply twin is
+                        # `_apply_batch_dev_locked`.
                         reply = ((OK, kv[v.key]) if v.key in kv
                                  else (ErrNoKey, ""))
                     elif kind == "put":
@@ -384,6 +413,109 @@ class KVPaxosServer:
             dup.apply_batch(pend)
         return notif
 
+    def _apply_batch_dev_locked(self, vals, cnotif=None,
+                                scope_cids=None) -> list:
+        """`_apply_batch_locked`, devapply edition: the run's hot ops
+        build int columns (one intern probe per op — no dict walk, no
+        string concat) and ONE jitted device step per drain applies them
+        all (`DevApplyEngine.batch_commit`).  Only gets defer: their
+        reply slot carries the op's drain-local index `j` until the
+        commit's readback resolves node→value, then one sweep rewrites
+        the sentinels in notif/cnotif/pend — put/append replies are
+        `(OK, "")` by construction and never wait.  A mid-run `compact`
+        forces an early commit (flush) so the dup-retire scan runs at
+        its exact log position, identical on every replica."""
+        dev = self._dev
+        dup = self.dup
+        dup_seen = dup.seen
+        waiters_pop = self._waiters.pop
+        ccseq = self._ccseq
+        ccseq_get = ccseq.get
+        ctag_pop = self._ctag.pop
+        if cnotif is not None:
+            ctags, creps, ctctx = cnotif
+        nodup = self._test_disable_dup
+        notif = []
+        pend: dict = {}  # cid -> (cseq, reply-or-sentinel, applied)
+        pend_get = pend.get
+        batch_op = dev.batch_op
+        dev.batch_reset(len(vals))
+        dres: dict = {}  # get sentinel j -> resolved reply tuple
+
+        def flush():
+            for j, node in dev.batch_commit(self.applied):
+                dres[j] = dev.get_reply(node)
+
+        def fix_pend():
+            for cid, ent in pend.items():
+                if type(ent[1]) is int:
+                    pend[cid] = (ent[0], dres[ent[1]], ent[2])
+
+        for v in vals:
+            self.applied += 1
+            if isinstance(v, Op):
+                ent = pend_get(v.cid)
+                seen = ent[0] if ent is not None else dup_seen(v.cid)
+                if v.cseq > seen or nodup:
+                    kind = v.kind
+                    if kind == "get":
+                        reply = batch_op(K_GET, v.key, "")
+                    elif kind == "put":
+                        batch_op(K_PUT, v.key, v.value)
+                        reply = (OK, "")
+                    elif kind == "append":
+                        batch_op(K_APPEND, v.key, v.value)
+                        reply = (OK, "")
+                    elif kind == "compact":
+                        # Commit the columns built so far and fold the
+                        # batch's dup writes FIRST (host path contract:
+                        # the retirement scan's view is a pure function
+                        # of log position).  `j` stays monotone across
+                        # the early commit, so later sentinels don't
+                        # collide.
+                        flush()
+                        if pend:
+                            fix_pend()
+                            dup.apply_batch(pend)
+                            pend.clear()
+                        self._compact_locked(self.applied)
+                        reply = (OK, "")
+                    else:
+                        reply = (OK, "")
+                    pend[v.cid] = (v.cseq, reply, self.applied)
+                else:
+                    reply = ent[1] if ent is not None else dup.reply(v.cid)
+                fut = waiters_pop((v.cid, v.cseq), None)
+                if fut is not None:
+                    if v.tc is not None:
+                        self._trace_resolve(v, fut)
+                    notif.append((fut, reply))
+                    if scope_cids is not None:
+                        scope_cids.append(v.cid)
+                elif cnotif is not None and ccseq_get(v.cid) == v.cseq:
+                    del ccseq[v.cid]
+                    ctags.append(ctag_pop(v.cid))
+                    creps.append(reply)
+                    ctctx.append(self._trace_apply(v)
+                                 if v.tc is not None else None)
+                    if scope_cids is not None:
+                        scope_cids.append(v.cid)
+            self._pop_lost_inflight_locked(v)
+        flush()  # also advances dev.last_applied to self.applied
+        if pend:
+            fix_pend()
+            dup.apply_batch(pend)
+        if dres:
+            notif = [(f, dres[r] if type(r) is int else r)
+                     for f, r in notif]
+            if cnotif is not None:
+                # Earlier runs in this drain already rewrote theirs —
+                # any int left in the shared lists is from this run.
+                for i, r in enumerate(creps):
+                    if type(r) is int:
+                        creps[i] = dres[r]
+        return notif
+
     def _drain_feed_locked(self):
         """Feed-based drain: pop the tap's contiguous decided run, apply
         it as one batch, resolve the batch's futures in one notify sweep,
@@ -397,6 +529,10 @@ class KVPaxosServer:
         tap = self._tap
         prof = self._prof
         base0 = self.applied + 1
+        # Hoisted once per drain (toggles happen under mu, never mid-
+        # drain): the devapply columnar step or the host dict batch.
+        apply_batch = (self._apply_batch_dev_locked if self._dev is not None
+                       else self._apply_batch_locked)
         notif = []
         cnotif = ([], [], []) if self._csink is not None else None
         # opscope (ISSUE 15): per-drain stage stamps — decide-feed
@@ -434,8 +570,7 @@ class KVPaxosServer:
                 # decide and apply stamps; never set outside tests.
                 time.sleep(self._test_apply_delay)
             t0 = time.perf_counter_ns()
-            notif.extend(self._apply_batch_locked(run, cnotif,
-                                                  scope_cids))
+            notif.extend(apply_batch(run, cnotif, scope_cids))
             apply_ns += time.perf_counter_ns() - t0
         applied_n = self.applied + 1 - base0
         if applied_n > 0:
@@ -455,6 +590,11 @@ class KVPaxosServer:
                               time.monotonic_ns())
         self._last_drain = applied_n
         if self.applied >= base0:
+            if self._dev is not None:
+                # A trailing FORGOTTEN fast-forward advances `applied`
+                # past the last commit — no KV effect, note it so the
+                # snapshot cut's watermark assert stays exact.
+                self._dev.note_applied(self.applied)
             self._done_fn(self.applied)
 
     def _drain_bulk_locked(self, status_many):
@@ -501,6 +641,8 @@ class KVPaxosServer:
                 self._pop_lost_inflight_locked(v)
         self._last_drain = self.applied + 1 - base0
         if self.applied >= base0:
+            if self._dev is not None:
+                self._dev.note_applied(self.applied)
             self._done_fn(self.applied)
 
     def _drain_bulk_scalar_locked(self, status_many):
@@ -536,6 +678,8 @@ class KVPaxosServer:
             probe = min(2 * probe, 256)  # long decided run: widen the probe
         self._last_drain = self.applied + 1 - base0
         if self.applied >= base0:
+            if self._dev is not None:
+                self._dev.note_applied(self.applied)
             self._done_fn(self.applied)
 
     # ------------------------------------------------------ horizon (ISSUE 14)
@@ -559,7 +703,8 @@ class KVPaxosServer:
                     _horizon.note_dup_retired(n)
 
     def _horizon_rows(self) -> dict:
-        d = {"kv_rows": len(self.kv), "dup_rows": len(self.dup)}
+        nkv = self._dev.nkeys if self._dev is not None else len(self.kv)
+        d = {"kv_rows": nkv, "dup_rows": len(self.dup)}
         fab = getattr(self.px, "fabric", None)
         if fab is not None:
             d["window_live_slots"] = fab.live_slots
@@ -570,6 +715,11 @@ class KVPaxosServer:
         """Install a decoded snapshot: replace the applied state, jump
         the watermark, and settle anything parked below it."""
         self.kv = dict(blob["kv"])
+        if self._dev is not None:
+            # Snapshot-install catch-up lands in the device store: fresh
+            # intern tables, host-probed key table (bit-identical to the
+            # device probe), single-node chains.
+            self._dev.load_from_dict(self.kv, applied)
         dup = ColumnarDups()
         for cid, row in blob["dup"]:
             dup.put(cid, row[0], row[1], row[2] if len(row) > 2 else -1)
@@ -644,6 +794,8 @@ class KVPaxosServer:
                 while self.applied + 1 < mn:
                     self.applied += 1
                     self._inflight.pop(self.applied, None)
+                if self._dev is not None:
+                    self._dev.note_applied(self.applied)
                 if self._tap is not None:
                     self._tap.discard_through(self.applied)
             self._behind_min = 0
@@ -662,8 +814,32 @@ class KVPaxosServer:
             applied = self.applied
             if applied <= hz.last_applied:
                 return
-            blob = {"applied": applied, "kv": dict(self.kv),
-                    "dup": list(self.dup.items_with_seq())}
+            dev = self._dev
+            if dev is not None:
+                # Fused cut (ISSUE 16): under mu the cut is O(1) — jax
+                # arrays are immutable, so capturing the refs IS the
+                # consistent copy; materialization happens off-mu below.
+                # The watermark assert is the log-position-exactness
+                # contract: a cut taken between drains names exactly the
+                # prefix the device table has applied, even with a drain
+                # in flight on this same thread.
+                assert dev.last_applied == applied, \
+                    (dev.last_applied, applied)
+                cut = dev.snapshot_cut()
+                dup_rows = list(self.dup.items_with_seq())
+                blob = None
+            else:
+                blob = {"applied": applied, "kv": dict(self.kv),
+                        "dup": list(self.dup.items_with_seq())}
+        if blob is None:
+            # Off-mu half: resolve the cut into the blob dict.  Safe —
+            # every engine mutation runs on this driver thread, and the
+            # chain/intern slots a cut references are append-only.
+            # Doubles as a mirror sync, so snapshot cadence keeps
+            # `self.kv` fresh for free.
+            blob = {"applied": applied, "kv": dev.snapshot_resolve(cut),
+                    "dup": dup_rows}
+            self.kv = blob["kv"]
         hz.publish(applied, blob)
         if self.dup_retire_ops > 0:
             self._cmp_cseq += 1
@@ -858,6 +1034,20 @@ class KVPaxosServer:
                     self._catchup_pass()
                 if self.horizon.enabled():
                     self._maybe_snapshot()
+                dev = self._dev
+                if dev is not None and dev.mirror_due(self.applied):
+                    # Mirror cadence: the readback/resolve runs OFF mu
+                    # so replies keep flowing through it; under-mu
+                    # engine users (kv_view, set_devapply) serialize
+                    # against it on the engine's own leaf lock `emu`.
+                    # The swap rechecks the engine under mu so a
+                    # concurrent set_devapply(False) can't have its
+                    # fresher host dict clobbered by an orphaned
+                    # engine's mirror.
+                    snap = dev.sync_mirror()
+                    with self.mu:
+                        if self._dev is dev:
+                            self.kv = snap
                 if busy:
                     # Ops outstanding: pace on consensus progress, then
                     # drain again immediately — no idle tick in the
@@ -1096,6 +1286,31 @@ class KVPaxosServer:
 
     def put_append(self, kind: str, key: str, value: str, cid: int, cseq: int):
         return self._submit(Op(kind, key, value, cid, cseq))
+
+    def set_devapply(self, on: bool) -> None:
+        """Flip the devapply engine on a LIVE server (bench A/B): on
+        loads the device table from the current host dict; off syncs
+        the mirror back and drops the engine.  Under mu, so the flip
+        lands exactly between drains — no op ever applies half-here."""
+        with self.mu:
+            if self.dead:
+                return
+            if on and self._dev is None:
+                dev = DevApplyEngine()
+                dev.load_from_dict(self.kv, self.applied)
+                self._dev = dev
+            elif not on and self._dev is not None:
+                self.kv = self._dev.sync_mirror()
+                self._dev = None
+
+    def kv_view(self) -> dict:
+        """The applied store as a host dict (tests/debug — NEVER the
+        decided path): the live dict on the host path, a fresh mirror
+        sync on the devapply path."""
+        with self.mu:
+            if self._dev is not None:
+                self.kv = self._dev.sync_mirror()
+            return self.kv
 
     def kill(self):
         with self.mu:

@@ -288,6 +288,12 @@ class ShardKVServer:
             txnkv._M_LOCK_CONFLICTS.inc()
             return self._resolve(op, (ErrTxnLocked, ""))
         if op.kind == "get":
+            # tpusan: ok(host-walk-in-decided-path) — shardkv ops
+            # interleave with reconfig/migration/txn entries that
+            # mutate arbitrary key ranges host-side (shard handoff
+            # installs whole dicts); the devapply columnar contract
+            # covers the kvpaxos hot path first (ROADMAP: extend once
+            # shard state machines pin their stores).
             reply = (OK, self.kv[op.key]) if op.key in self.kv else (ErrNoKey, "")
         elif op.kind == "put":
             self.kv[op.key] = op.value
