@@ -1,0 +1,35 @@
+"""Golden: host-sync-in-sharded-step — host synchronization inside the
+sharded execution path (three findings: np.asarray in a sharded step,
+.block_until_ready in a dispatch helper, jax.device_get in a drain)."""
+
+import jax
+import numpy as np
+
+
+def sharded_step_host(state, link):
+    out = step(state, link)
+    # BAD: materializing the sharded result on the host serializes the
+    # whole mesh behind one device round-trip.
+    done = np.asarray(out.done)
+    return out, done
+
+
+def _dispatch_done(out):
+    # BAD: a barrier inside the per-shard dispatch path.
+    out.done.block_until_ready()
+    return out.done
+
+
+def drain_shard(out, shard):
+    # BAD: full-array readback inside the drain loop.
+    cols = jax.device_get(out.cols)
+    return cols[shard]
+
+
+def sharded_step_clean(state, link):
+    # OK: a nested closure handed to jit traces on the device — the
+    # host-sync filter must not reach into it.
+    def _inner(s, l):
+        return np.asarray([1], dtype=np.int32)  # traced as a constant
+
+    return jax.jit(_inner)(state, link)

@@ -1,0 +1,481 @@
+"""meshfab (ISSUE 17): the sharded REAL execution path.
+
+The quorum-sharded dryrun math promoted to the live fabric: the
+(G, I, P) state lives on a `jax.sharding.Mesh` behind jit+NamedSharding
+— semantically the SAME program as the single-device step, so the
+decided stream must be bit-identical between a single-device fabric and
+a mesh fabric under the same seed, the same op feed, and the same
+fault schedule.  That identity is the acceptance criterion this module
+pins, alongside:
+
+  - the `shard_groups` bucket ladder (per-shard group counts hit stable
+    compiled shapes; G auto-pads to rung x shards, padded lanes idle);
+  - the DevicePlane placement API (`num_shards` / `shard_of`) and the
+    meshfab observability surface (gauges, per-shard dispatch
+    histograms on the opscope/Collector surface, ShardDispatchSkew);
+  - zero steady-state recompiles on both configs (jitguard);
+  - exactly-once + Wing-Gong under a lossy clerk wire and a fixed-seed
+    nemesis composite on the mesh fabric;
+  - a subprocess smoke on a DIFFERENT forced-host-device count (12 -> a
+    {g:4, i:1, p:3} mesh), proving the sharded step beyond conftest's
+    8-device default.
+
+All tests run on the virtual CPU devices conftest forces via
+`XLA_FLAGS=--xla_force_host_platform_device_count=8`.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu6824.core.fabric import PaxosFabric
+from tpu6824.core.jitshape import GROUP_LADDER, shard_groups
+from tpu6824.core.peer import Fate
+from tpu6824.harness.nemesis import FabricTarget, FaultSchedule, Nemesis
+from tpu6824.obs import metrics as obs_metrics
+from tpu6824.parallel.mesh import fabric_mesh, make_hybrid_mesh
+
+from tests.invariants import check_appends
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gmesh4():
+    """Group-sharded: 4 shards, one group column each."""
+    return fabric_mesh(ngroups=4, devices=jax.devices()[:4])
+
+
+def _pmesh():
+    """Quorum-sharded: (2, 1, 3) over 6 devices — the peer axis spans
+    devices, majority counts lower to psum."""
+    return fabric_mesh(npeers=3, devices=jax.devices()[:6])
+
+
+# ------------------------------------------------------------ shard math
+
+
+def test_shard_groups_ladder():
+    # Identity for one shard: the single-device fabric is untouched.
+    for n in (1, 3, 7, 100):
+        assert shard_groups(n, 1) == n
+    # Per-shard counts snap to ladder rungs, then multiply back out.
+    assert shard_groups(7, 8) == 8          # ceil(7/8)=1 -> rung 1
+    assert shard_groups(9, 2) == 16         # ceil(9/2)=5 -> rung 8
+    assert shard_groups(8, 2) == 8          # exact fit stays exact
+    # Idempotent: a checkpoint written at padded G restores unchanged.
+    for n in (1, 3, 5, 7, 9, 100):
+        for s in (2, 4, 8):
+            g = shard_groups(n, s)
+            assert shard_groups(g, s) == g, (n, s, g)
+    # Padding never shrinks and divides evenly.
+    for n in (1, 5, 11):
+        for s in (2, 4, 8):
+            g = shard_groups(n, s)
+            assert g >= n and g % s == 0
+    assert GROUP_LADDER[0] == 1
+
+
+def test_fabric_mesh_placement_policy():
+    devs = jax.devices()
+    # 8 devices, npeers=3: 8 % 3 != 0 -> peer axis stays local, all 8
+    # devices become group shards.
+    m = fabric_mesh(npeers=3, devices=devs[:8])
+    assert dict(m.shape) == {"g": 8, "i": 1, "p": 1}
+    # 6 devices, npeers=3: quorum axis spans devices.
+    m = fabric_mesh(npeers=3, devices=devs[:6])
+    assert dict(m.shape) == {"g": 2, "i": 1, "p": 3}
+    # ngroups caps the shard count (device subset).
+    m = fabric_mesh(ngroups=2, npeers=3, devices=devs[:8])
+    assert dict(m.shape) == {"g": 2, "i": 1, "p": 1}
+    # make_hybrid_mesh validates the factorization.
+    with pytest.raises(ValueError):
+        make_hybrid_mesh(3, 1, 3, devices=devs[:8])
+
+
+def test_plane_padding_and_shard_of():
+    fab = PaxosFabric(ngroups=5, npeers=3, ninstances=4, mesh=_pmesh(),
+                      io_mode="compact")
+    try:
+        # 5 groups over 2 shards: rung 4 per shard -> G pads to 8.
+        assert fab.G_live == 5
+        assert fab.G == shard_groups(5, 2) == 8
+        assert fab.num_shards == 2
+        assert [fab.shard_of(g) for g in range(8)] == [0] * 4 + [1] * 4
+        # The meshfab gauges reflect the topology.
+        assert obs_metrics.gauge("meshfab.shards").snapshot()["value"] == 2
+        assert obs_metrics.gauge(
+            "meshfab.groups_per_shard").snapshot()["value"] == 4
+        # Live groups decide; padded lanes stay idle.
+        for g in range(5):
+            fab.start(g, g % 3, 0, f"pad{g}")
+        fab.step(6)
+        for g in range(5):
+            assert fab.status(g, 0, 0) == (Fate.DECIDED, f"pad{g}")
+    finally:
+        fab.stop_clock()
+
+
+def test_single_device_fabric_has_single_shard_api():
+    fab = PaxosFabric(ngroups=3, npeers=3, ninstances=4)
+    try:
+        assert fab.num_shards == 1
+        assert fab.shard_of(2) == 0
+        assert fab.G == fab.G_live == 3
+    finally:
+        fab.stop_clock()
+
+
+# ---------------------------------------- decide-stream identity (ACCEPT)
+
+# clock_pause sleeps on the driver thread (time-driven, not step-driven)
+# — every other fault dimension applies at exact step indices.
+_STEP_ACTIONS = [a for a in FabricTarget.ACTIONS if a != "clock_pause"]
+
+
+def _schedule_by_step(seed, nsteps, ngroups, npeers, duration=1.0):
+    """A fixed-seed nemesis composite mapped onto step indices, so the
+    same events hit both fabrics at the same point in the step
+    sequence (Nemesis.start() is time-driven; identity needs
+    step-driven)."""
+    spec = {"kind": "fabric", "groups": list(range(ngroups)),
+            "npeers": npeers, "actions": list(_STEP_ACTIONS)}
+    sched = FaultSchedule.generate(seed, duration, spec)
+    by_step: dict = {}
+    for e in sched.events:
+        idx = min(nsteps - 1, int(e.t / duration * nsteps))
+        by_step.setdefault(idx, []).append(e)
+    return by_step
+
+
+def _drive(mesh, seed, by_step, ngroups, nsteps, nseqs):
+    """One fabric under the step-indexed schedule: deterministic op
+    feed, manual stepping, full decided-stream capture (step index
+    included — identity covers WHEN each cell decides, not just what)."""
+    fab = PaxosFabric(ngroups=ngroups, npeers=3, ninstances=8, mesh=mesh,
+                      io_mode="compact", seed=seed)
+    target = FabricTarget(fab, groups=list(range(ngroups)),
+                          actions=list(_STEP_ACTIONS))
+    subs = [fab.subscribe_decided(g, 0) for g in range(ngroups)]
+    stream = []
+
+    def drain(step):
+        for g in range(ngroups):
+            for s, v in subs[g].pop():
+                stream.append((step, g, s, v))
+
+    try:
+        seq = 0
+        for step in range(nsteps):
+            for ev in by_step.get(step, ()):
+                target.apply(ev.action, ev.args)
+            if step % 3 == 0 and seq < nseqs:
+                for g in range(ngroups):
+                    fab.start(g, (g + seq) % 3, seq, f"v{g}.{seq}")
+                seq += 1
+            fab.step()
+            drain(step)
+        target.restore()
+        for step in range(nsteps, nsteps + 60):
+            fab.step()
+            drain(step)
+            if len(stream) >= ngroups * nseqs:
+                break
+        return list(stream)
+    finally:
+        fab.stop_clock()
+
+
+@pytest.mark.nemesis
+@pytest.mark.parametrize("mesh_fn", [_gmesh4, _pmesh],
+                         ids=["gshard", "pshard"])
+def test_decide_stream_identity_under_nemesis(mesh_fn):
+    """THE tentpole acceptance: under a fixed-seed nemesis composite
+    (partitions, kill/revive, unreliable, pipeline churn) the mesh
+    fabric's decided stream — order, step timing, seqs, values — is
+    identical to the single-device fabric's."""
+    ngroups, nsteps, nseqs, seed = 4, 36, 6, 1701
+    by_step = _schedule_by_step(77, nsteps, ngroups, 3)
+    base = _drive(None, seed, by_step, ngroups, nsteps, nseqs)
+    sharded = _drive(mesh_fn(), seed, by_step, ngroups, nsteps, nseqs)
+    assert len(base) == ngroups * nseqs, "single-device did not converge"
+    assert sharded == base
+    # Exactly-once at the feed: every (g, seq) delivered exactly once.
+    cells = [(g, s) for _, g, s, _ in base]
+    assert len(set(cells)) == len(cells) == ngroups * nseqs
+
+
+@pytest.mark.nemesis
+def test_decide_stream_identity_with_padded_groups():
+    """5 live groups on a 2-shard mesh pad to G=8; identity must hold
+    with idle padded lanes in the sharded state.  Reliable-path faults
+    only: the Bernoulli drop masks are drawn at state shape, so padded
+    G legitimately changes unreliable-mode draws — padding is a shape
+    concern, the lossless program is shape-independent per group."""
+    acts = ["partition_minority", "partition_random", "partition_isolate",
+            "heal", "kill", "revive", "pipeline_depth"]
+    ngroups, nsteps, nseqs = 5, 30, 4
+    spec = {"kind": "fabric", "groups": list(range(ngroups)),
+            "npeers": 3, "actions": acts}
+    sched = FaultSchedule.generate(55, 1.0, spec)
+    by_step: dict = {}
+    for e in sched.events:
+        by_step.setdefault(min(nsteps - 1, int(e.t * nsteps)), []).append(e)
+    base = _drive(None, 9, by_step, ngroups, nsteps, nseqs)
+    sharded = _drive(_pmesh(), 9, by_step, ngroups, nsteps, nseqs)
+    assert len(base) == ngroups * nseqs
+    assert sharded == base
+
+
+# --------------------------------------------------- jitguard (ACCEPT)
+
+
+@pytest.mark.parametrize("mesh_fn", [lambda: None, _gmesh4, _pmesh],
+                         ids=["single", "gshard", "pshard"])
+def test_zero_steady_state_recompiles(mesh_fn):
+    """Warm every variant the feed pattern touches, then an identical
+    traffic phase must hit compile caches only — on the single-device
+    AND both mesh configs."""
+    from tpu6824.analysis.jitguard import RecompileGuard
+
+    fab = PaxosFabric(ngroups=4, npeers=3, ninstances=8, mesh=mesh_fn(),
+                      io_mode="compact", seed=3)
+    try:
+        def phase(seq0):
+            for seq in (seq0, seq0 + 1):
+                for g in range(4):
+                    fab.start(g, (g + seq) % 3, seq, f"w{g}.{seq}")
+                fab.step(3)
+            fab.step(2)
+
+        phase(0)  # warm: compiles the step at every rung the feed hits
+        with RecompileGuard() as guard:
+            phase(2)  # steady state: same cadence, fresh seqs
+        assert guard.compiles == 0
+        for g in range(4):
+            assert fab.status(g, 0, 3)[0] == Fate.DECIDED
+    finally:
+        fab.stop_clock()
+
+
+# ------------------------------- exactly-once + Wing-Gong on the mesh
+
+
+@pytest.mark.nemesis
+def test_mesh_service_exactly_once_wing_gong(nemesis_report):
+    """kvpaxos over the quorum-sharded mesh fabric, lossy clerk wire
+    (forced replays -> dup filter), fixed-seed nemesis composite:
+    appends land exactly once and the full history linearizes."""
+    from tpu6824.harness.linearize import History, HistoryClerk, \
+        check_history
+    from tpu6824.services.common import FlakyNet
+    from tpu6824.services.kvpaxos import Clerk, make_cluster
+
+    mesh = _pmesh()
+    fabric = PaxosFabric(ngroups=1, npeers=3, ninstances=64, mesh=mesh,
+                         auto_step=True, io_mode="compact", seed=11)
+    # ngroups=1 over 2 shards: the service rides a PADDED (G=2) fabric.
+    assert fabric.G == 2 and fabric.G_live == 1
+    fabric, servers = make_cluster(nservers=3, fabric=fabric)
+    net = FlakyNet(seed=7)
+    for s in servers:
+        net.set_unreliable(s, True)
+    history = History()
+    try:
+        target = FabricTarget(fabric, groups=[0])
+        sched = FaultSchedule.generate(31, 1.2, target.spec())
+        nem = Nemesis(target, sched).start()
+        nemesis_report.attach(nemesis=nem, seed=31)
+        errs: list = []
+
+        def client(idx):
+            try:
+                ck = HistoryClerk(Clerk(servers, net=net), history)
+                for j in range(4):
+                    ck.append("k", f"x {idx} {j} y")
+                    if j % 2 == 1:
+                        ck.get("k")
+            except Exception as e:  # pragma: no cover
+                errs.append((idx, e))
+
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=240.0)
+        assert not any(t.is_alive() for t in ts), "client stuck past 240s"
+        nem.join(60.0)
+        assert nem.done
+        assert nem.signature() == sched.signature()
+        assert not errs, errs
+        for s in servers:
+            net.set_unreliable(s, False)
+        final = HistoryClerk(Clerk(servers), history)
+        check_appends(final.get("k"), 3, 4)
+        res = check_history(history)
+        assert res.ok, res.describe()
+    finally:
+        for s in servers:
+            s.dead = True
+        fabric.stop_clock()
+
+
+# ----------------------------------------------- observability surface
+
+
+def test_opscope_shard_dimension_merges_through_collector():
+    """fold(shard=) splits the dispatch edge per shard; the split rides
+    opscope.snapshot()'s histogram surface, so the fleet Collector
+    merges per-shard waterfalls with its name-agnostic bucket sum."""
+    from tpu6824.obs import opscope
+    from tpu6824.obs.collector import Collector
+
+    mesh_h = obs_metrics.histogram("meshfab.shard_dispatch_us")
+    before = mesh_h.snapshot()["count"]
+    t = time.monotonic_ns()
+    for i, shard in enumerate((0, 1, 1)):
+        cid = 917_100 + i
+        opscope.note_dispatch_many([cid], t + 1_000_000)
+        opscope.fold([cid], t + 2_000_000, t + 3_000_000, t + 4_000_000,
+                     shard=shard)
+    # Per-shard registry series exist (watchdog reads these)...
+    s0 = obs_metrics.histogram(
+        "opscope.stage.dispatch.shard0.latency_us").snapshot()
+    s1 = obs_metrics.histogram(
+        "opscope.stage.dispatch.shard1.latency_us").snapshot()
+    assert s0["count"] >= 1 and s1["count"] >= 2
+    # ...the roll-up counts every tagged fold...
+    assert mesh_h.snapshot()["count"] == before + 3
+    # ...and the snapshot surface carries the split for the Collector.
+    snap = opscope.snapshot()
+    assert "dispatch.shard0" in snap["histograms"]
+    assert "dispatch.shard1" in snap["histograms"]
+    merged = Collector.merge_opscope(
+        {"processes": {"p0": {"opscope": snap}}})
+    assert merged["histograms"]["dispatch.shard1"]["count"] >= 2
+
+
+def test_watchdog_flags_shard_dispatch_skew(tmp_path):
+    """One shard's dispatch p99 at >=4x the fleet median -> incident."""
+    from tpu6824.obs.pulse import Pulse
+    from tpu6824.obs.watchdog import ShardDispatchSkew, Watchdog
+
+    hs = [obs_metrics.histogram(
+        f"opscope.stage.dispatch.shard{i}.latency_us") for i in range(3)]
+    p = Pulse(interval=0.02)
+    wd = Watchdog(p, outdir=str(tmp_path),
+                  rules=[ShardDispatchSkew(factor=4.0, min_us=100.0)],
+                  window=60.0, cooldown=60.0).start()
+    for _ in range(2):  # balanced fleet: silent
+        for h in hs:
+            for _ in range(20):
+                h.observe(120)
+        time.sleep(0.02)
+        p.sample_once()
+    assert not wd.incidents
+    for _ in range(20):  # shard 2 diverges
+        hs[2].observe(50_000)
+    time.sleep(0.02)
+    p.sample_once()
+    assert wd.incidents
+    inc = wd.incidents[0]
+    assert inc["rule"] == "shard-dispatch-skew"
+    assert "shard 2" in inc["reason"]
+
+
+def test_frontend_cross_shard_counter():
+    """Multi-group batches spanning shard boundaries bump
+    meshfab.cross_shard_ops; single-shard batches do not."""
+    from tpu6824.services.frontend import ClerkFrontend
+
+    c = obs_metrics.counter("meshfab.cross_shard_ops")
+
+    class _Stub:
+        shard = 0
+
+    fe = object.__new__(ClerkFrontend)
+    fe.groups = [[_Stub()], [_Stub()], [_Stub()], [_Stub()]]
+    fe._shard_of = lambda g: g // 2       # groups 0,1 -> shard 0; 2,3 -> 1
+    fe._multi_shard = True
+    before = c.snapshot()["total"]
+    fe._note_shards([0, 1])               # same shard: no bump
+    assert c.snapshot()["total"] == before
+    fe._note_shards([0, 3])               # crosses shards: counts both ops
+    assert c.snapshot()["total"] == before + 2
+    fe._multi_shard = False
+    fe._note_shards([0, 3])               # single-shard deployment: no-op
+    assert c.snapshot()["total"] == before + 2
+
+
+# ------------------------------------------------- sharded apply bank
+
+
+def test_sharded_apply_bank_round_trip():
+    """devapply's stacked per-group state on the mesh: puts/appends/gets
+    round-trip per group, chains resolve root-first, state persists
+    across apply calls."""
+    from tpu6824.services.devapply import ShardedApplyBank
+
+    mesh = fabric_mesh(devices=jax.devices()[:8])
+    bank = ShardedApplyBank(mesh, ngroups=6, slots=1 << 6, bucket=8)
+    assert bank.G == shard_groups(6, 8) == 8
+    pre = bank.apply([[("put", 5, 100)], [("append", 9, 200)]])
+    assert pre.shape[0] == bank.G
+    pre = bank.apply([[("get", 5, 0)], [("append", 9, 201)]])
+    assert bank.resolve_chain(0, int(pre[0, 0])) == [100]
+    pre = bank.apply([[], [("get", 9, 0)]])
+    assert bank.resolve_chain(1, int(pre[1, 0])) == [200, 201]
+
+
+# ------------------------------------------- subprocess smoke (ACCEPT)
+
+_SMOKE = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=12"
+import jax
+assert len(jax.devices()) == 12, jax.devices()
+from tpu6824.core.fabric import PaxosFabric
+from tpu6824.parallel.mesh import fabric_mesh
+
+def run(mesh):
+    fab = PaxosFabric(ngroups=4, npeers=3, ninstances=4, mesh=mesh,
+                      io_mode="compact", seed=2)
+    subs = [fab.subscribe_decided(g, 0) for g in range(4)]
+    out = []
+    for seq in range(3):
+        for g in range(4):
+            fab.start(g, (g + seq) % 3, seq, f"s{g}.{seq}")
+        fab.step(4)
+        for g in range(4):
+            out.append((g, tuple(subs[g].pop())))
+    fab.stop_clock()
+    return out
+
+mesh = fabric_mesh(npeers=3)
+shape = dict(mesh.shape)
+assert shape == {"g": 4, "i": 1, "p": 3}, shape
+assert run(mesh) == run(None)
+print("MESHFAB-12DEV-OK")
+"""
+
+
+@pytest.mark.nemesis
+def test_sharded_step_on_forced_12_device_mesh():
+    """Simulated-mesh CI beyond conftest's 8 devices: a subprocess
+    forces 12 host devices, builds the {g:4, i:1, p:3} mesh, and the
+    sharded real path's decided stream matches single-device exactly."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the script pins its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", _SMOKE], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "MESHFAB-12DEV-OK" in r.stdout

@@ -128,3 +128,72 @@ def test_host_shard_system_pooled(tmp_path):
         assert ck.get("a", timeout=60.0) == "12"
     finally:
         s.shutdown()
+
+
+# ------------------------------------------------- SKVOP wire round trips
+# ROADMAP item 4d: the gob host backend's SKVOP schema used to refuse
+# txn ops and XState payloads carrying prepared transactions.  The
+# XTxn slice (one JSON document per prepared-lock-table row) closes
+# that gap; these tests pin the exact round trip THROUGH the real gob
+# codec, since the RSM's "mine?" equality check runs on wire-decoded
+# ops.
+
+import io
+
+from tpu6824.services.shardkv import (
+    SKVOP_WIRE, XState, _op_from_wire, _op_to_wire, Op,
+)
+from tpu6824.services.shardmaster import Config
+from tpu6824.shim.gob import Decoder, Encoder, GobError, complete
+
+
+def _gob_roundtrip(value):
+    buf = bytearray()
+    Encoder(buf.extend).encode(SKVOP_WIRE, value)
+    stream = io.BytesIO(bytes(buf))
+
+    def read(n):
+        b = stream.read(n)
+        if len(b) != n:
+            raise GobError("eof")
+        return b
+
+    _, v = Decoder(read).next()
+    return complete(SKVOP_WIRE, v)
+
+
+def test_txn_op_rides_gob_wire():
+    # txn_* kinds carry their payload as JSON in Value; the base SKVOP
+    # fields cover them — encode, decode, and reconstruct identically.
+    payload = '{"tid": "t-1", "ops": [["k", "put", "v", null]]}'
+    op = Op("txn_prepare", "", payload, "clk-7", 3, None)
+    got = _op_from_wire(_gob_roundtrip(_op_to_wire(op)))
+    assert got == op
+
+
+def test_reconf_with_prepared_txns_round_trips():
+    cfg = Config(num=4, shards=(1, 2) * 5, groups=((1, ("a", "b")),
+                                                   (2, ("c",))))
+    txn = (
+        ("t-9", 2, ("skv2-0", "skv2-1"),
+         (("ka", "put", "1", None), ("kb", "cas", "2", "old")),
+         (1,)),
+        ("t-11", 1, ("skv1-0",),
+         (("kc", "read", "", None),),
+         (1, 2)),
+    )
+    xs = XState(kv=(("ka", "1"),),
+                dup=(("c1", (5, ("OK", "1"))),),
+                txn=txn)
+    op = Op("reconf", "", "", "cfg-4", 4, (cfg, xs))
+    got = _op_from_wire(_gob_roundtrip(_op_to_wire(op)))
+    assert got.extra[1].txn == txn
+    assert got == op
+
+
+def test_reconf_without_txns_unchanged():
+    cfg = Config(num=1, shards=(1,) * 10, groups=((1, ("a",)),))
+    xs = XState(kv=(("k", "v"),), dup=())
+    op = Op("reconf", "", "", "cfg-1", 1, (cfg, xs))
+    got = _op_from_wire(_gob_roundtrip(_op_to_wire(op)))
+    assert got == op

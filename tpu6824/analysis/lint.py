@@ -150,6 +150,15 @@ RULES: dict[str, str] = {
         "(reconfig/compact/txn, the host fallback engine) carry "
         "justified suppressions — that inventory IS the hot-path "
         "contract",
+    "host-sync-in-sharded-step":
+        "host synchronization (np.asarray / jax.device_get / "
+        ".block_until_ready) inside a sharded-step or per-shard "
+        "dispatch/drain function in mesh scope — the sharded fabric "
+        "step runs ONE fused program across every mesh shard, and a "
+        "host sync inside it serializes the whole mesh behind a single "
+        "device round-trip (ISSUE 17 meshfab: decide feeds drain "
+        "per-shard with no cross-device host sync); read back via "
+        "DevicePlane.fetch_host on the snapshot path, off the step",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -168,9 +177,17 @@ _DET_SCOPE = ("harness/nemesis.py", "harness/linearize.py")
 # The fused step path: modules whose dispatch loop the zero-extra-readback
 # contract covers (kernel rounds, the fabric clock, the sharded mesh).
 _STEP_SCOPE = ("core/kernel.py", "core/pallas_kernel.py",
-               "core/fabric.py", "parallel/mesh.py")
+               "core/fabric.py", "parallel/mesh.py", "core/fabdev.py")
 # Calls that force a device→host round-trip.
 _READBACK_TAILS = {"device_get", "block_until_ready"}
+# Mesh-fabric scope (host-sync-in-sharded-step): the sharded execution
+# path and the fabric's device plane.  Functions named `sharded_*` or
+# whose name mentions dispatch/drain run once per fused step across
+# every shard — a host sync there stalls the whole mesh.
+# DevicePlane.fetch_host is the sanctioned shard-local readback
+# (snapshot path, not the step path) and does not match the filter.
+_MESHSTEP_SCOPE = ("parallel/", "core/fabdev.py")
+_MESHSTEP_SYNC_DOTTED = {"np.asarray", "numpy.asarray", "jax.device_get"}
 _FEED_HOME = "core/fabric.py"  # the only module allowed to touch sub._q
 _MET_HOME = "obs/"  # the registry itself may get-or-create anywhere
 # The one module allowed to write-then-rename raw: the durable-write seam
@@ -373,6 +390,7 @@ class _FileLint(ast.NodeVisitor):
         self.commit_scope = _in_scope(relpath, _COMMIT_SCOPE)
         self.walldur_scope = _in_scope(relpath, _WALLDUR_SCOPE)
         self.decided_scope = _in_scope(relpath, _DECIDED_SCOPE)
+        self.meshstep_scope = _in_scope(relpath, _MESHSTEP_SCOPE)
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
@@ -382,6 +400,7 @@ class _FileLint(ast.NodeVisitor):
         self._scan_decided_walks()
         self._scan_eventloop_callbacks()
         self._scan_native_decode()
+        self._scan_meshstep_sync()
         self._scan_obs_buffers()
         self._scan_retry_loops()
         self._scan_wallclock_durations()
@@ -762,6 +781,41 @@ class _FileLint(ast.NodeVisitor):
                                    f"callback {fn.name}() — per-op frame "
                                    "decode belongs to the native ingest "
                                    "layer (rpcserver.cpp + rpc/wire.py)")
+
+    def _scan_meshstep_sync(self) -> None:
+        """host-sync-in-sharded-step: np.asarray / jax.device_get /
+        .block_until_ready inside a `sharded_*` or dispatch/drain
+        function in mesh scope — the fused sharded step must stay
+        async across every shard.  Nested defs are excluded (a closure
+        handed to jit runs on the device, not the host)."""
+        if not self.meshstep_scope:
+            return
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = fn.name
+            if not (name.startswith("sharded_")
+                    or "dispatch" in name or "drain" in name):
+                continue
+            skip: set[int] = set()
+            for n in ast.walk(fn):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n is not fn:
+                    skip.update(id(m) for m in ast.walk(n))
+            for n in ast.walk(fn):
+                if id(n) in skip or not isinstance(n, ast.Call):
+                    continue
+                d = _dotted(n.func)
+                if d is None:
+                    continue
+                tail = d.rsplit(".", 1)[-1]
+                if d in _MESHSTEP_SYNC_DOTTED or (
+                        "." in d and tail in _READBACK_TAILS):
+                    self._flag(n, "host-sync-in-sharded-step",
+                               f"{d}() synchronizes with the host inside "
+                               f"{name}() — the sharded step must stay "
+                               "async across every shard; read back via "
+                               "DevicePlane.fetch_host off the step path")
 
     def _scan_obs_buffers(self) -> None:
         """unbounded-obs-buffer: inside tpu6824/obs/, (a) any deque

@@ -288,9 +288,23 @@ class PaxosFabric:
         self._req_drop = unreliable_req_drop
         self._rep_drop = unreliable_rep_drop
         self.G, self.I, self.P = ngroups, ninstances, npeers
+        self.G_live = ngroups  # pre-padding group count (mesh fabrics pad)
+        self._mesh = mesh
+        self._plane = None
+        if mesh is not None:
+            # Device plane FIRST: it owns the shape policy — the group
+            # universe is ladder-padded to a per-shard jitshape rung so
+            # any service topology rides any mesh with a finite compiled
+            # signature set — and every host array below sizes against
+            # the padded count.  Padding groups are idle lanes: never
+            # started, never fed, invisible to services.
+            from tpu6824.core.fabdev import DevicePlane
+
+            self._plane = DevicePlane(mesh, ngroups, ninstances, npeers,
+                                      kernel=kernel)
+            self.G = self._plane.G
         G, I, P = self.G, self.I, self.P
         self._state = init_state(G, I, P)
-        self._mesh = mesh
         if mesh is None:
             self._step_fn = get_step(kernel)
             # On the XLA path, steps with no unreliable server skip
@@ -319,31 +333,18 @@ class PaxosFabric:
             # mesh — peer-axis reductions become psum over ICI when 'p'
             # spans devices — while the host API is unchanged (mirrors are
             # gathered by the per-step readback; compact io keeps that
-            # readback O(active cells)).
-            from tpu6824.parallel.mesh import (
-                place_state,
-                sharded_apply_starts,
-                sharded_step_auto,
-                sharded_step_reliable,
-            )
-
-            for ax in ("g", "i", "p"):
-                dim = {"g": G, "i": I, "p": P}[ax]
-                if dim % mesh.shape[ax]:
-                    raise ValueError(
-                        f"fabric {ax}-dim {dim} not divisible by mesh "
-                        f"axis {ax}={mesh.shape[ax]}")
-            self._state = place_state(self._state, mesh)
-            self._step_fn, impl = sharded_step_auto(mesh, impl=kernel)
+            # readback O(active cells)).  All placement decisions live in
+            # the device plane (core/fabdev.py); the fabric consumes its
+            # compiled entry points and shardings.
+            plane = self._plane
+            self._state = plane.place_state(self._state)
+            self._step_fn = plane.step_fn
             self._multi_step = self._multi_reliable = None
-            self._reliable_ok = impl == "xla"
-            self._step_reliable = (sharded_step_reliable(mesh)
-                                   if self._reliable_ok else None)
-            self._apply_starts = sharded_apply_starts(mesh)
-            from tpu6824.parallel.mesh import step_args_shardings
-
-            (self._sh_link, self._sh_done, self._sh_key,
-             self._sh_drop, _) = step_args_shardings(mesh)
+            self._reliable_ok = plane.reliable_ok
+            self._step_reliable = plane.step_reliable
+            self._apply_starts = plane.apply_starts
+            self._sh_link, self._sh_done = plane.sh_link, plane.sh_done
+            self._sh_key, self._sh_drop = plane.sh_key, plane.sh_drop
         self._key = jax.random.key(seed)
         self._key_arr = None  # current split batch; indexed by countdown
         self._key_buf_n = 0
@@ -378,11 +379,8 @@ class PaxosFabric:
         if io_mode == "compact":
             self._slot_seq_dev = jnp.full((G, I), -1, jnp.int32)
             if mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec
-
-                self._slot_seq_dev = jax.device_put(
-                    self._slot_seq_dev,
-                    NamedSharding(mesh, PartitionSpec("g", "i")))
+                self._slot_seq_dev = self._plane.place_slot_seq(
+                    self._slot_seq_dev)
         self._compact_fns: dict = {}
         self._zero_drop = None  # lazily-built (G, P, P) f32 zeros
         self._dummy_keys = None  # stacked (K,) dummies for the fused scan
@@ -651,19 +649,17 @@ class PaxosFabric:
             self._key_buf_n = _KEY_BATCH
         self._key_buf_n -= 1
         sub = self._key_arr[self._key_buf_n]
-        if self._mesh is not None:
-            sub = jax.device_put(sub, self._sh_key)
+        if self._plane is not None:
+            sub = self._plane.put_key(sub)
         return sub
 
     def _put(self, kind: str, x):
         """Host array → device, honoring the mesh placement when the
         fabric is mesh-hosted (a committed single-device array would
         conflict with the sharded step's in_shardings)."""
-        if self._mesh is None:
+        if self._plane is None:
             return jnp.asarray(x)
-        sh = {"link": self._sh_link, "done": self._sh_done,
-              "drop": self._sh_drop}[kind]
-        return jax.device_put(np.asarray(x), sh)
+        return self._plane.put(kind, x)
 
     def _step_once(self):
         if self._io_mode == "compact":
@@ -939,13 +935,13 @@ class PaxosFabric:
         step's in_shardings (same reason _put exists)."""
         if keys is not None:
             ks = jnp.stack(keys)
-            if self._mesh is not None:
-                ks = jax.device_put(ks, self._sh_key)
+            if self._plane is not None:
+                ks = self._plane.put_key(ks)
             return ks
         if self._dummy_keys is None:
             ks = jax.random.split(jax.random.key(0), self._spd)
-            if self._mesh is not None:
-                ks = jax.device_put(ks, self._sh_key)
+            if self._plane is not None:
+                ks = self._plane.put_key(ks)
             self._dummy_keys = ks
         return self._dummy_keys
 
@@ -1208,6 +1204,19 @@ class PaxosFabric:
     @property
     def pipeline_depth(self) -> int:
         return self._pipeline_depth
+
+    @property
+    def num_shards(self) -> int:
+        """Mesh shards on the group axis (1 for single-device fabrics —
+        the degradation contract's observable form)."""
+        return self._plane.shards if self._plane is not None else 1
+
+    def shard_of(self, g: int) -> int:
+        """Mesh shard owning group `g` (always 0 off-mesh).  The service
+        layer binds each kvpaxos/shardkv group to this at attach time —
+        drain/opscope attribution and the frontend's cross-shard routing
+        read the binding, never the mesh."""
+        return self._plane.shard_of(g) if self._plane is not None else 0
 
     @property
     def live_slots(self) -> int:
@@ -1892,7 +1901,12 @@ class PaxosFabric:
             if self._running:
                 raise RuntimeError("stop_clock() before checkpoint()")
             self._fold_done_async_locked()  # deferred Done → the snapshot
-            state_np = {f: np.array(x)
+            # Mesh fabrics read each leaf shard-locally (per-shard column
+            # pulls, core/fabdev.py::fetch_host) — the snapshot never
+            # triggers a cross-device all-gather.
+            fetch = (self._plane.fetch_host if self._plane is not None
+                     else np.array)
+            state_np = {f: fetch(x)
                         for f, x in zip(self._state._fields, self._state)}
             # Pending window-GC resets are applied INTO the snapshot (their
             # effect is deterministic): the device arrays may still carry
@@ -2004,10 +2018,8 @@ class PaxosFabric:
                 st[f] = remap(st[f]).astype(st[f].dtype)
             fab._state = type(fab._state)(**{
                 f: jnp.asarray(v) for f, v in st.items()})
-            if fab._mesh is not None:
-                from tpu6824.parallel.mesh import place_state
-
-                fab._state = place_state(fab._state, fab._mesh)
+            if fab._plane is not None:
+                fab._state = fab._plane.place_state(fab._state)
             fab._link = np.array(blob["link"])
             fab._link_dev = None
             fab._unreliable = np.array(blob["unreliable"])
@@ -2024,12 +2036,8 @@ class PaxosFabric:
             fab._slot_alloc_t[:] = time.monotonic()
             if fab._io_mode == "compact":
                 ss = jnp.asarray(fab._slot_seq.astype(np.int32))
-                if fab._mesh is not None:
-                    from jax.sharding import NamedSharding, PartitionSpec
-
-                    ss = jax.device_put(
-                        ss, NamedSharding(fab._mesh,
-                                          PartitionSpec("g", "i")))
+                if fab._plane is not None:
+                    ss = fab._plane.place_slot_seq(ss)
                 fab._slot_seq_dev = ss
             fab._seq2slot = [dict(d) for d in blob["seq2slot"]]
             # Pre-heap blobs stored LIFO lists; heapify restores the

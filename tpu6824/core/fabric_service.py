@@ -35,6 +35,11 @@ FABRIC_RPCS = [
     # clock pacing for group-commit drivers (blocks server-side until the
     # next step or timeout; positional args — the Proxy takes no kwargs)
     "wait_steps",
+    # shard binding (meshfab): which mesh shard owns group g.  Services
+    # probe it with hasattr at attach — but the Proxy synthesizes ANY
+    # method name, so omitting it here turns every remote-fabric service
+    # attach into an RPCError, not a single-shard fallback.
+    "shard_of",
     # harness / fault injection (set_pipeline_depth: live depth churn —
     # the nemesis engine treats pipeline depth as a fault dimension)
     "ndecided", "set_unreliable", "partition", "heal", "deafen",

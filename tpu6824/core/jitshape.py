@@ -49,6 +49,32 @@ def bucket_for(n: int, ladder: tuple[int, ...]) -> int:
     return ladder[-1]
 
 
+# The group-axis ladder (meshfab, ISSUE 17): when the fabric's G groups
+# shard over a mesh's 'g' axis, every compiled signature carries the
+# PER-SHARD group count G/n — so G itself must land on a rung·shards
+# product or each distinct service topology would compile its own
+# executables.  Capped at 1024 per shard: the paper's north-star shape
+# (1024 groups on v5e-8) is 128/shard, well inside.
+GROUP_LADDER = bucket_ladder(1, 1024)
+
+
+def shard_groups(n: int, shards: int,
+                 ladder: tuple[int, ...] = GROUP_LADDER) -> int:
+    """Total group count to ALLOCATE so `n` live groups shard evenly
+    over `shards` mesh slices with a ladder-stable per-shard count:
+    ceil(n/shards) rounded up to a rung, times shards.  The padding
+    groups are idle lanes (never started, never fed) — the price of a
+    finite compiled-signature set on the sharded real path.  With
+    shards=1 this is the identity for any n (single-device fabrics
+    keep their exact shapes)."""
+    shards = max(1, int(shards))
+    n = max(1, int(n))
+    if shards == 1:
+        return n
+    per = bucket_for((n + shards - 1) // shards, ladder)
+    return per * shards
+
+
 def pad_i32(arr, fill: int, bucket: int):
     """Pad (or create) an int32 column of exactly `bucket` slots, the
     tail filled with `fill` (a guard row index, a NOP kind — whatever

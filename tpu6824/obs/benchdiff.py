@@ -214,6 +214,22 @@ METRICS = [
     Metric(("service", "catchup", "install_ms_deepest"), 0.65,
            higher_is_better=False, host_bound=True,
            leg_shape=[("service", "catchup", "shape")]),
+    # meshfab (ISSUE 17): sharded real-path decided/s from the
+    # MULTICHIP_r07+ artifacts — the live fabric (pump loop, compact io,
+    # GC) hosted on the fabric_mesh quorum-sharded shapes at forced host
+    # device counts ({g:4,p:3}=12, {g:8,p:3}=24).  Forced-host "devices"
+    # are CPU threads sharing one box, so these are host-bound-noisy
+    # like every clerk-path leg; gated on the leg's own recorded mesh +
+    # group shape so a trimmed run skips, not false-alarms.  First
+    # recorded artifact (r07) baselines them; gated thereafter.
+    Metric(("meshfab", "g4p3", "decided_per_sec"), 0.65, host_bound=True,
+           leg_shape=[("meshfab", "g4p3", "mesh"),
+                      ("meshfab", "g4p3", "groups"),
+                      ("meshfab", "g4p3", "window")]),
+    Metric(("meshfab", "g8p3", "decided_per_sec"), 0.65, host_bound=True,
+           leg_shape=[("meshfab", "g8p3", "mesh"),
+                      ("meshfab", "g8p3", "groups"),
+                      ("meshfab", "g8p3", "window")]),
     # Host-edge legs: the demonstrated noise floor is −55% (wire
     # −40%/−53%, thread-per-clerk −55% between real artifacts).
     Metric(("wire", "value"), 0.65, host_bound=True),
@@ -336,6 +352,10 @@ def load_artifact(path: str) -> dict:
     with open(path) as f:
         d = json.load(f)
     if isinstance(d, dict) and "metric" in d:
+        return d
+    if isinstance(d, dict) and "meshfab" in d:
+        # MULTICHIP_r07+ artifact: dryrun verdict wrapper plus the
+        # meshfab real-path legs — the legs ARE the comparable payload.
         return d
     if isinstance(d, dict) and ("parsed" in d or "tail" in d):
         if isinstance(d.get("parsed"), dict):

@@ -292,7 +292,11 @@ class Collector:
         if not any_enabled:
             return None
         out = {}
-        for st in stages:
+        # Beyond the stage list proper: per-shard dispatch splits
+        # (ISSUE 17 meshfab) merge by the same bucket sum — any
+        # histogram a member serves survives into the fleet waterfall.
+        extra = sorted(k for k in merged if k not in stages)
+        for st in list(stages) + extra:
             b = merged.get(st, [0] * _NBUCKETS)
             n = counts.get(st, 0)
             out[st] = {

@@ -112,6 +112,22 @@ _TRIM_CAP = int(os.environ.get("TPU6824_OPSCOPE_CAP", str(1 << 16)))
 # carry the stage for the watchdog's culprit attribution.
 _H_EDGE = {e: _metrics.histogram(f"opscope.stage.{e}.latency_us")
            for e in EDGES}
+# Per-shard dispatch-edge histograms (meshfab): a fold tagged with the
+# folding group's owning mesh shard ALSO observes its dispatch edge
+# under `opscope.stage.dispatch.shard<k>.latency_us`, giving pulse a
+# per-shard p99 series the watchdog's shard-skew rule compares against
+# the fleet median.  Lazy per shard (the shard universe is the mesh 'g'
+# extent, known only at service attach; the registry returns the
+# already-created object, so the race-free fast path is one dict get).
+# Untagged folds (single-device fabrics, non-fabric servers) cost
+# nothing — the name parses as stage "dispatch" for the existing
+# watchdog culprit attribution.
+_H_SHARD_DISPATCH: dict = {}
+# Fleet-wide twin of the per-shard histograms: every shard-tagged
+# dispatch edge also lands here, so pulse carries ONE
+# `meshfab.shard_dispatch_us` p99 series for dashboards that want the
+# mesh-serving picture without per-shard cardinality.
+_H_MESH_DISPATCH = _metrics.histogram("meshfab.shard_dispatch_us")
 _H_TOTAL = _metrics.histogram("opscope.op.latency_us")
 _C_FOLDED = _metrics.counter("opscope.folded")
 _C_TRIM = _metrics.counter("opscope.trimmed")
@@ -231,12 +247,15 @@ def _maybe_trim() -> None:
 # ------------------------------------------------------------- the fold
 
 
-def fold(cids, t_decide: int, t_apply: int, t_reply: int) -> None:
+def fold(cids, t_decide: int, t_apply: int, t_reply: int,
+         shard: int | None = None) -> None:
     """One drained batch → per-stage-edge histograms + the exemplar
     reservoir.  `cids` are the ops this drain resolved; the three
     drain-level stamps are batch scalars (delivery / applied / pushed).
     The histogram update is one numpy stack + diff + bincount per batch
-    — never a per-op observe."""
+    — never a per-op observe.  `shard` (when the folding server's group
+    lives on a mesh shard) additionally routes the dispatch edge into
+    that shard's histogram — the opscope shard dimension."""
     if not cids:
         return
     import numpy as np
@@ -267,6 +286,14 @@ def fold(cids, t_decide: int, t_apply: int, t_reply: int) -> None:
     for i, edge in enumerate(EDGES[:-1]):
         counts = np.bincount(bl[i], minlength=64)
         _H_EDGE[edge].add_pow2(counts, n, int(us[i].sum()))
+        if shard is not None and edge == "dispatch":
+            h = _H_SHARD_DISPATCH.get(shard)
+            if h is None:
+                h = _metrics.histogram(
+                    f"opscope.stage.dispatch.shard{int(shard)}.latency_us")
+                _H_SHARD_DISPATCH[shard] = h
+            h.add_pow2(counts, n, int(us[i].sum()))
+            _H_MESH_DISPATCH.add_pow2(counts, n, int(us[i].sum()))
     tot = (m[-1] - m[0]) // 1000
     tbl = np.clip(np.ceil(np.log2(tot + 1.0)).astype(np.int64), 0, 63)
     _H_TOTAL.add_pow2(np.bincount(tbl, minlength=64), n, int(tot.sum()))
@@ -378,6 +405,15 @@ def snapshot() -> dict:
         hists[e] = {"count": s["count"], "sum": s["sum"],
                     "p50": s["p50"], "p95": s["p95"], "p99": s["p99"],
                     "pow2": s["pow2"]}
+    # Per-shard dispatch splits (ISSUE 17 meshfab) ride the same surface
+    # so the fleet Collector merges per-shard waterfalls like any other
+    # stage; single-shard deployments never populate these.
+    for shard in sorted(_H_SHARD_DISPATCH):
+        s = _H_SHARD_DISPATCH[shard].snapshot()
+        hists[f"dispatch.shard{shard}"] = {
+            "count": s["count"], "sum": s["sum"],
+            "p50": s["p50"], "p95": s["p95"], "p99": s["p99"],
+            "pow2": s["pow2"]}
     t = _H_TOTAL.snapshot()
     return {"schema": SCHEMA_VERSION, "enabled": _ENABLED,
             "stages": list(EDGES),

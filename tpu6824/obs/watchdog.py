@@ -217,6 +217,57 @@ class LatencySpike(Rule):
         return None
 
 
+class ShardDispatchSkew(Rule):
+    """One mesh shard's dispatch tail diverging from the fleet (meshfab):
+    the per-shard opscope dispatch histograms
+    (`opscope.stage.dispatch.shard<k>.latency_us.p99`) should track each
+    other on a healthy mesh — the fused dispatch is one device program.
+    A shard whose p99 runs ≥ `factor`× the FLEET MEDIAN of the same tick
+    means that shard's groups are being served slower: a hot shard
+    (placement imbalance the group ladder should have spread), a slices'
+    DCN link degrading, or one device throttling.  Needs at least 3
+    shard series (a median of 2 is just the other shard) and the same
+    absolute µs floor as the spike rule, so scheduler jitter on nearly-
+    idle shards never pages anyone."""
+
+    name = "shard-dispatch-skew"
+    _prefix = "opscope.stage.dispatch.shard"
+
+    def __init__(self, factor: float | None = None,
+                 min_us: float | None = None):
+        self.factor = _envf("TPU6824_WD_SHARD_SKEW_FACTOR", 4.0) \
+            if factor is None else factor
+        self.min_us = _envf("TPU6824_WD_SPIKE_MIN_US", 8192.0) \
+            if min_us is None else min_us
+
+    def check(self, wd):
+        last: dict[str, float] = {}
+        for name in wd.series_names():
+            if not (name.startswith(self._prefix)
+                    and name.endswith(".latency_us.p99")):
+                continue
+            pts = wd.points(name)
+            if pts:
+                shard = name[len(self._prefix):].split(".", 1)[0]
+                last[shard] = pts[-1][1]
+        if len(last) < 3:
+            return None
+        vals = sorted(last.values())
+        fleet = vals[len(vals) // 2]
+        if fleet <= 0:
+            return None
+        worst = max(last, key=last.get)
+        w = last[worst]
+        if w >= fleet * self.factor and w >= self.min_us:
+            self.evidence = {"shard": worst,
+                             "shard_p99_us": {k: round(v, 1)
+                                              for k, v in last.items()},
+                             "fleet_median_us": round(fleet, 1)}
+            return (f"shard {worst} dispatch p99 {w:.0f}us is "
+                    f"x{w / fleet:.1f} the fleet median ({fleet:.0f}us)")
+        return None
+
+
 class QueueGrowth(Rule):
     name = "queue-growth"
     # Consumer-side depth gauges: the fabric's decided-feed depth, the
@@ -469,8 +520,9 @@ class MemoryGrowth(Rule):
 
 def default_rules() -> list[Rule]:
     return [StalledGroups(), ThroughputCollapse(), LatencySpike(),
-            QueueGrowth(), ThreadCrashes(), DroppedClimbing(),
-            JitRecompile(), RetryStorm(), AbortStorm(), MemoryGrowth()]
+            ShardDispatchSkew(), QueueGrowth(), ThreadCrashes(),
+            DroppedClimbing(), JitRecompile(), RetryStorm(), AbortStorm(),
+            MemoryGrowth()]
 
 
 class Watchdog:

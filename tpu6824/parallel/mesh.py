@@ -58,6 +58,59 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices).reshape(g, i, p), axis_names=("g", "i", "p"))
 
 
+def make_hybrid_mesh(g: int, i: int, p: int, devices=None) -> Mesh:
+    """A (g, i, p) mesh that is DCN-aware on multi-slice topologies
+    (the t5x `create_hybrid_device_mesh` pattern): the 'g' axis — the
+    only axis with no collectives, groups never communicate — spans the
+    slower DCN links between slices, while 'i'/'p' (whose quorum psum
+    and window reductions ride ICI) stay within a slice.  Single-slice
+    or CPU-host device sets fall back to the plain reshape `make_mesh`
+    layout, which is the identity ordering there.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    if g * i * p != len(devices):
+        raise ValueError(
+            f"mesh shape (g={g}, i={i}, p={p}) needs {g * i * p} devices, "
+            f"got {len(devices)}")
+    slices = {getattr(d, "slice_index", 0) for d in devices}
+    nslice = len(slices)
+    if nslice > 1 and g % nslice == 0:
+        try:
+            from jax.experimental.mesh_utils import create_hybrid_device_mesh
+
+            dm = create_hybrid_device_mesh(
+                mesh_shape=(g // nslice, i, p),
+                dcn_mesh_shape=(nslice, 1, 1),
+                devices=devices)
+            return Mesh(dm, axis_names=("g", "i", "p"))
+        except Exception:  # pragma: no cover — topology probe unavailable
+            pass
+    return Mesh(np.asarray(devices).reshape(g, i, p),
+                axis_names=("g", "i", "p"))
+
+
+def fabric_mesh(ngroups: int | None = None, npeers: int | None = None,
+                devices=None) -> Mesh:
+    """The fabric's mesh policy, in one place: given the live device set
+    and a fabric topology, pick the (g, i, p) split and build the
+    (hybrid-aware) mesh.  The quorum axis 'p' spans devices only when
+    the device count divides by the peer count — then majority checks
+    lower to psum over ICI (the paper's headline shape, e.g. 12 devices
+    × 3 peers → {g:4, i:1, p:3}); otherwise every quorum stays local and
+    all devices become group lanes.  'g' shard count is capped at the
+    live group count so tiny services don't pay ladder padding across
+    idle devices.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    p = npeers if npeers and npeers > 1 and n % npeers == 0 else 1
+    g = n // p
+    if ngroups and ngroups < g:
+        g = ngroups
+        devices = devices[:g * p]
+    return make_hybrid_mesh(g, 1, p, devices)
+
+
 def state_shardings(mesh: Mesh) -> PaxosState:
     """PartitionSpecs for every PaxosState leaf."""
     s3 = NamedSharding(mesh, P("g", "i", "p"))
@@ -120,6 +173,29 @@ def sharded_apply_starts(mesh: Mesh):
         apply_starts.__wrapped__,
         in_shardings=(st, gi, gip, gip),
         out_shardings=st,
+    )
+
+
+def sharded_apply_step_groups(mesh: Mesh):
+    """The devapply kernel's stacked per-group step (`apply_step_groups`,
+    devapply_kernel.py's shard_map composition hook) under the mesh: the
+    leading group axis of every DevKVState leaf and of the packed op
+    columns shards over 'g', and — since `_apply_cols` is per-group pure
+    with no cross-group reads — GSPMD partitions the vmap with ZERO
+    collectives.  Each device applies only its own groups' drains.
+
+    Same donation contract as the single-device `apply_step_groups`:
+    the stacked state is consumed, callers chain the returned one.
+    """
+    from tpu6824.core.devapply_kernel import DevKVState, _apply_cols
+
+    lead = NamedSharding(mesh, P("g"))
+    st = DevKVState(*([lead] * len(DevKVState._fields)))
+    return jax.jit(
+        jax.vmap(_apply_cols),
+        in_shardings=(st, lead),
+        out_shardings=(st, lead),
+        donate_argnums=(0,),
     )
 
 

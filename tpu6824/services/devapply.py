@@ -618,3 +618,128 @@ class DevApplyEngine:
 
     def table_load(self) -> float:
         return len(self._i2k) / self.slots
+
+
+class ShardedApplyBank:
+    """Stacked per-group device KV states over a mesh's 'g' axis — the
+    composition hook `apply_step_groups` promised, made real (meshfab).
+
+    G group states ride ONE stacked DevKVState whose leaves lead with a
+    ladder-padded group axis (`jitshape.shard_groups`), applied by
+    `parallel.mesh.sharded_apply_step_groups`: one jitted,
+    collective-free device step applies EVERY group's drain, each mesh
+    shard touching only its own groups' table/chain columns.
+
+    Deliberately leaner than DevApplyEngine — no interning, no mirror,
+    no rebase: callers speak integer ids, `(kind, kid, vid)` per op, and
+    read back pre-nodes.  The host bookkeeping is the engine's same
+    slot-probe/chain-cursor discipline (host_insert against a per-group
+    tbl_kid shadow, consecutive chain nodes, last-write tmask,
+    same-batch read-after-write prevs), vectorized per group.  The
+    kvpaxos decided path keeps DevApplyEngine; this bank is the mesh
+    real-path building block the multichip bench and the meshfab smoke
+    drive."""
+
+    def __init__(self, mesh, ngroups: int, slots: int = 1 << 10,
+                 bucket: int = 256):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from tpu6824.core.jitshape import shard_groups
+        from tpu6824.parallel.mesh import sharded_apply_step_groups
+
+        if slots & (slots - 1):
+            raise ValueError(f"slots must be a power of two: {slots}")
+        self.mesh = mesh
+        self.G_live = int(ngroups)
+        self.G = shard_groups(ngroups, mesh.shape["g"])
+        self.slots = slots
+        self.chain = 4 * slots
+        self.bucket = int(bucket)
+        self._step = sharded_apply_step_groups(mesh)
+        G, S, C = self.G, slots, self.chain
+        lead = NamedSharding(mesh, PartitionSpec("g"))
+        self._state = DevKVState(
+            tbl_kid=jax.device_put(np.full((G, S + 1), -1, np.int32), lead),
+            tbl_node=jax.device_put(np.full((G, S + 1), -1, np.int32), lead),
+            chain_vid=jax.device_put(np.zeros((G, C + 1), np.int32), lead),
+            chain_prev=jax.device_put(np.full((G, C + 1), -1, np.int32),
+                                      lead),
+            n_chain=jax.device_put(np.zeros(G, np.int32), lead),
+        )
+        # Host shadows (slot authority + chain walk), per group:
+        self._htbl = np.full((G, S + 1), -1, np.int32)
+        self._nc = np.zeros(G, np.int64)
+        # node → (vid, prev) per group: the host-known chain shadow a
+        # get's pre-node resolves through (the bank's analog of the
+        # engine's _node_val memo, ids only).
+        self._nodes: list[dict] = [dict() for _ in range(G)]
+        # kid → last chain node per group — the host shadow of
+        # tbl_node, so append chains link across batches exactly as
+        # the device's table gather does.
+        self._lastn: list[dict] = [dict() for _ in range(G)]
+        self._fills = col_fills(S)
+
+    def apply(self, ops_per_group) -> np.ndarray:
+        """One stacked device step over every group's ops.
+
+        `ops_per_group`: sequence (≤ G_live long) of per-group op lists,
+        each op `(kind, kid, vid)` with kind in {"get", "put",
+        "append"}; vid ignored for gets.  Returns the (G, bucket)
+        pre-node readback — `pre[g, i]` is group g's op i's key chain
+        node BEFORE the op (the get result / append prev), -1 for
+        a key never written.  Callers chunk batches wider than
+        `bucket` (the jitshape chunking discipline)."""
+        import jax
+
+        G, S, B = self.G, self.slots, self.bucket
+        if max((len(o) for o in ops_per_group), default=0) > B:
+            raise ValueError(f"batch wider than bucket {B}: chunk it")
+        cols = np.tile(self._fills, (G, 1, B)).astype(np.int32)
+        for g, ops in enumerate(ops_per_group):
+            nodes, htbl = self._nodes[g], self._htbl[g]
+            lastn = self._lastn[g]
+            nc = int(self._nc[g])
+            lastw: dict[int, int] = {}
+            last_slot: dict[int, int] = {}
+            for i, (kind, kid, vid) in enumerate(ops):
+                slot = host_insert(htbl, S, kid)
+                cols[g, C_SLOT, i] = slot
+                cols[g, C_KID, i] = kid
+                cols[g, C_PREV, i] = lastw.get(kid, -1)
+                if kind == "get":
+                    cols[g, C_KIND, i] = K_GET
+                    continue
+                if nc >= self.chain:
+                    raise RuntimeError(
+                        f"sharded bank chain full (group {g}): "
+                        "snapshot/rebuild before more writes")
+                code = _KIND_CODE[kind]
+                cols[g, C_KIND, i] = code
+                cols[g, C_VID, i] = vid
+                cols[g, C_NODE, i] = nc
+                prevn = lastw.get(kid, lastn.get(kid, -1))
+                nodes[nc] = (vid, prevn if code == K_APPEND else -1)
+                lastw[kid] = lastn[kid] = nc
+                last_slot[slot] = i
+                nc += 1
+            for i in last_slot.values():
+                cols[g, C_TMASK, i] = 1
+            cols[g, C_NC, 0] = nc
+            self._nc[g] = nc
+        self._state, pre = self._step(self._state, cols)
+        # One host readback per stacked batch — the bank's whole-mesh
+        # analog of the engine's one-readback-per-flush contract.
+        return np.asarray(pre)
+
+    def resolve_chain(self, g: int, node: int) -> list:
+        """Value-id segments of the chain ending at `node`, root first
+        (a put chain is one segment; appends accumulate)."""
+        out = []
+        while node >= 0:
+            vid, prev = self._nodes[g][node]
+            out.append(vid)
+            node = prev
+        out.reverse()
+        return out

@@ -95,6 +95,12 @@ _M_TIMEOUTS = _metrics.counter("frontend.timeouts")
 # up as frontend.timeouts — reached sooner, which is the point.
 _M_SHED = _metrics.counter("frontend.shed")
 _M_INFLIGHT = _metrics.gauge("frontend.inflight_ops")
+# meshfab cross-shard serving: ops arriving in a frame whose routed
+# groups span MORE THAN ONE mesh shard — the frame fans out across
+# devices to be served.  A mesh deployment whose clerks batch
+# shard-locally keeps this near zero; a climbing rate says the key→
+# group→shard placement is fighting the traffic shape.
+_M_XSHARD = _metrics.counter("meshfab.cross_shard_ops")
 # Native zero-GIL ingest (ISSUE 11): the C++ loop's decode counters,
 # mirrored into the registry each engine pass so pulse/top/watchdog see
 # the native path (the inflight gauge is what queue-growth watches).
@@ -294,13 +300,27 @@ class ClerkFrontend:
     def __init__(self, servers=None, addr: str = "", *,
                  op_timeout: float = OP_TIMEOUT, seed: int | None = None,
                  prefer_native: bool = True, op_factory=_kv_op,
-                 groups=None, route=None,
+                 groups=None, route=None, shard_of=None,
                  ingest_max_ops: int = 1 << 16,
                  max_inflight: int | None = None):
         if groups is None:
             groups = [list(servers)]
         self.groups = [list(g) for g in groups]
         self._route = route if route is not None else (lambda key: 0)
+        # meshfab cross-shard serving: per-group owning mesh shard,
+        # defaulting to each group's lead replica's shard binding (the
+        # kvpaxos/shardkv servers bind `shard` at attach) — so routing
+        # ops to the shard owning their group needs no extra wiring, and
+        # a frame spanning shards is observable (_note_shards).  Single-
+        # device fabrics bind everything to shard 0 and the whole path
+        # is one predicate.
+        if shard_of is None:
+            binds = [getattr(g[0], "shard", 0) if g else 0
+                     for g in self.groups]
+            shard_of = binds.__getitem__
+        self._shard_of = shard_of
+        self._multi_shard = len(
+            {shard_of(i) for i in range(len(self.groups))}) > 1
         self._leaders = [0] * len(self.groups)
         self.addr = addr
         self.op_timeout = op_timeout
@@ -506,6 +526,19 @@ class ClerkFrontend:
                     tc = (sp.trace_id, sp.span_id)
                     sp.end()
         return self.op_factory(kind, key, value, cid, cseq, tc)
+
+    def _note_shards(self, gids) -> None:
+        """Cross-shard accounting for ONE frame's routed groups: when
+        they span more than one mesh shard, every op in the frame is a
+        cross-shard op (serving it fans out across devices).  One
+        predicate + at most one counter bump per frame; single-shard
+        deployments early-out on a cached bool."""
+        if not self._multi_shard or not gids:
+            return
+        so = self._shard_of
+        first = so(gids[0])
+        if any(so(g) != first for g in gids):
+            _M_XSHARD.inc(len(gids))
 
     def _submit(self, ops, owners, gids, futmap) -> None:
         """This pass's ops, ONE columnar submit_batch per target group
@@ -790,6 +823,7 @@ class ClerkFrontend:
                     defer.append(nf)  # no tickets: decref next pass
                     continue
                 nf.gids = gids
+                self._note_shards(gids)
             if tr and tc is not None:
                 # The frame-scoped wire context fans out per op, same
                 # span names as the Python decode path (tracing is the
@@ -1037,6 +1071,7 @@ class ClerkFrontend:
                                     raise ValueError(
                                         f"route() -> {gid} outside "
                                         f"[0, {ngroups})")
+                            self._note_shards(fr.gids)
                         else:
                             fr.gids = [0] * nops
                     except Exception as e:  # noqa: BLE001 — bad frame ≠ dead loop
@@ -1132,6 +1167,8 @@ class ClerkFrontend:
         multi = len(self.groups) > 1
         gids = [self._route(op.key) for op in ops] if multi \
             else [0] * len(ops)
+        if multi:
+            self._note_shards(gids)
         deadline = time.monotonic() + self.op_timeout
         replies = [_UNSET] * len(ops)
         todo = list(range(len(ops)))

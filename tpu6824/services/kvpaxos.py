@@ -217,6 +217,13 @@ class KVPaxosServer:
         # fast-forward semantics byte-for-byte).
         self.peers = peers
         self.g = g
+        # meshfab shard binding: which mesh shard owns this group's
+        # device columns (0 off-mesh / non-fabric backends).  Read at
+        # every drain fold for the opscope shard dimension — bound once
+        # here so the hot path never touches the fabric's placement map.
+        fab = getattr(self.px, "fabric", None)
+        self.shard = (fab.shard_of(g)
+                      if fab is not None and hasattr(fab, "shard_of") else 0)
         self.dup_retire_ops = (_horizon.DUP_RETIRE_OPS
                                if dup_retire_ops is None
                                else int(dup_retire_ops))
@@ -587,7 +594,7 @@ class KVPaxosServer:
             prof.add("notify", time.perf_counter_ns() - t0)
             if scope_cids:
                 _opscope.fold(scope_cids, t_decide, t_apply,
-                              time.monotonic_ns())
+                              time.monotonic_ns(), shard=self.shard)
         self._last_drain = applied_n
         if self.applied >= base0:
             if self._dev is not None:

@@ -118,6 +118,13 @@ class ShardKVServer:
         self.px = px if px is not None else PaxosPeer(fabric, fg, me)
         self.gid = gid
         self.me = me
+        # meshfab shard binding (see kvpaxos): the mesh shard owning
+        # this group's fabric columns, 0 off-mesh — folded drains tag
+        # their dispatch edge with it.
+        _fab = getattr(self.px, "fabric", None)
+        self.shard = (_fab.shard_of(fg)
+                      if _fab is not None and hasattr(_fab, "shard_of")
+                      else 0)
         self.name = f"g{gid}-{me}"
         self.directory = directory
         directory[self.name] = self
@@ -382,7 +389,8 @@ class ShardKVServer:
                 acc, self._scope_acc = self._scope_acc, None
                 if acc:
                     t_now = time.monotonic_ns()
-                    _opscope.fold(acc, t_decide or t_now, t_now, t_now)
+                    _opscope.fold(acc, t_decide or t_now, t_now, t_now,
+                                  shard=self.shard)
             if self.applied >= base0:
                 self.px.done(self.applied)
             return
@@ -1124,6 +1132,8 @@ class ShardSystem(_ShardSystemOps):
 # travels as flattened gob maps; to_wire/from_wire are exact round-trips so
 # the RSM's "mine?" equality check works on wire-decoded ops.
 
+import json as _json
+
 from tpu6824.services.host_backend import StructOpPeer
 from tpu6824.shim.gob import INT, STRING, Array, Map, Slice, Struct
 
@@ -1141,28 +1151,26 @@ SKVOP_WIRE = Struct("SKVOp", [
     ("XSeq", Map(STRING, INT)),
     ("XErr", Map(STRING, STRING)),
     ("XVal", Map(STRING, STRING)),
+    ("XTxn", Slice(STRING)),
 ])
 
 
 def _op_to_wire(op: Op) -> dict:
-    if op.kind in txnkv.TXN_KINDS:
-        # The decentralized gob backend does not speak 2PC (SKVOP_WIRE
-        # has no txn fields; silently dropping a prepare would be a
-        # half-applied transaction by construction) — refuse loudly.
-        raise ValueError(
-            f"txn op {op.kind!r} unsupported on the gob host backend")
+    # txn_* ops carry their whole payload in Kind/Value/CID/Seq (the
+    # payload is already JSON) — the base fields cover them.  The only
+    # txn-specific wire state is XState.txn riding a reconf, below.
     d = {"Kind": op.kind, "Key": op.key, "Value": op.value,
          "CID": op.cid, "Seq": op.cseq,
          "Config": {"Num": 0, "Shards": [0] * NSHARDS, "Groups": {}},
-         "XKV": {}, "XSeq": {}, "XErr": {}, "XVal": {}}
+         "XKV": {}, "XSeq": {}, "XErr": {}, "XVal": {}, "XTxn": []}
     if op.kind == "reconf":
         cfg, xs = op.extra
-        if getattr(xs, "txn", ()):
-            raise ValueError(
-                "XState with prepared transactions cannot ride the gob "
-                "wire (no txn fields) — txnkv requires the fabric backend")
         d["Config"] = {"Num": cfg.num, "Shards": list(cfg.shards),
                        "Groups": {g: list(s) for g, s in cfg.groups}}
+        # Prepared-lock-table rows (export_prepared 5-tuples) as one
+        # JSON document per row — gob stays schema-stable while the
+        # row shape is free to grow trailing columns.
+        d["XTxn"] = [_json.dumps(row) for row in getattr(xs, "txn", ())]
         d["XKV"] = dict(xs.kv)
         for cid, (cseq, reply) in xs.dup:
             err, val = reply
@@ -1180,12 +1188,19 @@ def _op_from_wire(d: dict) -> Op:
             num=c["Num"], shards=tuple(c["Shards"]),
             groups=tuple(sorted((g, tuple(s)) for g, s in c["Groups"].items())),
         )
+        txn = []
+        for doc in d.get("XTxn") or ():
+            tid, coord, coord_srv, tops, origins = _json.loads(doc)
+            txn.append((tid, int(coord), tuple(coord_srv),
+                        tuple(tuple(t) for t in tops),
+                        tuple(int(o) for o in origins)))
         xs = XState(
             kv=tuple(sorted(d["XKV"].items())),
             dup=tuple(sorted(
                 (cid, (d["XSeq"][cid], (d["XErr"][cid], d["XVal"][cid])))
                 for cid in d["XSeq"]
             )),
+            txn=tuple(txn),
         )
         extra = (cfg, xs)
     return Op(d["Kind"], d["Key"], d["Value"], d["CID"], d["Seq"], extra)
