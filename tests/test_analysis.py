@@ -54,6 +54,7 @@ GOLDEN_EXPECT = {
     "services/commit_wait.py": {"blocking-commit-wait": 2},
     "services/unbounded_state.py": {"unbounded-host-state": 2},
     "services/kvpaxos.py": {"host-walk-in-decided-path": 3},
+    "services/fe_local_dedup.py": {"frontend-local-dedup": 2},
     "rpc/native_server.py": {"python-decode-in-native-path": 3},
     "rpc/retry_loop.py": {"unbounded-retry": 2},
     "rpc/wallclock.py": {"wallclock-duration": 2},

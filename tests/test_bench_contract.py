@@ -35,6 +35,11 @@ def test_one_json_line_with_required_keys():
                    "BENCH_OVERLOAD_SECONDS": "1",
                    "BENCH_OVERLOAD_WIDTH": "32",
                    "BENCH_OVERLOAD_CONNS": "2",
+                   "BENCH_FLEET_GROUPS": "2",
+                   "BENCH_FLEET_INSTANCES": "128",
+                   "BENCH_FLEET_SECONDS": "1",
+                   "BENCH_FLEET_WIDTH": "32",
+                   "BENCH_FLEET_CONNS": "3",
                    "BENCH_TXN_SECONDS": "1",
                    "BENCH_TXN_ACCOUNTS": "6",
                    "BENCH_TXN_CLIENTS": "2",
@@ -140,6 +145,35 @@ def test_one_json_line_with_required_keys():
         assert 0.0 <= leg["shed_frac"] <= 1.0, leg
     assert ov["goodput_4x_frac"] > 0, ov
     assert ov["shape"]["max_inflight"] >= 1, ov
+    # Fleet provenance (ISSUE 18, fleetfe): every recorded run must
+    # carry the fleet storm leg — measured fleet capacity, the
+    # 1×/4×/16× open-loop table, the watchdog-armed fault-free control
+    # (which must be SILENT), and the kill/revive storm with its
+    # re-convergence window and retry-migration count — or the
+    # crash-tolerant-frontend claims have no artifact trail and
+    # benchdiff cannot gate the new entries.
+    fl = d["service"]["fleet"]
+    assert "error" not in fl, fl
+    assert fl["capacity_ops_s"] > 0 and fl["value"] > 0, fl
+    assert fl["shape"]["frontends"] >= 3, fl
+    assert [leg["multiplier"] for leg in fl["legs"]] == [1, 4, 16], fl
+    for leg in fl["legs"]:
+        assert leg["offered_ops_s"] > 0, leg
+        assert 0.0 <= leg["shed_frac"] <= 1.0, leg
+    assert fl["logical_clients"] > 0, fl
+    ctl = fl["control"]
+    assert ctl["watchdog_incidents"] == 0, ctl
+    assert set(ctl["watchdog_rules"]) == {
+        "retry-storm", "abort-storm", "queue-growth", "latency-spike"}, ctl
+    st = fl["storm"]
+    assert st["kill_wall_s"] is not None, st
+    assert st["revive_wall_s"] > st["kill_wall_s"], st
+    assert st["goodput_ops_s"] > 0, st
+    assert st["nemesis_signature_len"] > 0, st
+    # per-frontend attribution: one collector member per frontend id
+    col = fl["collector"]
+    assert col["errors"] == 0, col
+    assert len(col["per_frontend"]) >= fl["shape"]["frontends"], col
     # Transaction provenance (ISSUE 13, txnkv): every recorded run must
     # carry the txn leg — cross-shard 2PC commit throughput, the abort
     # fraction at the recorded contention, commit-latency percentiles,

@@ -552,7 +552,7 @@ def test_new_vocabulary_schedules_are_stamped_and_round_trip(tmp_path):
             "scopes": ["a", "b"], "actions": [
                 "crash_process", "reboot_process", "disk_fault"]}
     sched = FaultSchedule.generate(99, 4.0, spec)
-    assert sched.schema == FaultSchedule.SCHEMA == 5
+    assert sched.schema == FaultSchedule.SCHEMA == 6
     acts = [e.action for e in sched]
     assert "crash_process" in acts and "disk_fault" in acts
     # Every crash ends rebooted (the revival guarantee).
@@ -568,7 +568,7 @@ def test_new_vocabulary_schedules_are_stamped_and_round_trip(tmp_path):
     with open(p, "w") as f:
         json.dump(sched.to_dict(), f)
     again = FaultSchedule.from_json(p)
-    assert again == sched and again.schema == 5
+    assert again == sched and again.schema == 6
     assert again.signature() == sched.signature()
     # Determinism across the new vocabulary.
     assert FaultSchedule.generate(99, 4.0, spec) == sched

@@ -466,7 +466,7 @@ def test_pre_horizon_schema4_capture():
     again = FaultSchedule.from_dict(sched.to_dict())
     assert again == sched and again.schema == 4
     assert again.signature() == sched.signature()
-    assert FaultSchedule.SCHEMA == 5
+    assert FaultSchedule.SCHEMA == 6
 
 
 def test_lag_revive_schedule_generation_deterministic():
@@ -478,7 +478,7 @@ def test_lag_revive_schedule_generation_deterministic():
                                 weights={"lag_revive": 4.0})
     s2 = FaultSchedule.generate(141, 4.0, spec,
                                 weights={"lag_revive": 4.0})
-    assert s1 == s2 and s1.schema == 5
+    assert s1 == s2 and s1.schema == 6
     lagged = [e for e in s1 if e.action == "lag_revive"]
     assert lagged, "weighted lag_revive never sampled"
     assert all(e.args["disk"] in ("keep", "dirty", "lose")

@@ -194,7 +194,7 @@ def test_python_fallback_serves_same_layout(tmp_path):
         assert not fe.deferred
         st = FrontendStream(fe.addr, conns=2, width=4,
                             wire_format="native")
-        assert st._native is True
+        assert st._native[fe.addr] is True
         total = st.run_appends(lambda c: "pk", lambda c, i: f"x {c} {i} y",
                                stop=None, max_per_client=3)
         assert total == 12

@@ -959,7 +959,7 @@ def test_pre_txn_schema3_capture():
     again = FaultSchedule.from_dict(sched.to_dict())
     assert again == sched and again.schema == 3
     assert again.signature() == sched.signature()
-    assert FaultSchedule.SCHEMA == 5
+    assert FaultSchedule.SCHEMA == 6
 
 
 def test_kill_mid_commit_schedule_generation_deterministic():
@@ -968,7 +968,7 @@ def test_kill_mid_commit_schedule_generation_deterministic():
     ).spec()
     s1 = FaultSchedule.generate(77, 3.0, spec)
     s2 = FaultSchedule.generate(77, 3.0, spec)
-    assert s1 == s2 and s1.schema == 5
+    assert s1 == s2 and s1.schema == 6
     assert all(e.action == "kill_mid_commit" and
                e.args["disk"] in ("keep", "dirty") for e in s1)
     assert len(s1) > 0

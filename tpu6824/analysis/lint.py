@@ -159,6 +159,16 @@ RULES: dict[str, str] = {
         "device round-trip (ISSUE 17 meshfab: decide feeds drain "
         "per-shard with no cross-device host sync); read back via "
         "DevicePlane.fetch_host on the snapshot path, off the step",
+    "frontend-local-dedup":
+        "dup/at-most-once state (attribute names mentioning dup/dedup/"
+        "seen/last_reply/replied) grown on a *Frontend* class in "
+        "services scope — the frontend tier is horizontally replaceable "
+        "(fleetfe, ISSUE 18): a clerk's retry after a frontend death "
+        "lands on a DIFFERENT frontend, so an at-most-once decision "
+        "made from frontend-local memory answers from state the rest "
+        "of the fleet cannot see (stale dup hit, or a double-apply the "
+        "local table never heard about); dedupe through the replicated "
+        "dup table the RSM applies, and keep frontends stateless",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -214,6 +224,13 @@ _DECODE_TAILS = {"unpack", "unpack_from", "from_bytes"}
 # Commit-wait scope (blocking-commit-wait): the service layer, where
 # RSM apply paths and server mutexes live.
 _COMMIT_SCOPE = ("services/",)
+# Frontend-dedup scope (frontend-local-dedup): the service layer again,
+# but keyed by CLASS name — the rule polices classes named *Frontend*
+# (the horizontally-replaceable serving tier), not the RSM servers,
+# whose replicated `self.dup` tables are exactly where dedup belongs.
+_FE_DEDUP_SCOPE = ("services/",)
+_FE_DEDUP_ATTR_RE = re.compile(
+    r"dup|dedup|seen|last_?reply|replied", re.IGNORECASE)
 # Decided-path scope (host-walk-in-decided-path): the RSM services whose
 # apply/drain loops the devapply columnar contract covers (ISSUE 16).
 # Key-keyed store walks there belong on the device; cid-keyed waiter/dup
@@ -397,6 +414,7 @@ class _FileLint(ast.NodeVisitor):
         self._jit_defs = self._resolve_jit_defs()
         self._scan_persistence()
         self._scan_apply_growth()
+        self._scan_frontend_dedup()
         self._scan_decided_walks()
         self._scan_eventloop_callbacks()
         self._scan_native_decode()
@@ -599,6 +617,58 @@ class _FileLint(ast.NodeVisitor):
                            f"{cls.name} with no trim/GC/snapshot-"
                            "replace path anywhere in the class — "
                            "unbounded host state on the decided path")
+
+    def _scan_frontend_dedup(self) -> None:
+        """frontend-local-dedup: inside classes named *Frontend* in
+        services scope, flag growth of self-attribute state whose name
+        reads as dup/at-most-once bookkeeping (subscript assignment or
+        add/setdefault/append on `self.<dup-ish>`).  The RSM servers'
+        replicated `self.dup` tables live in classes NOT named
+        *Frontend* and stay clean; a frontend caching "already answered
+        (cid, cseq)" locally is exactly the state a migrated retry
+        cannot see.  One finding per (class, attr), at the first growth
+        site."""
+        if not _in_scope(self.rel, _FE_DEDUP_SCOPE):
+            return
+        grow_verbs = {"add", "setdefault", "append", "put"}
+
+        def self_attr(node) -> str | None:
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return node.attr
+            return None
+
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef) or \
+                    "Frontend" not in cls.name:
+                continue
+            flagged: set[str] = set()
+            for n in ast.walk(cls):
+                attr, site = None, None
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Subscript):
+                            a = self_attr(t.value)
+                            if a:
+                                attr, site = a, n
+                elif isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in grow_verbs:
+                    a = self_attr(n.func.value)
+                    if a:
+                        attr, site = a, n
+                if attr is None or attr in flagged:
+                    continue
+                if not _FE_DEDUP_ATTR_RE.search(attr):
+                    continue
+                flagged.add(attr)
+                self._flag(site, "frontend-local-dedup",
+                           f"self.{attr} grows dup/at-most-once state "
+                           f"inside frontend class {cls.name} — a "
+                           "migrated retry lands on a frontend that "
+                           "never saw this table; dedupe through the "
+                           "replicated dup table instead")
 
     def _scan_decided_walks(self) -> None:
         """host-walk-in-decided-path: inside `_apply*` / `*drain*`

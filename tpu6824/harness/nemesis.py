@@ -79,6 +79,13 @@ DEFAULT_WEIGHTS = {
     # for the group's GC horizon to pass it — revival (the ordinary
     # reboot_process / restore tail) must catch up via snapshot-install
     "lag_revive": 1.0,
+    # fleetfe (ISSUE 18): the frontend TIER as a fault dimension — kill
+    # a serving frontend outright, drain one gracefully (stop accepting,
+    # flush parked replies, exit), revive a downed one.  The generator
+    # always leaves >= 1 frontend alive so open-loop clerks can migrate.
+    "fe_kill": 1.2,
+    "fe_revive": 3.0,
+    "fe_drain": 0.8,
 }
 EXTRA_WEIGHT = 1.5
 
@@ -132,12 +139,15 @@ class FaultSchedule:
     #: and commit-record, ISSUE 13); 5 adds the horizon action
     #: (`lag_revive {name, disk}` — crash a process and hold it down
     #: past the group's GC horizon so its revival must catch up via
-    #: snapshot-install, ISSUE 14).  `from_dict` accepts unstamped v1
-    #: artifacts — old /tmp/nemesis-*.json captures keep replaying —
-    #: loads stamped v2/v3/v4 captures byte-exact, and never rejects a
-    #: NEWER stamp (events are plain (t, action, args) rows; unknown
-    #: actions fail loudly at apply time, which is the right place).
-    SCHEMA = 5
+    #: snapshot-install, ISSUE 14); 6 adds the fleetfe actions
+    #: (`fe_kill/fe_revive/fe_drain {name}` — kill, revive, or
+    #: gracefully drain a frontend-tier process, ISSUE 18).
+    #: `from_dict` accepts unstamped v1 artifacts — old
+    #: /tmp/nemesis-*.json captures keep replaying — loads stamped
+    #: v2/v3/v4/v5 captures byte-exact, and never rejects a NEWER stamp
+    #: (events are plain (t, action, args) rows; unknown actions fail
+    #: loudly at apply time, which is the right place).
+    SCHEMA = 6
 
     def __init__(self, events: list[NemesisEvent], seed: int | None = None,
                  params: dict | None = None, schema: int | None = None):
@@ -253,6 +263,12 @@ class _GenState:
         # txnkv: mid-commit kill disk dispositions (TxnKillTarget).
         self.txn_disk_modes = list(
             spec.get("txn_disk_modes", MID_COMMIT_DISK_MODES))
+        # fleetfe: serving-tier frontends (FrontendTarget).  The sampler
+        # keeps >= 1 alive at all times — a storm that downs the whole
+        # tier tests nothing but clerk timeouts; the migration scenario
+        # needs a survivor to migrate TO.
+        self.frontends = list(spec.get("frontends", []))
+        self.fe_down: set = set()
 
     def _max_killed(self) -> int:
         return max(0, (self.P - 1) // 2)
@@ -295,6 +311,10 @@ class _GenState:
             return bool(self.scopes)
         if a == "net_fault":
             return bool(self.net_scopes)
+        if a in ("fe_kill", "fe_drain"):
+            return len(self.frontends) - len(self.fe_down) >= 2
+        if a == "fe_revive":
+            return bool(self.fe_down)
         return True
 
     def _quiet_names(self):
@@ -422,6 +442,17 @@ class _GenState:
             return {"scope": rng.choice(sorted(self.net_scopes)),
                     "kind": rng.choice(self.net_kinds),
                     "frac": round(rng.random(), 6)}
+        if action in ("fe_kill", "fe_drain"):
+            alive = [n for n in self.frontends if n not in self.fe_down]
+            if len(alive) < 2:
+                return None
+            name = rng.choice(alive)
+            self.fe_down.add(name)
+            return {"name": name}
+        if action == "fe_revive":
+            name = rng.choice(sorted(self.fe_down))
+            self.fe_down.discard(name)
+            return {"name": name}
         if action == "kill_mid_commit":
             # Mostly keep the disk; sometimes reboot over a
             # power-crashed one (the crash_process weighting, minus
@@ -455,6 +486,10 @@ class _GenState:
         # crashes injected before a stop()).
         for name in sorted(self.crashed):
             tail.append(("reboot_process", {"name": name}))
+        # Frontend-tier revival guarantee: killed/drained frontends end
+        # revived, so the post-soak reads always have the full tier.
+        for name in sorted(self.fe_down):
+            tail.append(("fe_revive", {"name": name}))
         return tail
 
 
@@ -711,6 +746,72 @@ class TxnKillTarget:
     def restore(self) -> None:
         if self.disarm_fn is not None:
             self.disarm_fn()
+
+
+class FrontendTarget:
+    """The serving tier as a nemesis dimension (fleetfe, ISSUE 18):
+    `fe_kill {name}` downs a frontend process outright (its parked
+    columnar waiters are abandoned, its intern refs released — clerks
+    migrate their in-flight (cid, cseq) to a surviving frontend and
+    dedupe through the replicated dup table), `fe_drain {name}` takes
+    one down gracefully (stop accepting, flush parked replies, exit —
+    `ClerkFrontend.drain`), and `fe_revive {name}` brings a downed one
+    back on its old address.  The generator always leaves >= 1 frontend
+    alive and the restore tail revives everything; `restore()` re-revives
+    runtime-tracked downs as the belt-and-braces half, mirroring
+    `ProcessTarget`.
+
+    `kill_fn(name)` / `revive_fn(name)` / `drain_fn(name)` are
+    caller-provided (in-process `ClerkFrontend.kill`/`.drain` + rebuild,
+    or SIGKILL/SIGTERM + respawn for real OS processes).  `drain_fn` is
+    optional — without it `fe_drain` leaves the vocabulary, the same
+    shape as ProcessTarget's lag_fn gate."""
+
+    ACTIONS = ["fe_kill", "fe_revive"]
+
+    def __init__(self, frontends: list[str], kill_fn, revive_fn,
+                 drain_fn=None):
+        self.frontends = list(frontends)
+        self.kill_fn = kill_fn
+        self.revive_fn = revive_fn
+        self.drain_fn = drain_fn
+        self._down: set = set()
+
+    def spec(self) -> dict:
+        acts = list(self.ACTIONS)
+        if self.drain_fn is not None:
+            acts.append("fe_drain")
+        return {"kind": "frontend", "frontends": self.frontends,
+                "actions": acts}
+
+    def apply(self, action: str, args: dict) -> None:
+        if action == "fe_kill":
+            self._down.add(args["name"])
+            self.kill_fn(args["name"])
+        elif action == "fe_drain":
+            if self.drain_fn is None:
+                # Replaying a schema-6 capture against a target built
+                # without the drain hook: fail loudly with the actual
+                # problem, not a NoneType call.
+                raise ValueError(
+                    "fe_drain event but this FrontendTarget has no "
+                    "drain_fn — construct it with drain_fn=... to "
+                    "replay fleetfe captures")
+            self._down.add(args["name"])
+            self.drain_fn(args["name"])
+        elif action == "fe_revive":
+            self.revive_fn(args["name"])
+            self._down.discard(args["name"])
+        else:
+            raise ValueError(f"unknown frontend nemesis action {action!r}")
+
+    def restore(self) -> None:
+        for name in sorted(self._down):
+            try:
+                self.revive_fn(name)
+            except Exception as e:  # noqa: BLE001 — restore is best-effort
+                crashsink.record("nemesis-fe-revive", e, fatal=False)
+        self._down.clear()
 
 
 class CompositeTarget:

@@ -194,6 +194,18 @@ METRICS = [
     Metric(("service", "overload", "capacity_ops_s"), 0.65,
            host_bound=True,
            leg_shape=[("service", "overload", "shape")]),
+    # Fleet storm leg (ISSUE 18, fleetfe): goodput through the
+    # kill/revive storm and the fleet's measured closed-loop capacity.
+    # Host-edge noisy like every clerk-path leg AND nemesis-phased (a
+    # third of the leg runs one frontend down), so the widest service
+    # tolerance; gated on the leg's OWN shape (env-trimmed contract
+    # runs skip loudly).  First recorded artifact baselines them;
+    # gated thereafter.
+    Metric(("service", "fleet", "value"), 0.65, host_bound=True,
+           leg_shape=[("service", "fleet", "shape")]),
+    Metric(("service", "fleet", "capacity_ops_s"), 0.65,
+           host_bound=True,
+           leg_shape=[("service", "fleet", "shape")]),
     # Transaction leg (ISSUE 13, txnkv): cross-shard 2PC commit
     # throughput + commit-latency tail — host-edge noisy like every
     # clerk-path leg (contention makes it swing further), gated on the

@@ -213,12 +213,30 @@ def render(view: dict) -> str:
 # ------------------------------------------------------------------ main
 
 
+def member_name(i: int, addr: str, stats: dict | None) -> str:
+    """A fleet-unique collector member name.  Frontends stamp a
+    fleet-unique `frontend.id` in stats() (fleetfe, ISSUE 18) — use it
+    when present, because two frontends both serving `fe.sock` in
+    different directories would otherwise merge ambiguously under the
+    socket-basename scheme.  Everything else (fabricd, replica daemons,
+    pre-fleetfe frontends) keeps `proc{i}@{basename}`."""
+    fe = (stats or {}).get("frontend")
+    if isinstance(fe, dict) and fe.get("id"):
+        return str(fe["id"])
+    return f"proc{i}@{addr.rsplit('/', 1)[-1]}"
+
+
 def build_collector(addrs, local: bool, timeout: float) -> Collector:
     col = Collector(poll_timeout=timeout)
     for i, addr in enumerate(addrs):
         from tpu6824.rpc import connect  # socket transport only, no JAX
-        col.add(f"proc{i}@{addr.rsplit('/', 1)[-1]}",
-                connect(addr, timeout=timeout))
+        h = connect(addr, timeout=timeout)
+        try:
+            st = h.stats()
+        except Exception:  # noqa: BLE001 — a member down at add time is
+            st = None      # data; snapshot() records it under the
+            #                fallback name like any other dead member.
+        col.add(member_name(i, addr, st), h)
     if local or not addrs:
         col.add_local("local")
     return col

@@ -113,6 +113,10 @@ _ONE8 = (1).to_bytes(8, "little")  # eventfd wake payload (preallocated)
 
 _UNSET = object()  # reply slot not yet resolved
 
+# Default frontend_id sequence: unique per (pid, instance) — see
+# ClerkFrontend.frontend_id.
+_FE_SEQ = iter(range(1 << 62))
+
 
 def _kv_op(kind, key, value, cid, cseq, tc):
     """Default op factory: the kvpaxos log entry."""
@@ -302,9 +306,19 @@ class ClerkFrontend:
                  prefer_native: bool = True, op_factory=_kv_op,
                  groups=None, route=None, shard_of=None,
                  ingest_max_ops: int = 1 << 16,
-                 max_inflight: int | None = None):
+                 max_inflight: int | None = None,
+                 frontend_id: str | None = None):
         if groups is None:
             groups = [list(servers)]
+        # Fleet identity (ISSUE 18): a fleet-unique name the frontend
+        # stamps on its stats/caps surfaces, so Collector members and
+        # obs.top rows attribute a sick frontend by NAME — N frontends
+        # of one fleet usually share a socket basename pattern
+        # (fe0.sock, fe1.sock in one dir, or fe.sock in N dirs), and
+        # the basename-derived member names collide.  Default is
+        # unique per process AND per instance (pid + instance seq).
+        self.frontend_id = frontend_id if frontend_id \
+            else f"fe-{os.getpid()}-{next(_FE_SEQ)}"
         self.groups = [list(g) for g in groups]
         self._route = route if route is not None else (lambda key: 0)
         # meshfab cross-shard serving: per-group owning mesh shard,
@@ -377,7 +391,8 @@ class ClerkFrontend:
         srv.register("fe_caps", lambda: {"fe_wire": wire.VERSION,
                                          "fe_deadline": self._ext_ok,
                                          "fe_crc": self._ext_ok,
-                                         "fe_txn": self._txn_ok})
+                                         "fe_txn": self._txn_ok,
+                                         "fe_id": self.frontend_id})
         # Observability plane (regular threaded handlers — pollers are
         # rare and must never touch the event loop): a fleet Collector
         # polls a live frontend process like any fabric process — the
@@ -495,6 +510,7 @@ class ClerkFrontend:
         ing = self._ing
         return {
             "frontend": {
+                "id": self.frontend_id,
                 "groups": len(self.groups),
                 "replicas": [len(g) for g in self.groups],
                 "pending_frames": len(self._pending),
@@ -589,6 +605,21 @@ class ClerkFrontend:
             # resolving, re-arm" branch and defer this rotation a
             # whole backoff interval.
             fr.retry_at = 0.0
+            fr.last_remaining = fr.remaining
+            return
+        if type(v) is tuple and v and v[0] == ErrTxnLocked \
+                and fr.ops[slot].kind not in wire.TXN_KINDS:
+            # PLAIN op vs a prepared-transaction lock window (PR 12
+            # flag f): requeue HERE instead of answering — a clerk that
+            # never learned ErrTxnLocked would treat it as terminal.
+            # Lock windows are short (prepare→resolve); re-submitting
+            # the same (cid, cseq) shortly is dup-safe because the lock
+            # reply is never recorded in the dup filter.  If the window
+            # outlives the frame budget, the frame times out with the
+            # standard RETRYABLE error — never a terminal lock reply.
+            # Txn-kind ops pass through untouched: the txn clerk's
+            # bounded lock_retries/deadlock breaker must SEE conflicts.
+            fr.retry_at = min(fr.retry_at, time.monotonic() + 0.01)
             fr.last_remaining = fr.remaining
             return
         fr.replies[slot] = v
@@ -1014,8 +1045,36 @@ class ClerkFrontend:
                 for fr in list(live.values()):
                     self._drop_frame(fr, live, futmap, "frontend killed")
                 if ing is not None:
+                    # Fleet teardown (ISSUE 18): a dying frontend must
+                    # not strand server-side state it owns.  (1) Drop
+                    # every columnar waiter parked under OUR sink —
+                    # ownership-guarded, so a sibling frontend's re-park
+                    # of the same migrated (cid, cseq) survives.  The
+                    # detached blocks still advance the drain-ticket
+                    # fence at the driver's next proposal pass (skipped,
+                    # not materialized).  (2) Release the intern refs of
+                    # every live and fence-deferred frame NOW — safe:
+                    # the waiters are gone, so no materialization will
+                    # read the freed ids (and the driver's `key is None`
+                    # guard covers any block already in flight).
+                    sink = self._csink
+                    for g in self.groups:
+                        for s in g:
+                            detach = getattr(s, "detach_columnar", None)
+                            if detach is not None:
+                                try:
+                                    detach(sink)
+                                except RPCError:
+                                    pass
                     for nf in list(nframes.values()):
                         ing.fail(nf.fid, "frontend killed")
+                        ing.decref_keys(nf.kid_arr)
+                        ing.decref_vals(nf.vid_arr)
+                    nframes.clear()
+                    for nf in defer:
+                        ing.decref_keys(nf.kid_arr)
+                        ing.decref_vals(nf.vid_arr)
+                    defer.clear()
                 return
             now = time.monotonic()
             # ---- ingest: everything queued since the last pass becomes
@@ -1189,15 +1248,25 @@ class ClerkFrontend:
                 if futs is None:
                     continue
                 for i, fut in zip(idxs, futs):
-                    if fut.wait(max(0.0, deadline - time.monotonic())) \
-                            and fut.value is not _DEAD:
-                        replies[i] = fut.value
-                        todo.remove(i)
-                    else:
+                    v = fut.value \
+                        if fut.wait(max(0.0, deadline - time.monotonic())) \
+                        else _UNSET
+                    if v is _UNSET or v is _DEAD:
                         try:
                             srv.abandon(ops[i].cid, ops[i].cseq)
                         except RPCError:
                             pass
+                    elif type(v) is tuple and v and v[0] == ErrTxnLocked \
+                            and ops[i].kind not in wire.TXN_KINDS:
+                        # Lock-window requeue for plain ops (PR 12 flag
+                        # f, blocking edition): keep the op in `todo` —
+                        # the loop re-submits the same (cid, cseq) after
+                        # the backoff; budget expiry raises the standard
+                        # retryable timeout, never a terminal lock reply.
+                        pass
+                    else:
+                        replies[i] = v
+                        todo.remove(i)
             if todo:
                 now = time.monotonic()
                 if now >= deadline:
@@ -1232,6 +1301,28 @@ class ClerkFrontend:
 
     def undeafen(self) -> None:
         self._srv.undeafen()
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """SIGTERM-style graceful exit (the nemesis `fe_drain` action):
+        stop accepting new dials, let the engine flush everything
+        already admitted — parked columnar waiters included — then
+        kill.  Clerks mid-stream on existing connections see their
+        current frames answered and the next dial refused, which is the
+        rotate-to-a-sibling signal; the wait is bounded, so a clerk
+        that keeps streaming on a live connection cannot wedge the
+        drain past `timeout`."""
+        self.deafen()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.stats()["frontend"]
+            ni = st["native_ingest"]
+            if not (st["pending_frames"] or st["done_queue"]
+                    or st["inflight_ops"]
+                    or (ni.get("inflight_ops", 0)
+                        if isinstance(ni, dict) else 0)):
+                break
+            time.sleep(0.02)
+        self.kill()
 
     def kill(self) -> None:
         self._dead = True
@@ -1579,6 +1670,14 @@ class FrontendStream:
     (checkAppends) holds exactly.  Reconnects resend the in-flight
     frames, same cseqs — at-most-once via the dup filter.
 
+    FLEET mode (ISSUE 18): `addr` may be a LIST of frontend addresses.
+    Connections spread round-robin across the fleet, and a torn
+    connection redials the NEXT address — so the resent in-flight
+    frames (same cseqs) land on a DIFFERENT frontend after a frontend
+    death, and at-most-once must hold through the replicated dup
+    table, not any frontend-local state.  Wire format and extension
+    caps are tracked PER ADDRESS (a mixed fleet stays correct).
+
     Reply matching relies on the SERVER's per-connection FIFO: both
     transports serve one frame per connection at a time (the C++ loop's
     `handed_off` flag / the Python loop's sequential `_serve_conn`), so
@@ -1586,22 +1685,26 @@ class FrontendStream:
     replies can never cross on one connection, and the in-flight
     deque's popleft always names the frame being answered."""
 
-    def __init__(self, addr: str, conns: int, width: int,
+    def __init__(self, addr, conns: int, width: int,
                  op_timeout: float = 10.0, depth: int = STREAM_DEPTH,
                  wire_format: str = "auto"):
         assert conns >= 1 and width >= conns * depth
-        self.addr = addr
+        self.addrs = [addr] if isinstance(addr, str) else list(addr)
+        assert self.addrs
+        self.addr = self.addrs[0]  # single-frontend back-compat alias
         self.op_timeout = op_timeout
         self.depth = depth
-        # "auto": one fe_caps probe on the first dial decides whether
-        # frames go out in the versioned fe wire layout (zero-GIL server
-        # decode) or as classic pickled fe_batch tuples.  The probe's
-        # caps dict also gates the v1 extension flags (deadline
-        # propagation + frame CRC, ISSUE 12); pinned "native" sends
-        # plain v1 frames (no probe ran, so no extension is known-safe).
-        self._native = {"native": True, "pickle": False,
-                        "auto": None}[wire_format]
-        self._caps: dict = {}
+        # "auto": one fe_caps probe on the first dial PER ADDRESS
+        # decides whether frames go out in the versioned fe wire layout
+        # (zero-GIL server decode) or as classic pickled fe_batch
+        # tuples.  The probe's caps dict also gates the v1 extension
+        # flags (deadline propagation + frame CRC, ISSUE 12); pinned
+        # "native" sends plain v1 frames (no probe ran, so no extension
+        # is known-safe).
+        self._pin = {"native": True, "pickle": False,
+                     "auto": None}[wire_format]
+        self._native: dict = {a: self._pin for a in self.addrs}
+        self._caps: dict = {a: {} for a in self.addrs}
         self.clients = [[fresh_cid(), 0] for _ in range(width)]
         # conn ci, cohort k owns clients {c : c ≡ ci·depth+k (mod C·D)}.
         self._cohorts = [
@@ -1620,6 +1723,14 @@ class FrontendStream:
 
         nconns = len(self._cohorts)
         conns: list = [None] * nconns
+        # Fleet routing state: each connection's current position in the
+        # frontend list.  Initial dials spread round-robin; a REdial
+        # advances the position first, so a connection torn by a
+        # frontend death resends its in-flight frames (same cseqs) to a
+        # DIFFERENT frontend — the at-most-once migration path.
+        addr_i = list(range(nconns))
+        cur_addr = [self.addrs[ci % len(self.addrs)] for ci in range(nconns)]
+        opened = [False] * nconns
         # Per-client next-op index.
         progress = {c: 0 for c in range(len(self.clients))}
         # Per-conn FIFO of in-flight cohorts: (k, ops, members, t_sent);
@@ -1642,8 +1753,9 @@ class FrontendStream:
             return tuple(ops), took
 
         def send_frame(ci, ops):
-            if self._native:
-                caps = self._caps
+            addr = cur_addr[ci]
+            if self._native[addr]:
+                caps = self._caps[addr]
                 dl = max(1, int(self.op_timeout * 1000)) \
                     if caps.get("fe_deadline") else None
                 conns[ci].send_raw(wire.encode_batch(
@@ -1663,17 +1775,24 @@ class FrontendStream:
 
         def open_conn(ci):
             """(Re)dial and (re)send everything in flight, in order —
-            same cseqs, so replays are dup-filtered server-side."""
-            conns[ci] = transport.FramedConn(self.addr,
+            same cseqs, so replays are dup-filtered server-side.  A
+            redial after a failure ROTATES to the next frontend of the
+            fleet (single-frontend streams rotate onto the same addr)."""
+            if opened[ci]:
+                addr_i[ci] += 1
+            opened[ci] = True
+            addr = self.addrs[addr_i[ci] % len(self.addrs)]
+            cur_addr[ci] = addr
+            conns[ci] = transport.FramedConn(addr,
                                              timeout=self.op_timeout)
-            if self._native is None:
-                # One fe_caps probe decides the stream's wire format.
+            if self._native[addr] is None:
+                # One fe_caps probe per address decides its wire format.
                 ok, caps = conns[ci].request(("fe_caps", ()))
-                self._native = bool(ok and isinstance(caps, dict)
-                                    and caps.get("fe_wire")
-                                    == wire.VERSION)
-                if self._native:
-                    self._caps = caps
+                self._native[addr] = bool(ok and isinstance(caps, dict)
+                                          and caps.get("fe_wire")
+                                          == wire.VERSION)
+                if self._native[addr]:
+                    self._caps[addr] = caps
             requeue = list(inflight[ci])
             inflight[ci].clear()
             for k, ops, took, _ in requeue:

@@ -106,6 +106,36 @@ class _Fut:
         return self.ev.wait(timeout)
 
 
+def _push_cnotif(cnotif) -> None:
+    """Deliver a drain's resolved columnar waiters to their owning
+    frontends' reply rings.  Reply tags are ring-LOCAL: a tag pushed
+    into another frontend's ring would answer an unrelated op's slot,
+    so a fleet drain groups by owner.  The one-owner drain (the only
+    shape a single-frontend deployment ever sees, and the common fleet
+    case) stays a single push with the original lists — no copies."""
+    ctags, creps, ctctx, cowns = cnotif
+    own0 = cowns[0]
+    for o in cowns:
+        if o is not own0:
+            break
+    else:
+        if own0 is not None:
+            own0.push(ctags, creps, ctctx)
+        return
+    groups: dict[int, list] = {}
+    for i, o in enumerate(cowns):
+        if o is None:
+            continue  # owner detached mid-flight: nobody is listening
+        g = groups.get(id(o))
+        if g is None:
+            groups[id(o)] = g = [o, [], [], []]
+        g[1].append(ctags[i])
+        g[2].append(creps[i])
+        g[3].append(ctctx[i])
+    for o, tags, reps, tctxs in groups.values():
+        o.push(tags, reps, tctxs)
+
+
 class KVPaxosServer:
     RPC_METHODS = ["get", "put_append", "snapshot_fetch"]  # wire surface
 
@@ -182,9 +212,20 @@ class KVPaxosServer:
         # dicts (cid → awaited cseq, cid → reply-ring tag) instead of a
         # per-op future, and materialization into log entries is deferred
         # to the driver's proposal pass (`_collect_proposals_locked`).
-        # One frontend sink per server; `columnar_drained` is the ticket
-        # fence the engine's deferred intern-decref waits on.
-        self._csink = None
+        # A FLEET of frontends may front this server (ISSUE 18): each
+        # parked columnar waiter records its owning sink, because the
+        # reply tag indexes that frontend's reply ring — pushing it into
+        # another frontend's ring answers some unrelated op's slot.  A
+        # clerk retry that migrated frontends re-parks the same
+        # (cid, cseq) with the new owner (last-writer-wins is the
+        # routing truth: the clerk is now listening over there).
+        # `_csinks` keeps every sink ever attached so kill() can fan the
+        # server-dead wake out to the whole fleet; `columnar_drained` is
+        # the ticket fence the engines' deferred intern-decrefs wait on
+        # (a single monotonic counter — conservative and correct with
+        # interleaved blocks from several frontends).
+        self._csinks: dict[int, object] = {}   # id(sink) -> sink
+        self._cowner: dict[int, object] = {}   # cid -> owning sink
         self._ccseq: dict[int, int] = {}
         self._ctag: dict[int, int] = {}
         self._cblocks: list = []         # (ticket, block, accepted idxs)
@@ -316,12 +357,14 @@ class KVPaxosServer:
             fut.set(reply)
         elif self._ccseq.get(op.cid) == op.cseq:
             # Columnar waiter on the scalar-drain path (feedless
-            # backends): resolve straight into the native reply ring.
+            # backends): resolve straight into the OWNING frontend's
+            # native reply ring (the tag is ring-local).
             del self._ccseq[op.cid]
             tag = self._ctag.pop(op.cid)
+            owner = self._cowner.pop(op.cid, None)
             tctx = self._trace_apply(op) if op.tc is not None else None
-            if self._csink is not None:
-                self._csink.push([tag], [reply], [tctx])
+            if owner is not None:
+                owner.push([tag], [reply], [tctx])
         return reply
 
     def _pop_lost_inflight_locked(self, v):
@@ -345,10 +388,11 @@ class KVPaxosServer:
         work.  Dup-filter writes are likewise collected in `pend` (which
         doubles as the intra-batch read-your-writes overlay) and folded
         into the columnar store in ONE `apply_batch` pass per drain.
-        Columnar waiters (native ingest) collect into `cnotif` — three
-        parallel lists (tags, replies, trace ctxs; int/ref appends only,
-        no per-op tuples) the caller pushes into the reply ring once per
-        drain.  Returns [(fut, reply), ...]."""
+        Columnar waiters (native ingest) collect into `cnotif` — four
+        parallel lists (tags, replies, trace ctxs, owning sinks; int/ref
+        appends only, no per-op tuples) the caller pushes into the
+        owning frontends' reply rings once per drain.  Returns
+        [(fut, reply), ...]."""
         dup = self.dup
         kv = self.kv
         kv_get = kv.get
@@ -357,8 +401,9 @@ class KVPaxosServer:
         ccseq = self._ccseq
         ccseq_get = ccseq.get
         ctag_pop = self._ctag.pop
+        cowner_pop = self._cowner.pop
         if cnotif is not None:
-            ctags, creps, ctctx = cnotif
+            ctags, creps, ctctx, cowns = cnotif
         nodup = self._test_disable_dup
         notif = []
         pend: dict = {}  # cid -> (cseq, reply): this batch's dup writes
@@ -413,6 +458,7 @@ class KVPaxosServer:
                     creps.append(reply)
                     ctctx.append(self._trace_apply(v)
                                  if v.tc is not None else None)
+                    cowns.append(cowner_pop(v.cid, None))
                     if scope_cids is not None:
                         scope_cids.append(v.cid)
             self._pop_lost_inflight_locked(v)
@@ -439,8 +485,9 @@ class KVPaxosServer:
         ccseq = self._ccseq
         ccseq_get = ccseq.get
         ctag_pop = self._ctag.pop
+        cowner_pop = self._cowner.pop
         if cnotif is not None:
-            ctags, creps, ctctx = cnotif
+            ctags, creps, ctctx, cowns = cnotif
         nodup = self._test_disable_dup
         notif = []
         pend: dict = {}  # cid -> (cseq, reply-or-sentinel, applied)
@@ -505,6 +552,7 @@ class KVPaxosServer:
                     creps.append(reply)
                     ctctx.append(self._trace_apply(v)
                                  if v.tc is not None else None)
+                    cowns.append(cowner_pop(v.cid, None))
                     if scope_cids is not None:
                         scope_cids.append(v.cid)
             self._pop_lost_inflight_locked(v)
@@ -541,7 +589,7 @@ class KVPaxosServer:
         apply_batch = (self._apply_batch_dev_locked if self._dev is not None
                        else self._apply_batch_locked)
         notif = []
-        cnotif = ([], [], []) if self._csink is not None else None
+        cnotif = ([], [], [], []) if self._csinks else None
         # opscope (ISSUE 15): per-drain stage stamps — decide-feed
         # delivery, batch apply done, notify/reply push — plus the
         # resolved ops' cids, folded ONCE per drain into the per-stage
@@ -588,9 +636,11 @@ class KVPaxosServer:
             for fut, reply in notif:
                 fut.set(reply)
             if cnotif is not None and cnotif[0]:
-                # Columnar waiters: ONE reply-ring push per drain — the
-                # native loop thread serializes and flushes the frames.
-                self._csink.push(*cnotif)
+                # Columnar waiters: ONE reply-ring push per owning
+                # frontend per drain — the single-frontend fast path is
+                # still exactly one push; a fleet's drain fans out once
+                # per distinct owner, order-preserving within each.
+                _push_cnotif(cnotif)
             prof.add("notify", time.perf_counter_ns() - t0)
             if scope_cids:
                 _opscope.fold(scope_cids, t_decide, t_apply,
@@ -741,15 +791,17 @@ class KVPaxosServer:
             cid, cseq = key
             if cseq <= dup.seen(cid):
                 self._waiters.pop(key).set(dup.reply(cid))
-        if self._csink is not None and self._ccseq:
-            tags, reps = [], []
+        if self._csinks and self._ccseq:
+            cnotif = ([], [], [], [])
             for cid in list(self._ccseq):
                 if self._ccseq[cid] <= dup.seen(cid):
                     del self._ccseq[cid]
-                    tags.append(self._ctag.pop(cid))
-                    reps.append(dup.reply(cid))
-            if tags:
-                self._csink.push(tags, reps, [None] * len(tags))
+                    cnotif[0].append(self._ctag.pop(cid))
+                    cnotif[1].append(dup.reply(cid))
+                    cnotif[2].append(None)
+                    cnotif[3].append(self._cowner.pop(cid, None))
+            if cnotif[0]:
+                _push_cnotif(cnotif)
         if self._tap is not None:
             self._tap.discard_through(applied)
         self._next_seq = max(self._next_seq, applied + 1)
@@ -1114,10 +1166,11 @@ class KVPaxosServer:
 
         `sink` (optional) is attached to every returned future BEFORE it
         can resolve: `fut.set` then invokes `sink(fut)` exactly once —
-        the clerk frontend's event-loop completion hook.  A future that
-        already carries a different sink keeps it (one frontend per
-        server; a frontend re-submitting its own op re-attaches the same
-        hook)."""
+        the clerk frontend's event-loop completion hook.  A re-submit of
+        an already-parked (cid, cseq) re-points the waiter at the NEW
+        sink (last-writer-wins): with a frontend fleet, the retry that
+        migrated frontends must be heard by the frontend the clerk is
+        connected to now, not the one that first parked it."""
         futs = []
         tr = _tracing.enabled()
         cur = _tracing.current() if tr else None
@@ -1162,10 +1215,12 @@ class KVPaxosServer:
                                 sp.end()
                         waiters[key] = fut
                         subq.append(op)
-                    elif sink is not None and fut.sink is None:
-                        # A waiter parked by the blocking surface (e.g. a
-                        # frontend op retried through the per-op fallback):
-                        # adopt it so the frontend hears the resolution.
+                    elif sink is not None and fut.sink is not sink:
+                        # A waiter parked by the blocking surface or by
+                        # ANOTHER frontend (a migrated retry): re-point it
+                        # so the frontend the clerk talks to now hears the
+                        # resolution.  The displaced frontend times the op
+                        # out and abandons — at-most-once holds either way.
                         fut.sink = sink
                 futs.append(fut)
             if scope_cids:
@@ -1198,6 +1253,7 @@ class KVPaxosServer:
             dup = self.dup
             ccseq = self._ccseq
             ctag = self._ctag
+            cowner = self._cowner
             nodup = self._test_disable_dup
             cids = block.cids
             cseqs = block.cseqs
@@ -1212,8 +1268,12 @@ class KVPaxosServer:
                     dup_tags.append(tags[i])
                     dup_replies.append(dup.reply(cid))
                 else:
+                    # Last-writer-wins on a re-park: a clerk retry that
+                    # migrated frontends re-submits the same (cid, cseq)
+                    # — the NEW owner's ring is where the clerk listens.
                     ccseq[cid] = cseqs[i]
                     ctag[cid] = tags[i]
+                    cowner[cid] = sink
                     accepted.append(i)
             if accepted and _opscope.enabled():
                 # opscope park stamp for the columnar waiters, with the
@@ -1228,7 +1288,7 @@ class KVPaxosServer:
                 else:
                     _opscope.note_park([cids[i] for i in accepted],
                                        time.monotonic_ns())
-            self._csink = sink
+            self._csinks[id(sink)] = sink
             if accepted:
                 self._cblocks_submitted += 1
                 ticket = self._cblocks_submitted
@@ -1238,21 +1298,43 @@ class KVPaxosServer:
         self._wake.set()
         return ticket, dup_tags, dup_replies
 
-    def abandon_columnar(self, cids, cseqs) -> None:
+    def abandon_columnar(self, cids, cseqs, sink=None) -> None:
         """Drop columnar waiters (the engine's failover/timeout path) —
         the ops may still decide here, dup-filtered as ever, but this
         server stops re-proposing them and will not answer their tags.
-        FAILOVER ops keep their opscope stamps (the retry re-parks the
-        same cid on the next replica, overwriting park onward while the
-        frame-parse origin survives); a timed-out frame's residue is
-        bounded by the trim cap."""
+        `sink`, when given, is an OWNERSHIP guard: only waiters this
+        sink still owns are dropped.  The cseq check alone cannot
+        distinguish frontend A's stale park from frontend B's re-park
+        of the same migrated retry (same cid, SAME cseq) — without the
+        guard a dying frontend's cleanup would strand the live
+        frontend's waiter.  FAILOVER ops keep their opscope stamps (the
+        retry re-parks the same cid on the next replica, overwriting
+        park onward while the frame-parse origin survives); a timed-out
+        frame's residue is bounded by the trim cap."""
         with self.mu:
             ccseq = self._ccseq
             ctag = self._ctag
+            cowner = self._cowner
             for i, cid in enumerate(cids):
-                if ccseq.get(cid) == cseqs[i]:
+                if ccseq.get(cid) == cseqs[i] and \
+                        (sink is None or cowner.get(cid) is sink):
                     del ccseq[cid]
                     ctag.pop(cid, None)
+                    cowner.pop(cid, None)
+
+    def detach_columnar(self, sink) -> None:
+        """A frontend is going away: drop every columnar waiter it still
+        owns and forget its sink, in one lock acquisition per server.
+        Waiters the same cids re-parked through a DIFFERENT frontend
+        (migrated retries) are untouched — ownership, not cid, decides.
+        Idempotent; safe on a sink that never submitted here."""
+        with self.mu:
+            self._csinks.pop(id(sink), None)
+            cowner = self._cowner
+            for cid in [c for c, o in cowner.items() if o is sink]:
+                del cowner[cid]
+                self._ccseq.pop(cid, None)
+                self._ctag.pop(cid, None)
 
     def submit_nowait(self, op: Op) -> _Fut:
         return self.submit_batch((op,))[0]
@@ -1327,14 +1409,17 @@ class KVPaxosServer:
             self._waiters.clear()
             self._ccseq.clear()
             self._ctag.clear()
+            self._cowner.clear()
             self._cblocks.clear()
             # Dropped blocks will never materialize: release the fence so
-            # the engine's deferred intern decrefs are not stranded.
+            # the engines' deferred intern decrefs are not stranded.
             self.columnar_drained = self._cblocks_submitted
-            if self._csink is not None:
-                # The columnar twin of the _DEAD future: tell the engine
-                # to rotate this server's frames NOW (O(1) enqueue+wake).
-                self._csink.server_dead(self)
+            # The columnar twin of the _DEAD future: tell EVERY attached
+            # frontend engine to rotate this server's frames NOW (O(1)
+            # enqueue+wake per sink — a fleet hears it fleet-wide).
+            for s in self._csinks.values():
+                s.server_dead(self)
+            self._csinks.clear()
             self._trace_prop.clear()
             if self._tap is not None:
                 self._tap.close()  # stop the fabric fanning into a corpse

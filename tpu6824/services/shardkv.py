@@ -852,7 +852,11 @@ class ShardKVServer:
                         self._subq.append(op)
                         if parked is not None:
                             parked.append(op.cid)
-                    elif sink is not None and fut.sink is None:
+                    elif sink is not None and fut.sink is not sink:
+                        # Re-point a parked waiter at the submitting
+                        # frontend (last-writer-wins): a clerk retry that
+                        # migrated to a different frontend of the fleet
+                        # must be heard where the clerk listens now.
                         fut.sink = sink
                 futs.append(fut)
             if parked:
