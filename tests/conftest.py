@@ -87,6 +87,8 @@ def sanitize():
     assert not cycles, f"lock-order cycle(s):\n{report.describe()}"
     assert not report.violations, \
         f"lock hold-budget violation(s):\n{report.describe()}"
+    assert not report.order_violations, \
+        f"lock-manifest order violation(s):\n{report.describe()}"
 
 
 if os.environ.get("TPU6824_SANITIZE") == "1":
@@ -101,8 +103,8 @@ if os.environ.get("TPU6824_SANITIZE") == "1":
         yield
         report = lockwatch.disable()
         sys.stderr.write("\n" + report.describe() + "\n")
-        assert not report.cycles() and not report.violations, \
-            report.describe()
+        assert not report.cycles() and not report.violations \
+            and not report.order_violations, report.describe()
 
 
 @pytest.fixture

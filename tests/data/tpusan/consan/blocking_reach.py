@@ -1,0 +1,25 @@
+"""GOLDEN (consan): blocking call reachable under a held server mutex.
+The sleep is two calls away from the lock region — lexically invisible
+to the per-file lock-blocking-call rule, only the interprocedural reach
+analysis connects `apply`'s held mu to `_backoff`'s sleep.
+"""
+
+import time
+
+from tpu6824.utils.locks import new_lock
+
+
+class SlowServer:
+    def __init__(self):
+        self.mu = new_lock("kvpaxos.mu")
+        self.applied = 0
+
+    def apply(self):
+        with self.mu:
+            self._settle()
+
+    def _settle(self):
+        self._backoff()
+
+    def _backoff(self):
+        time.sleep(0.05)

@@ -1,0 +1,17 @@
+"""GOLDEN (consan): a named hot lock missing from the canonical
+manifest.  Naming a lock via utils.locks is a claim that it is part of
+the enforced hierarchy — a name absent from MANIFEST has no rank, so
+neither the static pass nor the runtime lockdep can order it.
+"""
+
+from tpu6824.utils.locks import new_lock
+
+
+class RogueService:
+    def __init__(self):
+        self._state_mu = new_lock("rogue.state_mu")
+        self.rows = 0
+
+    def bump(self):
+        with self._state_mu:
+            self.rows += 1

@@ -1,0 +1,41 @@
+"""GOLDEN (consan): seeded mu→emu lock-order inversion.
+
+The PR 15/16 nightmare shape: the sanctioned order is server mutex →
+engine leaf (kvpaxos.mu → devapply.emu), but `backward()` takes the
+engine leaf first and then re-enters the server mutex through a helper
+— an interprocedural AB/BA cycle no single function shows.
+
+This golden is double-duty: consan must find the cycle STATICALLY
+(lock-order-cycle, plus lock-manifest-order for the backward edge), and
+the runtime test imports it under lockwatch and drives both paths so
+the SAME inversion is caught live (graph cycle + manifest order
+violation).  One seeded bug, both halves of the sanitizer.
+"""
+
+from tpu6824.utils.locks import new_rlock
+
+
+class InvertedServer:
+    def __init__(self):
+        self.mu = new_rlock("kvpaxos.mu")
+        self.emu = new_rlock("devapply.emu")
+        self.applied = 0
+
+    def forward(self):
+        # The sanctioned order: server mutex, then engine leaf.
+        with self.mu:
+            self._drain()
+
+    def _drain(self):
+        with self.emu:
+            self.applied += 1
+
+    def backward(self):
+        # The seeded inversion: engine leaf first, then the helper
+        # re-enters the server mutex.
+        with self.emu:
+            self._publish()
+
+    def _publish(self):
+        with self.mu:
+            self.applied += 1

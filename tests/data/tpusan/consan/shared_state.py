@@ -1,0 +1,25 @@
+"""GOLDEN (consan): lock-protection inconsistency across thread
+classes.  `rows` is written under mu on the spawned ticker thread but
+read lock-free from the RPC surface — the devapply mirror race shape
+(PR 15): correct under the GIL by accident, a real race without it.
+"""
+
+import threading
+
+from tpu6824.utils.locks import new_lock
+
+
+class MixedTraffic:
+    def __init__(self, srv):
+        self.mu = new_lock("kvpaxos.mu")
+        self.rows = 0
+        self._ticker = threading.Thread(target=self._loop, daemon=True)
+        srv.register("Rows", self.rows_view)
+
+    def _loop(self):
+        while True:
+            with self.mu:
+                self.rows += 1
+
+    def rows_view(self):
+        return self.rows

@@ -19,7 +19,14 @@ import time
 
 import pytest
 
-from tpu6824.analysis import ANALYZER_VERSION, RULES, lint_paths
+from tpu6824.analysis import (
+    ANALYZER_VERSION,
+    CONSAN_VERSION,
+    RULES,
+    analyze_paths,
+    lint_paths,
+    merged_cycles,
+)
 from tpu6824.analysis import lockwatch
 from tpu6824.analysis.jitguard import CacheProbe, RecompileError, RecompileGuard
 from tpu6824.utils import crashsink
@@ -75,11 +82,53 @@ def test_golden_trips_expected_rules(path):
 
 def test_every_rule_has_a_golden():
     """No rule without a fixture proving it fires (bad/unused-suppression
-    included): a rule nothing can trip is dead weight or broken."""
+    included): a rule nothing can trip is dead weight or broken.  The
+    whole-program rules are proven by their consan goldens."""
     covered = set()
     for expect in GOLDEN_EXPECT.values():
         covered.update(expect)
+    for expect in CONSAN_GOLDEN_EXPECT.values():
+        covered.update(expect)
     assert covered == set(RULES), set(RULES) ^ covered
+
+
+# ---------------------------------------------------------- consan goldens
+
+# file -> {rule: expected count of ACTIVE findings} — whole-program pass
+CONSAN_GOLDEN_EXPECT = {
+    "consan/mu_emu_inversion.py": {"lock-order-cycle": 1,
+                                   "lock-manifest-order": 1},
+    "consan/manifest_missing.py": {"lock-manifest-missing": 1},
+    "consan/shared_state.py": {"unlocked-shared-state": 1},
+    "consan/blocking_reach.py": {"lock-blocking-reachable": 1},
+}
+
+
+@pytest.mark.parametrize("path", sorted(CONSAN_GOLDEN_EXPECT))
+def test_consan_golden_trips_expected_rules(path):
+    res = analyze_paths([os.path.join(GOLDENS, path)])
+    got: dict = {}
+    for f in res.findings:
+        if not f.suppressed:
+            got[f.rule] = got.get(f.rule, 0) + 1
+    assert got == CONSAN_GOLDEN_EXPECT[path], (
+        f"{path}: expected {CONSAN_GOLDEN_EXPECT[path]}, found {got}")
+
+
+def test_unused_consan_suppression_reported_by_consan_not_lint(tmp_path):
+    """A stale suppression naming ONLY whole-program rules is consan's
+    to account for — lint defers it, consan reports it."""
+    p = tmp_path / "mod_unused.py"
+    p.write_text(
+        "# tpusan: ok(lock-order-cycle) — stale justification\n"
+        "X = 1\n")
+    lint_unused = [f for f in lint_paths([str(p)])
+                   if f.rule == "unused-suppression"]
+    assert not lint_unused, [f.render() for f in lint_unused]
+    res = analyze_paths([str(p)])
+    unused = [f for f in res.findings if f.rule == "unused-suppression"]
+    assert unused and "lock-order-cycle" in unused[0].msg, (
+        [f.render() for f in res.findings])
 
 
 def test_suppressed_golden_is_silent():
@@ -107,10 +156,29 @@ def test_tree_lints_clean():
     assert not active, "\n".join(f.render() for f in active)
 
 
+def test_consan_tree_clean_acyclic_within_budget():
+    """The whole-program enforcement hook: zero unsuppressed consan
+    findings, an acyclic interprocedural lock-order graph, and the
+    whole pass cheap enough to run in every tier-1 pass (the budget is
+    ~10x the measured wall clock — a regression to quadratic blowup
+    fails here, not in CI latency graphs)."""
+    t0 = time.monotonic()
+    res = analyze_paths([TREE])
+    wall = time.monotonic() - t0
+    active = [f for f in res.findings if not f.suppressed]
+    assert not active, "\n".join(f.render() for f in active)
+    assert not res.cycles(), res.cycles()
+    assert res.nfiles > 50, res.nfiles
+    # the measured hierarchy: server mutexes over fabric/engine leaves
+    labels = {a for a, _ in res.edges} | {b for _, b in res.edges}
+    assert "PaxosFabric._lock" in labels, sorted(labels)
+    assert wall < 20.0, f"consan took {wall:.1f}s over {res.nfiles} files"
+
+
 def test_cli_clean_tree_exits_zero_and_stamps_version():
-    """The CLI contract (and the no-JAX guarantee: the AST pass must not
-    import jax — enforced by poisoning JAX_PLATFORMS so any jax.init in
-    the child would fail loudly)."""
+    """The CLI contract (and the no-JAX guarantee: the AST passes must
+    not import jax — enforced by poisoning JAX_PLATFORMS so any
+    jax.init in the child would fail loudly)."""
     env = dict(os.environ, JAX_PLATFORMS="no-such-platform")
     out = subprocess.run(
         [sys.executable, "-m", "tpu6824.analysis", TREE, "--json"],
@@ -122,6 +190,21 @@ def test_cli_clean_tree_exits_zero_and_stamps_version():
     assert rep["analyzer"] == ANALYZER_VERSION
     assert rep["active"] == 0
     assert rep["suppressed"] >= 1  # the justified inventory ships with us
+    assert rep["consan"]["version"] == CONSAN_VERSION
+    assert rep["consan"]["cycles"] == []
+    assert rep["consan"]["edges"], "lock-order graph unexpectedly empty"
+
+
+def test_cli_check_baseline_matches_committed_inventory():
+    """The ratchet: the committed baseline must exactly match the live
+    tree's finding inventory (suppressed included).  Drift in either
+    direction fails — a new finding must be fixed or justified, a fixed
+    one harvested via --write-baseline."""
+    env = dict(os.environ, JAX_PLATFORMS="no-such-platform")
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu6824.analysis", "--check-baseline"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
 
 
 def test_cli_dirty_tree_exits_nonzero():
@@ -244,6 +327,106 @@ def test_lockwatch_off_is_plain_threading():
     assert not lockwatch.enabled()
     lk = new_rlock("noop", hold_budget_s=0.001)
     assert type(lk).__module__ in ("_thread", "threading"), type(lk)
+
+
+# ------------------------------------------- consan x lockwatch (merged)
+
+
+@_needs_own_lockwatch
+def test_seeded_inversion_caught_statically_and_at_runtime():
+    """ONE seeded bug, BOTH halves of the sanitizer: the mu→emu
+    inversion golden must produce a static lock-order cycle from
+    consan, a runtime acquisition-graph cycle AND a manifest order
+    violation from lockwatch, and the merged static ∪ runtime graph
+    must agree."""
+    golden = os.path.join(GOLDENS, "consan", "mu_emu_inversion.py")
+    res = analyze_paths([golden])
+    rules = {f.rule for f in res.findings if not f.suppressed}
+    assert "lock-order-cycle" in rules, rules
+    assert any("devapply.emu" in c and "kvpaxos.mu" in c
+               for c in res.cycles()), res.cycles()
+
+    import importlib.util
+
+    lockwatch.enable()
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "mu_emu_inversion_golden", golden)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        srv = mod.InvertedServer()
+        srv.forward()   # mu -> emu (sanctioned)
+        srv.backward()  # emu -> mu (the seeded inversion)
+    finally:
+        report = lockwatch.disable()
+    assert report.cycles(), report.describe()
+    ov = report.order_violations
+    assert ov, report.describe()
+    assert ov[0]["acquired"] == "kvpaxos.mu" \
+        and ov[0]["held"] == "devapply.emu", ov
+    assert merged_cycles(res, report), "merged graph lost the cycle"
+
+
+@_needs_own_lockwatch
+def test_lockwatch_manifest_order_violation_before_any_cycle():
+    """The manifest lockdep fires on the FIRST backward acquisition —
+    no second thread closing a cycle needed (lock-order bugs in rarely
+    interleaved paths would otherwise need the unlucky schedule to be
+    seen at all)."""
+    lockwatch.enable()
+    try:
+        mu = new_rlock("kvpaxos.mu")
+        fab = new_rlock("PaxosFabric._lock")
+        with mu:
+            with fab:  # forward: sanctioned
+                pass
+    finally:
+        report = lockwatch.disable()
+    assert not report.order_violations, report.describe()
+
+    lockwatch.enable()
+    try:
+        mu = new_rlock("kvpaxos.mu")
+        fab = new_rlock("PaxosFabric._lock")
+        with fab:
+            with mu:  # backward: fabric core re-entering a server mutex
+                pass
+    finally:
+        report = lockwatch.disable()
+    ov = report.order_violations
+    assert ov, report.describe()
+    assert ov[0]["acquired"] == "kvpaxos.mu" \
+        and ov[0]["held"] == "PaxosFabric._lock", ov
+    assert ov[0]["acquired_rank"] < ov[0]["held_rank"], ov
+    assert not report.cycles()  # caught BEFORE any cycle exists
+
+
+@_needs_own_lockwatch
+def test_merged_static_runtime_graph_acyclic_on_live_tree():
+    """The acceptance gate: consan's static interprocedural graph
+    UNIONED with a live lockwatch run over a real kvpaxos cluster must
+    stay acyclic — neither half alone proves the hierarchy (static
+    misses instance aliasing, runtime misses unexercised paths)."""
+    from tpu6824.services.kvpaxos import Clerk, make_cluster
+
+    res = analyze_paths([TREE])
+    lockwatch.enable()
+    try:
+        fabric, servers = make_cluster(nservers=3, ninstances=16)
+        try:
+            ck = Clerk(servers)
+            ck.put("merged", "graph")
+            assert ck.get("merged") == "graph"
+        finally:
+            for s in servers:
+                s.dead = True
+            fabric.stop_clock()
+    finally:
+        report = lockwatch.disable()
+    assert not report.cycles(), report.describe()
+    assert not report.order_violations, report.describe()
+    assert not merged_cycles(res, report), (
+        merged_cycles(res, report), report.describe())
 
 
 # ------------------------------------------------------------ jitguard

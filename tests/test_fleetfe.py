@@ -277,14 +277,16 @@ def test_cross_frontend_at_most_once_replay(tmp_path, flavor):
 
 @pytest.mark.nemesis
 @pytest.mark.parametrize("flavor", FLAVORS)
-def test_fleet_kill_storm_soak(tmp_path, flavor, nemesis_report):
+def test_fleet_kill_storm_soak(tmp_path, flavor, nemesis_report, sanitize):
     """ACCEPTANCE: fixed-seed composite kill storm — frontend
     kill/revive/drain x fabric partitions x byte-level wire faults
     under ONE schedule — against a 3-frontend fleet over one replica
     group, on the native-ingest engine AND the pure-Python fallback.
     Wing-Gong green, exactly-once across frontend-migrating retries,
     crashsink delta 0, replay identity, jitguard zero steady-state
-    recompiles."""
+    recompiles.  Runs under the lockwatch sanitizer: the storm must
+    close with zero lock-order cycles, zero hold-budget violations and
+    zero manifest-order violations (fixture teardown asserts)."""
     from tpu6824.analysis.jitguard import RecompileGuard
 
     _require_flavor(flavor)

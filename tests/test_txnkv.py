@@ -1078,12 +1078,14 @@ def _txn_soak(system, seed, duration, nemesis_report, extra_targets=(),
 
 
 @pytest.mark.nemesis
-def test_txn_composite_nemesis_smoke(nemesis_report):
+def test_txn_composite_nemesis_smoke(nemesis_report, sanitize):
     """Tier-1 acceptance smoke: fixed-seed composite schedule —
     partitions (incl. majority-less), kill/revive, unreliable,
     schedule-driven RECONFIGURATION, and kill_mid_commit — against
     concurrent cross-shard transfers; transactional checker green,
-    transfer sum conserved, replay identity."""
+    transfer sum conserved, replay identity.  Runs under the lockwatch
+    sanitizer: zero lock-order cycles, zero hold-budget violations and
+    zero manifest-order violations at teardown."""
     system = _system(ninstances=64)
     try:
         _txn_soak(system, seed_from_env(1306), 2.0, nemesis_report,

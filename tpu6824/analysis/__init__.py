@@ -1,24 +1,40 @@
 """tpu6824.analysis — tpusan: lock-discipline & determinism analyzer.
 
-Three tools, one package:
+Four tools, one package:
 
-  - `lint` — the project-specific AST pass (`python -m tpu6824.analysis
-    <paths>`): lock-region blocking calls, per-cell loops under the
-    fabric lock, nondeterminism in schedule-replay paths, silent daemon
-    deaths, columnar-feed contract, tracer leaks.  Stdlib only — no JAX
-    import, fast enough for tier-1.
+  - `lint` — the project-specific per-file AST pass (`python -m
+    tpu6824.analysis <paths>`): lock-region blocking calls, per-cell
+    loops under the fabric lock, nondeterminism in schedule-replay
+    paths, silent daemon deaths, columnar-feed contract, tracer leaks.
+    Stdlib only — no JAX import, fast enough for tier-1.
+  - `consan` — the whole-program concurrency pass (same CLI): thread
+    entry points propagated through the call graph, a static
+    interprocedural lock-order graph checked for cycles and against
+    the canonical `tpu6824.utils.locks.MANIFEST`, lock-protection
+    inconsistencies (attr written under a lock, touched lock-free from
+    another thread class), and blocking calls reachable while a server
+    mutex is held.
   - `lockwatch` — opt-in runtime lock-order/hold-time sanitizer
-    (`TPU6824_SANITIZE=1` / the `sanitize` pytest fixture).
+    (`TPU6824_SANITIZE=1` / the `sanitize` pytest fixture), now also
+    enforcing the lock manifest's acquisition order live.
   - `jitguard` — steady-state recompile guard (lazy JAX import).
 
-`ANALYZER_VERSION` stamps reports and CHANGES-style artifacts so rule
-additions stay auditable across PRs.
+`ANALYZER_VERSION`/`CONSAN_VERSION` stamp reports and CHANGES-style
+artifacts so rule additions stay auditable across PRs.
 """
 
+from tpu6824.analysis.consan import (  # noqa: F401
+    CONSAN_RULES,
+    CONSAN_VERSION,
+    Analysis,
+    analyze_paths,
+    merged_cycles,
+)
 from tpu6824.analysis.lint import (  # noqa: F401
     ANALYZER_VERSION,
     Finding,
     RULES,
+    WHOLE_PROGRAM_RULES,
     lint_file,
     lint_paths,
     lint_source,
@@ -26,9 +42,15 @@ from tpu6824.analysis.lint import (  # noqa: F401
 
 __all__ = [
     "ANALYZER_VERSION",
+    "Analysis",
+    "CONSAN_RULES",
+    "CONSAN_VERSION",
     "Finding",
     "RULES",
+    "WHOLE_PROGRAM_RULES",
+    "analyze_paths",
     "lint_file",
     "lint_paths",
     "lint_source",
+    "merged_cycles",
 ]

@@ -1,25 +1,61 @@
 """CLI: `python -m tpu6824.analysis [paths...]`.
 
-Exit status 0 iff every finding is suppressed (each suppression carrying
-its mandatory justification).  `--json` emits a machine-readable report
-(stamped with ANALYZER_VERSION, the CHANGES-artifact form); `--all`
+Runs BOTH analysis passes — the per-file tpusan lint and the
+whole-program consan concurrency pass — over the same tree.  Exit
+status 0 iff every finding is suppressed (each suppression carrying its
+mandatory justification).  `--json` emits a machine-readable report
+(stamped with ANALYZER_VERSION/CONSAN_VERSION, the CHANGES-artifact
+form) including consan's interprocedural lock-order graph; `--all`
 includes suppressed findings in the listing; `--list-rules` documents
-the rule set.  No JAX import on this path — the AST pass is pure stdlib.
+the rule set.
+
+`--write-baseline` / `--check-baseline` maintain the committed finding
+inventory (`tests/data/tpusan/baseline.json`): the baseline records
+EVERY finding, suppressed or not, keyed by (path, rule, line-scrubbed
+message) so reformatting doesn't churn it, and the tier-1 ratchet test
+fails on any drift in either direction — a new finding must be fixed or
+justified, a fixed finding must be harvested out of the baseline.
+
+No JAX import on this path — both passes are pure stdlib AST.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 
+from tpu6824.analysis.consan import CONSAN_VERSION, analyze_paths
 from tpu6824.analysis.lint import ANALYZER_VERSION, RULES, lint_paths
+
+BASELINE_DEFAULT = "tests/data/tpusan/baseline.json"
+
+_LINE_REF = re.compile(r":\d+")
+
+
+def _fingerprint(f) -> tuple[str, str, str]:
+    """Identity of a finding across unrelated edits: path + rule + the
+    message with embedded line references scrubbed (messages cite other
+    sites by line, and those shift with every edit above them)."""
+    return (f.path, f.rule, _LINE_REF.sub("", f.msg))
+
+
+def _baseline_blob(findings) -> dict:
+    rows = sorted({_fingerprint(f) for f in findings})
+    return {
+        "analyzer": ANALYZER_VERSION,
+        "consan": CONSAN_VERSION,
+        "findings": [
+            {"path": p, "rule": r, "msg": m} for p, r, m in rows],
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpu6824.analysis",
-        description="tpusan — lock-discipline & determinism lint")
+        description="tpusan — lock-discipline & determinism lint + "
+                    "consan whole-program concurrency analysis")
     ap.add_argument("paths", nargs="*", default=["tpu6824"],
                     help="files or directories to lint (default: tpu6824)")
     ap.add_argument("--json", action="store_true",
@@ -28,19 +64,54 @@ def main(argv: list[str] | None = None) -> int:
                     help="also list suppressed findings")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--version", action="store_true")
+    ap.add_argument("--check-baseline", nargs="?", const=BASELINE_DEFAULT,
+                    metavar="FILE",
+                    help="fail on any finding drift vs the committed "
+                         f"baseline (default {BASELINE_DEFAULT})")
+    ap.add_argument("--write-baseline", nargs="?", const=BASELINE_DEFAULT,
+                    metavar="FILE",
+                    help="regenerate the baseline inventory")
     args = ap.parse_args(argv)
 
     if args.version:
-        print(ANALYZER_VERSION)
+        print(f"{ANALYZER_VERSION} {CONSAN_VERSION}")
         return 0
     if args.list_rules:
         for rule, desc in sorted(RULES.items()):
             print(f"{rule}:\n    {desc}")
         return 0
 
-    findings = lint_paths(args.paths)
+    findings = list(lint_paths(args.paths))
+    analysis = analyze_paths(args.paths)
+    findings += analysis.findings
     active = [f for f in findings if not f.suppressed]
     suppressed = [f for f in findings if f.suppressed]
+
+    if args.write_baseline:
+        blob = _baseline_blob(findings)
+        with open(args.write_baseline, "w") as fh:
+            json.dump(blob, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"baseline: {len(blob['findings'])} finding(s) -> "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.check_baseline:
+        with open(args.check_baseline) as fh:
+            base = json.load(fh)
+        want = {(r["path"], r["rule"], r["msg"])
+                for r in base.get("findings", ())}
+        got = {_fingerprint(f) for f in findings}
+        added, gone = sorted(got - want), sorted(want - got)
+        for p, r, m in added:
+            print(f"NEW (fix or justify): {p}: {r}: {m}")
+        for p, r, m in gone:
+            print(f"GONE (regen baseline with --write-baseline): "
+                  f"{p}: {r}: {m}")
+        if added or gone:
+            print(f"baseline drift: +{len(added)} -{len(gone)} vs "
+                  f"{args.check_baseline}")
+            return 1
 
     if args.json:
         print(json.dumps({
@@ -49,14 +120,25 @@ def main(argv: list[str] | None = None) -> int:
             "findings": [vars(f) for f in findings],
             "active": len(active),
             "suppressed": len(suppressed),
+            "consan": {
+                "version": CONSAN_VERSION,
+                "files": analysis.nfiles,
+                "edges": [
+                    {"from": a, "to": b, **meta}
+                    for (a, b), meta in sorted(analysis.edges.items())],
+                "cycles": analysis.cycles(),
+                "named_locks": sorted(analysis.named_locks),
+            },
         }, indent=2))
     else:
         shown = findings if args.all else active
         for f in sorted(shown, key=lambda f: (f.path, f.line)):
             tag = " [suppressed]" if f.suppressed else ""
             print(f.render() + tag)
-        print(f"{ANALYZER_VERSION}: {len(active)} finding(s), "
-              f"{len(suppressed)} suppressed")
+        print(f"{ANALYZER_VERSION}+{CONSAN_VERSION}: {len(active)} "
+              f"finding(s), {len(suppressed)} suppressed, "
+              f"{len(analysis.edges)} lock-order edge(s), "
+              f"{len(analysis.cycles())} cycle(s)")
     return 1 if active else 0
 
 

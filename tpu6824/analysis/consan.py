@@ -1,0 +1,1180 @@
+"""consan — whole-program interprocedural concurrency analysis.
+
+tpusan's lint rules are file-local AST scans and lockwatch only sees the
+interleavings a given run happened to take.  consan closes the gap
+between them: ONE pass over the whole tree that
+
+  - models thread entry points (the engine/driver/ticker daemons spawned
+    through ``threading.Thread(target=crashsink.guarded(...))``, RPC
+    handler registrations, pulse sampler hooks, the C++ event-loop
+    callback seams) and propagates the thread class of each entry
+    through a name-resolved call graph, so "which threads can run this
+    method" is an analysis fact instead of a docstring convention;
+  - extracts every lock acquisition (``with self.mu``, module-level
+    locks, ``utils.locks.new_lock/new_rlock(name=...)`` named locks, the
+    ``*_locked`` suffix and ``@_locked`` decorator conventions) and
+    builds a STATIC lock-order graph — edge a→b means "some code path
+    can acquire b while holding a", including paths that cross function
+    and module boundaries — reporting cycles as deadlock potential even
+    when no test interleaves them (``lock-order-cycle``);
+  - validates the declared lock hierarchy: the canonical manifest in
+    ``tpu6824.utils.locks.MANIFEST`` orders the named hot locks
+    outermost→innermost; a static edge against that order is a
+    ``lock-manifest-order`` finding, and a named lock missing from the
+    manifest is ``lock-manifest-missing``.  lockwatch enforces the same
+    manifest live (runtime lockdep), and ``merged_cycles`` unions the
+    static graph with a lockwatch Report so the combined static ∪
+    runtime graph is checked acyclic in tier-1;
+  - flags lock-protection inconsistency (``unlocked-shared-state``): a
+    ``self`` attribute written under the class lock in one method and
+    touched lock-free from a method a DIFFERENT thread class can reach —
+    exactly the PR 15 devapply mirror-cadence race shape;
+  - flags blocking calls (sleep, socket I/O, device readback, ``.wait``)
+    reachable while a lock is held INTERPROCEDURALLY
+    (``lock-blocking-reachable``): the lexical rule catches ``with mu:
+    sleep()``; this catches ``with mu: helper()`` where the sleep hides
+    two calls down.
+
+Precision stance: this is a linter, not a verifier.  Call resolution is
+name-based and deliberately conservative — ``self.meth()`` resolves
+within the class (and by-name bases), ``self.attr.meth()`` resolves
+through ``self.attr = ClassName(...)`` assignments, module functions
+resolve through the import map, and anything else is dropped rather
+than over-approximated into noise.  Lock nodes are LABELS (one node per
+named lock / per class attribute), so a same-label edge (two instances
+of one class) is skipped: instance-level inversions of one class are
+lockwatch's job, which keys by instance.  Findings suppress exactly
+like tpusan's (``# tpusan: ok(<rule>) — why``), and suppressions
+require the justification string — the loader rejects bare ones.
+
+Pure stdlib (ast): no JAX import, fast enough for tier-1 (the analysis
+test asserts a wall-clock budget over the full tree).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from tpu6824.analysis.lint import (
+    _BLOCKING_DOTTED,
+    _BLOCKING_TAILS,
+    WHOLE_PROGRAM_RULES,
+    Finding,
+    _collect_suppressions,
+    _dotted,
+    iter_py_files,
+)
+
+CONSAN_VERSION = "consan-1.0.0"
+
+#: Rules this pass owns.  They live in lint.RULES (so the suppression
+#: loader accepts them) but only consan can fire or clear them; lint's
+#: per-file unused-suppression check defers them here.
+CONSAN_RULES = WHOLE_PROGRAM_RULES
+
+# Attribute names that read as "a lock" even without a visible decl
+# (mirrors lint._LOCK_ATTRS plus the service-layer spellings).
+_LOCKISH = {"mu", "emu", "_lock", "_mu", "_fs_lock", "_state_mu",
+            "_mirror_mu", "_clock_mu", "_cseq_mu", "_wlock"}
+
+# Constructors whose product is a thread-safe primitive: attributes
+# assigned from these never trip unlocked-shared-state (their own
+# synchronization is the point).
+_SAFE_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Event",
+    "threading.Condition", "threading.Semaphore", "threading.Thread",
+    "threading.local", "Lock", "RLock", "Event", "Condition",
+    "new_lock", "new_rlock", "deque", "collections.deque", "Queue",
+    "queue.Queue", "SimpleQueue",
+}
+_SAFE_CTOR_TAILS = {"Lock", "RLock", "Event", "Condition", "Thread",
+                    "Semaphore", "counter", "gauge", "histogram",
+                    "new_lock", "new_rlock", "deque", "Queue", "local"}
+
+# Attribute mutators that count as writes for the shared-state rule.
+_MUTATORS = {"append", "appendleft", "add", "extend", "insert",
+             "setdefault", "update", "pop", "popitem", "popleft",
+             "clear", "remove", "discard"}
+
+# Methods whose bodies are lifecycle/bootstrap by convention: attribute
+# traffic there predates (or postdates) concurrency.
+_LIFECYCLE = {"__init__", "__new__", "__post_init__"}
+
+# The repo-wide kill-flag convention: `self.dead` is a single-writer
+# monotonic bool that daemon loops poll lock-free by design (the Go
+# reference's `isdead()` atomic) — a torn read is impossible and a
+# stale read only delays shutdown by one tick.
+_KILL_FLAGS = {"dead", "_dead", "killed"}
+
+# A justified lexical blocking suppression sanctions the blocking call
+# for callers too: when the seed line carries an `ok(<one of these>)`
+# suppression, lock-blocking-reachable does not re-fire the same
+# decision at every call site up the graph.
+_BLOCKING_SANCTION_RULES = {"lock-blocking-reachable", "lock-blocking-call",
+                            "blocking-in-eventloop", "blocking-commit-wait"}
+
+# Thread-class labels.
+_TC_API = "api"
+_TC_RPC = "rpc"
+_TC_LOOP = "eventloop"
+_TC_PULSE = "pulse"
+
+_EVENTLOOP_FILES = ("services/frontend.py", "rpc/native_server.py")
+
+
+# ------------------------------------------------------------ lock refs
+# A lockref is a tuple:
+#   ("attr", owner_key, attr)  — self/module lock, owner_key names the
+#                                class ("mod:Cls") or module ("mod")
+#   ("sym", param, attr)       — param-receiver lock (srv.mu), resolved
+#                                at the call site when the caller passes
+#                                self / its own param through
+
+
+def _is_self_attr(node) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclass
+class _LockDecl:
+    label: str
+    file: str
+    line: int
+    named: bool  # created via new_lock/new_rlock(name=...)
+
+
+@dataclass
+class _FuncInfo:
+    key: str                      # "mod:Cls.meth" / "mod:func"
+    module: str                   # module key ("services/kvpaxos")
+    cls: str | None
+    name: str
+    file: str
+    node: ast.AST = field(repr=False, default=None)
+    params: list = field(default_factory=list)
+    # events: ("acq", lockref, line) / ("call", site) / ("block", d, held, line)
+    events: list = field(default_factory=list)
+    accesses: list = field(default_factory=list)  # (attr, kind, locked, line)
+    entry_tcs: set = field(default_factory=set)
+    tcs: set = field(default_factory=set)
+    initial_held: list = field(default_factory=list)  # lockrefs (conventions)
+
+
+@dataclass
+class _CallSite:
+    callees: list                 # candidate _FuncInfo keys
+    submap: dict                  # callee param name -> "self-cls:<key>"|("sym", p)
+    held: list                    # lockrefs held lexically at the call
+    line: int
+
+
+class _ClassInfo:
+    def __init__(self, module: str, name: str, file: str):
+        self.module = module
+        self.name = name
+        self.key = f"{module}:{name}"
+        self.file = file
+        self.bases: list[str] = []
+        self.locks: dict[str, _LockDecl] = {}   # attr -> decl
+        self.safe_attrs: set[str] = set()
+        self.attr_types: dict[str, str] = {}    # attr -> class name
+        self.methods: dict[str, str] = {}       # meth name -> func key
+        self.spawns_thread = False
+
+
+class Program:
+    """The parsed tree: modules, classes, functions, import maps."""
+
+    def __init__(self):
+        self.files: dict[str, str] = {}          # file -> source
+        self.funcs: dict[str, _FuncInfo] = {}
+        self.classes: dict[str, _ClassInfo] = {} # "mod:Cls" -> info
+        self.by_method: dict[str, list[str]] = {}  # meth name -> func keys
+        self.mod_funcs: dict[str, dict[str, str]] = {}  # mod -> name -> key
+        self.mod_locks: dict[str, dict[str, _LockDecl]] = {}
+        self.imports: dict[str, dict[str, str]] = {}  # mod -> alias -> modkey
+        self.class_by_name: dict[str, list[str]] = {}
+        self.decorator_locks: dict[str, str] = {}  # "mod:decname" -> attr
+        self.sups: dict[str, dict] = {}          # file -> line -> Suppression
+
+
+def _match_suppression(prog: Program, path: str, line: int,
+                       rules: set) -> object | None:
+    """The tpusan matching walk: a suppression on `line`, or in the
+    comment block directly above it, covering any of `rules`."""
+    sups = prog.sups.get(path)
+    if not sups:
+        return None
+    src = prog.files.get(path, "")
+    lines = src.splitlines()
+
+    def comment_only(ln: int) -> bool:
+        return 1 <= ln <= len(lines) and \
+            lines[ln - 1].lstrip().startswith("#")
+
+    candidates = [line]
+    ln = line - 1
+    while comment_only(ln):
+        candidates.append(ln)
+        if ln in sups:
+            break
+        ln -= 1
+    candidates.append(ln)
+    for ln in candidates:
+        s = sups.get(ln)
+        if s and ("*" in s.rules or (s.rules & rules)):
+            return s
+    return None
+
+
+def _mod_key(relpath: str) -> str:
+    p = relpath.replace(os.sep, "/")
+    for marker in ("tpu6824/",):
+        i = p.find(marker)
+        if i >= 0:
+            p = p[i + len(marker):]
+            break
+    return p[:-3] if p.endswith(".py") else p
+
+
+def _lock_ctor(value: ast.AST) -> tuple[bool, str | None] | None:
+    """(named, name) when `value` constructs a lock, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    d = _dotted(value.func) or ""
+    tail = d.rsplit(".", 1)[-1]
+    if tail in ("new_lock", "new_rlock", "make_lock", "make_rlock"):
+        name = None
+        if value.args and isinstance(value.args[0], ast.Constant) and \
+                isinstance(value.args[0].value, str):
+            name = value.args[0].value
+        for kw in value.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                name = kw.value.value
+        return (name is not None, name)
+    if d in ("threading.Lock", "threading.RLock") or \
+            (tail in ("Lock", "RLock") and "." not in d):
+        return (False, None)
+    return None
+
+
+def _is_safe_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    d = _dotted(value.func) or ""
+    return d in _SAFE_CTORS or d.rsplit(".", 1)[-1] in _SAFE_CTOR_TAILS
+
+
+# ------------------------------------------------------------ indexing
+
+
+def _index_module(prog: Program, path: str, relpath: str,
+                  tree: ast.Module) -> None:
+    mod = _mod_key(relpath)
+    prog.mod_funcs.setdefault(mod, {})
+    prog.mod_locks.setdefault(mod, {})
+    prog.imports.setdefault(mod, {})
+
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                prog.imports[mod][a.asname or a.name.split(".")[0]] = \
+                    a.name.replace(".", "/")
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            src = node.module.replace(".", "/")
+            for a in node.names:
+                prog.imports[mod][a.asname or a.name] = f"{src}#{a.name}"
+        elif isinstance(node, ast.Assign):
+            ctor = _lock_ctor(node.value)
+            if ctor is not None:
+                named, name = ctor
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        prog.mod_locks[mod][t.id] = _LockDecl(
+                            name or f"{mod}.{t.id}", path, node.lineno,
+                            named)
+        elif isinstance(node, ast.FunctionDef):
+            key = f"{mod}:{node.name}"
+            prog.mod_funcs[mod][node.name] = key
+            prog.funcs[key] = _FuncInfo(
+                key, mod, None, node.name, path, node,
+                [a.arg for a in node.args.args])
+            attr = _decorator_lock_attr(node)
+            if attr:
+                prog.decorator_locks[f"{mod}:{node.name}"] = attr
+        elif isinstance(node, ast.ClassDef):
+            _index_class(prog, mod, path, node)
+
+
+def _decorator_lock_attr(fn: ast.FunctionDef) -> str | None:
+    """A decorator whose nested wrapper runs the wrapped call inside
+    `with self.<attr>` (the devapply `_locked` shape) hands that lock to
+    every method it decorates."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.FunctionDef) and n is not fn:
+            for m in ast.walk(n):
+                if isinstance(m, ast.With):
+                    for item in m.items:
+                        a = _is_self_attr(item.context_expr)
+                        if a:
+                            return a
+    return None
+
+
+def _index_class(prog: Program, mod: str, path: str,
+                 node: ast.ClassDef) -> None:
+    ci = _ClassInfo(mod, node.name, path)
+    for b in node.bases:
+        d = _dotted(b)
+        if d:
+            ci.bases.append(d.rsplit(".", 1)[-1])
+    prog.classes[ci.key] = ci
+    prog.class_by_name.setdefault(node.name, []).append(ci.key)
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = f"{mod}:{node.name}.{item.name}"
+            ci.methods[item.name] = key
+            prog.funcs[key] = _FuncInfo(
+                key, mod, node.name, item.name, path, item,
+                [a.arg for a in item.args.args])
+            prog.by_method.setdefault(item.name, []).append(key)
+    # attribute decls: lock attrs, safe attrs, typed attrs — anywhere in
+    # the class body (locks are born in __init__ by convention, but
+    # enable_ingest-style lazy inits exist).
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Assign):
+            continue
+        for t in n.targets:
+            attr = _is_self_attr(t)
+            if attr is None:
+                continue
+            ctor = _lock_ctor(n.value)
+            if ctor is not None:
+                named, name = ctor
+                ci.locks[attr] = _LockDecl(
+                    name or f"{ci.key}.{attr}", path, n.lineno, named)
+                ci.safe_attrs.add(attr)
+                continue
+            if _is_safe_ctor(n.value):
+                ci.safe_attrs.add(attr)
+            if isinstance(n.value, ast.Call):
+                d = _dotted(n.value.func)
+                if d:
+                    cname = d.rsplit(".", 1)[-1]
+                    if cname in prog.class_by_name or cname[:1].isupper():
+                        ci.attr_types.setdefault(attr, cname)
+
+
+# ------------------------------------------------------ event extraction
+
+
+class _Extractor:
+    """Per-function event walk: lock regions (`with`), calls with their
+    held-stack, blocking calls, attribute accesses.  Nested defs are
+    skipped (a closure handed elsewhere runs elsewhere)."""
+
+    def __init__(self, prog: Program, fi: _FuncInfo):
+        self.prog = prog
+        self.fi = fi
+        self.mod = fi.module
+        self.ci = prog.classes.get(f"{fi.module}:{fi.cls}") if fi.cls \
+            else None
+        self.alias: dict[str, str] = {}  # local -> self attr (lk = self.mu)
+
+    # ---- lockref resolution
+
+    def _lockref(self, expr: ast.AST):
+        attr = _is_self_attr(expr)
+        if attr is not None:
+            if (self.ci and attr in self.ci.locks) or attr in _LOCKISH \
+                    or attr.endswith(("_mu", "_lock")):
+                owner = self._lock_owner(attr)
+                return ("attr", owner, attr)
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.alias:
+                return self._lockref_attr(self.alias[expr.id])
+            if expr.id in self.prog.mod_locks.get(self.mod, {}):
+                return ("attr", self.mod, expr.id)
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base in self.fi.params and (
+                    attr in _LOCKISH or attr.endswith(("_mu", "_lock"))):
+                return ("sym", base, attr)
+            # module-level lock through an import alias
+            tgt = self.prog.imports.get(self.mod, {}).get(base)
+            if tgt and "#" not in tgt and \
+                    attr in self.prog.mod_locks.get(tgt, {}):
+                return ("attr", tgt, attr)
+        return None
+
+    def _lockref_attr(self, attr: str):
+        return ("attr", self._lock_owner(attr), attr)
+
+    def _lock_owner(self, attr: str) -> str:
+        """The class key whose decl wins for `self.attr` — the defining
+        base if the using class doesn't declare it."""
+        if self.ci is None:
+            return self.mod
+        if attr in self.ci.locks:
+            return self.ci.key
+        for b in self.ci.bases:
+            for bk in self.prog.class_by_name.get(b, ()):
+                bci = self.prog.classes[bk]
+                if attr in bci.locks:
+                    return bk
+        return self.ci.key
+
+    # ---- call resolution
+
+    def _callees(self, call: ast.Call) -> list[str]:
+        f = call.func
+        d = _dotted(f)
+        if d is None:
+            return []
+        parts = d.split(".")
+        # self.meth(...)
+        if len(parts) == 2 and parts[0] == "self" and self.ci:
+            m = self._class_method(self.ci, parts[1])
+            return [m] if m else []
+        # self.attr.meth(...) through a typed attribute
+        if len(parts) == 3 and parts[0] == "self" and self.ci:
+            tname = self.ci.attr_types.get(parts[1])
+            if tname:
+                for ck in self.prog.class_by_name.get(tname, ()):
+                    m = self._class_method(self.prog.classes[ck], parts[2])
+                    if m:
+                        return [m]
+            return []
+        # bare func(...)
+        if len(parts) == 1:
+            k = self.prog.mod_funcs.get(self.mod, {}).get(parts[0])
+            if k:
+                return [k]
+            tgt = self.prog.imports.get(self.mod, {}).get(parts[0])
+            if tgt and "#" in tgt:
+                m, fn = tgt.split("#")
+                k = self.prog.mod_funcs.get(m, {}).get(fn)
+                return [k] if k else []
+            return []
+        # mod.func(...)
+        if len(parts) == 2:
+            tgt = self.prog.imports.get(self.mod, {}).get(parts[0])
+            if tgt and "#" not in tgt:
+                k = self.prog.mod_funcs.get(tgt, {}).get(parts[1])
+                return [k] if k else []
+        return []
+
+    def _class_method(self, ci: _ClassInfo, name: str) -> str | None:
+        if name in ci.methods:
+            return ci.methods[name]
+        for b in ci.bases:
+            for bk in self.prog.class_by_name.get(b, ()):
+                m = self._class_method(self.prog.classes[bk], name)
+                if m:
+                    return m
+        return None
+
+    def _submap(self, call: ast.Call, callee_key: str) -> dict:
+        """callee param -> caller base, for symbolic lock substitution.
+        Bases: "cls:<classkey>" (caller passed self) or ("sym", p)
+        (caller passed its own param through)."""
+        fi = self.prog.funcs.get(callee_key)
+        if fi is None:
+            return {}
+        params = list(fi.params)
+        sub: dict = {}
+        if fi.cls is not None and params and params[0] == "self":
+            # bound call: self maps to the callee's own class
+            sub["self"] = f"cls:{fi.module}:{fi.cls}"
+            params = params[1:]
+        for p, a in zip(params, call.args):
+            if isinstance(a, ast.Name):
+                if a.id == "self" and self.ci:
+                    sub[p] = f"cls:{self.ci.key}"
+                elif a.id in self.fi.params:
+                    sub[p] = ("sym", a.id)
+        return sub
+
+    # ---- the walk
+
+    def run(self) -> None:
+        fi = self.fi
+        node = fi.node
+        # held-by-convention: the *_locked suffix (caller already holds
+        # the server lock) and `_apply*` (the RSM apply path, entered
+        # only from the decided drain under mu — same convention lint's
+        # blocking-commit-wait encodes) / lock-wrapping decorator
+        if fi.cls and (fi.name.endswith("_locked")
+                       or fi.name.startswith("_apply")) and self.ci:
+            primary = self._primary_lock()
+            if primary:
+                fi.initial_held.append(primary)
+        for dec in getattr(node, "decorator_list", ()):
+            d = _dotted(dec)
+            if d:
+                attr = self.prog.decorator_locks.get(
+                    f"{fi.module}:{d.rsplit('.', 1)[-1]}")
+                if attr:
+                    ref = self._lockref_attr(attr)
+                    fi.initial_held.append(ref)
+                    # Unlike *_locked (caller already holds), a lock-
+                    # wrapping decorator ACQUIRES — a caller holding mu
+                    # who calls a decorated method takes emu through
+                    # it, so the edge must be visible to callers.
+                    fi.events.append(("acq", ref, [], node.lineno))
+        # alias prescan: lk = self.mu
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                a = _is_self_attr(n.value)
+                if a and ((self.ci and a in self.ci.locks)
+                          or a in _LOCKISH):
+                    self.alias[n.targets[0].id] = a
+        self._walk_body(list(node.body), list(fi.initial_held))
+
+    def _primary_lock(self):
+        for cand in ("mu", "_lock", "_mu", "_fs_lock", "emu"):
+            if self.ci and cand in self.ci.locks:
+                return ("attr", self.ci.key, cand)
+            if cand in _LOCKISH and self.ci:
+                # undeclared (inherited) primary: resolve through bases
+                for b in self.ci.bases:
+                    for bk in self.prog.class_by_name.get(b, ()):
+                        if cand in self.prog.classes[bk].locks:
+                            return ("attr", bk, cand)
+        return None
+
+    def _walk_body(self, stmts: list, held: list) -> None:
+        for st in stmts:
+            self._walk_stmt(st, held)
+
+    def _walk_stmt(self, st: ast.AST, held: list) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested def: runs elsewhere
+        if isinstance(st, ast.With):
+            acquired = []
+            for item in st.items:
+                ref = self._lockref(item.context_expr)
+                if ref is not None and ref not in held:
+                    self.fi.events.append(("acq", ref, list(held),
+                                           st.lineno))
+                    acquired.append(ref)
+            self._walk_body(st.body, held + acquired)
+            return
+        if isinstance(st, ast.Try):
+            # Manual lock discipline: a `try:` whose `finally:` calls
+            # `X.release()` runs its body HELD (diskv.full_snapshot's
+            # timeout-acquire shape).  Held matters for access
+            # classification, blocking reach and outbound edges; the
+            # try-acquire itself contributes no inbound order edge —
+            # same stance as lockwatch's ordered=False for
+            # timeout/try acquires, which cannot wedge a cycle.
+            rel = self._finally_released(st)
+            if rel is not None and rel not in held:
+                self._walk_body(st.body, held + [rel])
+                for h in st.handlers:
+                    self._walk_body(h.body, held + [rel])
+                self._walk_body(st.orelse, held + [rel])
+                self._walk_body(st.finalbody, held)
+                return
+        for attr, kind, line in self._attr_traffic(st):
+            self.fi.accesses.append((attr, kind, bool(held), line))
+        for call in self._calls_of(st):
+            d = _dotted(call.func)
+            if d is not None:
+                tail = d.rsplit(".", 1)[-1]
+                if d in _BLOCKING_DOTTED or (
+                        "." in d and tail in _BLOCKING_TAILS):
+                    self.fi.events.append(("block", d, list(held),
+                                           call.lineno))
+            callees = self._callees(call)
+            if callees:
+                self.fi.events.append(("call", _CallSite(
+                    callees,
+                    {k: self._submap(call, k) for k in callees},
+                    list(held), call.lineno)))
+        # recurse into compound statements (their nested stmts share the
+        # held stack); With handled above, defs skipped.  ExceptHandler
+        # is not an ast.stmt but carries a stmt body.
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                self._walk_stmt(child, held)
+
+    def _finally_released(self, st: ast.Try):
+        """The lockref a `finally:` block releases, if any."""
+        for fin in st.finalbody:
+            for call in self._calls_of(fin):
+                f = call.func
+                if isinstance(f, ast.Attribute) and f.attr == "release":
+                    ref = self._lockref(f.value)
+                    if ref is not None:
+                        return ref
+        return None
+
+    def _calls_of(self, st: ast.AST):
+        """Calls lexically in `st` but not inside a nested stmt (those
+        are visited by the recursion) or nested def."""
+        out = []
+        for n in self._shallow_walk(st):
+            if isinstance(n, ast.Call):
+                out.append(n)
+        return out
+
+    def _attr_traffic(self, st: ast.AST):
+        out = []
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                a = _is_self_attr(t)
+                if a:
+                    out.append((a, "w", st.lineno))
+                elif isinstance(t, ast.Subscript):
+                    a = _is_self_attr(t.value)
+                    if a:
+                        out.append((a, "w", st.lineno))
+        elif isinstance(st, ast.AugAssign):
+            a = _is_self_attr(st.target)
+            if a:
+                out.append((a, "w", st.lineno))
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Subscript):
+                    a = _is_self_attr(t.value)
+                    if a:
+                        out.append((a, "w", st.lineno))
+        for n in self._shallow_walk(st):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in _MUTATORS:
+                a = _is_self_attr(n.func.value)
+                if a:
+                    out.append((a, "w", n.lineno))
+            elif isinstance(n, ast.Attribute) and \
+                    isinstance(n.ctx, ast.Load):
+                a = _is_self_attr(n)
+                if a:
+                    out.append((a, "r", n.lineno))
+        return out
+
+    def _shallow_walk(self, st: ast.AST):
+        """Expression-level walk of ONE statement: stops at nested
+        statements / handlers (recursed separately) and nested defs."""
+        todo = [c for c in ast.iter_child_nodes(st)
+                if not isinstance(c, (ast.stmt, ast.ExceptHandler))]
+        seen = []
+        while todo:
+            n = todo.pop()
+            if isinstance(n, (ast.stmt, ast.ExceptHandler, ast.Lambda,
+                              ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            seen.append(n)
+            todo.extend(ast.iter_child_nodes(n))
+        return seen
+
+
+# ------------------------------------------------------------ entries
+
+
+def _detect_entries(prog: Program) -> None:
+    """Thread entry points, attached to _FuncInfo.entry_tcs."""
+    for key, fi in prog.funcs.items():
+        rel = fi.file.replace(os.sep, "/")
+        # C++ event-loop callback seams
+        if any(rel.endswith(s) for s in _EVENTLOOP_FILES) and (
+                fi.name.startswith("_on_") or fi.name.endswith("_cb")):
+            fi.entry_tcs.add(_TC_LOOP)
+        # public service methods: callable from any client thread
+        if fi.cls is not None and not fi.name.startswith("_") and any(
+                seg in rel for seg in ("/services/", "/rpc/", "/core/",
+                                       "/obs/", "/harness/")):
+            fi.entry_tcs.add(_TC_API)
+
+    for key, fi in list(prog.funcs.items()):
+        if fi.node is None:
+            continue
+        ci = prog.classes.get(f"{fi.module}:{fi.cls}") if fi.cls else None
+        for n in ast.walk(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func) or ""
+            tail = d.rsplit(".", 1)[-1]
+            if tail == "Thread":
+                _mark_thread_target(prog, fi, ci, n)
+            elif tail in ("register", "register_inline"):
+                if len(n.args) >= 2:
+                    _mark_entry(prog, fi, ci, n.args[1], _TC_RPC)
+            elif tail == "register_obj" and n.args:
+                a = n.args[0]
+                if isinstance(a, ast.Name) and a.id == "self" and ci:
+                    for m, mk in ci.methods.items():
+                        if not m.startswith("_"):
+                            prog.funcs[mk].entry_tcs.add(_TC_RPC)
+            elif tail in ("add_global_sampler", "register_tracker",
+                          "add_sampler", "add_observer"):
+                for a in n.args:
+                    _mark_entry(prog, fi, ci, a, _TC_PULSE)
+
+
+def _mark_thread_target(prog, fi, ci, call: ast.Call) -> None:
+    target = next((kw.value for kw in call.keywords
+                   if kw.arg == "target"), None)
+    if target is None:
+        return
+    label = None
+    if isinstance(target, ast.Call):
+        d = _dotted(target.func) or ""
+        if d.endswith("guarded") and target.args:
+            if len(target.args) > 1 and \
+                    isinstance(target.args[1], ast.Constant):
+                label = str(target.args[1].value)
+            target = target.args[0]
+        else:
+            return
+    if ci:
+        ci.spawns_thread = True
+    _mark_entry(prog, fi, ci, target, label or "thread")
+
+
+def _mark_entry(prog, fi, ci, expr, tc: str) -> None:
+    a = _is_self_attr(expr)
+    if a is not None and ci:
+        mk = ci.methods.get(a)
+        if mk:
+            prog.funcs[mk].entry_tcs.add(tc)
+        return
+    if isinstance(expr, ast.Name):
+        k = prog.mod_funcs.get(fi.module, {}).get(expr.id)
+        if k:
+            prog.funcs[k].entry_tcs.add(tc)
+
+
+def _propagate_tcs(prog: Program) -> None:
+    """BFS each entry's thread class through the call graph."""
+    succ: dict[str, set[str]] = {}
+    for key, fi in prog.funcs.items():
+        outs = set()
+        for ev in fi.events:
+            if ev[0] == "call":
+                outs.update(ev[1].callees)
+        succ[key] = outs
+    work = []
+    for key, fi in prog.funcs.items():
+        if fi.entry_tcs:
+            fi.tcs |= fi.entry_tcs
+            work.append(key)
+    while work:
+        key = work.pop()
+        tcs = prog.funcs[key].tcs
+        for nxt in succ.get(key, ()):
+            nfi = prog.funcs.get(nxt)
+            if nfi is None:
+                continue
+            if not tcs <= nfi.tcs:
+                nfi.tcs |= tcs
+                work.append(nxt)
+
+
+def _locked_ctx(prog: Program) -> set[str]:
+    """Methods that run under their class lock WITHOUT taking it —
+    the interprocedural half of the *_locked convention: every visible
+    call site either holds a lock lexically or sits in a method already
+    known to run locked.  Entry points (thread targets, RPC handlers,
+    public API) never qualify: they are called from outside with
+    nothing held."""
+    ctx = {k for k, fi in prog.funcs.items() if fi.cls and fi.initial_held}
+    # callee -> [(caller_key, lexically_held_at_site)]
+    sites: dict[str, list] = {}
+    for key, fi in prog.funcs.items():
+        for ev in fi.events:
+            if ev[0] != "call":
+                continue
+            for ck in ev[1].callees:
+                sites.setdefault(ck, []).append((key, bool(ev[1].held)))
+    for _ in range(12):
+        changed = False
+        for key, fi in prog.funcs.items():
+            if key in ctx or fi.cls is None or fi.entry_tcs:
+                continue
+            ss = sites.get(key)
+            if not ss:
+                continue
+            if all(held or caller in ctx for caller, held in ss):
+                ctx.add(key)
+                changed = True
+        if not changed:
+            break
+    return ctx
+
+
+# ------------------------------------------------------ lock summaries
+
+
+def _subst(ref, submap: dict):
+    """Resolve a symbolic lockref through a call edge's submap."""
+    if ref[0] != "sym":
+        return ref
+    base = submap.get(ref[1])
+    if base is None:
+        return None
+    if isinstance(base, str) and base.startswith("cls:"):
+        return ("attr", base[4:], ref[2])
+    if isinstance(base, tuple) and base[0] == "sym":
+        return ("sym", base[1], ref[2])
+    return None
+
+
+def _fix_acquires(prog: Program) -> dict[str, set]:
+    """Fixpoint: every lockref a function may acquire, transitively."""
+    acq: dict[str, set] = {k: set() for k in prog.funcs}
+    for key, fi in prog.funcs.items():
+        for ev in fi.events:
+            if ev[0] == "acq":
+                acq[key].add(ev[1])
+    for _ in range(24):
+        changed = False
+        for key, fi in prog.funcs.items():
+            cur = acq[key]
+            before = len(cur)
+            for ev in fi.events:
+                if ev[0] != "call":
+                    continue
+                site = ev[1]
+                for ck in site.callees:
+                    for ref in acq.get(ck, ()):
+                        r = _subst(ref, site.submap.get(ck, {}))
+                        if r is not None:
+                            cur.add(r)
+            if len(cur) != before:
+                changed = True
+        if not changed:
+            break
+    return acq
+
+
+def _fix_blocking(prog: Program) -> dict[str, set]:
+    """Fixpoint: blocking calls reachable from each function when it
+    does NOT guard them behind its own lock... conservative: any
+    blocking call in the body (lexical `held` there is the callee's
+    business) propagates up with a chain tag."""
+    blk: dict[str, set] = {k: set() for k in prog.funcs}
+    for key, fi in prog.funcs.items():
+        for ev in fi.events:
+            if ev[0] == "block":
+                s = _match_suppression(prog, fi.file, ev[3],
+                                       _BLOCKING_SANCTION_RULES)
+                if s is not None:
+                    # A justified lexical suppression sanctions callers
+                    # too — don't re-litigate it up the call graph.
+                    if s.rules <= set(CONSAN_RULES):
+                        s.used = True  # consan-owned: we account for it
+                    continue
+                blk[key].add((ev[1], f"{fi.name}:{ev[3]}"))
+    for _ in range(24):
+        changed = False
+        for key, fi in prog.funcs.items():
+            cur = blk[key]
+            before = len(cur)
+            for ev in fi.events:
+                if ev[0] != "call":
+                    continue
+                for ck in ev[1].callees:
+                    for d, chain in blk.get(ck, ()):
+                        cfi = prog.funcs.get(ck)
+                        tag = f"{cfi.name}->{chain}" if cfi else chain
+                        if len(tag) < 200:
+                            cur.add((d, tag))
+            if len(cur) != before:
+                changed = True
+        if not changed:
+            break
+    return blk
+
+
+def _label(prog: Program, ref) -> str | None:
+    if ref[0] != "attr":
+        return None
+    _, owner, attr = ref
+    ci = prog.classes.get(owner)
+    if ci is not None:
+        decl = ci.locks.get(attr)
+        if decl is not None:
+            return decl.label
+        return f"{owner}.{attr}"
+    decl = prog.mod_locks.get(owner, {}).get(attr)
+    if decl is not None:
+        return decl.label
+    return f"{owner}.{attr}"
+
+
+# ------------------------------------------------------------ analysis
+
+
+class Analysis:
+    """The whole-program result: findings + the static lock-order graph
+    (label-keyed edges with first-seen provenance)."""
+
+    def __init__(self):
+        self.findings: list[Finding] = []
+        self.edges: dict[tuple[str, str], dict] = {}
+        self.named_locks: dict[str, _LockDecl] = {}
+        self.nfiles = 0
+
+    def cycles(self) -> list[list[str]]:
+        succ: dict[str, list[str]] = {}
+        for (a, b) in self.edges:
+            succ.setdefault(a, []).append(b)
+        WHITE, GREY, BLACK = 0, 1, 2
+        nodes = {n for e in self.edges for n in e}
+        color = dict.fromkeys(nodes, WHITE)
+        out: list[list[str]] = []
+        path: list[str] = []
+
+        def dfs(n: str) -> None:
+            color[n] = GREY
+            path.append(n)
+            for m in succ.get(n, ()):
+                c = color.get(m, BLACK)
+                if c == GREY:
+                    i = path.index(m)
+                    out.append(path[i:] + [m])
+                elif c == WHITE:
+                    dfs(m)
+            path.pop()
+            color[n] = BLACK
+
+        for n in sorted(nodes):
+            if color[n] == WHITE:
+                dfs(n)
+        return out
+
+    def edge_list(self) -> list[dict]:
+        return [{"from": a, "to": b, **info}
+                for (a, b), info in sorted(self.edges.items())]
+
+
+def merged_cycles(analysis: "Analysis", report) -> list[list[str]]:
+    """Cycles of the UNION of the static graph and a lockwatch Report's
+    runtime graph (label granularity).  Static sees orders no run took;
+    runtime sees instance-level and dynamic orders the static resolver
+    dropped — the merged graph must stay acyclic for the hierarchy to
+    be real."""
+    edges = set(analysis.edges)
+    for (a, b) in report.edges:
+        la, lb = report.nodes.get(a), report.nodes.get(b)
+        if la and lb and la != lb:
+            edges.add((la, lb))
+    merged = Analysis()
+    merged.edges = {e: {} for e in edges}
+    return merged.cycles()
+
+
+def analyze_paths(paths: list[str], manifest=None) -> Analysis:
+    """Run consan over a file/directory set.  `manifest` defaults to
+    the canonical tpu6824.utils.locks.MANIFEST."""
+    if manifest is None:
+        from tpu6824.utils.locks import MANIFEST as manifest  # noqa: N811
+    prog = Program()
+    res = Analysis()
+    for f in iter_py_files(paths):
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=f)
+        except (OSError, SyntaxError):
+            continue
+        rel = f.replace(os.sep, "/")
+        prog.files[f] = src
+        prog.sups[f] = _collect_suppressions(src, f, [])
+        res.nfiles += 1
+        _index_module(prog, f, rel, tree)
+    for fi in prog.funcs.values():
+        _Extractor(prog, fi).run()
+    _detect_entries(prog)
+    _propagate_tcs(prog)
+    acq = _fix_acquires(prog)
+
+    # ---- static lock-order edges
+    for key, fi in prog.funcs.items():
+        for ev in fi.events:
+            if ev[0] == "acq":
+                _, ref, held, line = ev
+                la = _label(prog, ref)
+                if la is None:
+                    continue
+                for h in held:
+                    lh = _label(prog, h)
+                    if lh and lh != la:
+                        res.edges.setdefault((lh, la), {
+                            "file": fi.file, "line": line,
+                            "via": fi.key})
+            elif ev[0] == "call":
+                site = ev[1]
+                if not site.held:
+                    continue
+                for ck in site.callees:
+                    for ref in acq.get(ck, ()):
+                        r = _subst(ref, site.submap.get(ck, {}))
+                        if r is None:
+                            continue
+                        la = _label(prog, r)
+                        if la is None:
+                            continue
+                        for h in site.held:
+                            lh = _label(prog, h)
+                            if lh and lh != la:
+                                res.edges.setdefault((lh, la), {
+                                    "file": fi.file, "line": site.line,
+                                    "via": f"{fi.key}->{ck}"})
+
+    # named-lock inventory
+    for ci in prog.classes.values():
+        for decl in ci.locks.values():
+            if decl.named:
+                res.named_locks.setdefault(decl.label, decl)
+    for mod, locks in prog.mod_locks.items():
+        for decl in locks.values():
+            if decl.named:
+                res.named_locks.setdefault(decl.label, decl)
+
+    ctx = _locked_ctx(prog)
+    _check_cycles(prog, res)
+    _check_manifest(prog, res, manifest)
+    _check_shared_state(prog, res, ctx)
+    _check_blocking_reachable(prog, res)
+    _apply_suppressions(prog, res)
+    return res
+
+
+def _check_cycles(prog: Program, res: Analysis) -> None:
+    for cyc in res.cycles():
+        # anchor at the provenance of the cycle's first edge
+        info = res.edges.get((cyc[0], cyc[1])) or {}
+        res.findings.append(Finding(
+            info.get("file", "?"), info.get("line", 0),
+            "lock-order-cycle",
+            "static lock-order cycle: " + " -> ".join(cyc) +
+            f" (first edge via {info.get('via', '?')})"))
+
+
+def _check_manifest(prog: Program, res: Analysis, manifest) -> None:
+    idx = {name: i for i, name in enumerate(manifest)}
+    for label, decl in sorted(res.named_locks.items()):
+        if label not in idx:
+            res.findings.append(Finding(
+                decl.file, decl.line, "lock-manifest-missing",
+                f"named lock {label!r} is not declared in "
+                "tpu6824.utils.locks.MANIFEST — add it at its rank in "
+                "the canonical acquisition order"))
+    for (a, b), info in sorted(res.edges.items()):
+        ia, ib = idx.get(a), idx.get(b)
+        if ia is not None and ib is not None and ib < ia:
+            res.findings.append(Finding(
+                info["file"], info["line"], "lock-manifest-order",
+                f"acquisition edge {a} -> {b} inverts the declared "
+                f"manifest order (rank {ia} -> {ib}) via {info['via']}"))
+
+
+def _check_shared_state(prog: Program, res: Analysis,
+                        ctx: set) -> None:
+    for ck, ci in prog.classes.items():
+        if not ci.locks:
+            continue
+        tcs_union: set = set()
+        for mk in ci.methods.values():
+            tcs_union |= prog.funcs[mk].tcs
+        if not ci.spawns_thread and len(tcs_union) < 2:
+            continue
+        writes: dict[str, tuple] = {}   # attr -> (fi, line) locked write
+        bare: dict[str, list] = {}      # attr -> [(fi, line, kind)]
+        for mname, mk in ci.methods.items():
+            fi = prog.funcs[mk]
+            if mname in _LIFECYCLE:
+                continue
+            in_ctx = mk in ctx
+            for attr, kind, locked, line in fi.accesses:
+                if attr in ci.safe_attrs or attr in _LOCKISH or \
+                        attr in _KILL_FLAGS or \
+                        attr.endswith(("_mu", "_lock")):
+                    continue
+                if (locked or in_ctx) and kind == "w":
+                    if attr not in writes:
+                        writes[attr] = (fi, line)
+                elif not locked and not in_ctx:
+                    bare.setdefault(attr, []).append((fi, line, kind))
+        for attr, (wfi, wline) in sorted(writes.items()):
+            sites = bare.get(attr)
+            if not sites:
+                continue
+            for bfi, bline, kind in sites:
+                if bfi.key == wfi.key:
+                    continue
+                # cross-thread evidence: the bare site's thread classes
+                # must not be a subset of the locked writer's (same-
+                # thread traffic is the lock's own business)
+                if not bfi.tcs or bfi.tcs <= wfi.tcs:
+                    continue
+                res.findings.append(Finding(
+                    bfi.file, bline, "unlocked-shared-state",
+                    f"self.{attr} ({'write' if kind == 'w' else 'read'} "
+                    f"in {ci.name}.{bfi.name}, threads "
+                    f"{'/'.join(sorted(bfi.tcs))}) touched lock-free "
+                    f"but written under the lock in {ci.name}."
+                    f"{wfi.name} ({wfi.file.rsplit('/', 1)[-1]}:{wline}"
+                    f", threads {'/'.join(sorted(wfi.tcs)) or '-'})"))
+                break  # one finding per (class, attr)
+
+
+def _check_blocking_reachable(prog: Program, res: Analysis) -> None:
+    blk = _fix_blocking(prog)
+    for key, fi in prog.funcs.items():
+        for ev in fi.events:
+            if ev[0] != "call" or not ev[1].held:
+                continue
+            site = ev[1]
+            held_labels = [x for x in (_label(prog, h) for h in site.held)
+                           if x]
+            if not held_labels:
+                continue
+            for ck in site.callees:
+                hits = blk.get(ck, ())
+                if not hits:
+                    continue
+                d, chain = sorted(hits)[0]
+                res.findings.append(Finding(
+                    fi.file, site.line, "lock-blocking-reachable",
+                    f"holding {'/'.join(held_labels)}, call into "
+                    f"{prog.funcs[ck].name}() reaches blocking "
+                    f"{d}() (chain {chain}) — the lock stalls every "
+                    "waiter for the full blocking call"))
+                break  # one finding per call site
+
+
+def _apply_suppressions(prog: Program, res: Analysis) -> None:
+    """tpusan-style suppression matching against the shared per-file
+    suppression tables, plus consan-owned unused-suppression reporting
+    (only for suppressions whose rules are ALL consan rules — mixed
+    ones are the lint pass's to account for)."""
+    for f in res.findings:
+        s = _match_suppression(prog, f.path, f.line, {f.rule})
+        if s is not None:
+            f.suppressed = True
+            s.used = True
+    extra: list[Finding] = []
+    for path, sups in prog.sups.items():
+        for s in sups.values():
+            if not s.used and s.rules and s.rules <= set(CONSAN_RULES):
+                extra.append(Finding(
+                    path, s.line, "unused-suppression",
+                    f"consan suppression for {sorted(s.rules)} matches "
+                    "no finding"))
+    res.findings.extend(extra)

@@ -177,6 +177,46 @@ RULES: dict[str, str] = {
         "refactor or rule change; delete it or fix the rule name",
 }
 
+# Whole-program rules owned by the consan pass (analysis/consan.py) —
+# registered here so the suppression loader accepts `ok(...)` comments
+# naming them, but NOT run by the per-file visitor: consan needs the
+# whole call graph at once.  The per-file unused-suppression check
+# defers suppressions naming only these rules to consan (which alone
+# can tell whether they match).
+WHOLE_PROGRAM_RULES: dict[str, str] = {
+    "lock-order-cycle":
+        "cycle in the static lock-order graph — two code paths (possibly "
+        "crossing function/module boundaries) acquire the same locks in "
+        "opposite orders, which deadlocks the moment two threads "
+        "interleave them; fix the acquisition order or drop one side to "
+        "a try-acquire",
+    "lock-manifest-order":
+        "static lock acquisition edge against the canonical order "
+        "declared in tpu6824.utils.locks.MANIFEST (outermost first) — "
+        "either the code path is wrong or the manifest is; change "
+        "whichever is lying, never suppress silently",
+    "lock-manifest-missing":
+        "named lock (utils.locks.new_lock/new_rlock) absent from the "
+        "canonical MANIFEST in tpu6824/utils/locks.py — every named hot "
+        "lock declares its rank so static consan and runtime lockwatch "
+        "can validate the same hierarchy",
+    "unlocked-shared-state":
+        "self attribute written under the class lock in one method but "
+        "touched lock-free from a method a different thread class "
+        "reaches — the devapply mirror-cadence race shape (PR 15); "
+        "either take the lock at the bare site or justify why it is "
+        "safe (immutable snapshot swap, single-writer field, monotonic "
+        "counter read)",
+    "lock-blocking-reachable":
+        "blocking call (sleep/socket/RPC/device readback/.wait) "
+        "reachable through the call graph while a named/server lock is "
+        "held — the interprocedural half of lock-blocking-call: the "
+        "lexical rule sees `with mu: sleep()`, this sees `with mu: "
+        "helper()` where the sleep hides two calls down, stalling every "
+        "thread behind the lock",
+}
+RULES.update(WHOLE_PROGRAM_RULES)
+
 # ---------------------------------------------------------------- scopes
 
 _LOCK_SCOPE = (
@@ -1364,7 +1404,10 @@ def lint_source(source: str, path: str,
                 s.used = True
                 break
     for s in sups.values():
-        if not s.used:
+        # Suppressions naming any whole-program rule are consan's to
+        # account for — this per-file pass cannot see whether an
+        # interprocedural finding matches them.
+        if not s.used and not (s.rules & set(WHOLE_PROGRAM_RULES)):
             findings.append(Finding(
                 path, s.line, "unused-suppression",
                 f"suppression for {sorted(s.rules)} matches no finding"))

@@ -48,7 +48,14 @@ _active = False
 _edges: dict[tuple[int, int], dict] = {}    # (node_a, node_b) -> first-seen info
 _nodes: dict[int, str] = {}                  # node id -> label
 _violations: list[dict] = []
+_order_violations: list[dict] = []
 _MAX_VIOLATIONS = 256
+# Canonical named-lock hierarchy (outermost first), pushed by
+# tpu6824.utils.locks at import from its MANIFEST.  Acquiring a manifest
+# lock while holding one that ranks BELOW it is an order violation even
+# before any cycle closes — runtime lockdep against the same declaration
+# the static consan pass validates.
+_manifest_idx: dict[str, int] = {}
 _serial = 0
 _tls = threading.local()  # .held = [[node_id, t0, depth, label], ...]
 
@@ -64,10 +71,12 @@ class Report:
     """What a sanitized run learned: the aggregated acquisition graph,
     any order cycles, and any hold-budget violations."""
 
-    def __init__(self, nodes, edges, violations):
+    def __init__(self, nodes, edges, violations, order_violations=None):
         self.nodes = nodes          # node id -> label
         self.edges = edges          # (a, b) -> {"thread", "count"}
         self.violations = violations  # [{"lock", "held_s", "budget_s", ...}]
+        # [{"held", "acquired", "held_rank", "acquired_rank", "thread"}]
+        self.order_violations = order_violations or []
 
     def cycles(self) -> list[list[str]]:
         """Cycles in the lock acquisition graph, as label lists.  Node
@@ -104,13 +113,19 @@ class Report:
     def describe(self) -> str:
         lines = [f"lockwatch: {len(self.nodes)} locks, "
                  f"{len(self.edges)} order edges, "
-                 f"{len(self.violations)} budget violations"]
+                 f"{len(self.violations)} budget violations, "
+                 f"{len(self.order_violations)} manifest-order violations"]
         for cyc in self.cycles():
             lines.append("  CYCLE: " + " -> ".join(cyc))
         for v in self.violations[:16]:
             lines.append(
                 f"  HOLD {v['lock']}: {v['held_s'] * 1e3:.1f}ms "
                 f"(budget {v['budget_s'] * 1e3:.0f}ms) at {v['site']}")
+        for v in self.order_violations[:16]:
+            lines.append(
+                f"  ORDER {v['acquired']} (rank {v['acquired_rank']}) "
+                f"acquired while holding {v['held']} (rank "
+                f"{v['held_rank']}) on {v['thread']}")
         return "\n".join(lines)
 
 
@@ -157,6 +172,25 @@ class _Watched:
                         }
                     else:
                         e["count"] += 1
+                ni = _manifest_idx.get(self._label)
+                if ni is not None:
+                    for ent in st:
+                        hi = _manifest_idx.get(ent[3])
+                        if (hi is None or ni >= hi
+                                or ent[3] == self._label):
+                            continue
+                        if len(_order_violations) < _MAX_VIOLATIONS and \
+                                not any(v["held"] == ent[3]
+                                        and v["acquired"] == self._label
+                                        for v in _order_violations):
+                            _order_violations.append({
+                                "held": ent[3],
+                                "acquired": self._label,
+                                "held_rank": hi,
+                                "acquired_rank": ni,
+                                "thread":
+                                    threading.current_thread().name,
+                            })
         st.append([self._node, time.monotonic(), 1, self._label])
 
     def _note_released(self) -> None:
@@ -290,6 +324,21 @@ def _patched_rlock():
     return _make(_real_rlock, reentrant=True)
 
 
+def set_manifest(names) -> None:
+    """Declare the canonical named-lock hierarchy, outermost first
+    (tpu6824.utils.locks.MANIFEST pushes itself here at import).  The
+    declaration outlives enable/disable cycles: it is the contract, not
+    a measurement."""
+    with _state_mu:
+        _manifest_idx.clear()
+        _manifest_idx.update({n: i for i, n in enumerate(names)})
+
+
+def manifest() -> tuple:
+    with _state_mu:
+        return tuple(sorted(_manifest_idx, key=_manifest_idx.get))
+
+
 def enabled() -> bool:
     return _active
 
@@ -302,6 +351,7 @@ def enable() -> None:
         _edges.clear()
         _nodes.clear()
         _violations.clear()
+        _order_violations.clear()
     _active = True
     threading.Lock = _patched_lock
     threading.RLock = _patched_rlock
@@ -316,14 +366,16 @@ def disable() -> Report:
     threading.Lock = _real_lock
     threading.RLock = _real_rlock
     with _state_mu:
-        return Report(dict(_nodes), dict(_edges), list(_violations))
+        return Report(dict(_nodes), dict(_edges), list(_violations),
+                      list(_order_violations))
 
 
 def snapshot() -> Report:
     """Mid-run report (the sanitize fixture's failure path uses this to
     assert without tearing instrumentation down first)."""
     with _state_mu:
-        return Report(dict(_nodes), dict(_edges), list(_violations))
+        return Report(dict(_nodes), dict(_edges), list(_violations),
+                      list(_order_violations))
 
 
 def make_lock(name: str | None = None, hold_budget_s: float | None = None):

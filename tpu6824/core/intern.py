@@ -94,6 +94,7 @@ def _load_native():
         "libintern6824.so",
         os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                      "native", "intern.cpp"),
+        sanitize=os.environ.get("TPU6824_NATIVE_SANITIZE") or None,
     )
     if lib is None or getattr(lib, "_intern_bound", False):
         return lib

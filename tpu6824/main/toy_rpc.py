@@ -24,6 +24,9 @@ _LEN = struct.Struct(">I")
 
 def _send(sock, obj):
     data = pickle.dumps(obj)
+    # tpusan: ok(lock-blocking-reachable) — _wlock exists precisely to
+    # serialize whole-frame socket writes; the blocking send IS the
+    # operation the lock guards, not work smuggled under it.
     sock.sendall(_LEN.pack(len(data)) + data)
 
 

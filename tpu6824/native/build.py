@@ -28,6 +28,16 @@ BUILD_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "build")
 
 CXX = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC"]
 
+# Sanitized build variants (ISSUE 19): a parallel artifact per variant,
+# same C ABI, never the default load — the nemesis soak opts in via
+# `sanitize="thread"` / TPU6824_NATIVE_SANITIZE=thread.  -O1 -g keeps
+# TSAN's shadow instrumentation honest (O2 elides the racy loads TSAN
+# exists to see) and the reports symbolized.
+SANITIZE_CXX = {
+    "thread": ["g++", "-O1", "-g", "-std=c++17", "-shared", "-fPIC",
+               "-fsanitize=thread"],
+}
+
 _cache: dict[str, "ctypes.CDLL | None"] = {}
 _lock = threading.Lock()
 
@@ -52,10 +62,19 @@ def source_closure(src: str) -> list[str]:
     return sorted(seen)
 
 
-def source_hash(src: str) -> str:
+def sanitized_name(so_name: str, sanitize: str) -> str:
+    """`rpcserver.so` -> `rpcserver.tsan.so` (thread variant): the
+    sanitized artifact lives NEXT TO the production one, never shadowing
+    it."""
+    tag = {"thread": "tsan"}[sanitize]
+    stem, ext = os.path.splitext(so_name)
+    return f"{stem}.{tag}{ext}"
+
+
+def source_hash(src: str, cmd: "list[str] | None" = None) -> str:
     """sha256 over the compile command + the source closure's contents."""
     h = hashlib.sha256()
-    h.update(" ".join(CXX).encode())
+    h.update(" ".join(cmd or CXX).encode())
     for path in source_closure(src):
         h.update(b"\x00" + os.path.basename(path).encode() + b"\x00")
         with open(path, "rb") as f:
@@ -67,15 +86,23 @@ def sidecar_path(so: str) -> str:
     return so + ".src.sha256"
 
 
-def load(so_name: str, src: str) -> "ctypes.CDLL | None":
+def load(so_name: str, src: str,
+         sanitize: "str | None" = None) -> "ctypes.CDLL | None":
     """Compile `src` (if its source closure's hash drifted) to
-    BUILD_DIR/so_name and dlopen it."""
+    BUILD_DIR/so_name and dlopen it.  `sanitize` selects an
+    instrumented variant (see SANITIZE_CXX) built as a parallel
+    artifact with its own sidecar — the variant's compile command is
+    part of its content hash, so production and sanitized builds never
+    satisfy each other's staleness check."""
+    cmd = CXX if sanitize is None else SANITIZE_CXX[sanitize]
+    if sanitize is not None:
+        so_name = sanitized_name(so_name, sanitize)
     with _lock:
         if so_name in _cache:
             return _cache[so_name]
         so = os.path.join(BUILD_DIR, so_name)
         try:
-            want = source_hash(src)
+            want = source_hash(src, cmd)
             have = None
             try:
                 with open(sidecar_path(so)) as f:
@@ -87,7 +114,7 @@ def load(so_name: str, src: str) -> "ctypes.CDLL | None":
                 tmp = f"{so}.{os.getpid()}.tmp"
                 try:
                     subprocess.run(
-                        CXX + ["-o", tmp, src],
+                        cmd + ["-o", tmp, src],
                         check=True, capture_output=True,
                     )
                     os.replace(tmp, so)

@@ -255,7 +255,11 @@ class Pulse:
                     if s["kind"] == "rate" and name not in updated:
                         s["points"].append((round(now, 6), 0.0))
             self.samples += 1
-        observers = list(self._observers)
+        # Snapshot under _mu: add_observer appends from attach threads
+        # while this sampler iterates, and a bare list() of a mutating
+        # list is not atomic without the GIL.
+        with self._mu:
+            observers = list(self._observers)
         for fn in observers:
             try:
                 fn(self, now)

@@ -46,7 +46,13 @@ def _get_lib():
     global _lib
     with _lib_once:
         if _lib is None:
-            lib = load("rpcserver.so", _SRC)
+            # TPU6824_NATIVE_SANITIZE=thread loads the parallel
+            # -fsanitize=thread artifact (the TSAN soak's seam); the
+            # child process must also LD_PRELOAD libtsan — see
+            # tests/test_native_tsan.py for the full recipe.
+            lib = load("rpcserver.so", _SRC,
+                       sanitize=os.environ.get("TPU6824_NATIVE_SANITIZE")
+                       or None)
             if lib is not None:
                 lib.rpcsrv_start.restype = ctypes.c_void_p
                 lib.rpcsrv_start.argtypes = [ctypes.c_char_p,

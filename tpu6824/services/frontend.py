@@ -59,6 +59,7 @@ from tpu6824.services.common import Backoff, fresh_cid
 from tpu6824.services.devapply import DevVal
 from tpu6824.services.kvpaxos import _DEAD, Op
 from tpu6824.utils import crashsink
+from tpu6824.utils.locks import new_lock
 from tpu6824.utils.errors import OK, ErrTxnLocked, RPCError
 
 # The multi-op frame's rpc name.  An old server answers it with
@@ -413,7 +414,7 @@ class ClerkFrontend:
         self._wake_armed = False
         self._ing_last = None  # previous counter snapshot (mirror deltas)
         self._flush_last = None  # opscope flush-hist snapshot (deltas)
-        self._mirror_mu = threading.Lock()  # engine pass vs metrics RPC
+        self._mirror_mu = new_lock("frontend.mirror_mu")  # engine pass vs metrics RPC
         if self.deferred and op_factory is _kv_op and all(
                 hasattr(s, "submit_columnar")
                 for g in self.groups for s in g):

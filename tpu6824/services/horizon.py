@@ -54,6 +54,7 @@ from tpu6824.core.fabric import (  # the PR 7 checksum frame, reused
 )
 from tpu6824.obs import metrics as _metrics
 from tpu6824.utils import durafs
+from tpu6824.utils.locks import new_lock
 
 __all__ = [
     "Snapshotter", "install_from_peer", "load_newest",
@@ -288,7 +289,7 @@ def install_from_peer(fetch, floor: int) -> tuple[str, int, dict | None]:
 # surface.  Registration is explicit and unregistration happens at
 # kill(), so the registry is bounded by live servers.
 
-_trackers_mu = threading.Lock()
+_trackers_mu = new_lock("horizon.trackers_mu")
 _trackers: dict[object, object] = {}  # key -> fn() -> dict
 
 

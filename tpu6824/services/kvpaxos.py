@@ -760,8 +760,12 @@ class KVPaxosServer:
                     _horizon.note_dup_retired(n)
 
     def _horizon_rows(self) -> dict:
-        nkv = self._dev.nkeys if self._dev is not None else len(self.kv)
-        d = {"kv_rows": nkv, "dup_rows": len(self.dup)}
+        # Runs on the pulse sampler thread (tracker registry) while the
+        # driver mutates these under mu — len() of a dict mid-resize is
+        # not safe without the GIL, and mu is cheap at sampling cadence.
+        with self.mu:
+            nkv = self._dev.nkeys if self._dev is not None else len(self.kv)
+            d = {"kv_rows": nkv, "dup_rows": len(self.dup)}
         fab = getattr(self.px, "fabric", None)
         if fab is not None:
             d["window_live_slots"] = fab.live_slots
@@ -865,6 +869,9 @@ class KVPaxosServer:
         cadence with one replicated `compact` proposal so the whole
         group trims at one log position."""
         hz = self.horizon
+        # tpusan: ok(unlocked-shared-state) — off-mu cadence probe:
+        # `applied` is re-read under mu below before any cut is taken;
+        # a stale read here only delays the snapshot one cadence tick.
         if not hz.due(self.applied):
             return
         with self.mu:
@@ -901,6 +908,10 @@ class KVPaxosServer:
             self.kv = blob["kv"]
         hz.publish(applied, blob)
         if self.dup_retire_ops > 0:
+            # tpusan: ok(unlocked-shared-state) — _cmp_cseq is touched
+            # only on this driver thread, which is also the only
+            # snapshot adopter (_catchup_pass → _adopt_blob_locked):
+            # same-thread single-writer, mu would add nothing.
             self._cmp_cseq += 1
             try:
                 self.submit_batch(
@@ -1093,6 +1104,11 @@ class KVPaxosServer:
                     self._catchup_pass()
                 if self.horizon.enabled():
                     self._maybe_snapshot()
+                # tpusan: ok(unlocked-shared-state) — single-reference
+                # probe: set_devapply flips `_dev` under mu and the
+                # mirror swap below rechecks the engine under mu, so a
+                # stale reference here costs one wasted resolve at
+                # worst (see the swap comment).
                 dev = self._dev
                 if dev is not None and dev.mirror_due(self.applied):
                     # Mirror cadence: the readback/resolve runs OFF mu

@@ -52,6 +52,7 @@ from tpu6824.services.common import (
 from tpu6824.services.kvpaxos import _DEAD, _Fut
 from tpu6824.services.shardmaster import Config
 from tpu6824.utils import crashsink
+from tpu6824.utils.locks import new_rlock
 from tpu6824.utils.errors import (
     OK,
     ErrNoKey,
@@ -129,7 +130,10 @@ class ShardKVServer:
         self.directory = directory
         directory[self.name] = self
         self.smck = shardmaster.Clerk(sm_clerk_servers)
-        self.mu = threading.RLock()
+        # Budget contract: the RSM handler legitimately rides mu across
+        # a full paxos agreement (see _sync), so the hold bound is the
+        # op deadline plus drain slack — not the leaf-lock default.
+        self.mu = new_rlock("shardkv.mu", hold_budget_s=op_timeout + 2.0)
         self.kv: dict[str, str] = {}
         self.dup: dict[str, tuple[int, object]] = {}
         # txnkv (ISSUE 13): replicated 2PC state, mutated ONLY in _apply
@@ -441,6 +445,10 @@ class ShardKVServer:
                     pass
             if time.monotonic() >= deadline:
                 raise RPCError("op timeout (no majority?)")
+            # tpusan: ok(lock-blocking-reachable) — the RSM handler
+            # holds mu across paxos agreement by design (ops serialize
+            # on the server mutex, reference lab semantics); the 2ms
+            # nap paces the decide poll, bounded by the deadline above.
             time.sleep(0.002)
 
     # ------------------------------------------------- horizon (ISSUE 14)
@@ -464,14 +472,23 @@ class ShardKVServer:
             for _n, srv in self._group_peers())
 
     def _compact_due(self) -> bool:
-        return self.dup_retire_ops > 0 or self.txn_decision_seq \
-            or self.txn_done_seq
+        # tpusan: ok(unlocked-shared-state) — ticker-side cadence
+        # probe: monotonic counters written under mu on the apply
+        # path; a stale read only delays compaction one tick, and the
+        # replicated compact op re-reads state under apply anyway.
+        due = self.dup_retire_ops, self.txn_decision_seq, self.txn_done_seq
+        return any(due)
 
     def _horizon_rows(self) -> dict:
-        d = {"kv_rows": len(self.kv), "dup_rows": len(self.dup),
-             "txn_prepared_rows": len(self.txn_prepared),
-             "txn_decision_rows": len(self.txn_decisions),
-             "txn_done_rows": len(self.txn_done)}
+        # Runs on the pulse sampler thread (tracker registry) while the
+        # apply path mutates these tables under mu — len() of a dict
+        # mid-resize is not safe without the GIL, and mu is cheap at
+        # sampling cadence.
+        with self.mu:
+            d = {"kv_rows": len(self.kv), "dup_rows": len(self.dup),
+                 "txn_prepared_rows": len(self.txn_prepared),
+                 "txn_decision_rows": len(self.txn_decisions),
+                 "txn_done_rows": len(self.txn_done)}
         fab = getattr(self.px, "fabric", None)
         if fab is not None:
             d["window_live_slots"] = fab.live_slots
@@ -598,6 +615,10 @@ class ShardKVServer:
             blob = self._snapshot_blob_locked()
         hz.publish(applied, blob)
         if self._compact_due():
+            # tpusan: ok(unlocked-shared-state) — _cmp_cseq is touched
+            # only on this ticker thread, which is also the only
+            # snapshot adopter (_catchup_pass → _adopt_blob_locked):
+            # same-thread single-writer, mu would add nothing.
             self._cmp_cseq += 1
             try:
                 self.submit_batch((Op(
@@ -641,6 +662,9 @@ class ShardKVServer:
                 # prepared transactions against their coordinator
                 # records.  Runs OUTSIDE the mutex and outside _apply
                 # by construction (the blocking-commit-wait rule).
+                # tpusan: ok(unlocked-shared-state) — cadence probe:
+                # a stale read skips one resolve pass; resolve_pass
+                # does its real reads under the proper discipline.
                 if self.txn_prepared:
                     txnkv.resolve_pass(self)
                 # horizon (ISSUE 14): participant acks → coordinator,
@@ -699,6 +723,10 @@ class ShardKVServer:
                     self._cfg_cache.pop(n, None)
                     continue
                 try:
+                    # tpusan: ok(lock-blocking-reachable) — the config
+                    # walk serializes against apply under mu by design
+                    # (reconfiguration is a mutex-held state-machine
+                    # step); the clerk query is deadline-bounded.
                     cfg = self._query_cfg(n)
                 except RPCError:
                     return False
@@ -969,6 +997,9 @@ class ShardKVServer:
         blocking-commit-wait shape)."""
         if self.dead:
             raise RPCError("dead")
+        # tpusan: ok(unlocked-shared-state) — see docstring: decisions
+        # are write-once, a stale read only under-reports, and the
+        # trim sentinel below catches the one dangerous miss.
         d = self.txn_decisions.get(tid)
         if d is None and tid in self._trimmed_tids:
             txnkv._M_TRIMMED_CONSULTS.inc()  # trim-safety sentinel

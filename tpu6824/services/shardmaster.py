@@ -25,6 +25,7 @@ from tpu6824.ops.rebalance import UNASSIGNED, rebalance_host
 from tpu6824.services.common import FlakyNet, fresh_cid
 from tpu6824.utils.errors import RPCError
 from tpu6824.utils import crashsink
+from tpu6824.utils.locks import new_rlock
 from tpu6824.utils.trace import dprintf
 
 
@@ -70,7 +71,11 @@ class ShardMasterServer:
                 "ShardMasterServer needs a fabric or an explicit px")
         self.px = px if px is not None else PaxosPeer(fabric, g, me)
         self.me = me
-        self.mu = threading.RLock()
+        # Budget contract: the RSM handler legitimately rides mu across
+        # a full paxos agreement (see _sync), so the hold bound is the
+        # op deadline plus drain slack — not the leaf-lock default.
+        self.mu = new_rlock("shardmaster.mu",
+                            hold_budget_s=op_timeout + 2.0)
         self.configs: list[Config] = [Config.initial()]
         self.applied = -1
         self.dup: dict[int, tuple[int, object]] = {}
@@ -199,6 +204,10 @@ class ShardMasterServer:
                     pass
             if time.monotonic() >= deadline:
                 raise RPCError("op timeout (no majority?)")
+            # tpusan: ok(lock-blocking-reachable) — the RSM handler
+            # holds mu across paxos agreement by design (ops serialize
+            # on the server mutex, reference lab semantics); the 2ms
+            # nap paces the decide poll, bounded by the deadline above.
             time.sleep(0.002)
 
     # ----------------------------------------------------------- RPC surface

@@ -79,6 +79,7 @@ from tpu6824.ops.hashing import key2shard
 from tpu6824.services import shardmaster
 from tpu6824.services.common import Backoff, FlakyNet, fresh_cid
 from tpu6824.utils import crashsink
+from tpu6824.utils.locks import new_lock
 from tpu6824.utils.errors import (
     OK,
     ErrTxnAbort,
@@ -157,7 +158,7 @@ _M_DONE_TRIMMED = _metrics.counter("txn.done_trimmed")
 # still needed it — the soaks assert this stays zero.
 _M_TRIMMED_CONSULTS = _metrics.counter("txn.trimmed_decision_consults")
 
-_inflight_mu = threading.Lock()
+_inflight_mu = new_lock("txnkv.inflight_mu")
 _inflight_n = 0
 
 
@@ -818,7 +819,7 @@ class _TxnClerkBase:
         self._backoff = Backoff()
         self.cid = f"txn-{fresh_cid():x}"
         self._cseq = 0
-        self._cseq_mu = threading.Lock()
+        self._cseq_mu = new_lock("txnkv.cseq_mu")
         self._cfg_at = -float("inf")
         self._cfg = None
 

@@ -196,6 +196,10 @@ class DuraDisk:
                     errno.EIO,
                     "durafs: crashed after rename, before dir fsync",
                     path, kind)
+            # tpusan: ok(lock-blocking-reachable) — the dir fsync must
+            # be ordered inside the disk mutation lock: releasing _mu
+            # before it would let a second writer interleave between
+            # rename and fsync and break the crash-atomicity contract.
             _fsync_dir(os.path.dirname(path))
             # The full discipline ran: this path's content is durable.
             self._journal.pop(path, None)

@@ -18,6 +18,34 @@ from __future__ import annotations
 
 from tpu6824.analysis import lockwatch
 
+# The canonical lock hierarchy, OUTERMOST FIRST: a thread holding a lock
+# may only acquire locks that appear LATER in this tuple.  One
+# declaration, validated twice — statically by analysis/consan.py (every
+# interprocedural acquisition edge must point forward; a named lock
+# missing here is a finding) and live by lockwatch's manifest lockdep
+# (a backward acquisition is an order violation the sanitize fixture
+# fails on, even before any cycle closes).  Derived from the measured
+# acquisition graph: the service-layer server mutexes sit above the
+# engine/core leaves they call into (kvpaxos.mu → devapply.emu is the
+# documented PR 15/16 order; server mu → PaxosFabric._lock is the
+# start/status path; shardkv.mu → FlakyNet._lock is the transport
+# bookkeeping leg), and the frontend/observability locks never nest
+# with them.  New named locks MUST be slotted here at their rank.
+MANIFEST: tuple[str, ...] = (
+    "frontend.mirror_mu",     # engine mirror pass vs metrics RPC
+    "shardkv.mu",             # shardkv server mutex
+    "shardmaster.mu",         # shardmaster server mutex
+    "kvpaxos.mu",             # kvpaxos server mutex
+    "txnkv.inflight_mu",      # module-level inflight-txn gauge guard
+    "devapply.emu",           # columnar apply-engine leaf (reentrant)
+    "PaxosFabric._lock",      # fabric clock/submit core
+    "FlakyNet._lock",         # transport partition/bookkeeping leaf
+    "horizon.trackers_mu",    # row-count tracker registry leaf
+    "txnkv.cseq_mu",          # clerk op-sequence counter leaf
+)
+
+lockwatch.set_manifest(MANIFEST)
+
 
 def new_lock(name: str, hold_budget_s: float | None = None):
     """A non-reentrant lock named for sanitizer reports; `hold_budget_s`
