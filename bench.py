@@ -1517,6 +1517,45 @@ def _clerk_frontend_rate():
                 "note": "devapply off (TPU6824_DEVAPPLY=0) or A/B "
                         "skipped (BENCH_DEVAPPLY_AB=0)",
             }
+        # blackbox recorder A/B (ISSUE 20): the SAME best shape with the
+        # flight-data recorder live — stamp() on every engine pass, the
+        # cadence sync sealing the ring — against the main sweep's
+        # recorder-off arm.  The hot-path contract says the difference
+        # is one dict store per pass; the recorded frac is the proof.
+        if os.environ.get("BENCH_FE_BLACKBOX_AB", "1") != "0":
+            import shutil as _sh
+            import tempfile as _tf
+
+            from tpu6824.obs import blackbox as _bb
+
+            bb_dir = _tf.mkdtemp(prefix="bench-blackbox-")
+            _bb.disable()
+            _bb.enable(bb_dir, name="bench-fe", sync_interval=0.25)
+            try:
+                bb_on = run_point(len(points) + 3, best["conns"],
+                                  best["batch_width"], wire_fmt)
+                ring = _bb.status()
+            finally:
+                _bb.disable()
+                _sh.rmtree(bb_dir, ignore_errors=True)
+            blackbox = {
+                "overhead_ab": {
+                    "on_ops_s": bb_on["value"],
+                    "off_ops_s": best["value"],
+                    "overhead_frac": (round(1.0 - bb_on["value"]
+                                            / best["value"], 4)
+                                      if best["value"] else None),
+                    "note": "same shape with the recorder live; "
+                            "positive = blackbox cost — judge on a "
+                            "quiet box (the env block brackets both "
+                            "windows)",
+                },
+                "ring": {"last_seq": ring["last_seq"],
+                         "seals": ring["seals"],
+                         "bytes_written": ring["bytes_written"]},
+            }
+        else:
+            blackbox = None
         # Per-client order + exact-once spot check: a client key holds
         # exactly its consecutive markers from 0 (prefix of its stream).
         from tpu6824.rpc import transport as _tr
@@ -1564,12 +1603,14 @@ def _clerk_frontend_rate():
         "native_ingest": native_ingest,
         "devapply": devapply,
         "waterfall": waterfall,
+        "blackbox": blackbox,
         "protocol": clerk_protocol,
         "knobs": "TPU6824_FRONTEND_OP_TIMEOUT, TPU6824_FRONTEND_DEPTH; "
                  "BENCH_FE_GROUPS/INSTANCES/SWEEP/SECONDS, BENCH_FE_WIRE, "
                  "BENCH_FE_OPSCOPE_AB, TPU6824_OPSCOPE; "
                  "TPU6824_DEVAPPLY(_SLOTS/_CHAIN/_SYNC), "
-                 "BENCH_DEVAPPLY_AB, BENCH_DEVAPPLY_CUT_SIZES",
+                 "BENCH_DEVAPPLY_AB, BENCH_DEVAPPLY_CUT_SIZES; "
+                 "BENCH_FE_BLACKBOX_AB, TPU6824_BLACKBOX_SLOT/SLOTS/SYNC",
     }
 
 

@@ -1,0 +1,42 @@
+"""Golden: blocking-io-in-telemetry-path — disk IO on a telemetry clock.
+
+An obs-layer sampler that opens a file inside its pulse-observer
+callback and fsyncs two calls below its fold body.  Both run on clocks
+shared with the serving path, so one slow disk turns the observability
+plane into the outage.  2 findings: the direct open in the `_on_*`
+callback, and the os.fsync reached through the fold's helper chain.
+The `sync` method is the sanctioned blackbox cadence seam — its msync
+is never flagged — and the drain body's dict store is the compliant
+producer shape.
+"""
+
+import os
+
+
+class DiskySampler:
+    def __init__(self, mm):
+        self._mm = mm
+        self.stamps = {}
+
+    def _on_sample(self, pulse, now):
+        with open("/tmp/telem.json", "w") as f:   # FINDING: IO in observer
+            f.write("{}")
+
+    def fold(self, cids):
+        self._spill(cids)
+
+    def _spill(self, cids):
+        os.fsync(3)                               # FINDING: via fold->_spill
+
+    def sync(self):
+        self._mm.flush()                          # fine: THE cadence seam
+
+    def drain_pass(self, counts):
+        self.stamps["n"] = len(counts)            # fine: memory store only
+
+    def sample_rss(self):
+        # tpusan: ok(blocking-io-in-telemetry-path) — golden: a tiny
+        # procfs read per tick, measured and documented (pulse's RSS
+        # gauge shape); procfs never blocks on storage
+        with open("/proc/self/statm") as f:
+            return f.read()

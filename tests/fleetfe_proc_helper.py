@@ -11,6 +11,12 @@ Two modes, spawned by tests/test_fleetfe.py::test_fleet_subprocess_smoke:
       consensus state survives in fabricd and the sibling processes'
       replicas keep serving.
 
+When TPU6824_BLACKBOX_DIR is set (the blackbox variant of the smoke,
+ISSUE 20) the fe body names its ring smoke-fe<me> before construction
+(ClerkFrontend's enable_from_env picks it up) and runs a fast pulse so
+the ring carries pulse/opscope ticks — the SIGKILL evidence the
+postmortem reconstructs from disk alone.
+
   clerk <nops> <addr> [<addr> ...]
       One logical client in its own process: a FrontendClerk over the
       whole frontend set, appending `x 0 <j> y` markers under ONE
@@ -20,6 +26,7 @@ Two modes, spawned by tests/test_fleetfe.py::test_fleet_subprocess_smoke:
       mid-traffic kill) and CLERK-DONE at the end.
 """
 
+import os
 import sys
 import time
 
@@ -29,14 +36,25 @@ def run_fe(fabric_addr: str, fe_addr: str, me: int, ttl: float) -> None:
     from tpu6824.services.frontend import ClerkFrontend
     from tpu6824.services.kvpaxos import KVPaxosServer
 
+    pulse = None
+    if os.environ.get("TPU6824_BLACKBOX_DIR"):
+        # Name the ring BEFORE construction: ClerkFrontend.__init__
+        # calls blackbox.enable_from_env().
+        os.environ.setdefault("TPU6824_BLACKBOX_NAME", f"smoke-fe{me}")
     rf = remote_fabric(fabric_addr, timeout=30.0)
     kv = KVPaxosServer(rf, 0, me, op_timeout=8.0)
     fe = ClerkFrontend([kv], fe_addr, op_timeout=8.0,
                        frontend_id=f"smoke-fe{me}")
+    if os.environ.get("TPU6824_BLACKBOX_DIR"):
+        from tpu6824.obs.pulse import Pulse
+
+        pulse = Pulse(interval=0.2).start()
     print(f"FE-UP {me} id={fe.frontend_id}", flush=True)
     try:
         time.sleep(ttl)
     finally:
+        if pulse is not None:
+            pulse.stop()
         fe.kill()
         kv.dead = True
 
@@ -49,7 +67,12 @@ def run_clerk(nops: int, addrs: list) -> None:
     for j in range(nops):
         # One logical op per marker: _call retries across the addr set
         # with the SAME cseq until it lands, so a frontend kill between
-        # CLERK-OP lines surfaces only as a migrated retry.
+        # CLERK-OP lines surfaces only as a migrated retry.  Rotate the
+        # clerk's preferred frontend per op (the sticky default would
+        # park ALL traffic on addrs[0]): every frontend — including the
+        # one about to be SIGKILLed — serves a share, so the victim's
+        # blackbox ring carries real decided/inflight evidence.
+        ck._i = j
         deadline = time.monotonic() + 120.0
         while True:
             try:

@@ -66,6 +66,7 @@ GOLDEN_EXPECT = {
     "rpc/retry_loop.py": {"unbounded-retry": 2},
     "rpc/wallclock.py": {"wallclock-duration": 2},
     "obs/unbounded.py": {"unbounded-obs-buffer": 3},
+    "obs/blocking_io.py": {"blocking-io-in-telemetry-path": 2},
     "parallel/host_sync.py": {"host-sync-in-sharded-step": 3},
 }
 

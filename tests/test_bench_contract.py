@@ -131,6 +131,17 @@ def test_one_json_line_with_required_keys():
         cut["sizes"][-1] >= 10 * cut["sizes"][0], cut
     assert all(us > 0 for us in cut["cut_us"]), cut
     assert cut["ratio"] is not None, cut
+    # blackbox provenance (ISSUE 20): every recorded run must carry the
+    # recorder overhead A/B (the same best shape with the flight-data
+    # recorder live) plus evidence the ring actually recorded (seals
+    # and bytes > 0) — or "the blackbox is free on the hot path" has no
+    # artifact trail and benchdiff cannot gate the on-arm entry.
+    bb = few["blackbox"]
+    assert bb is not None, "blackbox A/B missing from recorded artifact"
+    bab = bb["overhead_ab"]
+    assert bab["on_ops_s"] > 0 and bab["off_ops_s"] > 0, bab
+    assert bab["overhead_frac"] is not None, bab
+    assert bb["ring"]["seals"] > 0 and bb["ring"]["bytes_written"] > 0, bb
     # Overload provenance (ISSUE 12, netfault): every recorded run must
     # carry the overload leg — measured capacity, the 1×/2×/4× offered-
     # load table (goodput, explicit-shed fraction, p99), and the leg's

@@ -1,0 +1,454 @@
+"""blackbox (ISSUE 20) — the crash-surviving flight-data recorder.
+
+Covers the acceptance surface short of the subprocess smoke (which
+lives in tests/test_fleetfe.py, where the SIGKILL already happens):
+  - ring roundtrip: header anchors, liveness counters, single-slot and
+    slot-spanning (chunked) records, lock-free seq reservation;
+  - torn-tail tolerance — THE crash property: a ring truncated at
+    EVERY byte boundary of its final record still loads, keeps every
+    earlier record, and never raises; a CRC-torn mid-ring slot is
+    skipped and counted, not fatal;
+  - the stamp() hot-path primitive: heartbeat records persist the
+    stamp table; the cadence daemon seals on its interval;
+  - producers: pulse global observer -> pulse+opscope records per
+    sampling tick, crashsink flush hook -> crash records (fatal ones
+    force a sync), watchdog _fire -> ring record BEFORE the bundle;
+  - the anchor-pair join: two rings with skewed monotonic clocks merge
+    onto one causal wall timeline in injection order;
+  - fleet plumbing: the Collector's blackbox surface answers the PR 9
+    mixed-fleet rule (pre-blackbox member -> stable disabled shell);
+  - postmortem: reconstruct() derives the victim's final window (last
+    decided seq, in-flight ops, last pulse gauges), joins the nemesis
+    FaultSchedule (observed vs not-observed), and the `--json` doc is
+    pinned byte-for-byte by a committed golden fixture.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from tpu6824.obs import blackbox, postmortem
+from tpu6824.obs import watchdog as obs_watchdog
+from tpu6824.obs.collector import Collector
+from tpu6824.obs.pulse import Pulse
+from tpu6824.utils import crashsink
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+GOLDEN = os.path.join(DATA, "blackbox", "postmortem_golden.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_blackbox():
+    blackbox.disable()
+    yield
+    blackbox.disable()
+
+
+def _rec(ring, kind, payload, t_mono_ns):
+    """Append one JSON record exactly the way Recorder.record does —
+    fixture rings bypass the Recorder so anchors stay deterministic."""
+    blob = json.dumps(payload, separators=(",", ":"),
+                      default=repr).encode()
+    return ring.append(blackbox.KINDS[kind], blob, t_mono_ns=t_mono_ns)
+
+
+# ------------------------------------------------------- ring roundtrip
+
+
+def test_ring_roundtrip_and_header(tmp_path):
+    path = str(tmp_path / "a.bbx")
+    ring = blackbox.Ring(path, "procA", slot_size=128, nslots=16,
+                         anchor_wall_ns=10**15, anchor_mono_ns=10**6)
+    r1 = _rec(ring, "heartbeat", {"stamps": {"k": 1}}, 2 * 10**6)
+    r2 = _rec(ring, "event", {"x": "y"}, 3 * 10**6)
+    assert r2 > r1 > 0
+    ring.sync()
+    ring.close()
+    out = blackbox.load_ring(path)
+    assert out["valid"] and out["error"] is None
+    assert out["name"] == "procA" and out["pid"] == os.getpid() & 0xFFFFFFFF
+    assert out["slot_size"] == 128 and out["nslots"] == 16
+    assert out["anchor_wall_ns"] == 10**15
+    assert out["anchor_mono_ns"] == 10**6
+    assert out["last_seq"] == 2 and out["seals"] >= 1
+    assert out["torn_slots"] == 0 and out["torn_records"] == 0
+    kinds = [r["kind"] for r in out["records"]]
+    assert kinds == ["heartbeat", "event"]
+    assert out["records"][0]["data"] == {"stamps": {"k": 1}}
+    # The anchor join: wall = anchor_wall + (t_mono - anchor_mono).
+    assert out["records"][0]["t_wall_ns"] == 10**15 + 10**6
+    assert blackbox.wall_of(out, 2 * 10**6) == 10**15 + 10**6
+
+
+def test_ring_chunked_record_spans_slots(tmp_path):
+    path = str(tmp_path / "c.bbx")
+    ring = blackbox.Ring(path, "chunky", slot_size=64, nslots=32)
+    big = {"blob": "z" * 200}  # >> payload_max of 28
+    _rec(ring, "event", big, 10**6)
+    _rec(ring, "event", {"small": 1}, 2 * 10**6)
+    ring.close()
+    out = blackbox.load_ring(path)
+    assert out["torn_records"] == 0
+    assert [r["data"] for r in out["records"]] == [big, {"small": 1}]
+    # The big record really did span slots (seq advanced past 2 slots).
+    assert out["records"][1]["seq"] > out["records"][0]["seq"] + 1
+
+
+def test_ring_rejects_degenerate_slot_size(tmp_path):
+    with pytest.raises(ValueError, match="slot_size"):
+        blackbox.Ring(str(tmp_path / "x.bbx"), "x", slot_size=16)
+
+
+def test_ring_wrap_overwrites_oldest(tmp_path):
+    path = str(tmp_path / "w.bbx")
+    ring = blackbox.Ring(path, "wrap", slot_size=64, nslots=4)
+    for i in range(10):
+        _rec(ring, "event", {"i": i}, (i + 1) * 10**6)
+    ring.close()
+    out = blackbox.load_ring(path)
+    kept = [r["data"]["i"] for r in out["records"]]
+    # Only the newest window of the 10 survives a 4-slot ring; whatever
+    # survives is whole and ordered.
+    assert kept == sorted(kept) and kept[-1] == 9
+    assert 0 < len(kept) <= 4
+
+
+# -------------------------------------------------- torn-tail tolerance
+
+
+def test_torn_tail_every_byte_boundary(tmp_path):
+    """ACCEPTANCE: a SIGKILL can stop the final record's mmap store at
+    any byte.  Truncate the ring at EVERY byte boundary from the final
+    record's slot start through end-of-file: every prefix loads without
+    raising, keeps both earlier records intact, and accounts the final
+    record as present XOR torn — never garbage."""
+    path = str(tmp_path / "t.bbx")
+    ring = blackbox.Ring(path, "torn", slot_size=64, nslots=8)
+    _rec(ring, "event", {"i": 0}, 10**6)
+    _rec(ring, "event", {"i": 1}, 2 * 10**6)
+    _rec(ring, "event", {"i": 2}, 3 * 10**6)  # seq 3 -> slot 3
+    ring.close()
+    with open(path, "rb") as f:
+        buf = f.read()
+    final_off = blackbox.HEADER_SIZE + 3 * 64
+    torn = str(tmp_path / "torn.bbx")
+    for cut in range(final_off, len(buf) + 1):
+        with open(torn, "wb") as f:
+            f.write(buf[:cut])
+        out = blackbox.load_ring(torn)
+        assert out["valid"], (cut, out["error"])
+        ids = [r["data"]["i"] for r in out["records"]]
+        assert ids[:2] == [0, 1], (cut, ids)
+        if len(ids) == 3:
+            assert ids[2] == 2 and out["torn_slots"] == 0, cut
+        else:
+            # The cut landed inside the final slot: counted, not kept.
+            assert cut < final_off + 64, cut
+
+
+def test_torn_midring_slot_is_skipped_not_fatal(tmp_path):
+    path = str(tmp_path / "m.bbx")
+    ring = blackbox.Ring(path, "mid", slot_size=64, nslots=8)
+    for i in range(3):
+        _rec(ring, "event", {"i": i}, (i + 1) * 10**6)
+    ring.close()
+    # Flip one payload byte of the MIDDLE record (slot seq 2): its CRC
+    # fails, the neighbours still load.
+    off = blackbox.HEADER_SIZE + 2 * 64 + 40
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+    out = blackbox.load_ring(path)
+    assert out["torn_slots"] == 1
+    assert [r["data"]["i"] for r in out["records"]] == [0, 2]
+
+
+def test_load_ring_tolerates_junk_and_absent_files(tmp_path):
+    junk = str(tmp_path / "junk.bbx")
+    with open(junk, "wb") as f:
+        f.write(b"not a ring at all")
+    assert blackbox.load_ring(junk)["error"] == "truncated header"
+    bad = str(tmp_path / "bad.bbx")
+    with open(bad, "wb") as f:
+        f.write(b"\0" * 8192)
+    assert blackbox.load_ring(bad)["error"] == "bad magic"
+    gone = blackbox.load_ring(str(tmp_path / "gone.bbx"))
+    assert gone["valid"] is False and gone["error"]
+    assert blackbox.load_dir(str(tmp_path / "nodir")) == []
+
+
+# ------------------------------------------- recorder + module surface
+
+
+def test_stamp_heartbeat_and_status(tmp_path):
+    bb = blackbox.enable(str(tmp_path), name="hb",
+                         sync_interval=30.0)  # manual syncs only
+    assert blackbox.enabled()
+    assert blackbox.enable(str(tmp_path), name="other") is bb  # idempotent
+    blackbox.stamp("kvpaxos.applied.g0.s0", 41)
+    blackbox.stamp("frontend.inflight.fe0", 3)
+    blackbox.sync()
+    blackbox.stamp("kvpaxos.applied.g0.s0", 45)
+    blackbox.sync()
+    st = blackbox.status()
+    assert st["enabled"] and st["name"] == "hb" and st["seals"] >= 2
+    blackbox.disable()
+    out = blackbox.load_ring(os.path.join(str(tmp_path), "hb.bbx"))
+    hbs = [r["data"]["stamps"] for r in out["records"]
+           if r["kind"] == "heartbeat"]
+    assert hbs[0]["kvpaxos.applied.g0.s0"] == 41
+    assert hbs[-1]["kvpaxos.applied.g0.s0"] == 45
+    assert hbs[-1]["frontend.inflight.fe0"] == 3
+    # Disabled module surface: stable shell + silent no-op producers.
+    assert blackbox.status()["enabled"] is False
+    blackbox.stamp("k", 1)
+    blackbox.record("event", {"x": 1})
+    blackbox.sync()
+
+
+def test_status_shell_matches_status_keys(tmp_path):
+    bb = blackbox.enable(str(tmp_path), name="keys", sync_interval=30.0)
+    live, shell = bb.status(), blackbox.status_shell(reason="no such rpc")
+    assert set(shell) - {"unavailable"} == set(live)
+    assert shell["enabled"] is False and "unavailable" in shell
+    assert "unavailable" not in blackbox.status_shell()
+
+
+def test_sync_daemon_seals_on_cadence(tmp_path):
+    blackbox.enable(str(tmp_path), name="cad", sync_interval=0.02)
+    blackbox.stamp("k", 7)
+    deadline = time.monotonic() + 5.0
+    while blackbox.status()["seals"] < 3:
+        assert time.monotonic() < deadline, blackbox.status()
+        time.sleep(0.01)
+    blackbox.disable()
+    out = blackbox.load_ring(os.path.join(str(tmp_path), "cad.bbx"))
+    assert sum(1 for r in out["records"] if r["kind"] == "heartbeat") >= 3
+
+
+def test_enable_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU6824_BLACKBOX_DIR", raising=False)
+    assert blackbox.enable_from_env() is None
+    monkeypatch.setenv("TPU6824_BLACKBOX_DIR", str(tmp_path))
+    monkeypatch.setenv("TPU6824_BLACKBOX_NAME", "envproc")
+    bb = blackbox.enable_from_env()
+    assert bb is not None and bb.name == "envproc"
+    assert os.path.exists(os.path.join(str(tmp_path), "envproc.bbx"))
+
+
+# ------------------------------------------------------------ producers
+
+
+def test_pulse_tick_lands_pulse_and_opscope_records(tmp_path):
+    from tpu6824.obs import metrics as obs_metrics
+
+    g = obs_metrics.gauge("test.bb.gauge")
+    blackbox.enable(str(tmp_path), name="tick", sync_interval=30.0)
+    p = Pulse(interval=0.05)
+    p.add_sampler(lambda: g.set(17.0))
+    p.sample_once()  # baseline tick: sets the delta window
+    p.sample_once()
+    blackbox.disable()
+    out = blackbox.load_ring(os.path.join(str(tmp_path), "tick.bbx"))
+    pulses = [r["data"] for r in out["records"] if r["kind"] == "pulse"]
+    assert len(pulses) == 2
+    assert pulses[-1]["latest"]["test.bb.gauge"] == 17.0
+    # opscope is always-on, so its waterfall rides every tick too.
+    assert any(r["kind"] == "opscope" for r in out["records"])
+
+
+def test_crashsink_hook_records_crash_and_fatal_syncs(tmp_path):
+    blackbox.enable(str(tmp_path), name="boom", sync_interval=30.0)
+    seals0 = blackbox.status()["seals"]
+    crashsink.record("bg-thread", RuntimeError("soft"), fatal=False)
+    assert blackbox.status()["seals"] == seals0  # non-fatal: no sync
+    crashsink.record("engine-loop", RuntimeError("hard"), fatal=True)
+    assert blackbox.status()["seals"] == seals0 + 1  # fatal: durable NOW
+    blackbox.disable()
+    out = blackbox.load_ring(os.path.join(str(tmp_path), "boom.bbx"))
+    crashes = [r["data"] for r in out["records"] if r["kind"] == "crash"]
+    assert [c["thread"] for c in crashes] == ["bg-thread", "engine-loop"]
+    assert crashes[1]["fatal"] is True
+
+
+def test_watchdog_fire_lands_in_ring_before_bundle(tmp_path):
+    class _Tripped(obs_watchdog.Rule):
+        name = "golden-trip"
+
+    blackbox.enable(str(tmp_path), name="wd", sync_interval=30.0)
+    p = Pulse(interval=0.05)
+    wd = obs_watchdog.Watchdog(p, outdir=str(tmp_path), rules=[])
+    wd.start()
+    try:
+        rule = _Tripped()
+        rule.evidence = {"culprit": "apply"}
+        wd._fire(rule, "stage p99 blew the budget", time.monotonic())
+    finally:
+        wd.stop()
+    assert blackbox.status()["seals"] >= 1  # fired evidence is durable
+    blackbox.disable()
+    out = blackbox.load_ring(os.path.join(str(tmp_path), "wd.bbx"))
+    fires = [r["data"] for r in out["records"] if r["kind"] == "watchdog"]
+    assert len(fires) == 1 and fires[0]["rule"] == "golden-trip"
+    assert fires[0]["evidence"] == {"culprit": "apply"}
+    # The bundle landed beside the ring, so reconstruct() joins both.
+    doc = postmortem.reconstruct(str(tmp_path))
+    assert doc["processes"]["wd"]["watchdog"][0]["rule"] == "golden-trip"
+    assert [b["rule"] for b in doc["watchdog_bundles"]] == ["golden-trip"]
+
+
+# -------------------------------------------------------- fleet plumbing
+
+
+class _PreBlackboxMember:
+    """A healthy pre-blackbox fleet member: every surface but blackbox."""
+
+    def stats(self):
+        return {"decided_cells": 1}
+
+    def blackbox(self):
+        from tpu6824.utils.errors import RPCError
+
+        raise RPCError("no such rpc: blackbox")
+
+
+def test_collector_blackbox_mixed_fleet_disabled_shell(tmp_path):
+    blackbox.enable(str(tmp_path), name="member", sync_interval=30.0)
+    col = Collector().add("old", _PreBlackboxMember()).add_local("new")
+    snap = col.snapshot()
+    assert not [k for k in snap["errors"] if k.startswith("old.")], \
+        snap["errors"]
+    shell = snap["processes"]["old"]["blackbox"]
+    assert shell["enabled"] is False and "unavailable" in shell
+    assert snap["processes"]["new"]["blackbox"]["enabled"] is True
+    assert snap["processes"]["new"]["blackbox"]["name"] == "member"
+
+
+# ----------------------------------------------- postmortem + the join
+
+
+def _fixture_rings(dirpath):
+    """Two deterministic rings — a frontend killed mid-storm and a
+    surviving replica — with skewed monotonic clocks whose anchor pairs
+    join onto one wall timeline.  Every stamp is pinned so the derived
+    `--json` doc is byte-stable (the committed golden)."""
+    W = 1_700_000_000_000_000_000  # anchor wall, ns
+    fe = blackbox.Ring(os.path.join(dirpath, "smoke-fe1.bbx"), "smoke-fe1",
+                       slot_size=512, nslots=64,
+                       anchor_wall_ns=W, anchor_mono_ns=5_000_000)
+    kv = blackbox.Ring(os.path.join(dirpath, "kv-0.bbx"), "kv-0",
+                       slot_size=512, nslots=64,
+                       anchor_wall_ns=W + 250_000_000,
+                       anchor_mono_ns=9_000_000_000)
+    ms = 1_000_000
+    # t=0ms on the shared wall timeline == fe mono 5ms == kv mono 8750ms.
+    _rec(fe, "pulse", {"samples": 4, "interval": 0.05,
+                       "latest": {"fe.inflight": 2.0, "proc.rss": 1024.0}},
+         5 * ms + 100 * ms)
+    _rec(kv, "nemesis", {"t": 0.15, "action": "fe_kill",
+                         "args": {"name": "'smoke-fe1'"}},
+         8750 * ms + 150 * ms)
+    _rec(fe, "heartbeat",
+         {"stamps": {"kvpaxos.applied.g0.s1": 41,
+                     "frontend.inflight.smoke-fe1": 3}},
+         5 * ms + 200 * ms)
+    _rec(fe, "crash", {"thread": "fe-engine", "error": "SIGKILL(sim)",
+                       "fatal": True}, 5 * ms + 210 * ms)
+    fe.sync()
+    _rec(kv, "heartbeat", {"stamps": {"kvpaxos.applied.g0.s0": 44}},
+         8750 * ms + 400 * ms)
+    kv.sync()
+    fe.close()
+    kv.close()
+
+
+def test_anchor_pair_merge_ordering(tmp_path):
+    _fixture_rings(str(tmp_path))
+    doc = postmortem.reconstruct(str(tmp_path))
+    # Despite wildly skewed monotonic clocks, the joined timeline is
+    # causal: fe pulse -> kv-observed kill -> fe final heartbeat ->
+    # fe crash -> kv survivor heartbeat.
+    seq = [(e["proc"], e["kind"]) for e in doc["timeline"]]
+    assert seq == [("smoke-fe1", "pulse"), ("kv-0", "nemesis"),
+                   ("smoke-fe1", "heartbeat"), ("smoke-fe1", "crash"),
+                   ("kv-0", "heartbeat")]
+    walls = [e["t_wall_ns"] for e in doc["timeline"]]
+    assert walls == sorted(walls)
+
+
+def test_postmortem_final_window_and_schedule_join(tmp_path):
+    from tpu6824.harness.nemesis import FaultSchedule
+
+    _fixture_rings(str(tmp_path))
+    sched = FaultSchedule.from_dict({
+        "schema": FaultSchedule.SCHEMA, "seed": 1, "duration": 2.0,
+        "events": [
+            {"t": 0.15, "action": "fe_kill", "args": {"name": "smoke-fe1"}},
+            {"t": 1.75, "action": "fe_revive",
+             "args": {"name": "smoke-fe1"}}]})
+    doc = postmortem.reconstruct(str(tmp_path), schedule=sched)
+    victim = doc["processes"]["smoke-fe1"]
+    assert victim["last_decided_seq"] == 41
+    assert victim["inflight_ops"] == 3
+    assert victim["crashes"][0]["error"] == "SIGKILL(sim)"
+    assert victim["last_pulse"]["latest"]["fe.inflight"] == 2.0
+    assert doc["processes"]["kv-0"]["last_decided_seq"] == 44
+    # The join: the kill was observed in a ring; the revive (after the
+    # victim died and the run was cut) was not.
+    assert doc["nemesis"]["scheduled"] == 2
+    assert [e["action"] for e in doc["nemesis"]["observed"]] == ["fe_kill"]
+    assert [e["action"] for e in doc["nemesis"]["not_observed"]] == \
+        ["fe_revive"]
+
+
+def _normalized_doc(dirpath):
+    """The golden-comparable doc: host-varying fields (tmp dir, pid,
+    absolute ring paths) pinned to placeholders."""
+    doc = postmortem.reconstruct(dirpath)
+    doc["dir"] = "<DIR>"
+    for w in doc["processes"].values():
+        w["pid"] = 0
+        w["path"] = "<DIR>/" + os.path.basename(w["path"])
+    return json.loads(json.dumps(doc, sort_keys=True, default=repr))
+
+
+def test_postmortem_json_golden(tmp_path):
+    """The committed fixture pins the whole `--json` document shape:
+    regenerate with
+    `python -m pytest tests/test_blackbox.py -q --force-regen-blackbox`
+    (env TPU6824_REGEN_BLACKBOX_GOLDEN=1) after a DELIBERATE schema
+    bump, never to paper over drift."""
+    _fixture_rings(str(tmp_path))
+    doc = _normalized_doc(str(tmp_path))
+    if os.environ.get("TPU6824_REGEN_BLACKBOX_GOLDEN"):
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert doc == golden, "postmortem --json drifted from the golden"
+
+
+def test_postmortem_cli(tmp_path, capsys):
+    _fixture_rings(str(tmp_path))
+    assert postmortem.main([str(tmp_path)]) == 0
+    rep = capsys.readouterr().out
+    assert "smoke-fe1" in rep and "last decided seq: 41" in rep
+    assert "in-flight ops at death: 3" in rep
+    assert postmortem.main([str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == postmortem.SCHEMA_VERSION
+    trace = str(tmp_path / "trace.json")
+    assert postmortem.main([str(tmp_path), "--perfetto", trace]) == 0
+    capsys.readouterr()
+    with open(trace) as f:
+        events = json.load(f)["traceEvents"]
+    assert any(e.get("name") == "bb.crash" for e in events)
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert postmortem.main([empty]) == 2

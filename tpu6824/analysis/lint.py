@@ -169,6 +169,18 @@ RULES: dict[str, str] = {
         "of the fleet cannot see (stale dup hit, or a double-apply the "
         "local table never heard about); dedupe through the replicated "
         "dup table the RSM applies, and keep frontends stateless",
+    "blocking-io-in-telemetry-path":
+        "blocking filesystem IO (open/os.write/fsync/msync/flush) "
+        "reachable from a telemetry clock body in tpu6824/obs/ — a "
+        "pulse observer/sampler tick, an opscope fold, or a drain pass "
+        "— outside the sanctioned blackbox cadence seam "
+        "(Recorder.sync/_sync_loop).  Telemetry paths run on sampling "
+        "and drain clocks shared with the serving path; one slow disk "
+        "turns the observability plane into the outage (ISSUE 20's "
+        "whole design: producers do GIL-atomic memory stores, the ONE "
+        "sync seam does the msync on its own cadence).  Move the IO "
+        "into the blackbox seam, or suppress with the measured cost "
+        "and why the clock tolerates it",
     "bad-suppression":
         "malformed tpusan suppression: needs ok(<known-rule>) and a "
         "non-empty justification after a dash",
@@ -294,6 +306,19 @@ _RETRY_PACE_TAILS = {"sleep", "wait"}
 # already have the stricter nondet-clock rule.
 _WALLDUR_SCOPE = ("rpc/", "services/", "core/")
 _WALL_CALLS = ("time.time", "time.time_ns")
+# Telemetry-IO scope (blocking-io-in-telemetry-path): every obs/ module.
+# ENTRY functions — the bodies that run on a telemetry clock — are
+# `_on_*` callbacks plus any function whose name mentions a sampling/
+# fold/drain verb; the SEAM names are blackbox's sanctioned cadence
+# sync, excluded as entries and never traversed into.  Reachability is
+# same-file (bare-name and self-method calls), matching the other
+# per-file scans.
+_TELEM_SCOPE = ("obs/",)
+_TELEM_ENTRY_SUBSTR = ("sample", "fold", "drain", "tick", "observer")
+_TELEM_SEAM_NAMES = {"sync", "_sync_loop"}
+_TELEM_IO_DOTTED = {"open", "io.open", "os.open", "os.write", "os.fsync",
+                    "os.fdatasync", "os.sync"}
+_TELEM_IO_TAILS = {"flush", "fsync", "msync", "fdatasync"}
 
 # Receivers that denote the tpuscope metrics registry, and the
 # get-or-create constructors the metric-unregistered rule polices.
@@ -448,6 +473,7 @@ class _FileLint(ast.NodeVisitor):
         self.walldur_scope = _in_scope(relpath, _WALLDUR_SCOPE)
         self.decided_scope = _in_scope(relpath, _DECIDED_SCOPE)
         self.meshstep_scope = _in_scope(relpath, _MESHSTEP_SCOPE)
+        self.telem_scope = _in_scope(relpath, _TELEM_SCOPE)
         self._lock_depth = 0       # with <lock> nesting
         self._loop_depth_in_lock = 0
         self._daemon_targets = self._resolve_daemon_targets()
@@ -462,6 +488,7 @@ class _FileLint(ast.NodeVisitor):
         self._scan_obs_buffers()
         self._scan_retry_loops()
         self._scan_wallclock_durations()
+        self._scan_telemetry_io()
         self._fn_stack: list[ast.AST] = []
         self._calls_subscribe = False
         self._refs_columnar_consumer = False
@@ -1085,6 +1112,77 @@ class _FileLint(ast.NodeVisitor):
                                    "— wall clock jumps corrupt it; use "
                                    "time.monotonic()/monotonic_ns()")
                         break
+
+    def _scan_telemetry_io(self) -> None:
+        """blocking-io-in-telemetry-path: in obs/ scope, walk the
+        same-file call graph from every telemetry-clock entry (`_on_*`,
+        or a name mentioning sample/fold/drain/tick/observer) and flag
+        each blocking-IO call site reached — never traversing INTO the
+        sanctioned blackbox seam (`sync`/`_sync_loop`), which is the one
+        place telemetry may touch the filesystem.  The finding lands on
+        the IO site (where the fix goes) and names the entry + call
+        chain that reaches it."""
+        if not self.telem_scope:
+            return
+        defs = self._all_defs()
+
+        def io_desc(n: ast.AST) -> str | None:
+            if not isinstance(n, ast.Call):
+                return None
+            d = _dotted(n.func)
+            if d in _TELEM_IO_DOTTED:
+                return f"{d}()"
+            if isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in _TELEM_IO_TAILS:
+                return f".{n.func.attr}()"
+            return None
+
+        io_sites: dict[str, list] = {}
+        callees: dict[str, set[str]] = {}
+        for name, fns in defs.items():
+            for fn in fns:
+                for sub in ast.walk(fn):
+                    d = io_desc(sub)
+                    if d is not None:
+                        io_sites.setdefault(name, []).append((sub, d))
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    f = sub.func
+                    cal = None
+                    if isinstance(f, ast.Name) and f.id in defs:
+                        cal = f.id
+                    elif isinstance(f, ast.Attribute) and \
+                            isinstance(f.value, ast.Name) and \
+                            f.value.id == "self" and f.attr in defs:
+                        cal = f.attr
+                    if cal is not None:
+                        callees.setdefault(name, set()).add(cal)
+        flagged: set[int] = set()
+        for entry in sorted(defs):
+            if entry in _TELEM_SEAM_NAMES:
+                continue
+            if not (entry.startswith("_on_") or
+                    any(s in entry for s in _TELEM_ENTRY_SUBSTR)):
+                continue
+            seen = {entry}
+            queue = [(entry, (entry,))]
+            while queue:
+                name, chain = queue.pop(0)
+                for node, desc in io_sites.get(name, ()):
+                    if id(node) in flagged:
+                        continue
+                    flagged.add(id(node))
+                    via = "" if len(chain) == 1 else \
+                        " via " + "->".join(chain[1:])
+                    self._flag(node, "blocking-io-in-telemetry-path",
+                               f"{desc} reachable from telemetry entry "
+                               f"{entry}(){via} — blocking IO on a "
+                               "sampling/drain clock; only the blackbox "
+                               "sync seam may touch the filesystem")
+                for cal in sorted(callees.get(name, ())):
+                    if cal not in seen and cal not in _TELEM_SEAM_NAMES:
+                        seen.add(cal)
+                        queue.append((cal, chain + (cal,)))
 
     def _resolve_jit_defs(self) -> set[int]:
         """FunctionDefs that are jit-compiled: decorated with jax.jit /

@@ -2221,6 +2221,16 @@ class PaxosFabric:
 
         return obs_opscope.snapshot()
 
+    def blackbox(self) -> dict:
+        """The process-global blackbox recorder status (obs/blackbox.py,
+        ISSUE 20) — ring path, seal count, bytes written — served over
+        the fabric_service wire so the fleet collector can report which
+        members are flight-recording and where their rings live.  A
+        stable `enabled: False` shell when no recorder runs here."""
+        from tpu6824.obs import blackbox as obs_blackbox
+
+        return obs_blackbox.status()
+
     def start_pulse(self, interval: float | None = None,
                     cap: int | None = None,
                     stall_after: float | None = None):

@@ -59,8 +59,12 @@ FABRIC_RPCS = [
     # opscope is the per-stage request-path latency waterfall
     # (obs/opscope.py, ISSUE 15) — always-on stage histograms + tail
     # exemplars, merged fleet-wide by the Collector, with the same
-    # mixed-fleet rule: a pre-opscope member yields the disabled shell)
-    "dims", "stats", "metrics", "flight", "pulse", "opscope",
+    # mixed-fleet rule: a pre-opscope member yields the disabled shell;
+    # blackbox is the crash-surviving flight-data recorder's status
+    # (obs/blackbox.py, ISSUE 20) — ring path / seal count / bytes
+    # written, same mixed-fleet rule: a pre-blackbox member answering
+    # "no such rpc" yields the stable disabled shell)
+    "dims", "stats", "metrics", "flight", "pulse", "opscope", "blackbox",
 ]
 
 

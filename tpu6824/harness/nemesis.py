@@ -43,6 +43,7 @@ import re
 import threading
 import time
 
+from tpu6824.obs import blackbox as _blackbox
 from tpu6824.obs import tracing as _tracing
 from tpu6824.utils import crashsink
 from tpu6824.utils.trace import dprintf
@@ -970,6 +971,14 @@ class Nemesis:
                                args={"t": ev.t,
                                      **{k: repr(v)
                                         for k, v in ev.args.items()}})
+                # blackbox (ISSUE 20): the injection also lands in the
+                # crash-surviving ring, so a postmortem joins the
+                # VICTIM's final window to the fault that killed it even
+                # when the harness process itself died before writing
+                # its artifact.
+                _blackbox.record("nemesis", {
+                    "t": ev.t, "action": ev.action,
+                    "args": {k: repr(v) for k, v in ev.args.items()}})
                 try:
                     self.target.apply(ev.action, ev.args)
                 except Exception as e:  # noqa: BLE001 — recorded, not fatal

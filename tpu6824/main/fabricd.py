@@ -52,6 +52,11 @@ def main(argv=None):
                          "series (requires --pulse); evidence bundles "
                          "for stalls/collapses/spikes land in DIR in "
                          "the nemesis-artifact format")
+    ap.add_argument("--blackbox-dir", default=None, metavar="DIR",
+                    help="flight-data recorder (obs/blackbox.py): "
+                         "append crash-surviving telemetry to a ring "
+                         "file DIR/fabricd-<pid>.bbx; reconstruct with "
+                         "python -m tpu6824.obs.postmortem DIR")
     args = ap.parse_args(argv)
     if args.watchdog_dir and not args.pulse:
         ap.error("--watchdog-dir requires --pulse")
@@ -86,6 +91,10 @@ def main(argv=None):
             ninstances=args.instances, seed=args.seed, auto_step=True,
         )
     srv = serve_fabric(fabric, args.addr)
+    if args.blackbox_dir:
+        from tpu6824.obs import blackbox as _blackbox
+
+        _blackbox.enable(args.blackbox_dir, name=f"fabricd-{os.getpid()}")
     if args.pulse:
         pulse = fabric.start_pulse(interval=args.pulse)
         if args.watchdog_dir:

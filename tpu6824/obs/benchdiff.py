@@ -184,6 +184,19 @@ METRICS = [
            leg_shape=[("service", "clerk_frontend", "groups"),
                       ("service", "clerk_frontend", "conns"),
                       ("service", "clerk_frontend", "batch_width")]),
+    # blackbox recorder A/B (ISSUE 20): throughput at the best shape
+    # WITH the flight-data recorder live — the arm whose collapse would
+    # mean the recorder leaked blocking work onto the request path.
+    # Host-edge noisy like every clerk-path number (0.65).  The
+    # overhead_frac itself is NOT gated: it hovers at ~0 by design, and
+    # a relative tolerance on a near-zero difference of two noisy
+    # numbers is pure alarm — the on-arm absolute throughput is the
+    # meaningful gate.  First recorded artifact (r12) baselines it.
+    Metric(("service", "clerk_frontend", "blackbox", "overhead_ab",
+            "on_ops_s"), 0.65, host_bound=True,
+           leg_shape=[("service", "clerk_frontend", "groups"),
+                      ("service", "clerk_frontend", "conns"),
+                      ("service", "clerk_frontend", "batch_width")]),
     # Overload leg (ISSUE 12, netfault): goodput under 4× offered load
     # and the measured closed-loop capacity it is relative to.  Both
     # host-edge noisy like every clerk-path leg; gated on the leg's OWN

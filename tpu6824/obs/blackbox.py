@@ -1,0 +1,511 @@
+"""blackbox — a crash-surviving flight-data recorder (ISSUE 20).
+
+Every telemetry surface built so far — pulse series, opscope waterfalls,
+flight-recorder spans, watchdog evidence — lives in the process heap and
+dies with it.  The harness kills processes as a matter of course
+(fleetfe's kill storm SIGKILLs live frontends; nemesis crashes replicas
+mid-commit), so the ops we most need to explain are exactly the ones we
+cannot.  blackbox closes that gap: a per-process, always-on, mmap-backed
+ring file into which telemetry producers append fixed-size checksummed
+records, so a postmortem (`python -m tpu6824.obs.postmortem <dir>`)
+reconstructs the victim's final window from disk alone.
+
+Crash model, in order of strength:
+
+  - **SIGKILL / crash** (the common harness case): every mmap store
+    already lives in the page cache — the kernel keeps the pages when
+    the process dies, so the ring holds everything written up to the
+    killing instruction, msync'd or not.
+  - **Machine/power loss**: only data through the last `sync()` (one
+    msync per cadence, `TPU6824_BLACKBOX_SYNC`) is guaranteed.
+
+Hot-path contract (the jitguard/bench invariant): nothing here runs
+per-op.  Producers on request paths call `stamp(key, value)` — a single
+GIL-atomic dict store, one per drain/engine pass with a precomputed key
+— and the cadence `sync()` persists the stamp table as one heartbeat
+record.  Ring appends happen only at telemetry cadence (pulse ticks,
+watchdog firings, nemesis injections, crash records, the sync seam's
+flight-ring delta); slot reservation is `itertools.count().__next__`
+(GIL-atomic, the tracing-id idiom) so the writer takes ZERO locks, and
+`sync()` is THE sanctioned blocking-IO seam — the
+`blocking-io-in-telemetry-path` tpusan rule holds every other telemetry
+path to memory stores only.
+
+Ring format (`<name>.bbx`): one 4096-byte header page — magic, version,
+slot geometry, a (wall-ns, monotonic-ns) anchor pair stamped at create
+time (the cross-process join key: rings from different processes map
+their monotonic records onto one causal wall timeline via
+`wall = anchor_wall + (t_mono - anchor_mono)`), pid, process name, plus
+sync-stamped liveness counters — followed by `nslots` fixed-size slots.
+Each record chunk carries a CRC32 over its used bytes: a slot torn by
+SIGKILL mid-store fails the checksum and the loader skips it, exactly
+the PR 7 `frame_checkpoint` torn-frame discipline applied per slot.
+Oversize payloads span slots as (rec, part, nparts) continuation chunks;
+the loader reassembles whole records and counts partial ones as torn.
+
+Stdlib-only like the rest of obs/.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import mmap
+import os
+import struct
+import threading
+import time
+import zlib
+
+from tpu6824.obs import pulse as _pulse
+from tpu6824.obs import tracing as _tracing
+from tpu6824.utils import crashsink
+
+__all__ = ["Ring", "Recorder", "enable", "enable_from_env", "disable",
+           "enabled", "record", "stamp", "sync", "status", "status_shell",
+           "load_ring", "load_dir", "wall_of", "SCHEMA_VERSION", "MAGIC",
+           "KINDS", "KIND_NAMES"]
+
+SCHEMA_VERSION = "blackbox-1.0.0"
+
+MAGIC = b"TPU6824BBX1"
+HEADER_SIZE = 4096
+RING_SUFFIX = ".bbx"
+
+# Fixed header at offset 0: magic, version, slot_size, nslots,
+# anchor_wall_ns, anchor_mono_ns, pid, process name (NUL-padded).
+_HDR = struct.Struct("!12sIIQQQI64s")
+# Sync-stamped liveness counters at a fixed offset past the static
+# header: last reserved seq, seal (sync) count, payload bytes written.
+# Best-effort for the loader (a SIGKILL between stamps just means the
+# counters lag the slots — the loader scans slots regardless).
+_HDR_LIVE = struct.Struct("!QQQ")
+_HDR_LIVE_OFF = 256
+
+# Per-slot header: crc32 (over the remaining used bytes), used payload
+# length, slot seq, record id (= first chunk's seq), monotonic ns,
+# kind code, chunk index, chunk count, pad.
+_SLOT = struct.Struct("!IIQQQBBBx")
+
+KINDS = {"heartbeat": 1, "pulse": 2, "opscope": 3, "flight": 4,
+         "watchdog": 5, "nemesis": 6, "crash": 7, "event": 8}
+KIND_NAMES = {v: k for k, v in KINDS.items()}
+
+_DEF_SLOT_SIZE = int(os.environ.get("TPU6824_BLACKBOX_SLOT", "1024"))
+_DEF_NSLOTS = int(os.environ.get("TPU6824_BLACKBOX_SLOTS", "4096"))
+_DEF_SYNC = float(os.environ.get("TPU6824_BLACKBOX_SYNC", "0.25"))
+# Flight-ring records drained per sync: bounds the slot share one busy
+# interval can claim; the overflow is counted in the flight record
+# itself (no silent caps).
+_FLIGHT_PER_SYNC = int(os.environ.get("TPU6824_BLACKBOX_FLIGHT", "512"))
+
+
+class Ring:
+    """One mmap-backed ring file.  Appends are lock-free: slot index is
+    a GIL-atomic counter modulo `nslots`, and each chunk is one mmap
+    slice store.  Concurrent writers can only collide on a slot after a
+    full wrap between their reservations — the same already-overwritten
+    regime the ring lives in by design, and the per-slot CRC keeps any
+    torn slot detectable."""
+
+    def __init__(self, path: str, name: str,
+                 slot_size: int | None = None, nslots: int | None = None,
+                 anchor_wall_ns: int | None = None,
+                 anchor_mono_ns: int | None = None):
+        self.path = path
+        self.name = name
+        self.slot_size = _DEF_SLOT_SIZE if slot_size is None \
+            else int(slot_size)
+        self.nslots = _DEF_NSLOTS if nslots is None else int(nslots)
+        if self.slot_size <= _SLOT.size:
+            raise ValueError(f"slot_size must exceed {_SLOT.size}")
+        self.payload_max = self.slot_size - _SLOT.size
+        # The clock-anchor pair: stamped ONCE at create time, never
+        # updated — both clocks read back-to-back so the pair's skew is
+        # bounded by one scheduling quantum (TUNING round 24).
+        # Overridable for deterministic test fixtures.
+        self.anchor_wall_ns = time.time_ns() if anchor_wall_ns is None \
+            else int(anchor_wall_ns)
+        self.anchor_mono_ns = time.monotonic_ns() if anchor_mono_ns is None \
+            else int(anchor_mono_ns)
+        size = HEADER_SIZE + self.slot_size * self.nslots
+        fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_TRUNC, 0o644)
+        try:
+            os.ftruncate(fd, size)
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._mm[0:_HDR.size] = _HDR.pack(
+            MAGIC.ljust(12, b"\0"), 1, self.slot_size, self.nslots,
+            self.anchor_wall_ns, self.anchor_mono_ns,
+            os.getpid() & 0xFFFFFFFF,
+            name.encode("utf-8", "replace")[:64].ljust(64, b"\0"))
+        # GIL-atomic slot reservation (the tracing `_ids` idiom); the
+        # shadow counters are telemetry-grade (racing += may undercount
+        # by a few — the slots themselves are the ground truth).
+        self._seq = itertools.count(1)
+        self.last_seq = 0
+        self.bytes_written = 0
+        self.seals = 0
+        self.closed = False
+
+    def append(self, kind: int, payload: bytes,
+               t_mono_ns: int | None = None) -> int:
+        """Write one record (chunking oversize payloads across slots).
+        Returns the record id.  Memory stores only — never blocks."""
+        if self.closed:
+            return 0
+        if t_mono_ns is None:
+            t_mono_ns = time.monotonic_ns()
+        pm = self.payload_max
+        nparts = max(1, -(-len(payload) // pm))
+        if nparts > 255:
+            # A >255-slot record cannot be encoded; keep the head (the
+            # loader sees a complete, smaller record — better than a
+            # permanently-partial giant).
+            nparts = 255
+            payload = payload[:255 * pm]
+        rec_id = 0
+        mm = self._mm
+        for part in range(nparts):
+            chunk = payload[part * pm:(part + 1) * pm]
+            seq = next(self._seq)
+            if part == 0:
+                rec_id = seq
+            rest = _SLOT.pack(0, len(chunk), seq, rec_id, t_mono_ns,
+                              kind, part, nparts)[4:] + chunk
+            off = HEADER_SIZE + (seq % self.nslots) * self.slot_size
+            mm[off:off + 4 + len(rest)] = \
+                struct.pack("!I", zlib.crc32(rest)) + rest
+            self.last_seq = seq
+            self.bytes_written += len(chunk)
+        return rec_id
+
+    def sync(self) -> None:
+        """Stamp the liveness counters and msync — the ONE blocking-IO
+        seam (the `blocking-io-in-telemetry-path` sanction)."""
+        if self.closed:
+            return
+        self.seals += 1
+        self._mm[_HDR_LIVE_OFF:_HDR_LIVE_OFF + _HDR_LIVE.size] = \
+            _HDR_LIVE.pack(self.last_seq, self.seals, self.bytes_written)
+        self._mm.flush()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.sync()
+        self.closed = True
+        self._mm.close()
+
+
+class Recorder:
+    """The per-process recorder: one Ring + the stamp table + the
+    cadence sync daemon + the producer registrations (pulse observer,
+    crashsink flush hook)."""
+
+    def __init__(self, dirpath: str, name: str,
+                 slot_size: int | None = None, nslots: int | None = None,
+                 sync_interval: float | None = None):
+        os.makedirs(dirpath, exist_ok=True)
+        self.name = name
+        self.dir = dirpath
+        self.ring = Ring(os.path.join(dirpath, name + RING_SUFFIX), name,
+                         slot_size=slot_size, nslots=nslots)
+        self.interval = _DEF_SYNC if sync_interval is None \
+            else float(sync_interval)
+        # Telemetry stamp table: single-key stores are GIL-atomic (the
+        # opscope stamp-dict idiom) — producers on request paths touch
+        # ONLY this dict, with keys precomputed at init.
+        self.stamps: dict = {}
+        self._flight_cursor = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "Recorder":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=crashsink.guarded(self._sync_loop, "blackbox-sync"),
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def record(self, kind: str, payload: dict,
+               t_mono_ns: int | None = None) -> int:
+        """JSON-encode one record into the ring (telemetry-cadence
+        call sites only — never per-op)."""
+        blob = json.dumps(payload, separators=(",", ":"),
+                          default=repr).encode("utf-8", "replace")
+        return self.ring.append(KINDS.get(kind, KINDS["event"]), blob,
+                                t_mono_ns=t_mono_ns)
+
+    def sync(self) -> None:
+        """THE cadence seam: persist the stamp table as one heartbeat
+        record, drain the flight ring's delta, stamp the header, msync
+        once.  Every blocking syscall blackbox ever issues happens
+        here."""
+        self.record("heartbeat", {"stamps": dict(self.stamps)})
+        recs, self._flight_cursor, missed = \
+            _tracing.FLIGHT.snapshot_delta(self._flight_cursor)
+        if len(recs) > _FLIGHT_PER_SYNC:
+            missed += len(recs) - _FLIGHT_PER_SYNC
+            recs = recs[-_FLIGHT_PER_SYNC:]
+        if recs or missed:
+            self.record("flight", {"records": recs, "missed": missed})
+        self.ring.sync()
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sync()
+            except Exception as e:  # noqa: BLE001 — a full/vanished disk
+                # must not kill the recorder; recorded (which also lands
+                # the failure in the ring via the flush hook) and the
+                # loop keeps driving for when the disk returns.
+                crashsink.record("blackbox-sync", e, fatal=False)
+        self.sync()
+
+    def status(self) -> dict:
+        r = self.ring
+        return {"schema": SCHEMA_VERSION, "enabled": True,
+                "name": self.name, "path": r.path, "pid": os.getpid(),
+                "slot_size": r.slot_size, "nslots": r.nslots,
+                "last_seq": r.last_seq, "seals": r.seals,
+                "bytes_written": r.bytes_written,
+                "sync_interval": self.interval,
+                "anchor_wall_ns": r.anchor_wall_ns,
+                "anchor_mono_ns": r.anchor_mono_ns}
+
+
+# ------------------------------------------------- process-global recorder
+
+_BB: Recorder | None = None
+_enable_mu = threading.Lock()
+
+
+def enabled() -> bool:
+    return _BB is not None
+
+
+def enable(dirpath: str, name: str | None = None,
+           slot_size: int | None = None, nslots: int | None = None,
+           sync_interval: float | None = None) -> Recorder:
+    """Start (or return) THE process recorder, registering the telemetry
+    producers: the pulse global observer (pulse + opscope records per
+    sampling tick) and the crashsink flush hook (crash records at
+    record time, synced on fatal)."""
+    global _BB
+    with _enable_mu:
+        if _BB is not None:
+            return _BB
+        bb = Recorder(dirpath, name or f"proc-{os.getpid()}",
+                      slot_size=slot_size, nslots=nslots,
+                      sync_interval=sync_interval).start()
+        _BB = bb
+    _pulse.add_global_observer(_on_pulse_tick)
+    crashsink.add_flush_hook(_on_crash)
+    return bb
+
+
+def enable_from_env() -> Recorder | None:
+    """Env-gated enable (`TPU6824_BLACKBOX_DIR`, optional
+    `TPU6824_BLACKBOX_NAME`) — the one-line wiring every daemon/frontend
+    constructor calls; a cheap no-op when the env is unset."""
+    d = os.environ.get("TPU6824_BLACKBOX_DIR")
+    if not d:
+        return None
+    return enable(d, name=os.environ.get("TPU6824_BLACKBOX_NAME"))
+
+
+def disable() -> None:
+    """Stop the recorder (final sync, ring closed, producers
+    unregistered) — tests and the bench A/B."""
+    global _BB
+    with _enable_mu:
+        bb, _BB = _BB, None
+    if bb is None:
+        return
+    _pulse.remove_global_observer(_on_pulse_tick)
+    crashsink.remove_flush_hook(_on_crash)
+    bb.stop()
+    bb.ring.close()
+
+
+def record(kind: str, payload: dict) -> None:
+    """Append one record to the process ring (no-op when disabled).
+    Telemetry-cadence call sites only — never per-op."""
+    bb = _BB
+    if bb is not None:
+        bb.record(kind, payload)
+
+
+def stamp(key: str, value) -> None:
+    """The request-path producer primitive: one GIL-atomic dict store
+    (keys precomputed by the caller).  The cadence sync persists the
+    whole table as a heartbeat record."""
+    bb = _BB
+    if bb is not None:
+        bb.stamps[key] = value
+
+
+def sync() -> None:
+    """Force a cadence sync now (watchdog firings, fatal crash records
+    — evidence that must be durable at detection time)."""
+    bb = _BB
+    if bb is not None:
+        bb.sync()
+
+
+def status() -> dict:
+    """The `blackbox` wire surface (served next to
+    stats/metrics/flight/pulse/opscope): recorder status, or the stable
+    disabled shell when no recorder runs."""
+    bb = _BB
+    if bb is None:
+        return status_shell()
+    return bb.status()
+
+
+def status_shell(reason: str | None = None) -> dict:
+    """The stable disabled shell — what a poller reports for a member
+    that does not serve blackbox (pre-blackbox fleet member, PR 9's
+    mixed-fleet rule): same key set, enabled False, never an error."""
+    out = {"schema": SCHEMA_VERSION, "enabled": False, "name": None,
+           "path": None, "pid": None, "slot_size": None, "nslots": None,
+           "last_seq": 0, "seals": 0, "bytes_written": 0,
+           "sync_interval": None, "anchor_wall_ns": None,
+           "anchor_mono_ns": None}
+    if reason is not None:
+        out["unavailable"] = reason
+    return out
+
+
+# ---------------------------------------------------- telemetry producers
+
+
+def _on_pulse_tick(pulse, now) -> None:
+    """Pulse global observer: per sampling tick, the latest point of
+    every series plus the opscope waterfall land in the ring — memory
+    stores only (the sync seam does the IO)."""
+    bb = _BB
+    if bb is None:
+        return
+    snap = pulse.series(window=2 * pulse.interval)
+    bb.record("pulse", {
+        "samples": snap["samples"], "interval": snap["interval"],
+        "latest": {name: s["v"][-1]
+                   for name, s in snap["series"].items() if s["v"]}})
+    from tpu6824.obs import opscope as _opscope
+
+    if _opscope.enabled():
+        bb.record("opscope", _opscope.snapshot())
+
+
+def _on_crash(rec: dict) -> None:
+    """crashsink flush hook: every crash record lands in the ring at
+    record time; fatal ones force a sync — the dying thread's evidence
+    must not wait for the cadence."""
+    bb = _BB
+    if bb is None:
+        return
+    bb.record("crash", rec)
+    if rec.get("fatal"):
+        sync()
+
+
+# ---------------------------------------------------------------- loading
+
+
+def wall_of(ring: dict, t_mono_ns: int) -> int:
+    """Map one ring's monotonic stamp onto the shared wall timeline via
+    its anchor pair — the cross-process join."""
+    return ring["anchor_wall_ns"] + (t_mono_ns - ring["anchor_mono_ns"])
+
+
+def load_ring(path: str) -> dict:
+    """Parse one ring file, tolerating torn tails: short files (SIGKILL
+    mid-growth, copied prefixes), CRC-failed slots, and partial chunked
+    records are counted and skipped, never raised.  Returns header
+    fields + whole records ordered by seq, each with a wall-ns stamp
+    derived from the anchor pair."""
+    out = {"path": path, "valid": False, "name": None, "pid": None,
+           "slot_size": None, "nslots": None, "anchor_wall_ns": None,
+           "anchor_mono_ns": None, "last_seq": 0, "seals": 0,
+           "bytes_written": 0, "records": [], "torn_slots": 0,
+           "torn_records": 0, "error": None}
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except OSError as e:
+        out["error"] = repr(e)
+        return out
+    if len(buf) < _HDR.size:
+        out["error"] = "truncated header"
+        return out
+    magic, version, slot_size, nslots, aw, am, pid, name = \
+        _HDR.unpack_from(buf, 0)
+    if magic[:len(MAGIC)] != MAGIC:
+        out["error"] = "bad magic"
+        return out
+    out.update(valid=True, name=name.rstrip(b"\0").decode("utf-8", "replace"),
+               pid=pid, slot_size=slot_size, nslots=nslots,
+               anchor_wall_ns=aw, anchor_mono_ns=am)
+    if len(buf) >= _HDR_LIVE_OFF + _HDR_LIVE.size:
+        last_seq, seals, written = _HDR_LIVE.unpack_from(buf, _HDR_LIVE_OFF)
+        out.update(last_seq=last_seq, seals=seals, bytes_written=written)
+    chunks: dict[int, tuple] = {}
+    for i in range(nslots):
+        off = HEADER_SIZE + i * slot_size
+        if off + _SLOT.size > len(buf):
+            break  # torn tail: the file ends mid-ring; what's left is data
+        crc, used, seq, rec, t_ns, kind, part, nparts = \
+            _SLOT.unpack_from(buf, off)
+        if seq == 0 and used == 0:
+            continue  # never written
+        end = off + _SLOT.size + used
+        if used > slot_size - _SLOT.size or end > len(buf) \
+                or zlib.crc32(buf[off + 4:end]) != crc:
+            out["torn_slots"] += 1
+            continue
+        chunks[seq] = (rec, part, nparts, kind,
+                       t_ns, buf[off + _SLOT.size:end])
+    groups: dict[int, dict[int, tuple]] = {}
+    for seq in sorted(chunks):
+        rec, part, nparts, kind, t_ns, data = chunks[seq]
+        groups.setdefault(rec, {})[part] = (nparts, kind, t_ns, data)
+    for rec_id in sorted(groups):
+        parts = groups[rec_id]
+        nparts = parts[min(parts)][0]
+        if set(parts) != set(range(nparts)):
+            out["torn_records"] += 1  # wrapped-over or torn continuation
+            continue
+        _, kind, t_ns, _ = parts[0]
+        payload = b"".join(parts[p][3] for p in range(nparts))
+        try:
+            data = json.loads(payload)
+        except ValueError:
+            out["torn_records"] += 1
+            continue
+        out["records"].append({
+            "seq": rec_id, "kind": KIND_NAMES.get(kind, f"kind{kind}"),
+            "t_mono_ns": t_ns, "t_wall_ns": aw + (t_ns - am),
+            "data": data})
+    return out
+
+
+def load_dir(dirpath: str) -> list[dict]:
+    """Every ring in a blackbox dir, name-sorted (stable postmortem
+    input order)."""
+    try:
+        names = sorted(n for n in os.listdir(dirpath)
+                       if n.endswith(RING_SUFFIX))
+    except OSError:
+        return []
+    return [load_ring(os.path.join(dirpath, n)) for n in names]

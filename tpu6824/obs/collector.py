@@ -37,6 +37,7 @@ from __future__ import annotations
 import threading
 import time
 
+from tpu6824.obs import blackbox as obs_blackbox
 from tpu6824.obs import metrics as obs_metrics
 from tpu6824.obs import opscope as obs_opscope
 from tpu6824.obs import pulse as obs_pulse
@@ -88,6 +89,9 @@ class _LocalProcess:
     def opscope(self):
         return obs_opscope.snapshot()
 
+    def blackbox(self):
+        return obs_blackbox.status()
+
 
 def local_handle(fabric=None) -> _LocalProcess:
     """A collector handle for THIS process (the harness/driver process is
@@ -99,7 +103,8 @@ def local_handle(fabric=None) -> _LocalProcess:
 class Collector:
     """Named fabric-shaped handles → one merged observability artifact."""
 
-    _SURFACES = ("stats", "metrics", "flight", "pulse", "opscope")
+    _SURFACES = ("stats", "metrics", "flight", "pulse", "opscope",
+                 "blackbox")
 
     def __init__(self, poll_timeout: float = 15.0):
         # Per-MEMBER wall budget for one snapshot poll: a hung member
@@ -168,6 +173,15 @@ class Collector:
                         # shell, never an error entry.
                         with mu:
                             out[surface] = obs_opscope.snapshot_shell(
+                                reason=repr(e)[:200])
+                        continue
+                    if surface == "blackbox":
+                        # Same mixed-fleet rule for the blackbox surface
+                        # (ISSUE 20): a pre-blackbox member answering
+                        # "no such rpc" yields the stable disabled
+                        # shell, never an error entry.
+                        with mu:
+                            out[surface] = obs_blackbox.status_shell(
                                 reason=repr(e)[:200])
                         continue
                     with mu:

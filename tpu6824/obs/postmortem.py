@@ -1,0 +1,313 @@
+"""postmortem — fleet incident reconstruction from blackbox rings.
+
+    python -m tpu6824.obs.postmortem <dir> [--json] [--perfetto out.json]
+                                           [--schedule artifact.json]
+
+The read side of obs/blackbox.py: load every `*.bbx` ring in a directory
+(tolerating torn tails from SIGKILL — that is the point), join them onto
+one causal wall-clock timeline via each ring's (wall-ns, monotonic-ns)
+anchor pair, fold in any watchdog evidence bundles found beside the
+rings, and reconstruct each process's FINAL WINDOW: the last pulse
+gauges, the last opscope waterfall, the last decided seq it applied
+(kvpaxos/shardkv heartbeat stamps), and the ops it died holding
+(frontend inflight stamp).  With `--schedule` the nemesis
+`FaultSchedule` (or a failure artifact embedding one) is joined against
+the ring-observed injections, so the report reads "fe_kill smoke-fe1 at
+t=+2.31 → last decided seq 412, 7 ops in flight" — the question a
+kill-storm victim used to take to the grave.
+
+Offline and stdlib-only: this module never touches a live process, so
+it runs on a workstation against a directory copied from the wreckage.
+`--json` emits a stable machine document (sorted keys, schema-stamped —
+the committed golden fixture pins it); `--perfetto` exports every ring's
+flight spans plus injection/watchdog/crash instants as one Chrome trace,
+process per track, on the joined wall timeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpu6824.obs import blackbox as _blackbox
+from tpu6824.obs import tracing as _tracing
+
+__all__ = ["reconstruct", "main", "SCHEMA_VERSION"]
+
+SCHEMA_VERSION = "postmortem-1.0.0"
+
+# Heartbeat-stamp key substrings with derived meaning: decided-seq
+# stamps (kvpaxos/shardkv drain high-waters) and in-flight counts
+# (frontend engine passes).  Producers keep these substrings in their
+# precomputed keys; everything else rides the heartbeat verbatim.
+_DECIDED_SUBSTR = ("applied", "decided")
+_INFLIGHT_SUBSTR = ("inflight",)
+
+
+def _last_of(records: list[dict], kind: str) -> dict | None:
+    for rec in reversed(records):
+        if rec["kind"] == kind:
+            return rec
+    return None
+
+
+def _final_window(ring: dict) -> dict:
+    """One process's reconstructed last-known state: liveness counters,
+    the final record of each telemetry kind, and the derived
+    decided/in-flight evidence from the last heartbeat's stamp table."""
+    recs = ring["records"]
+    by_kind: dict[str, int] = {}
+    for rec in recs:
+        by_kind[rec["kind"]] = by_kind.get(rec["kind"], 0) + 1
+    hb = _last_of(recs, "heartbeat")
+    stamps = (hb or {}).get("data", {}).get("stamps", {})
+    decided = {k: v for k, v in stamps.items()
+               if any(s in k for s in _DECIDED_SUBSTR)}
+    inflight = {k: v for k, v in stamps.items()
+                if any(s in k for s in _INFLIGHT_SUBSTR)}
+    seqs = [v for v in decided.values() if isinstance(v, (int, float))]
+    flights = [v for v in inflight.values() if isinstance(v, (int, float))]
+    last_pulse = _last_of(recs, "pulse")
+    last_opscope = _last_of(recs, "opscope")
+    return {
+        "name": ring["name"], "pid": ring["pid"], "path": ring["path"],
+        "valid": ring["valid"], "error": ring["error"],
+        "last_seq": ring["last_seq"], "seals": ring["seals"],
+        "bytes_written": ring["bytes_written"],
+        "torn_slots": ring["torn_slots"],
+        "torn_records": ring["torn_records"],
+        "records_by_kind": by_kind,
+        "first_t_wall_ns": recs[0]["t_wall_ns"] if recs else None,
+        "last_t_wall_ns": recs[-1]["t_wall_ns"] if recs else None,
+        "last_heartbeat": stamps or None,
+        "last_pulse": (last_pulse or {}).get("data"),
+        "last_opscope": (last_opscope or {}).get("data"),
+        "decided": decided or None,
+        "last_decided_seq": max(seqs) if seqs else None,
+        "inflight": inflight or None,
+        "inflight_ops": sum(flights) if inflight else None,
+        "crashes": [r["data"] for r in recs if r["kind"] == "crash"],
+        "watchdog": [r["data"] for r in recs if r["kind"] == "watchdog"],
+        "nemesis_seen": sum(1 for r in recs if r["kind"] == "nemesis"),
+    }
+
+
+def _bundles(dirpath: str) -> list[dict]:
+    """Watchdog evidence bundles written beside the rings (fabricd's
+    `--watchdog-dir` pointed at the blackbox dir, or copied in) — the
+    full-fat bundle joins the ring's fire-time core when both exist."""
+    out = []
+    try:
+        names = sorted(n for n in os.listdir(dirpath)
+                       if n.startswith("watchdog-") and n.endswith(".json"))
+    except OSError:
+        return out
+    for n in names:
+        try:
+            with open(os.path.join(dirpath, n)) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            out.append({"file": n, "error": repr(e)})
+            continue
+        wd = d.get("watchdog", {})
+        out.append({"file": n, "rule": wd.get("rule"),
+                    "reason": wd.get("reason"),
+                    "evidence": wd.get("evidence"),
+                    "t_mono": wd.get("t_mono")})
+    return out
+
+
+def _schedule_join(rings: list[dict], schedule) -> dict:
+    """Ring-observed injections are authoritative (they carry the joined
+    wall clock); the schedule says what SHOULD have fired, so events the
+    rings never saw — the harness died first, or the run was cut short —
+    are reported as not-observed instead of silently missing."""
+    observed = []
+    for ring in rings:
+        for rec in ring["records"]:
+            if rec["kind"] == "nemesis":
+                observed.append({"t": rec["data"].get("t"),
+                                 "action": rec["data"].get("action"),
+                                 "args": rec["data"].get("args"),
+                                 "t_wall_ns": rec["t_wall_ns"],
+                                 "recorded_by": ring["name"]})
+    observed.sort(key=lambda e: (e["t_wall_ns"], e["recorded_by"]))
+    out = {"observed": observed, "scheduled": None, "not_observed": None}
+    if schedule is not None:
+        seen = {(round(float(e["t"]), 9), e["action"]) for e in observed
+                if e["t"] is not None}
+        missing = [e.to_dict() for e in schedule.events
+                   if (round(e.t, 9), e.action) not in seen]
+        out["scheduled"] = len(schedule.events)
+        out["not_observed"] = missing
+    return out
+
+
+def reconstruct(dirpath: str, schedule=None) -> dict:
+    """The whole postmortem as one JSON-safe document (the `--json`
+    shape; `schedule` is an optional `FaultSchedule`)."""
+    rings = _blackbox.load_dir(dirpath)
+    timeline = []
+    for ring in rings:
+        for rec in ring["records"]:
+            entry = {"t_wall_ns": rec["t_wall_ns"], "proc": ring["name"],
+                     "seq": rec["seq"], "kind": rec["kind"]}
+            if rec["kind"] in ("nemesis", "watchdog", "crash"):
+                entry["data"] = rec["data"]
+            timeline.append(entry)
+    timeline.sort(key=lambda e: (e["t_wall_ns"], e["proc"], e["seq"]))
+    return {
+        "schema": SCHEMA_VERSION,
+        "dir": dirpath,
+        "rings": len(rings),
+        "processes": {r["name"] or os.path.basename(r["path"]):
+                      _final_window(r) for r in rings},
+        "timeline": timeline,
+        "watchdog_bundles": _bundles(dirpath),
+        "nemesis": _schedule_join(rings, schedule),
+    }
+
+
+# ----------------------------------------------------------------- export
+
+
+def _perfetto_events(rings: list[dict]) -> list[dict]:
+    """Every ring's flight spans + one instant per non-flight record,
+    REBASED onto the joined wall timeline: each ring's monotonic stamps
+    shift by (anchor_wall - anchor_mono), then the fleet-minimum wall
+    stamp becomes t=0 — Perfetto renders cross-process causality
+    directly."""
+    events: list[dict] = []
+    walls = [r["records"][0]["t_wall_ns"] for r in rings if r["records"]]
+    base = min(walls) if walls else 0
+    for pid, ring in enumerate(rings, start=1):
+        shift = ring["anchor_wall_ns"] - ring["anchor_mono_ns"] - base
+        flight: list[dict] = []
+        for rec in ring["records"]:
+            if rec["kind"] == "flight":
+                for fr in rec["data"].get("records", ()):
+                    fr = dict(fr)
+                    fr["ts"] = fr.get("ts", 0) + shift
+                    flight.append(fr)
+            else:
+                flight.append({"ph": "i", "name": f"bb.{rec['kind']}",
+                               "comp": "blackbox", "trace_id": 0,
+                               "span_id": rec["seq"], "parent_id": 0,
+                               "ts": rec["t_wall_ns"] - base, "dur": 0,
+                               "args": {"kind": rec["kind"]}})
+        events.extend(_tracing.chrome_events(
+            flight, process=ring["name"], pid=pid))
+    return events
+
+
+# ----------------------------------------------------------------- report
+
+
+def _fmt_ns(t_ns, base_ns) -> str:
+    return f"+{(t_ns - base_ns) / 1e9:.3f}s"
+
+
+def _render_report(doc: dict) -> str:
+    lines = [f"postmortem over {doc['dir']} — {doc['rings']} ring(s)"]
+    walls = [w["first_t_wall_ns"] for w in doc["processes"].values()
+             if w["first_t_wall_ns"] is not None]
+    base = min(walls) if walls else 0
+    for name in sorted(doc["processes"]):
+        w = doc["processes"][name]
+        lines.append(f"\n== {name} (pid {w['pid']}) ==")
+        if not w["valid"]:
+            lines.append(f"  UNREADABLE ring: {w['error']}")
+            continue
+        kinds = ", ".join(f"{k}:{v}" for k, v in
+                          sorted(w["records_by_kind"].items()))
+        lines.append(f"  ring: seq {w['last_seq']}, {w['seals']} seal(s), "
+                     f"{w['bytes_written']}B, torn {w['torn_slots']} "
+                     f"slot(s)/{w['torn_records']} record(s)")
+        lines.append(f"  records: {kinds or '(none)'}")
+        if w["last_t_wall_ns"] is not None:
+            lines.append("  last record at "
+                         f"{_fmt_ns(w['last_t_wall_ns'], base)}")
+        if w["last_decided_seq"] is not None:
+            per = ", ".join(f"{k}={v}" for k, v in
+                            sorted(w["decided"].items()))
+            lines.append(f"  last decided seq: {w['last_decided_seq']} "
+                         f"({per})")
+        if w["inflight_ops"] is not None:
+            lines.append(f"  in-flight ops at death: {w['inflight_ops']}")
+        if w["last_pulse"]:
+            latest = w["last_pulse"].get("latest", {})
+            top = sorted(latest.items())[:8]
+            lines.append(f"  last pulse tick ({w['last_pulse'].get('samples')}"
+                         " samples): "
+                         + ", ".join(f"{k}={v}" for k, v in top))
+        if w["last_opscope"]:
+            hist = w["last_opscope"].get("histograms", {})
+            stages = [f"{st} p99={h.get('p99')}" for st, h in
+                      sorted(hist.items()) if h.get("count")]
+            lines.append("  last opscope waterfall: "
+                         + ("; ".join(stages) or "(no folded ops)"))
+        for c in w["crashes"]:
+            lines.append(f"  crash: [{c.get('thread')}] {c.get('error')}"
+                         f" (fatal={c.get('fatal')})")
+        for wd in w["watchdog"]:
+            lines.append(f"  watchdog fired: {wd.get('rule')} — "
+                         f"{wd.get('reason')}")
+    nem = doc["nemesis"]
+    if nem["observed"]:
+        lines.append(f"\n== nemesis timeline ({len(nem['observed'])} "
+                     "observed) ==")
+        for e in nem["observed"]:
+            lines.append(f"  {_fmt_ns(e['t_wall_ns'], base)} "
+                         f"t={e['t']:+.3f} {e['action']} {e['args']}")
+    if nem["not_observed"]:
+        lines.append(f"  NOT observed in any ring "
+                     f"({len(nem['not_observed'])} of "
+                     f"{nem['scheduled']} scheduled):")
+        for e in nem["not_observed"]:
+            lines.append(f"    t={e['t']:+.3f} {e['action']} {e['args']}")
+    if doc["watchdog_bundles"]:
+        lines.append("\n== watchdog bundles ==")
+        for b in doc["watchdog_bundles"]:
+            lines.append(f"  {b['file']}: {b.get('rule')} — "
+                         f"{b.get('reason', b.get('error'))}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="postmortem",
+        description="reconstruct a fleet incident from blackbox rings")
+    ap.add_argument("dir", help="directory of *.bbx rings "
+                                "(+ optional watchdog-*.json bundles)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the stable machine document")
+    ap.add_argument("--perfetto", metavar="PATH", default=None,
+                    help="export the joined timeline as a Chrome trace")
+    ap.add_argument("--schedule", metavar="PATH", default=None,
+                    help="nemesis FaultSchedule (or failure artifact) to "
+                         "join against the observed injections")
+    args = ap.parse_args(argv)
+    schedule = None
+    if args.schedule:
+        from tpu6824.harness.nemesis import FaultSchedule
+
+        schedule = FaultSchedule.from_json(args.schedule)
+    doc = reconstruct(args.dir, schedule=schedule)
+    if not doc["rings"]:
+        print(f"postmortem: no rings under {args.dir}", file=sys.stderr)
+        return 2
+    if args.perfetto:
+        rings = _blackbox.load_dir(args.dir)
+        _tracing.write_chrome_trace(args.perfetto, _perfetto_events(rings))
+        print(f"postmortem: wrote {args.perfetto}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True, default=repr))
+    else:
+        print(_render_report(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

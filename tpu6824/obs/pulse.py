@@ -71,6 +71,9 @@ def read_rss_bytes() -> int | None:
     """Resident set size of THIS process in bytes (None where /proc is
     unavailable) — stdlib-only like the rest of obs/."""
     try:
+        # tpusan: ok(blocking-io-in-telemetry-path) — one tiny procfs
+        # read per sampling tick is the documented cost of the RSS
+        # gauge (module comment above); procfs never blocks on storage
         with open("/proc/self/statm") as f:
             return int(f.read().split()[1]) * _PAGE_BYTES
     except (OSError, ValueError, IndexError):
@@ -192,6 +195,12 @@ class Pulse:
         with self._mu:
             return g + [f for f in self._samplers if f not in g]
 
+    def _all_observers(self) -> list:
+        with _observer_mu:
+            g = list(_GLOBAL_OBSERVERS)
+        with self._mu:
+            return g + [f for f in self._observers if f not in g]
+
     # ----------------------------------------------------------- sampling
 
     def _run(self) -> None:
@@ -255,12 +264,10 @@ class Pulse:
                     if s["kind"] == "rate" and name not in updated:
                         s["points"].append((round(now, 6), 0.0))
             self.samples += 1
-        # Snapshot under _mu: add_observer appends from attach threads
-        # while this sampler iterates, and a bare list() of a mutating
-        # list is not atomic without the GIL.
-        with self._mu:
-            observers = list(self._observers)
-        for fn in observers:
+        # Snapshot under the registry locks: add_observer appends from
+        # attach threads while this sampler iterates, and a bare list()
+        # of a mutating list is not atomic without the GIL.
+        for fn in self._all_observers():
             try:
                 fn(self, now)
             except Exception as e:  # noqa: BLE001 — a broken watchdog rule
@@ -352,6 +359,29 @@ def remove_global_sampler(fn) -> None:
     with _sampler_mu:
         if fn in _GLOBAL_SAMPLERS:
             _GLOBAL_SAMPLERS.remove(fn)
+
+
+# Global observer registry (ISSUE 20): tick callbacks `fn(pulse, now)`
+# that must run on WHICHEVER pulse samples, regardless of registration
+# order — how blackbox records a pulse/opscope snapshot per tick without
+# holding a reference to any particular Pulse.  Bounded: one
+# deduplicated callable per consumer, never accumulates samples.
+_GLOBAL_OBSERVERS: list = []
+_observer_mu = threading.Lock()
+
+
+def add_global_observer(fn) -> None:
+    """Register a per-tick observer with EVERY pulse instance (current
+    and future) — the order-independent form of `Pulse.add_observer`."""
+    with _observer_mu:
+        if fn not in _GLOBAL_OBSERVERS:
+            _GLOBAL_OBSERVERS.append(fn)
+
+
+def remove_global_observer(fn) -> None:
+    with _observer_mu:
+        if fn in _GLOBAL_OBSERVERS:
+            _GLOBAL_OBSERVERS.remove(fn)
 
 
 def start(fabric=None, interval: float | None = None,

@@ -128,6 +128,9 @@ class FlightRecorder:
         self._mu = threading.Lock()
         self._ring: deque = deque(maxlen=max(1, capacity))
         self.dropped = 0
+        # Lifetime append count — the cursor clock for delta drains
+        # (blackbox persists only what arrived since its last sync).
+        self.appended = 0
 
     def record(self, rec: dict) -> None:
         dropped = None
@@ -136,6 +139,7 @@ class FlightRecorder:
                 self.dropped += 1
                 dropped = self.dropped
             self._ring.append(rec)
+            self.appended += 1
         if dropped is not None:
             # Gauge mirror outside self._mu (the registry takes its own
             # lock); records are batch/fault granular, and the set only
@@ -146,10 +150,28 @@ class FlightRecorder:
         with self._mu:
             return list(self._ring)
 
+    def snapshot_delta(self, cursor: int) -> tuple[list[dict], int, int]:
+        """Records appended since `cursor` (a previous return's second
+        element; start at 0) as `(records, new_cursor, missed)` —
+        `missed` counts records that arrived since the cursor but were
+        already pushed out of the bounded ring.  A cursor from before a
+        `clear()` self-heals to "everything currently in the ring"."""
+        with self._mu:
+            total = self.appended
+            new = total - cursor
+            if new <= 0:
+                # cursor at (or, post-clear, beyond) the present
+                return [], total, 0
+            ring = list(self._ring)
+            if new >= len(ring):
+                return ring, total, new - len(ring)
+            return ring[-new:], total, 0
+
     def clear(self) -> None:
         with self._mu:
             self._ring.clear()
             self.dropped = 0
+            self.appended = 0
         _G_FLIGHT_DROPPED.set(0)
 
 

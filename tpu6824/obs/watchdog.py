@@ -68,6 +68,7 @@ import threading
 import time
 from collections import deque
 
+from tpu6824.obs import blackbox as _blackbox
 from tpu6824.obs import pulse as _pulse
 from tpu6824.utils import crashsink
 
@@ -615,6 +616,17 @@ class Watchdog:
                     "t_mono": round(now, 6),
                     "detected_after_s": round(self.uptime(), 3),
                     "seq": seq, "path": None}
+        # Fire-time evidence into the LOCAL blackbox ring (ISSUE 20),
+        # BEFORE the bundle write: the full bundle only exists when the
+        # disk cooperates, but the incident core must survive the
+        # process — synced immediately so it is durable at detection
+        # time, not one cadence later.
+        _blackbox.record("watchdog", {
+            "rule": rule.name, "reason": reason,
+            "evidence": getattr(rule, "evidence", None),
+            "t_mono": round(now, 6),
+            "detected_after_s": round(self.uptime(), 3), "seq": seq})
+        _blackbox.sync()
         try:
             incident["path"] = self._write_bundle(rule, reason, now, seq)
         except Exception as e:  # noqa: BLE001 — evidence capture must
@@ -657,6 +669,10 @@ class Watchdog:
         }
         path = os.path.join(self.outdir,
                             f"watchdog-{rule.name}-{seq}.json")
+        # tpusan: ok(blocking-io-in-telemetry-path) — fire-time evidence
+        # capture: at most one bundle per rule per cooldown (30s), and
+        # by the time a rule fires the clock's cadence is already the
+        # least interesting thing about the process
         with open(path, "w") as f:
             json.dump(d, f, indent=1, default=str)
         return path

@@ -49,6 +49,7 @@ import threading
 import time
 from collections import deque
 
+from tpu6824.obs import blackbox as _blackbox
 from tpu6824.obs import metrics as _metrics
 from tpu6824.obs import opscope as _opscope
 from tpu6824.obs import pulse as _obs_pulse
@@ -320,6 +321,14 @@ class ClerkFrontend:
         # unique per process AND per instance (pid + instance seq).
         self.frontend_id = frontend_id if frontend_id \
             else f"fe-{os.getpid()}-{next(_FE_SEQ)}"
+        # Crash forensics (ISSUE 20): with TPU6824_BLACKBOX_DIR set the
+        # process records into a crash-surviving ring; the engine loop
+        # stamps its in-flight count there (one GIL-atomic dict store
+        # per PASS, key precomputed here — zero per-op cost) so a
+        # postmortem over a SIGKILLed frontend reports the ops it died
+        # holding.
+        _blackbox.enable_from_env()
+        self._bb_key = f"frontend.inflight.{self.frontend_id}"
         self.groups = [list(g) for g in groups]
         self._route = route if route is not None else (lambda key: 0)
         # meshfab cross-shard serving: per-group owning mesh shard,
@@ -404,6 +413,7 @@ class ClerkFrontend:
         srv.register("flight", _tracing.flight_snapshot)
         srv.register("pulse", _obs_pulse.series_snapshot)
         srv.register("opscope", _opscope.snapshot)
+        srv.register("blackbox", _blackbox.status)
         srv.start()
         # Zero-GIL ingest (ISSUE 11): only the kvpaxos submit_columnar
         # seam can consume the columnar frames, so custom op factories
@@ -1203,6 +1213,7 @@ class ClerkFrontend:
             # the C++ decode state machine's reject counter mirrored
             # into rpc.wire.rejected (delta-inc, one lock per pass).
             _M_INFLIGHT.set(self._inflight)
+            _blackbox.stamp(self._bb_key, self._inflight)
             rej = getattr(self._srv, "wire_rejected", 0)
             if rej > self._rej_last:
                 transport._M_WIRE_REJ.inc(rej - self._rej_last,
@@ -1216,6 +1227,22 @@ class ClerkFrontend:
         connection economics.  The whole frame is still ONE submit_batch
         per group; unresolved ops fail over across replicas within the
         op budget."""
+        # Inflight visibility for the blocking path (ISSUE 20): the
+        # engine loop stamps once per pass; here once per frame edge.
+        # Telemetry-grade — racing += across connection threads may
+        # transiently miscount, and the blackbox heartbeat only needs
+        # the magnitude a victim died holding.
+        self._inflight += len(ops_wire)
+        _M_INFLIGHT.set(self._inflight)
+        _blackbox.stamp(self._bb_key, self._inflight)
+        try:
+            return self._serve_blocking_inner(ops_wire, single)
+        finally:
+            self._inflight -= len(ops_wire)
+            _M_INFLIGHT.set(self._inflight)
+            _blackbox.stamp(self._bb_key, self._inflight)
+
+    def _serve_blocking_inner(self, ops_wire, single):
         ops = [self._make_op(t, None) for t in ops_wire]
         if _opscope.enabled():
             # Blocking fallback (thread-per-connection transport): the

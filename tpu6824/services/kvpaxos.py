@@ -25,6 +25,7 @@ from typing import NamedTuple
 from tpu6824.core.devapply_kernel import K_APPEND, K_GET, K_PUT
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
+from tpu6824.obs import blackbox as _blackbox
 from tpu6824.obs import metrics as _metrics
 from tpu6824.obs import opscope as _opscope
 from tpu6824.obs import tracing as _tracing
@@ -258,6 +259,12 @@ class KVPaxosServer:
         # fast-forward semantics byte-for-byte).
         self.peers = peers
         self.g = g
+        # Crash forensics (ISSUE 20): each drain pass stamps its applied
+        # high-water into the blackbox heartbeat table — one GIL-atomic
+        # dict store per DRAIN with a key precomputed here, so a
+        # postmortem over a SIGKILLed process names the last decided seq
+        # this replica applied.
+        self._bb_key = f"kvpaxos.applied.g{g}.s{me}"
         # meshfab shard binding: which mesh shard owns this group's
         # device columns (0 off-mesh / non-fabric backends).  Read at
         # every drain fold for the opscope shard dimension — bound once
@@ -653,6 +660,7 @@ class KVPaxosServer:
                 # snapshot cut's watermark assert stays exact.
                 self._dev.note_applied(self.applied)
             self._done_fn(self.applied)
+            _blackbox.stamp(self._bb_key, self.applied)
 
     def _drain_bulk_locked(self, status_many):
         """Apply every already-decided instance in order, in bulk.  On the
@@ -701,6 +709,7 @@ class KVPaxosServer:
             if self._dev is not None:
                 self._dev.note_applied(self.applied)
             self._done_fn(self.applied)
+            _blackbox.stamp(self._bb_key, self.applied)
 
     def _drain_bulk_scalar_locked(self, status_many):
         """status_many-probe drain for backends without drain_decided."""
@@ -738,6 +747,7 @@ class KVPaxosServer:
             if self._dev is not None:
                 self._dev.note_applied(self.applied)
             self._done_fn(self.applied)
+            _blackbox.stamp(self._bb_key, self.applied)
 
     # ------------------------------------------------------ horizon (ISSUE 14)
 

@@ -37,6 +37,7 @@ from typing import NamedTuple
 
 from tpu6824.core.fabric import PaxosFabric, WindowFullError
 from tpu6824.core.peer import Fate, PaxosPeer
+from tpu6824.obs import blackbox as _blackbox
 from tpu6824.obs import opscope as _opscope
 from tpu6824.obs import tracing as _tracing
 from tpu6824.ops.hashing import NSHARDS, key2shard
@@ -127,6 +128,11 @@ class ShardKVServer:
                       if _fab is not None and hasattr(_fab, "shard_of")
                       else 0)
         self.name = f"g{gid}-{me}"
+        # Crash forensics (ISSUE 20): drain exits stamp the applied
+        # high-water into the blackbox heartbeat table (one GIL-atomic
+        # dict store per drain, key precomputed here) — the shardkv half
+        # of the postmortem's last-decided-seq evidence.
+        self._bb_key = f"shardkv.applied.g{gid}.s{me}"
         self.directory = directory
         directory[self.name] = self
         self.smck = shardmaster.Clerk(sm_clerk_servers)
@@ -397,6 +403,7 @@ class ShardKVServer:
                                   shard=self.shard)
             if self.applied >= base0:
                 self.px.done(self.applied)
+                _blackbox.stamp(self._bb_key, self.applied)
             return
         while True:
             fate, v = self.px.status(self.applied + 1)
@@ -409,10 +416,12 @@ class ShardKVServer:
                 if self._can_install():
                     self._behind_min = max(self.px.min(),
                                            self.applied + 2)
+                    _blackbox.stamp(self._bb_key, self.applied)
                     return
                 self.applied += 1
                 self._inflight.pop(self.applied, None)
             else:
+                _blackbox.stamp(self._bb_key, self.applied)
                 return
 
     def _sync(self, want: Op):

@@ -33,6 +33,26 @@ _lock = threading.Lock()
 _records: list[dict] = []
 _dropped = 0
 
+# Flush hooks (ISSUE 20): callables `fn(rec)` invoked after each record
+# lands — how blackbox gets crash evidence onto disk AT RECORD TIME
+# instead of whenever the next poller asks.  Bounded: one deduplicated
+# hook per consumer.  Hook failures are swallowed and counted (a hook
+# must never recurse into record(), so no re-entry here).
+_flush_hooks: list = []
+_hook_errors = 0
+
+
+def add_flush_hook(fn) -> None:
+    with _lock:
+        if fn not in _flush_hooks:
+            _flush_hooks.append(fn)
+
+
+def remove_flush_hook(fn) -> None:
+    with _lock:
+        if fn in _flush_hooks:
+            _flush_hooks.remove(fn)
+
 
 def record(name: str, exc: BaseException, *, fatal: bool = True) -> None:
     """Record one thread crash.  `fatal=True` means the thread is dying;
@@ -58,8 +78,18 @@ def record(name: str, exc: BaseException, *, fatal: bool = True) -> None:
     with _lock:
         if len(_records) >= _MAX_RECORDS:
             _dropped += 1
-        else:
-            _records.append(rec)
+            return
+        _records.append(rec)
+        hooks = list(_flush_hooks)
+    # Hooks run OUTSIDE _lock (a hook that records telemetry must not
+    # serialize against concurrent crashes) and never raise — a broken
+    # flush path must not mask the crash being recorded.
+    global _hook_errors
+    for fn in hooks:
+        try:
+            fn(rec)
+        except Exception:  # noqa: BLE001 — counted, never propagated
+            _hook_errors += 1
 
 
 def crashes() -> list[dict]:
